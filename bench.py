@@ -1,0 +1,82 @@
+"""Benchmark: Llama LoRA fine-tune MFU on the attached TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference platform publishes no perf numbers (BASELINE.md); the
+north star from BASELINE.json is >=50% MFU on a Llama LoRA fine-tune
+from a notebook, so ``vs_baseline`` is measured MFU / 0.50.
+
+Model is the Llama-3.2-1B shape (fits one v5e chip with optimizer state
+for LoRA adapters only); MFU accounting uses 3x forward matmul FLOPs
+and the chip's bf16 peak from ``utils/tpu.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+    import jax
+    import jax.numpy as jnp
+
+    from odh_kubeflow_tpu.models import LlamaConfig, LoraConfig
+    from odh_kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from odh_kubeflow_tpu.train import TrainConfig, Trainer
+    from odh_kubeflow_tpu.utils.tpu import peak_flops_per_chip
+
+    devices = jax.devices()
+    n = len(devices)
+    peak = peak_flops_per_chip(devices[0]) * n
+
+    batch_size = int(os.environ.get("BENCH_BATCH", "8"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    # batch must tile the data-parallel extent (= all devices here)
+    batch_size = -(-max(batch_size, n) // n) * n
+
+    cfg = LlamaConfig.llama3_1b(dtype=jnp.bfloat16)
+    trainer = Trainer(
+        cfg,
+        TrainConfig(warmup_steps=2, total_steps=100),
+        lora_cfg=LoraConfig(rank=16),
+        mesh=build_mesh(MeshConfig(fsdp=n), devices),
+    )
+    stats = trainer.benchmark(batch_size, seq_len, steps=steps, warmup=2)
+
+    if peak > 0:
+        value = stats["flops_per_s"] / peak
+        metric, unit = "llama1b_lora_train_mfu", "mfu"
+        vs_baseline = value / 0.50  # north-star: 50% MFU
+    else:
+        value = stats["tokens_per_s"]
+        metric, unit = "llama1b_lora_train_tokens_per_s", "tokens/s"
+        vs_baseline = 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(value, 4),
+                "unit": unit,
+                "vs_baseline": round(vs_baseline, 4),
+                "detail": {
+                    "devices": n,
+                    "device_kind": getattr(devices[0], "device_kind", "cpu"),
+                    "batch": batch_size,
+                    "seq": seq_len,
+                    "step_time_s": round(stats["step_time_s"], 4),
+                    "tokens_per_s": round(stats["tokens_per_s"], 1),
+                    "loss": round(stats["loss"], 4),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

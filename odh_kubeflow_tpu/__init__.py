@@ -1,0 +1,18 @@
+"""odh_kubeflow_tpu — a TPU-native ML notebook platform + runtime.
+
+A ground-up rebuild of the capabilities of ``bartoszmajsak/odh-kubeflow``
+(a Kubeflow ~1.6 fork: CRDs + controllers + admission webhooks + web apps
+for multi-tenant notebook serving), redesigned TPU-first:
+
+- The *platform* half (``apis/``, ``machinery/``, ``controllers/``,
+  ``webhooks/``, ``web/``) schedules notebooks onto TPU pod slices
+  (``google.com/tpu`` limits + ``cloud.google.com/gke-tpu-topology``
+  node selectors) instead of ``nvidia.com/gpu``.
+- The *runtime* half (``models/``, ``ops/``, ``parallel/``, ``train/``)
+  is the JAX/XLA/pallas stack shipped inside the notebook images:
+  sharded Llama-family models, LoRA fine-tuning, ring-attention context
+  parallelism, and pallas TPU kernels — the path to the BASELINE north
+  star (>=50% MFU Llama-3-8B LoRA on a v5p-8 slice).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,8 @@
+from odh_kubeflow_tpu.machinery.store import (  # noqa: F401
+    APIServer,
+    Conflict,
+    Denied,
+    NotFound,
+    AlreadyExists,
+    Invalid,
+)

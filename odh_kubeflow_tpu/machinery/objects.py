@@ -1,0 +1,198 @@
+"""Helpers for dict-shaped Kubernetes objects.
+
+All API objects in this framework are plain nested dicts (the dynamic-
+client representation) — typed accessors live with the component that
+owns the CRD (``apis/``). These helpers cover the metadata/selector
+semantics every layer shares.
+"""
+
+from __future__ import annotations
+
+import copy
+import fnmatch
+import time
+from typing import Any, Optional
+
+Obj = dict[str, Any]
+
+
+def deepcopy(obj: Obj) -> Obj:
+    return copy.deepcopy(obj)
+
+
+def meta(obj: Obj) -> Obj:
+    return obj.setdefault("metadata", {})
+
+
+def name_of(obj: Obj) -> str:
+    return obj.get("metadata", {}).get("name", "")
+
+
+def namespace_of(obj: Obj) -> str:
+    return obj.get("metadata", {}).get("namespace", "")
+
+
+def labels_of(obj: Obj) -> dict[str, str]:
+    return obj.get("metadata", {}).get("labels") or {}
+
+
+def annotations_of(obj: Obj) -> dict[str, str]:
+    return obj.get("metadata", {}).get("annotations") or {}
+
+
+def set_label(obj: Obj, key: str, value: str) -> None:
+    meta(obj).setdefault("labels", {})[key] = value
+
+
+def set_annotation(obj: Obj, key: str, value: str) -> None:
+    meta(obj).setdefault("annotations", {})[key] = value
+
+
+def now_rfc3339() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def owner_reference(owner: Obj, *, controller: bool = True, block: bool = True) -> Obj:
+    return {
+        "apiVersion": owner.get("apiVersion", ""),
+        "kind": owner.get("kind", ""),
+        "name": name_of(owner),
+        "uid": meta(owner).get("uid", ""),
+        "controller": controller,
+        "blockOwnerDeletion": block,
+    }
+
+
+def set_controller_reference(obj: Obj, owner: Obj) -> None:
+    refs = meta(obj).setdefault("ownerReferences", [])
+    for ref in refs:
+        if ref.get("controller"):
+            ref.update(owner_reference(owner))
+            return
+    refs.append(owner_reference(owner))
+
+
+def get_path(obj: Obj, *path, default=None):
+    cur: Any = obj
+    for p in path:
+        if isinstance(cur, dict) and p in cur:
+            cur = cur[p]
+        elif isinstance(cur, list) and isinstance(p, int) and p < len(cur):
+            cur = cur[p]
+        else:
+            return default
+    return cur
+
+
+# ---------------------------------------------------------------------------
+# label selectors
+
+
+def match_label_selector(selector: Optional[Obj], labels: dict[str, str]) -> bool:
+    """LabelSelector semantics: matchLabels AND matchExpressions.
+
+    An empty/None selector matches everything (k8s convention for the
+    selectors used by PodDefault / AuthorizationPolicy matching).
+    """
+    if not selector:
+        return True
+    for k, v in (selector.get("matchLabels") or {}).items():
+        if labels.get(k) != v:
+            return False
+    for expr in selector.get("matchExpressions") or []:
+        key = expr.get("key", "")
+        op = expr.get("operator", "In")
+        values = expr.get("values") or []
+        has = key in labels
+        if op == "In":
+            if not has or labels[key] not in values:
+                return False
+        elif op == "NotIn":
+            if has and labels[key] in values:
+                return False
+        elif op == "Exists":
+            if not has:
+                return False
+        elif op == "DoesNotExist":
+            if has:
+                return False
+        else:
+            raise ValueError(f"unknown selector operator {op!r}")
+    return True
+
+
+def parse_selector_string(s: str) -> Obj:
+    """'a=b,c!=d,e' → LabelSelector dict (the list-API query form).
+
+    Supports '=', '==', '!=' and bare-key existence; anything else
+    raises rather than silently mis-parsing."""
+    match_labels: dict[str, str] = {}
+    exprs: list[Obj] = []
+    for part in s.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "!=" in part:
+            k, _, v = part.partition("!=")
+            exprs.append(
+                {"key": k.strip(), "operator": "NotIn", "values": [v.strip()]}
+            )
+        elif "=" in part:
+            k, _, v = part.partition("=")
+            if "(" in v or " in " in part:
+                raise ValueError(f"unsupported selector segment {part!r}")
+            match_labels[k.strip()] = v.strip().lstrip("=")
+        elif " " in part or "(" in part:
+            raise ValueError(f"unsupported selector segment {part!r}")
+        else:
+            exprs.append({"key": part, "operator": "Exists"})
+    sel: Obj = {}
+    if match_labels:
+        sel["matchLabels"] = match_labels
+    if exprs:
+        sel["matchExpressions"] = exprs
+    return sel
+
+
+# ---------------------------------------------------------------------------
+# JSON merge patch (RFC 7386)
+
+
+def json_merge_patch(target: Any, patch: Any) -> Any:
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    if not isinstance(target, dict):
+        target = {}
+    result = copy.deepcopy(target)
+    for k, v in patch.items():
+        if v is None:
+            result.pop(k, None)
+        else:
+            result[k] = json_merge_patch(result.get(k), v)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# quantity parsing (resource limits: '500m', '1Gi', '4')
+
+
+_SUFFIXES = {
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+}
+
+
+def parse_quantity(q) -> float:
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q).strip()
+    if s.endswith("m"):
+        return float(s[:-1]) / 1000.0
+    for suffix in sorted(_SUFFIXES, key=len, reverse=True):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * _SUFFIXES[suffix]
+    return float(s)
+
+
+def glob_match(pattern: str, value: str) -> bool:
+    return fnmatch.fnmatchcase(value, pattern)

@@ -1,0 +1,488 @@
+"""In-memory Kubernetes-compatible API server.
+
+This is the framework's envtest equivalent (SURVEY.md §4: the reference
+tests every controller against a real apiserver with no kubelet; here
+the apiserver itself is embedded). It implements the API-machinery
+semantics the controllers rely on:
+
+- typed registration (apiVersion/kind/plural, namespaced or cluster)
+- CRUD with uid / resourceVersion / generation / creationTimestamp
+- optimistic concurrency (Conflict on stale resourceVersion)
+- finalizers + deletionTimestamp two-phase delete
+- ownerReference cascade deletion (foreground, synchronous)
+- label-selector list filtering
+- watch streams (queue-backed, per-watcher)
+- admission chain: mutating + validating hooks run on create/update,
+  exactly where the real webhook HTTPS hop would sit
+- status subresource (update_status does not bump generation)
+
+Threading: a single re-entrant lock serialises all mutations; watch
+delivery is synchronous enqueue, consumers drain from their own queue.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Optional
+
+from odh_kubeflow_tpu.machinery import objects as obj_util
+
+Obj = dict[str, Any]
+
+
+class APIError(Exception):
+    code = 500
+
+
+class NotFound(APIError):
+    code = 404
+
+
+class AlreadyExists(APIError):
+    code = 409
+
+
+class Conflict(APIError):
+    code = 409
+
+
+class Invalid(APIError):
+    code = 422
+
+
+class Denied(APIError):
+    """Raised by admission (validating webhook semantics)."""
+
+    code = 403
+
+
+@dataclass
+class TypeInfo:
+    api_version: str
+    kind: str
+    plural: str
+    namespaced: bool = True
+
+
+@dataclass
+class AdmissionRequest:
+    operation: str  # CREATE | UPDATE | DELETE
+    obj: Obj
+    old_obj: Optional[Obj] = None
+    dry_run: bool = False
+
+
+@dataclass
+class _Hook:
+    kinds: set[str]
+    fn: Callable[[AdmissionRequest], Optional[Obj]]
+    mutating: bool = True
+    name: str = ""
+
+
+class Watch:
+    """Iterator over (event_type, obj) with a bounded drain queue."""
+
+    def __init__(self, server: "APIServer", kind: str, namespace: Optional[str]):
+        self._q: "queue.Queue[Optional[tuple[str, Obj]]]" = queue.Queue()
+        self._server = server
+        self.kind = kind
+        self.namespace = namespace
+        self._stopped = False
+
+    def _enqueue(self, event: tuple[str, Obj]) -> None:
+        if not self._stopped:
+            self._q.put(event)
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._q.put(None)
+        self._server._remove_watch(self)
+
+    def events(self, timeout: Optional[float] = None) -> Iterator[tuple[str, Obj]]:
+        while True:
+            try:
+                item = self._q.get(timeout=timeout)
+            except queue.Empty:
+                return
+            if item is None:
+                return
+            yield item
+
+    def get(self, timeout: Optional[float] = None) -> Optional[tuple[str, Obj]]:
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return item
+
+
+class APIServer:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._types: dict[str, TypeInfo] = {}
+        self._store: dict[str, dict[tuple[str, str], Obj]] = {}
+        self._rv = 0
+        self._watches: list[Watch] = []
+        self._hooks: list[_Hook] = []
+        self._register_builtins()
+
+    # -- type registry ------------------------------------------------------
+
+    def register_kind(
+        self, api_version: str, kind: str, plural: str, namespaced: bool = True
+    ) -> None:
+        with self._lock:
+            self._types[kind] = TypeInfo(api_version, kind, plural, namespaced)
+            self._store.setdefault(kind, {})
+
+    def _register_builtins(self) -> None:
+        core = [
+            ("v1", "Namespace", "namespaces", False),
+            ("v1", "Pod", "pods", True),
+            ("v1", "Service", "services", True),
+            ("v1", "ServiceAccount", "serviceaccounts", True),
+            ("v1", "Secret", "secrets", True),
+            ("v1", "ConfigMap", "configmaps", True),
+            ("v1", "PersistentVolumeClaim", "persistentvolumeclaims", True),
+            ("v1", "Event", "events", True),
+            ("v1", "Node", "nodes", False),
+            ("v1", "ResourceQuota", "resourcequotas", True),
+            ("apps/v1", "StatefulSet", "statefulsets", True),
+            ("apps/v1", "Deployment", "deployments", True),
+            ("rbac.authorization.k8s.io/v1", "Role", "roles", True),
+            ("rbac.authorization.k8s.io/v1", "RoleBinding", "rolebindings", True),
+            ("rbac.authorization.k8s.io/v1", "ClusterRole", "clusterroles", False),
+            (
+                "rbac.authorization.k8s.io/v1",
+                "ClusterRoleBinding",
+                "clusterrolebindings",
+                False,
+            ),
+            ("networking.k8s.io/v1", "NetworkPolicy", "networkpolicies", True),
+            ("networking.istio.io/v1beta1", "VirtualService", "virtualservices", True),
+            (
+                "security.istio.io/v1beta1",
+                "AuthorizationPolicy",
+                "authorizationpolicies",
+                True,
+            ),
+            ("gateway.networking.k8s.io/v1", "HTTPRoute", "httproutes", True),
+        ]
+        for api_version, kind, plural, namespaced in core:
+            self.register_kind(api_version, kind, plural, namespaced)
+
+    def type_info(self, kind: str) -> TypeInfo:
+        try:
+            return self._types[kind]
+        except KeyError:
+            raise NotFound(f"kind {kind!r} not registered") from None
+
+    def kind_for_plural(self, plural: str) -> str:
+        for kind, info in self._types.items():
+            if info.plural == plural:
+                return kind
+        raise NotFound(f"no kind with plural {plural!r}")
+
+    # -- admission ----------------------------------------------------------
+
+    def register_admission_hook(
+        self,
+        kinds,
+        fn: Callable[[AdmissionRequest], Optional[Obj]],
+        mutating: bool = True,
+        name: str = "",
+    ) -> None:
+        """Hooks run on CREATE/UPDATE inside the API call, mutating
+        first (may return a replacement object), then validating (may
+        raise Denied). This is the in-process stand-in for the
+        MutatingWebhookConfiguration HTTPS hop."""
+        with self._lock:
+            self._hooks.append(_Hook(set(kinds), fn, mutating, name))
+
+    def _run_admission(self, req: AdmissionRequest) -> Obj:
+        obj = req.obj
+        for hook in [h for h in self._hooks if h.mutating]:
+            if req.obj.get("kind") in hook.kinds:
+                out = hook.fn(
+                    AdmissionRequest(req.operation, obj, req.old_obj, req.dry_run)
+                )
+                if out is not None:
+                    obj = out
+        for hook in [h for h in self._hooks if not h.mutating]:
+            if obj.get("kind") in hook.kinds:
+                hook.fn(AdmissionRequest(req.operation, obj, req.old_obj, req.dry_run))
+        return obj
+
+    # -- keys ---------------------------------------------------------------
+
+    def _key(self, info: TypeInfo, namespace: Optional[str], name: str):
+        if info.namespaced:
+            if not namespace:
+                raise Invalid(f"{info.kind} is namespaced; namespace required")
+            return (namespace, name)
+        return ("", name)
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, obj: Obj, dry_run: bool = False) -> Obj:
+        kind = obj.get("kind", "")
+        info = self.type_info(kind)
+        obj = obj_util.deepcopy(obj)
+        obj.setdefault("apiVersion", info.api_version)
+        meta = obj.setdefault("metadata", {})
+        if not meta.get("name") and meta.get("generateName"):
+            meta["name"] = meta["generateName"] + uuid.uuid4().hex[:5]
+        if not meta.get("name"):
+            raise Invalid("metadata.name required")
+        with self._lock:
+            # admission first: a mutating hook may rewrite name/namespace,
+            # and the store key must reflect what admission returns.
+            obj = self._run_admission(AdmissionRequest("CREATE", obj, None, dry_run))
+            meta = obj["metadata"]
+            name = meta["name"]
+            namespace = meta.get("namespace") if info.namespaced else None
+            key = self._key(info, namespace, name)
+            if key in self._store[kind]:
+                raise AlreadyExists(f"{kind} {namespace or ''}/{name} exists")
+            if dry_run:
+                return obj
+            meta["uid"] = str(uuid.uuid4())
+            meta["creationTimestamp"] = obj_util.now_rfc3339()
+            meta["generation"] = 1
+            meta["resourceVersion"] = self._next_rv()
+            self._store[kind][key] = obj
+            self._notify("ADDED", obj)
+            return obj_util.deepcopy(obj)
+
+    def get(self, kind: str, name: str, namespace: Optional[str] = None) -> Obj:
+        info = self.type_info(kind)
+        with self._lock:
+            key = self._key(info, namespace, name)
+            found = self._store[kind].get(key)
+            if found is None:
+                raise NotFound(f"{kind} {namespace or ''}/{name} not found")
+            return obj_util.deepcopy(found)
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Obj] = None,
+        field_matches: Optional[dict[str, Any]] = None,
+    ) -> list[Obj]:
+        info = self.type_info(kind)
+        with self._lock:
+            out = []
+            for (ns, _), stored in self._store[kind].items():
+                if info.namespaced and namespace and ns != namespace:
+                    continue
+                if not obj_util.match_label_selector(
+                    label_selector, obj_util.labels_of(stored)
+                ):
+                    continue
+                if field_matches and any(
+                    obj_util.get_path(stored, *path.split(".")) != want
+                    for path, want in field_matches.items()
+                ):
+                    continue
+                out.append(obj_util.deepcopy(stored))
+            return out
+
+    def _update_inner(self, obj: Obj, status_only: bool) -> Obj:
+        kind = obj.get("kind", "")
+        info = self.type_info(kind)
+        obj = obj_util.deepcopy(obj)
+        meta = obj.get("metadata", {})
+        name = meta.get("name", "")
+        namespace = meta.get("namespace") if info.namespaced else None
+        with self._lock:
+            key = self._key(info, namespace, name)
+            current = self._store[kind].get(key)
+            if current is None:
+                raise NotFound(f"{kind} {namespace or ''}/{name} not found")
+            sent_rv = meta.get("resourceVersion")
+            if sent_rv and sent_rv != current["metadata"]["resourceVersion"]:
+                raise Conflict(
+                    f"{kind} {name}: resourceVersion {sent_rv} is stale "
+                    f"(current {current['metadata']['resourceVersion']})"
+                )
+            if status_only:
+                merged = obj_util.deepcopy(current)
+                merged["status"] = obj.get("status", {})
+                obj = merged
+            else:
+                # keep server-owned fields
+                obj["metadata"]["uid"] = current["metadata"]["uid"]
+                obj["metadata"]["creationTimestamp"] = current["metadata"][
+                    "creationTimestamp"
+                ]
+                obj["metadata"]["generation"] = current["metadata"].get(
+                    "generation", 1
+                )
+                if "status" not in obj and "status" in current:
+                    obj["status"] = obj_util.deepcopy(current["status"])
+                obj = self._run_admission(
+                    AdmissionRequest("UPDATE", obj, obj_util.deepcopy(current))
+                )
+                if obj.get("spec") != current.get("spec"):
+                    obj["metadata"]["generation"] = (
+                        current["metadata"].get("generation", 1) + 1
+                    )
+            obj["metadata"]["resourceVersion"] = self._next_rv()
+            self._store[kind][key] = obj
+            self._notify("MODIFIED", obj)
+            # a finalizer removal may release a pending delete
+            if obj["metadata"].get("deletionTimestamp") and not obj["metadata"].get(
+                "finalizers"
+            ):
+                self._remove(info, obj)
+            return obj_util.deepcopy(obj)
+
+    def update(self, obj: Obj) -> Obj:
+        return self._update_inner(obj, status_only=False)
+
+    def update_status(self, obj: Obj) -> Obj:
+        return self._update_inner(obj, status_only=True)
+
+    def patch(
+        self,
+        kind: str,
+        name: str,
+        patch: Obj,
+        namespace: Optional[str] = None,
+    ) -> Obj:
+        with self._lock:
+            current = self.get(kind, name, namespace)
+            merged = obj_util.json_merge_patch(current, patch)
+            # merge patches cannot move server-owned metadata
+            for k in ("uid", "creationTimestamp", "resourceVersion", "generation"):
+                if k in current.get("metadata", {}):
+                    merged["metadata"][k] = current["metadata"][k]
+            return self.update(merged)
+
+    def delete(self, kind: str, name: str, namespace: Optional[str] = None) -> None:
+        info = self.type_info(kind)
+        with self._lock:
+            key = self._key(info, namespace, name)
+            current = self._store[kind].get(key)
+            if current is None:
+                raise NotFound(f"{kind} {namespace or ''}/{name} not found")
+            if current["metadata"].get("finalizers"):
+                if not current["metadata"].get("deletionTimestamp"):
+                    current["metadata"]["deletionTimestamp"] = obj_util.now_rfc3339()
+                    current["metadata"]["resourceVersion"] = self._next_rv()
+                    self._notify("MODIFIED", current)
+                return
+            self._remove(info, current)
+
+    def _remove(self, info: TypeInfo, current: Obj) -> None:
+        key = self._key(
+            info,
+            current["metadata"].get("namespace") if info.namespaced else None,
+            current["metadata"]["name"],
+        )
+        self._store[info.kind].pop(key, None)
+        self._notify("DELETED", current)
+        self._cascade(current)
+
+    def _cascade(self, owner: Obj) -> None:
+        """Foreground GC: delete dependents referencing the owner uid."""
+        owner_uid = owner["metadata"].get("uid")
+        if not owner_uid:
+            return
+        for kind in list(self._store):
+            for stored in list(self._store[kind].values()):
+                refs = stored["metadata"].get("ownerReferences") or []
+                if any(r.get("uid") == owner_uid for r in refs):
+                    try:
+                        self.delete(
+                            kind,
+                            stored["metadata"]["name"],
+                            stored["metadata"].get("namespace"),
+                        )
+                    except NotFound:
+                        pass
+
+    # -- watches ------------------------------------------------------------
+
+    def watch(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        send_initial: bool = True,
+    ) -> Watch:
+        self.type_info(kind)
+        with self._lock:
+            w = Watch(self, kind, namespace)
+            if send_initial:
+                for item in self.list(kind, namespace=namespace):
+                    w._enqueue(("ADDED", item))
+            self._watches.append(w)
+            return w
+
+    def _remove_watch(self, w: Watch) -> None:
+        with self._lock:
+            if w in self._watches:
+                self._watches.remove(w)
+
+    def _notify(self, event_type: str, obj: Obj) -> None:
+        kind = obj.get("kind", "")
+        ns = obj.get("metadata", {}).get("namespace", "")
+        for w in list(self._watches):
+            if w.kind != kind:
+                continue
+            if w.namespace and w.namespace != ns:
+                continue
+            w._enqueue((event_type, obj_util.deepcopy(obj)))
+
+    # -- convenience --------------------------------------------------------
+
+    def create_or_get(self, obj: Obj) -> Obj:
+        try:
+            return self.create(obj)
+        except AlreadyExists:
+            meta = obj.get("metadata", {})
+            return self.get(obj["kind"], meta["name"], meta.get("namespace"))
+
+    def emit_event(
+        self,
+        involved: Obj,
+        reason: str,
+        message: str,
+        event_type: str = "Normal",
+        component: str = "",
+    ) -> Obj:
+        """Create a v1 Event pointing at ``involved`` (the mechanism the
+        notebook controller mirrors back onto Notebook CRs)."""
+        ns = involved.get("metadata", {}).get("namespace") or "default"
+        event = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "generateName": f"{obj_util.name_of(involved)}.",
+                "namespace": ns,
+            },
+            "involvedObject": {
+                "apiVersion": involved.get("apiVersion", ""),
+                "kind": involved.get("kind", ""),
+                "name": obj_util.name_of(involved),
+                "namespace": ns,
+                "uid": involved.get("metadata", {}).get("uid", ""),
+            },
+            "reason": reason,
+            "message": message,
+            "type": event_type,
+            "source": {"component": component},
+            "firstTimestamp": obj_util.now_rfc3339(),
+            "lastTimestamp": obj_util.now_rfc3339(),
+            "count": 1,
+        }
+        return self.create(event)

@@ -1,0 +1,11 @@
+from odh_kubeflow_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    forward,
+    init_params,
+    param_specs,
+)
+from odh_kubeflow_tpu.models.lora import (  # noqa: F401
+    LoraConfig,
+    init_lora_params,
+    lora_specs,
+)

@@ -1,0 +1,318 @@
+"""Llama-family transformer, TPU-first.
+
+Design choices (deliberately *not* a torch translation):
+
+- **Pure functional**: params are a pytree of jnp arrays; the forward is
+  a jittable function of (params, tokens). No modules, no state.
+- **Stacked layers + ``lax.scan``**: every per-layer weight carries a
+  leading ``[L, ...]`` axis and the decoder runs as one scanned body.
+  XLA compiles the layer once (compile time O(1) in depth), and the
+  stacked layout is what pipeline parallelism shards later.
+- **Sharding by annotation**: ``param_specs`` returns a PartitionSpec
+  tree mirroring the params; activations get
+  ``with_sharding_constraint`` at layer boundaries. XLA inserts the
+  collectives (all-gather for fsdp, reduce-scatter on grads, all-reduce
+  for tensor) — nothing here issues a collective by hand.
+- **bfloat16 activations / float32 master weights** are both supported;
+  ``config.dtype`` controls the compute dtype, params keep their own.
+
+This model is the flagship workload for the platform's north star
+(BASELINE.json: Llama-3-8B LoRA >= 50% MFU on a v5p-8 notebook slice).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from odh_kubeflow_tpu.ops.attention import dense_attention
+from odh_kubeflow_tpu.ops.norms import rms_norm
+from odh_kubeflow_tpu.ops.rope import apply_rope, rope_angles
+from odh_kubeflow_tpu.parallel.mesh import (
+    AXIS_CONTEXT,
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_TENSOR,
+    constrain,
+)
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    hidden_size: int = 4096
+    intermediate_size: int = 14_336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 500_000.0
+    rms_norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    # "dense" (XLA einsum), "flash" (pallas kernel), "ring"
+    # (context-parallel ring attention over the `context` mesh axis).
+    attention_impl: str = "dense"
+    # rematerialise each decoder layer in the backward pass
+    remat: bool = True
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama3_1b(**kw) -> "LlamaConfig":
+        """Llama-3.2-1B shape — fits a single v5e chip for training."""
+        d = dict(
+            hidden_size=2048,
+            intermediate_size=8192,
+            num_layers=16,
+            num_heads=32,
+            num_kv_heads=8,
+            head_dim=64,
+            tie_embeddings=True,
+        )
+        d.update(kw)
+        return LlamaConfig(**d)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Unit-test shape: runs in milliseconds on CPU."""
+        d = dict(
+            vocab_size=256,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            remat=False,
+        )
+        d.update(kw)
+        return LlamaConfig(**d)
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def num_params(self) -> int:
+        D, F, V, L = (
+            self.hidden_size,
+            self.intermediate_size,
+            self.vocab_size,
+            self.num_layers,
+        )
+        per_layer = (
+            D * self.q_dim  # wq
+            + 2 * D * self.kv_dim  # wk, wv
+            + self.q_dim * D  # wo
+            + 3 * D * F  # gate, up, down
+            + 2 * D  # norms
+        )
+        head = 0 if self.tie_embeddings else D * V
+        return V * D + L * per_layer + D + head
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Forward-pass matmul FLOPs per token (2*params-style estimate
+        plus the quadratic attention term), for MFU accounting."""
+        D, F, L = self.hidden_size, self.intermediate_size, self.num_layers
+        proj = 2 * (D * self.q_dim + 2 * D * self.kv_dim + self.q_dim * D + 3 * D * F)
+        attn = 2 * 2 * self.num_heads * self.head_dim * seq_len  # qk^T + av
+        head = 2 * D * self.vocab_size
+        embed = 0  # lookup, not a matmul
+        return L * (proj + attn) + head + embed
+
+
+# ---------------------------------------------------------------------------
+# init
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Params:
+    D, F, V, L = (
+        cfg.hidden_size,
+        cfg.intermediate_size,
+        cfg.vocab_size,
+        cfg.num_layers,
+    )
+    k = iter(jax.random.split(key, 16))
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * fan_in**-0.5).astype(
+            dtype
+        )
+
+    params: Params = {
+        "embed": dense(next(k), (V, D), D),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), dtype),
+            "wq": dense(next(k), (L, D, cfg.q_dim), D),
+            "wk": dense(next(k), (L, D, cfg.kv_dim), D),
+            "wv": dense(next(k), (L, D, cfg.kv_dim), D),
+            "wo": dense(next(k), (L, cfg.q_dim, D), cfg.q_dim),
+            "mlp_norm": jnp.ones((L, D), dtype),
+            "w_gate": dense(next(k), (L, D, F), D),
+            "w_up": dense(next(k), (L, D, F), D),
+            "w_down": dense(next(k), (L, F, D), F),
+        },
+        "final_norm": jnp.ones((D,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(next(k), (D, V), D)
+    return params
+
+
+def param_specs(cfg: LlamaConfig) -> Params:
+    """PartitionSpec tree mirroring ``init_params`` output.
+
+    2D sharding: model dims split across (fsdp, tensor); the leading
+    ``L`` (layer-stack) axis is always replicated — it is consumed by
+    the scan, one slice per step.
+    """
+    specs: Params = {
+        "embed": P(AXIS_TENSOR, AXIS_FSDP),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, AXIS_FSDP, AXIS_TENSOR),
+            "wk": P(None, AXIS_FSDP, AXIS_TENSOR),
+            "wv": P(None, AXIS_FSDP, AXIS_TENSOR),
+            "wo": P(None, AXIS_TENSOR, AXIS_FSDP),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, AXIS_FSDP, AXIS_TENSOR),
+            "w_up": P(None, AXIS_FSDP, AXIS_TENSOR),
+            "w_down": P(None, AXIS_TENSOR, AXIS_FSDP),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(AXIS_FSDP, AXIS_TENSOR)
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# forward
+
+
+def _maybe_lora(name: str, x: jnp.ndarray, w: jnp.ndarray, lora_layer) -> jnp.ndarray:
+    """x @ w, plus the low-rank LoRA delta when an adapter is attached."""
+    y = x @ w.astype(x.dtype)
+    if lora_layer is not None and name in lora_layer:
+        a = lora_layer[name]["a"].astype(x.dtype)  # [D, r]
+        b = lora_layer[name]["b"].astype(x.dtype)  # [r, out]
+        scale = lora_layer[name]["scale"].astype(x.dtype)
+        y = y + ((x @ a) @ b) * scale
+    return y
+
+
+def _activation_spec() -> P:
+    return P((AXIS_DATA, AXIS_FSDP), AXIS_CONTEXT, None)
+
+
+def _decoder_layer(
+    cfg: LlamaConfig,
+    attention_fn: Callable,
+    x: jnp.ndarray,  # [B, S, D]
+    layer: Params,  # leaves sliced to this layer (no leading L)
+    lora_layer,  # matching slice of lora params, or None
+    sin: jnp.ndarray,
+    cos: jnp.ndarray,
+    segment_ids,
+) -> jnp.ndarray:
+    B, S, D = x.shape
+    x = constrain(x, _activation_spec())
+
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+    q = _maybe_lora("wq", h, layer["wq"], lora_layer)
+    kk = _maybe_lora("wk", h, layer["wk"], lora_layer)
+    vv = _maybe_lora("wv", h, layer["wv"], lora_layer)
+    q = q.reshape(B, S, cfg.num_heads, cfg.head_dim)
+    kk = kk.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    vv = vv.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    q = apply_rope(q, sin, cos)
+    kk = apply_rope(kk, sin, cos)
+    attn = attention_fn(q, kk, vv, segment_ids=segment_ids)
+    attn = attn.reshape(B, S, cfg.q_dim)
+    x = x + _maybe_lora("wo", attn, layer["wo"], lora_layer)
+
+    h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+    gate = _maybe_lora("w_gate", h, layer["w_gate"], lora_layer)
+    up = _maybe_lora("w_up", h, layer["w_up"], lora_layer)
+    x = x + _maybe_lora("w_down", jax.nn.silu(gate) * up, layer["w_down"], lora_layer)
+    return x
+
+
+def _select_attention(cfg: LlamaConfig) -> Callable:
+    if cfg.attention_impl == "dense":
+        return partial(dense_attention, causal=True)
+    if cfg.attention_impl == "flash":
+        try:
+            from odh_kubeflow_tpu.ops.pallas_attention import flash_attention
+        except ImportError as e:
+            raise NotImplementedError(
+                "attention_impl='flash' requires ops/pallas_attention (pallas "
+                "TPU kernel); not available in this build"
+            ) from e
+        return partial(flash_attention, causal=True)
+    if cfg.attention_impl == "ring":
+        try:
+            from odh_kubeflow_tpu.parallel.ring_attention import ring_attention
+        except ImportError as e:
+            raise NotImplementedError(
+                "attention_impl='ring' requires parallel/ring_attention "
+                "(context-parallel mesh axis); not available in this build"
+            ) from e
+        return partial(ring_attention, causal=True)
+    raise ValueError(
+        f"unknown attention_impl {cfg.attention_impl!r}; "
+        "expected 'dense', 'flash', or 'ring'"
+    )
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32
+    cfg: LlamaConfig,
+    lora: Optional[Params] = None,
+    positions: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Returns logits [B, S, V] in float32."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    sin, cos = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    attention_fn = _select_attention(cfg)
+
+    layer_fn = partial(_decoder_layer, cfg, attention_fn)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+
+    lora_layers = lora["layers"] if lora is not None else None
+
+    def body(x, scanned):
+        layer, lora_layer = scanned
+        return layer_fn(x, layer, lora_layer, sin, cos, segment_ids), None
+
+    x, _ = jax.lax.scan(body, x, (params["layers"], lora_layers))
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, head.astype(cfg.dtype), preferred_element_type=jnp.float32
+    )
+    return logits

@@ -1,0 +1,88 @@
+"""LoRA adapters for the stacked-layer Llama.
+
+The adapter tree mirrors ``params["layers"]`` with the same leading
+``[L, ...]`` axis, so the decoder scan consumes base weights and adapter
+slices in lockstep. Training differentiates w.r.t. *only* this tree —
+the frozen base params never enter optimizer state, which is what makes
+8B LoRA fit small slices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from odh_kubeflow_tpu.models.llama import LlamaConfig
+from odh_kubeflow_tpu.parallel.mesh import AXIS_FSDP, AXIS_TENSOR
+
+Params = dict[str, Any]
+
+_TARGET_DIMS = {
+    # name -> (fan_in attr, fan_out attr) resolved against LlamaConfig
+    "wq": ("hidden_size", "q_dim"),
+    "wk": ("hidden_size", "kv_dim"),
+    "wv": ("hidden_size", "kv_dim"),
+    "wo": ("q_dim", "hidden_size"),
+    "w_gate": ("hidden_size", "intermediate_size"),
+    "w_up": ("hidden_size", "intermediate_size"),
+    "w_down": ("intermediate_size", "hidden_size"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 16
+    alpha: float = 32.0
+    targets: Sequence[str] = ("wq", "wk", "wv", "wo")
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def init_lora_params(
+    key: jax.Array, cfg: LlamaConfig, lora: LoraConfig, dtype=jnp.float32
+) -> Params:
+    L = cfg.num_layers
+    layers: Params = {}
+    keys = jax.random.split(key, len(lora.targets))
+    for k, name in zip(keys, lora.targets):
+        fan_in = getattr(cfg, _TARGET_DIMS[name][0])
+        fan_out = getattr(cfg, _TARGET_DIMS[name][1])
+        layers[name] = {
+            # A ~ gaussian, B = 0 → adapter starts as identity delta
+            "a": (
+                jax.random.normal(k, (L, fan_in, lora.rank), jnp.float32)
+                * fan_in**-0.5
+            ).astype(dtype),
+            "b": jnp.zeros((L, lora.rank, fan_out), dtype),
+            "scale": jnp.full((L,), lora.scale, jnp.float32),
+        }
+    return {"layers": layers}
+
+
+def lora_specs(cfg: LlamaConfig, lora: LoraConfig) -> Params:
+    layers: Params = {}
+    for name in lora.targets:
+        layers[name] = {
+            "a": P(None, AXIS_FSDP, None),
+            "b": P(None, None, AXIS_TENSOR),
+            "scale": P(None),
+        }
+    return {"layers": layers}
+
+
+def merge_lora(params: Params, lora_params: Params) -> Params:
+    """Fold adapters into the base weights (for export / serving)."""
+    merged = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy
+    for name, ab in lora_params["layers"].items():
+        w = params["layers"][name]
+        delta = jnp.einsum(
+            "lir,lro->lio", ab["a"].astype(jnp.float32), ab["b"].astype(jnp.float32)
+        ) * ab["scale"][:, None, None]
+        merged["layers"][name] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+    return merged

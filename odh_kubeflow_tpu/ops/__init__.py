@@ -1,0 +1,3 @@
+from odh_kubeflow_tpu.ops.attention import dense_attention  # noqa: F401
+from odh_kubeflow_tpu.ops.norms import rms_norm  # noqa: F401
+from odh_kubeflow_tpu.ops.rope import apply_rope, rope_angles  # noqa: F401

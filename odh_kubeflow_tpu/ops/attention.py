@@ -1,0 +1,67 @@
+"""Attention implementations.
+
+``dense_attention`` is the XLA-fused baseline: one einsum → softmax →
+einsum chain that XLA maps straight onto the MXU. GQA is handled by
+reshaping queries to [B, S, Hkv, group, hd] rather than materialising
+repeated KV heads (saves Hq/Hkv × KV HBM traffic).
+
+Higher-performance paths plug in behind the same signature:
+- pallas flash attention (``ops.pallas.flash_attention``) — tiled,
+  never materialises the [S, S] score matrix;
+- ring attention (``parallel.ring_attention``) — context-parallel over a
+  mesh axis via ``ppermute``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_attention(
+    q: jnp.ndarray,  # [B, Sq, Hq, hd]
+    k: jnp.ndarray,  # [B, Sk, Hkv, hd]
+    v: jnp.ndarray,  # [B, Sk, Hkv, hd]
+    *,
+    causal: bool = True,
+    q_offset: int | jnp.ndarray = 0,
+    segment_ids: Optional[jnp.ndarray] = None,  # [B, S] same-id attends
+) -> jnp.ndarray:
+    """Returns [B, Sq, Hq, hd]. Scores accumulate in float32.
+
+    ``q_offset`` is the absolute position of q[0] relative to k[0]
+    (used by the KV-cache decode path and by ring attention blocks).
+    """
+    B, Sq, Hq, hd = q.shape
+    _, Sk, Hkv, _ = k.shape
+    assert Hq % Hkv == 0, (Hq, Hkv)
+    group = Hq // Hkv
+
+    scale = hd**-0.5
+    qg = q.reshape(B, Sq, Hkv, group, hd)
+    # [B, Hkv, group, Sq, Sk]
+    scores = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    )
+    scores = scores * scale
+
+    mask = None
+    if causal:
+        q_pos = jnp.arange(Sq)[:, None] + q_offset
+        k_pos = jnp.arange(Sk)[None, :]
+        mask = q_pos >= k_pos  # [Sq, Sk]
+        mask = mask[None, None, None, :, :]
+    if segment_ids is not None:
+        # [B, Sq, Sk] → [B, 1, 1, Sq, Sk]
+        seg = (
+            segment_ids[:, :, None] == segment_ids[:, None, :]
+        )[:, None, None, :, :]
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.float32(-1e30))
+
+    weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", weights, v)
+    return out.reshape(B, Sq, Hq, hd)

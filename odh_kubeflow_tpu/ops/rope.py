@@ -1,0 +1,38 @@
+"""Rotary position embeddings (plain RoPE with configurable theta).
+
+Angles are precomputed once per forward *outside* the layer scan so the
+sin/cos tables are computed a single time and live in registers/VMEM
+across all layers instead of being re-derived per layer.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_angles(
+    positions: jnp.ndarray,  # [B, S] int32 absolute positions
+    head_dim: int,
+    theta: float = 500_000.0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (sin, cos), each [B, S, head_dim//2], float32."""
+    freq_exponents = jnp.arange(0, head_dim // 2, dtype=jnp.float32) / (head_dim // 2)
+    inv_freq = theta**-freq_exponents  # [hd/2]
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [B, S, hd/2]
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [B, S, H, hd]
+    sin: jnp.ndarray,  # [B, S, hd/2]
+    cos: jnp.ndarray,  # [B, S, hd/2]
+) -> jnp.ndarray:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    sin = sin[:, :, None, :]
+    cos = cos[:, :, None, :]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(dtype)

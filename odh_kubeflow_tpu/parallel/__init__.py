@@ -1,0 +1,10 @@
+from odh_kubeflow_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_CONTEXT,
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_TENSOR,
+    MeshConfig,
+    batch_spec,
+    build_mesh,
+    local_mesh_config,
+)

@@ -1,0 +1,5 @@
+from odh_kubeflow_tpu.train.trainer import (  # noqa: F401
+    TrainConfig,
+    Trainer,
+    cross_entropy_loss,
+)

@@ -1,0 +1,255 @@
+"""Sharded training loop for Llama models (full fine-tune or LoRA).
+
+One jitted ``train_step`` compiled against a ``jax.sharding.Mesh``:
+- the *trainable* tree (LoRA adapters, or the full params) carries
+  optimizer state sharded like the params themselves;
+- the frozen base params are closed over as sharded donated inputs;
+- XLA derives every collective from the in/out shardings — there is no
+  hand-written pmap/all-reduce anywhere.
+
+This is the workload behind BASELINE.json's north-star metric (Llama-3-8B
+LoRA on a v5p-8 notebook at >=50% MFU) and is what ``bench.py`` times.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from odh_kubeflow_tpu.models import llama, lora as lora_lib
+from odh_kubeflow_tpu.parallel.mesh import batch_spec, build_mesh, constrain
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.0
+    warmup_steps: int = 10
+    total_steps: int = 1000
+    max_grad_norm: float = 1.0
+    b1: float = 0.9
+    b2: float = 0.95
+    z_loss: float = 0.0
+
+
+def cross_entropy_loss(
+    logits: jnp.ndarray,  # [B, S, V] float32
+    targets: jnp.ndarray,  # [B, S] int32
+    loss_mask: Optional[jnp.ndarray] = None,  # [B, S]
+    z_loss: float = 0.0,
+) -> jnp.ndarray:
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)  # [B, S]
+    target_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - target_logit
+    if z_loss:
+        nll = nll + z_loss * jnp.square(logz)
+    if loss_mask is None:
+        return jnp.mean(nll)
+    loss_mask = loss_mask.astype(jnp.float32)
+    return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+
+
+def _make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=cfg.learning_rate,
+        warmup_steps=cfg.warmup_steps,
+        decay_steps=max(cfg.total_steps, cfg.warmup_steps + 1),
+        end_value=cfg.learning_rate * 0.1,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adamw(
+            schedule, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay
+        ),
+    )
+
+
+class Trainer:
+    """Owns mesh, sharded state, and the compiled train step.
+
+    ``lora_cfg=None`` → full fine-tune (grads w.r.t. all params);
+    otherwise base params are frozen and only adapters train.
+    """
+
+    def __init__(
+        self,
+        model_cfg: llama.LlamaConfig,
+        train_cfg: TrainConfig = TrainConfig(),
+        lora_cfg: Optional[lora_lib.LoraConfig] = None,
+        mesh: Optional[Mesh] = None,
+        seed: int = 0,
+    ):
+        self.model_cfg = model_cfg
+        self.train_cfg = train_cfg
+        self.lora_cfg = lora_cfg
+        self.mesh = mesh if mesh is not None else build_mesh()
+        self.optimizer = _make_optimizer(train_cfg)
+
+        key = jax.random.key(seed)
+        k_params, k_lora = jax.random.split(key)
+
+        p_specs = llama.param_specs(model_cfg)
+        with jax.set_mesh(self.mesh):
+            init_fn = jax.jit(
+                partial(llama.init_params, cfg=model_cfg, dtype=model_cfg.dtype),
+                out_shardings=self._sh(p_specs),
+            )
+            self.params = init_fn(k_params)
+            if lora_cfg is not None:
+                l_specs = lora_lib.lora_specs(model_cfg, lora_cfg)
+                lora_init = jax.jit(
+                    partial(
+                        lora_lib.init_lora_params, cfg=model_cfg, lora=lora_cfg
+                    ),
+                    out_shardings=self._sh(l_specs),
+                )
+                self.lora_params = lora_init(k_lora)
+                self._train_specs = l_specs
+            else:
+                self.lora_params = None
+                self._train_specs = p_specs
+            trainable = self.lora_params if lora_cfg is not None else self.params
+            self._opt_specs = self._opt_state_specs(trainable, self._train_specs)
+            opt_init = jax.jit(
+                self.optimizer.init, out_shardings=self._sh(self._opt_specs)
+            )
+            self.opt_state = opt_init(trainable)
+        self.step = 0
+        self._compiled = self._build_step()
+
+    # -- sharding helpers ---------------------------------------------------
+
+    def _sh(self, spec_tree):
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s),
+            spec_tree,
+            is_leaf=lambda s: isinstance(s, P),
+        )
+
+    def _opt_state_specs(self, trainable, train_specs):
+        """Optimizer state shards like the param it mirrors; non-param
+        state (step counts, schedule state) replicates."""
+        shapes = jax.eval_shape(self.optimizer.init, trainable)
+        return optax.tree_map_params(
+            self.optimizer,
+            lambda _leaf, spec: spec,
+            shapes,
+            train_specs,
+            transform_non_params=lambda _leaf: P(),
+        )
+
+    # -- train step ---------------------------------------------------------
+
+    def _loss_fn(self, trainable, frozen, batch):
+        if self.lora_cfg is not None:
+            params, lora_params = frozen, trainable
+        else:
+            params, lora_params = trainable, None
+        logits = llama.forward(
+            params,
+            batch["tokens"],
+            self.model_cfg,
+            lora=lora_params,
+            segment_ids=batch.get("segment_ids"),
+        )
+        loss = cross_entropy_loss(
+            logits,
+            batch["targets"],
+            batch.get("loss_mask"),
+            z_loss=self.train_cfg.z_loss,
+        )
+        return loss
+
+    def _build_step(self):
+        def step_fn(trainable, frozen, opt_state, batch):
+            loss, grads = jax.value_and_grad(self._loss_fn)(
+                trainable, frozen, batch
+            )
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params=trainable
+            )
+            trainable = optax.apply_updates(trainable, updates)
+            gnorm = optax.global_norm(grads)
+            return trainable, opt_state, {"loss": loss, "grad_norm": gnorm}
+
+        train_sh = self._sh(self._train_specs)
+        frozen_specs = (
+            llama.param_specs(self.model_cfg)
+            if self.lora_cfg is not None
+            else self._train_specs
+        )
+        opt_sh = self._sh(self._opt_specs)
+        return jax.jit(
+            step_fn,
+            in_shardings=(train_sh, self._sh(frozen_specs), opt_sh, None),
+            # pin outputs too: without this GSPMD is free to pick a
+            # different layout for step N's outputs than step N+1's
+            # pinned inputs, which raises a sharding mismatch on call 2.
+            out_shardings=(train_sh, opt_sh, None),
+            donate_argnums=(0, 2),
+        )
+
+    def train_step(self, batch: dict) -> dict:
+        trainable = self.lora_params if self.lora_cfg is not None else self.params
+        frozen = self.params
+        with jax.set_mesh(self.mesh):
+            trainable, self.opt_state, metrics = self._compiled(
+                trainable, frozen, self.opt_state, batch
+            )
+        if self.lora_cfg is not None:
+            self.lora_params = trainable
+        else:
+            self.params = trainable
+        self.step += 1
+        return metrics
+
+    # -- convenience --------------------------------------------------------
+
+    def make_fake_batch(self, batch_size: int, seq_len: int, seed: int = 0) -> dict:
+        key = jax.random.key(seed)
+        tokens = jax.random.randint(
+            key, (batch_size, seq_len), 0, self.model_cfg.vocab_size, jnp.int32
+        )
+        targets = jnp.roll(tokens, -1, axis=1)
+        sharding = NamedSharding(self.mesh, batch_spec())
+        return {
+            "tokens": jax.device_put(tokens, sharding),
+            "targets": jax.device_put(targets, sharding),
+        }
+
+    def benchmark(
+        self, batch_size: int, seq_len: int, steps: int = 10, warmup: int = 2
+    ) -> dict:
+        batch = self.make_fake_batch(batch_size, seq_len)
+        # Synchronise via a host transfer, not block_until_ready: on
+        # remote-relay TPU backends block_until_ready can return before
+        # the queued executions drain, which makes steps look free.
+        for _ in range(max(warmup, 1)):  # >=1: keep compile out of timing
+            metrics = self.train_step(batch)
+        float(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            metrics = self.train_step(batch)
+        loss = float(metrics["loss"])
+        dt = (time.perf_counter() - t0) / steps
+        tokens = batch_size * seq_len
+        # fwd + bwd ≈ 3× forward matmul flops (LoRA bwd still back-props
+        # through the frozen matmuls, so the classic 3× estimate holds).
+        flops = 3 * self.model_cfg.flops_per_token(seq_len) * tokens
+        return {
+            "step_time_s": dt,
+            "tokens_per_s": tokens / dt,
+            "model_flops_per_step": flops,
+            "flops_per_s": flops / dt,
+            "loss": loss,
+        }

@@ -1,0 +1,36 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before jax imports.
+
+Mirrors the reference's envtest strategy (SURVEY.md §4: multi-node
+behavior is tested against fakes, never real hardware): all sharding /
+collective paths compile and run on 8 virtual CPU devices.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# jax may already be imported by the interpreter's sitecustomize (TPU
+# tunnel); the config update still wins as long as no backend has been
+# initialised yet.
+jax.config.update("jax_platforms", "cpu")
+
+# Numerical-equivalence tests (merge-vs-adapter, sharded-vs-single) need
+# true float32 matmuls; the default precision emulates TPU bf16 passes.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) == 8, devs
+    return devs

@@ -1,0 +1,108 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from odh_kubeflow_tpu.models import (
+    LlamaConfig,
+    LoraConfig,
+    forward,
+    init_lora_params,
+    init_params,
+    param_specs,
+)
+from odh_kubeflow_tpu.models.lora import merge_lora
+from odh_kubeflow_tpu.ops.attention import dense_attention
+
+
+def test_forward_shapes():
+    cfg = LlamaConfig.tiny()
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = forward(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_causality():
+    """Changing a future token must not change past logits."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    t1 = jnp.zeros((1, 8), jnp.int32)
+    t2 = t1.at[0, 5].set(7)
+    l1 = forward(params, t1, cfg)
+    l2 = forward(params, t2, cfg)
+    np.testing.assert_allclose(l1[0, :5], l2[0, :5], rtol=1e-5)
+    assert not np.allclose(l1[0, 5:], l2[0, 5:])
+
+
+def test_gqa_matches_repeated_kv():
+    """Grouped-query reshape == explicitly repeating KV heads."""
+    key = jax.random.key(1)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (2, 8, 4, 16))
+    k = jax.random.normal(kk, (2, 8, 2, 16))
+    v = jax.random.normal(kv, (2, 8, 2, 16))
+    out = dense_attention(q, k, v, causal=True)
+    k_rep = jnp.repeat(k, 2, axis=2)
+    v_rep = jnp.repeat(v, 2, axis=2)
+    ref = dense_attention(q, k_rep, v_rep, causal=True)
+    # repeat puts kv head h at positions 2h, 2h+1; grouped reshape maps
+    # q heads (2h, 2h+1) to kv head h — identical pairing.
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_segment_ids_block_cross_attention():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    seg = jnp.array([[0, 0, 0, 0, 1, 1, 1, 1]])
+    # second segment with positions restarted == standalone forward
+    pos = jnp.array([[0, 1, 2, 3, 0, 1, 2, 3]])
+    l_packed = forward(params, tokens, cfg, segment_ids=seg, positions=pos)
+    l_alone = forward(params, tokens[:, :4], cfg)
+    np.testing.assert_allclose(l_packed[0, 4:], l_alone[0, :4], rtol=1e-4, atol=1e-5)
+
+
+def test_lora_zero_init_is_identity():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    lcfg = LoraConfig(rank=4)
+    params = init_params(jax.random.key(0), cfg)
+    lora = init_lora_params(jax.random.key(1), cfg, lcfg)
+    base = forward(params, jnp.zeros((1, 8), jnp.int32), cfg)
+    with_lora = forward(params, jnp.zeros((1, 8), jnp.int32), cfg, lora=lora)
+    np.testing.assert_allclose(base, with_lora, rtol=1e-6)
+
+
+def test_merge_lora_matches_adapter_forward():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    lcfg = LoraConfig(rank=4, targets=("wq", "wo"))
+    params = init_params(jax.random.key(0), cfg)
+    lora = init_lora_params(jax.random.key(1), cfg, lcfg)
+    # make B nonzero so the adapter actually does something
+    lora["layers"]["wq"]["b"] = (
+        jax.random.normal(jax.random.key(2), lora["layers"]["wq"]["b"].shape) * 0.02
+    )
+    tokens = jnp.arange(8, dtype=jnp.int32)[None]
+    with_adapter = forward(params, tokens, cfg, lora=lora)
+    merged = merge_lora(params, lora)
+    with_merged = forward(merged, tokens, cfg)
+    np.testing.assert_allclose(with_adapter, with_merged, rtol=1e-4, atol=1e-4)
+
+
+def test_param_specs_mirror_params():
+    cfg = LlamaConfig.tiny()
+    params = init_params(jax.random.key(0), cfg)
+    specs = param_specs(cfg)
+    ps = jax.tree_util.tree_structure(params)
+    ss = jax.tree_util.tree_structure(
+        specs, is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec)
+    )
+    assert ps == ss
+
+
+def test_num_params_matches_init():
+    cfg = LlamaConfig.tiny()
+    params = init_params(jax.random.key(0), cfg)
+    actual = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    assert actual == cfg.num_params()
