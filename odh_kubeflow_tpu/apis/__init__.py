@@ -1,0 +1,84 @@
+"""CRD schemas for the TPU notebook platform.
+
+Mirrors the reference's API groups (SURVEY.md §1 L1):
+- ``Notebook``    kubeflow.org/v1beta1, namespaced
+  (reference: components/notebook-controller/api/v1beta1/notebook_types.go:27-63)
+- ``Profile``     kubeflow.org/v1, cluster-scoped
+  (reference: components/profile-controller/api/v1/profile_types.go:36-60)
+- ``Tensorboard`` tensorboard.kubeflow.org/v1alpha1, namespaced
+  (reference: components/tensorboard-controller/api/v1alpha1/tensorboard_types.go:28-32)
+- ``PodDefault``  kubeflow.org/v1alpha1, namespaced
+  (reference: components/admission-webhook/pkg/apis/settings/v1alpha1/poddefault_types.go:27-78)
+
+Objects are dict-shaped; this package contributes registration,
+validation (as validating-admission hooks), and typed builders.
+"""
+
+from odh_kubeflow_tpu.machinery.store import APIServer, Denied, Invalid
+
+GROUP = "kubeflow.org"
+
+NOTEBOOK_API_VERSION = f"{GROUP}/v1beta1"
+PROFILE_API_VERSION = f"{GROUP}/v1"
+TENSORBOARD_API_VERSION = f"tensorboard.{GROUP}/v1alpha1"
+PODDEFAULT_API_VERSION = f"{GROUP}/v1alpha1"
+
+# annotations shared across controllers (reference: culler.go:40-41,
+# notebook_controller.go:420-422, jwa patch.py:18-75)
+STOP_ANNOTATION = "kubeflow-resource-stopped"
+LAST_ACTIVITY_ANNOTATION = "notebooks.kubeflow.org/last-activity"
+LAST_ACTIVITY_CHECK_ANNOTATION = (
+    "notebooks.kubeflow.org/last_activity_check_timestamp"
+)
+
+# TPU scheduling contract (replaces the reference's nvidia.com/gpu path,
+# BASELINE.json north star)
+TPU_RESOURCE = "google.com/tpu"
+TPU_ACCELERATOR_ANNOTATION = "notebooks.kubeflow.org/tpu-accelerator"
+TPU_TOPOLOGY_ANNOTATION = "notebooks.kubeflow.org/tpu-topology"
+TPU_ACCEL_NODE_LABEL = "cloud.google.com/gke-tpu-accelerator"
+TPU_TOPO_NODE_LABEL = "cloud.google.com/gke-tpu-topology"
+
+
+def _validate_notebook(req):
+    if req.operation not in ("CREATE", "UPDATE"):
+        return
+    spec = req.obj.get("spec") or {}
+    template = spec.get("template") or {}
+    containers = (template.get("spec") or {}).get("containers")
+    if not containers:
+        raise Invalid("Notebook spec.template.spec.containers must be non-empty")
+
+
+def _validate_profile(req):
+    if req.operation not in ("CREATE", "UPDATE"):
+        return
+    owner = (req.obj.get("spec") or {}).get("owner") or {}
+    if not owner.get("name"):
+        raise Invalid("Profile spec.owner.name is required")
+
+
+def _validate_tensorboard(req):
+    if req.operation not in ("CREATE", "UPDATE"):
+        return
+    if not (req.obj.get("spec") or {}).get("logspath"):
+        raise Invalid("Tensorboard spec.logspath is required")
+
+
+def register_crds(api: APIServer) -> None:
+    api.register_kind(NOTEBOOK_API_VERSION, "Notebook", "notebooks", True)
+    api.register_kind(PROFILE_API_VERSION, "Profile", "profiles", False)
+    api.register_kind(TENSORBOARD_API_VERSION, "Tensorboard", "tensorboards", True)
+    api.register_kind(PODDEFAULT_API_VERSION, "PodDefault", "poddefaults", True)
+    api.register_admission_hook(
+        {"Notebook"}, _validate_notebook, mutating=False, name="validate-notebook"
+    )
+    api.register_admission_hook(
+        {"Profile"}, _validate_profile, mutating=False, name="validate-profile"
+    )
+    api.register_admission_hook(
+        {"Tensorboard"},
+        _validate_tensorboard,
+        mutating=False,
+        name="validate-tensorboard",
+    )

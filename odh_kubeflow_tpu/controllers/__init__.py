@@ -1,0 +1,6 @@
+from odh_kubeflow_tpu.controllers.runtime import (  # noqa: F401
+    Controller,
+    Manager,
+    Request,
+    Result,
+)

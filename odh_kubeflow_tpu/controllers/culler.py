@@ -1,0 +1,216 @@
+"""Idleness culling, TPU-duty-cycle aware.
+
+Reference parity (notebook-controller/pkg/culler/culler.go): probes the
+running server's Jupyter REST API (``/api/kernels``, ``/api/terminals``,
+:155-221), maintains the ``notebooks.kubeflow.org/last-activity``
+annotation with a monotonic guard (:266-355), and sets
+``kubeflow-resource-stopped`` once idle beyond the threshold (:405-420).
+Design doc: components/proposals/20220121-jupyter-notebook-idleness.md.
+
+TPU-first change (SURVEY.md §7 hard part (b)): kernel-state probing
+alone would cull a notebook mid-fine-tune — a long training step looks
+"busy-but-quiet" (no new kernel activity, websocket silent). The culler
+therefore also probes ``/api/tpu/activity`` (served by the in-image
+runtime agent, images/: jupyter-jax-tpu) and treats recent TPU duty
+cycle above a threshold as activity. A multi-host slice is culled
+atomically — the stop annotation acts on the Notebook, never a subset
+of hosts.
+"""
+
+from __future__ import annotations
+
+import calendar
+import dataclasses
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Optional
+
+from odh_kubeflow_tpu.apis import (
+    LAST_ACTIVITY_ANNOTATION,
+    LAST_ACTIVITY_CHECK_ANNOTATION,
+    STOP_ANNOTATION,
+)
+from odh_kubeflow_tpu.controllers.runtime import Result
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.store import APIServer, Conflict, NotFound
+
+Obj = dict[str, Any]
+
+TIME_FORMAT = "%Y-%m-%dT%H:%M:%SZ"
+
+
+def _parse_time(s: str) -> float:
+    s = s.split(".")[0].rstrip("Z") + "Z"
+    return calendar.timegm(time.strptime(s, TIME_FORMAT))
+
+
+def _fmt_time(t: float) -> str:
+    return time.strftime(TIME_FORMAT, time.gmtime(t))
+
+
+@dataclasses.dataclass
+class CullerConfig:
+    cull_idle_seconds: float = 1440 * 60.0
+    idleness_check_seconds: float = 60.0
+    cluster_domain: str = "cluster.local"
+    probe_timeout: float = 5.0
+    # TPU activity: duty cycle above this percentage counts as active
+    tpu_duty_cycle_threshold: float = 5.0
+
+
+class Culler:
+    def __init__(
+        self,
+        api: APIServer,
+        config: Optional[CullerConfig] = None,
+        base_url_fn: Optional[Callable[[Obj], str]] = None,
+        now_fn: Callable[[], float] = time.time,
+        cull_counter=None,
+    ):
+        self.api = api
+        self.config = config or CullerConfig()
+        self._base_url_fn = base_url_fn or self._default_base_url
+        self.now = now_fn
+        self.m_cull = cull_counter
+
+    def _default_base_url(self, notebook: Obj) -> str:
+        name = obj_util.name_of(notebook)
+        ns = obj_util.namespace_of(notebook)
+        # service port 80 → jupyter 8888 (culler.go:155-180 URL shape)
+        return (
+            f"http://{name}.{ns}.svc.{self.config.cluster_domain}"
+            f"/notebook/{ns}/{name}"
+        )
+
+    # -- probes -------------------------------------------------------------
+
+    def _get_json(self, url: str):
+        try:
+            with urllib.request.urlopen(url, timeout=self.config.probe_timeout) as r:
+                return json.loads(r.read().decode())
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    def probe_activity(self, notebook: Obj) -> Optional[float]:
+        """Returns the server's latest activity timestamp (epoch), or
+        None when the server is unreachable (treated as no-information:
+        the annotation is left alone, matching the reference's behavior
+        of skipping updates when probing fails)."""
+        base = self._base_url_fn(notebook)
+        latest: Optional[float] = None
+
+        kernels = self._get_json(f"{base}/api/kernels")
+        if kernels is not None:
+            for k in kernels:
+                if k.get("execution_state") == "busy":
+                    return self.now()
+                la = k.get("last_activity")
+                if la:
+                    t = _parse_time(la)
+                    latest = t if latest is None else max(latest, t)
+
+        terminals = self._get_json(f"{base}/api/terminals")
+        if terminals is not None:
+            for term in terminals:
+                la = term.get("last_activity")
+                if la:
+                    t = _parse_time(la)
+                    latest = t if latest is None else max(latest, t)
+
+        tpu = self._get_json(f"{base}/api/tpu/activity")
+        if tpu is not None:
+            duty = float(tpu.get("duty_cycle_pct", 0.0))
+            if duty >= self.config.tpu_duty_cycle_threshold:
+                return self.now()
+            la = tpu.get("last_active")
+            if la:
+                t = _parse_time(la)
+                latest = t if latest is None else max(latest, t)
+
+        return latest
+
+    # -- annotation state machine -------------------------------------------
+
+    def reconcile_notebook(self, notebook: Obj) -> Result:
+        """Called from the notebook controller's reconcile tail
+        (reference :252-281). Returns the requeue period."""
+        ann = obj_util.annotations_of(notebook)
+        if STOP_ANNOTATION in ann:
+            return Result()  # already stopped; nothing to track
+
+        now = self.now()
+        period = self.config.idleness_check_seconds
+
+        last_check = ann.get(LAST_ACTIVITY_CHECK_ANNOTATION)
+        if last_check is not None and now - _parse_time(last_check) < period:
+            remaining = period - (now - _parse_time(last_check))
+            return Result(requeue_after=max(remaining, 1.0))
+
+        running = self._notebook_running(notebook)
+        if running:
+            # initialize on first sight (culler.go:118-141): without
+            # this, a server that never reports activity (no kernels,
+            # probe unreachable) would hold its TPU slice forever.
+            if LAST_ACTIVITY_ANNOTATION not in ann:
+                obj_util.set_annotation(
+                    notebook, LAST_ACTIVITY_ANNOTATION, _fmt_time(now)
+                )
+                ann = obj_util.annotations_of(notebook)
+            activity = self.probe_activity(notebook)
+            if activity is not None:
+                prev = ann.get(LAST_ACTIVITY_ANNOTATION)
+                # monotonic guard (culler.go:302-355)
+                if prev is None or activity > _parse_time(prev):
+                    obj_util.set_annotation(
+                        notebook, LAST_ACTIVITY_ANNOTATION, _fmt_time(activity)
+                    )
+        obj_util.set_annotation(
+            notebook, LAST_ACTIVITY_CHECK_ANNOTATION, _fmt_time(now)
+        )
+
+        if running and self.needs_culling(notebook):
+            obj_util.set_annotation(notebook, STOP_ANNOTATION, _fmt_time(now))
+            if self.m_cull is not None:
+                self.m_cull.inc()
+            self.api.emit_event(
+                notebook,
+                "Culling",
+                "Notebook idle beyond threshold; scaling to zero",
+                component="notebook-controller",
+            )
+        self._patch_annotations(notebook)
+        return Result(requeue_after=period)
+
+    def needs_culling(self, notebook: Obj) -> bool:
+        ann = obj_util.annotations_of(notebook)
+        last = ann.get(LAST_ACTIVITY_ANNOTATION)
+        if last is None:
+            return False
+        return self.now() - _parse_time(last) > self.config.cull_idle_seconds
+
+    def _notebook_running(self, notebook: Obj) -> bool:
+        try:
+            pod = self.api.get(
+                "Pod",
+                f"{obj_util.name_of(notebook)}-0",
+                obj_util.namespace_of(notebook),
+            )
+        except NotFound:
+            return False
+        return obj_util.get_path(pod, "status", "phase") == "Running"
+
+    def _patch_annotations(self, notebook: Obj) -> None:
+        patch = {
+            "metadata": {"annotations": dict(obj_util.annotations_of(notebook))}
+        }
+        try:
+            self.api.patch(
+                "Notebook",
+                obj_util.name_of(notebook),
+                patch,
+                obj_util.namespace_of(notebook),
+            )
+        except (Conflict, NotFound):
+            pass  # next requeue retries

@@ -1,0 +1,103 @@
+"""Create-or-update helpers with field-copy semantics.
+
+Parity with the reference's common/reconcilehelper/util.go:18-219:
+create if missing; otherwise copy only the fields a controller owns
+(labels, annotations, replicas, pod template / spec) so server-managed
+fields (clusterIP, status) survive, and only write when something
+changed (level-triggered idempotence)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.store import APIServer, Conflict, NotFound
+
+Obj = dict[str, Any]
+
+
+def _copy_meta(dst: Obj, src: Obj) -> bool:
+    changed = False
+    for field in ("labels", "annotations"):
+        want = obj_util.meta(src).get(field) or {}
+        have = obj_util.meta(dst).get(field) or {}
+        if want != have:
+            obj_util.meta(dst)[field] = dict(want)
+            changed = True
+    return changed
+
+
+def copy_statefulset_fields(desired: Obj, current: Obj) -> bool:
+    changed = _copy_meta(current, desired)
+    for path in (("spec", "replicas"), ("spec", "template"), ("spec", "serviceName")):
+        want = obj_util.get_path(desired, *path)
+        have = obj_util.get_path(current, *path)
+        if want != have:
+            cur = current
+            for p in path[:-1]:
+                cur = cur.setdefault(p, {})
+            cur[path[-1]] = want
+            changed = True
+    return changed
+
+
+def copy_deployment_fields(desired: Obj, current: Obj) -> bool:
+    return copy_statefulset_fields(desired, current)
+
+
+def copy_service_fields(desired: Obj, current: Obj) -> bool:
+    """Service: keep clusterIP (server-assigned), copy ports/selector."""
+    changed = _copy_meta(current, desired)
+    want_spec = dict(desired.get("spec") or {})
+    have_spec = current.setdefault("spec", {})
+    if "clusterIP" in have_spec:
+        want_spec["clusterIP"] = have_spec["clusterIP"]
+    if want_spec != have_spec:
+        current["spec"] = want_spec
+        changed = True
+    return changed
+
+
+def copy_spec_wholesale(desired: Obj, current: Obj) -> bool:
+    changed = _copy_meta(current, desired)
+    if desired.get("spec") != current.get("spec"):
+        current["spec"] = obj_util.deepcopy(desired.get("spec") or {})
+        changed = True
+    return changed
+
+
+_COPIERS: dict[str, Callable[[Obj, Obj], bool]] = {
+    "StatefulSet": copy_statefulset_fields,
+    "Deployment": copy_deployment_fields,
+    "Service": copy_service_fields,
+}
+
+
+def reconcile_object(
+    api: APIServer,
+    desired: Obj,
+    owner: Optional[Obj] = None,
+    copier: Optional[Callable[[Obj, Obj], bool]] = None,
+) -> Obj:
+    """Create ``desired`` (with controller ownerReference) or update the
+    existing object using the kind-appropriate field copier. Retries
+    once on Conflict (reference: notebook_route.go:119-131 pattern)."""
+    if owner is not None:
+        obj_util.set_controller_reference(desired, owner)
+    kind = desired.get("kind", "")
+    copier = copier or _COPIERS.get(kind, copy_spec_wholesale)
+    meta = desired.get("metadata", {})
+    for attempt in (0, 1):
+        try:
+            current = api.get(kind, meta.get("name", ""), meta.get("namespace"))
+        except NotFound:
+            return api.create(desired)
+        if copier(desired, current):
+            try:
+                return api.update(current)
+            except Conflict:
+                if attempt:
+                    raise
+                continue
+        return current
+    return current

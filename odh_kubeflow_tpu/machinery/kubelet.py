@@ -1,0 +1,307 @@
+"""Node / scheduler / kubelet simulation for tests and local dev.
+
+The reference tests controllers with envtest (apiserver, no kubelet), so
+StatefulSets never produce Pods there. This simulator closes that gap:
+it materialises Pods from StatefulSets/Deployments, schedules them onto
+fake nodes honoring TPU nodeSelectors and ``google.com/tpu`` capacity,
+and drives pod phases — which is what lets the culler, status mirroring,
+and TPU-slice scheduling be tested end-to-end with no cluster.
+
+Deterministic by design: ``step()`` runs one reconcile pass; call it
+after mutations instead of racing a background thread.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Optional
+
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.store import APIServer, AlreadyExists, NotFound
+
+Obj = dict[str, Any]
+
+TPU_RESOURCE = "google.com/tpu"
+TPU_ACCEL_LABEL = "cloud.google.com/gke-tpu-accelerator"
+TPU_TOPO_LABEL = "cloud.google.com/gke-tpu-topology"
+
+
+class FakeCluster:
+    def __init__(self, api: APIServer):
+        self.api = api
+        self._ip_counter = itertools.count(2)
+
+    # -- nodes --------------------------------------------------------------
+
+    def add_node(
+        self,
+        name: str,
+        cpu: str = "16",
+        memory: str = "64Gi",
+        labels: Optional[dict[str, str]] = None,
+        extra_capacity: Optional[dict[str, str]] = None,
+    ) -> Obj:
+        capacity = {"cpu": cpu, "memory": memory, "pods": "110"}
+        capacity.update(extra_capacity or {})
+        node = {
+            "apiVersion": "v1",
+            "kind": "Node",
+            "metadata": {"name": name, "labels": labels or {}},
+            "status": {
+                "capacity": capacity,
+                "allocatable": dict(capacity),
+                "conditions": [{"type": "Ready", "status": "True"}],
+            },
+        }
+        return self.api.create(node)
+
+    def add_tpu_node_pool(
+        self,
+        name: str,
+        accelerator_type: str,
+        topology: str,
+        num_hosts: int = 1,
+        chips_per_host: int = 4,
+    ) -> list[Obj]:
+        """One Node per TPU host in the slice, labelled the way GKE
+        labels TPU node pools (accelerator + topology + worker hostnames
+        feed multi-host scheduling)."""
+        nodes = []
+        for i in range(num_hosts):
+            nodes.append(
+                self.add_node(
+                    f"{name}-{i}",
+                    labels={
+                        TPU_ACCEL_LABEL: accelerator_type,
+                        TPU_TOPO_LABEL: topology,
+                        "cloud.google.com/gke-nodepool": name,
+                    },
+                    extra_capacity={TPU_RESOURCE: str(chips_per_host)},
+                )
+            )
+        return nodes
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _pod_tpu_request(self, pod: Obj) -> float:
+        total = 0.0
+        for c in obj_util.get_path(pod, "spec", "containers", default=[]) or []:
+            limits = obj_util.get_path(c, "resources", "limits", default={}) or {}
+            total += obj_util.parse_quantity(limits.get(TPU_RESOURCE, 0))
+        return total
+
+    def _node_fits(self, node: Obj, pod: Obj) -> bool:
+        selector = obj_util.get_path(pod, "spec", "nodeSelector", default={}) or {}
+        node_labels = obj_util.labels_of(node)
+        for k, v in selector.items():
+            if node_labels.get(k) != v:
+                return False
+        want_tpu = self._pod_tpu_request(pod)
+        if want_tpu:
+            alloc = obj_util.parse_quantity(
+                obj_util.get_path(
+                    node, "status", "allocatable", TPU_RESOURCE, default=0
+                )
+            )
+            used = 0.0
+            for other in self.api.list("Pod"):
+                if (
+                    obj_util.get_path(other, "spec", "nodeName")
+                    == obj_util.name_of(node)
+                    and obj_util.get_path(other, "status", "phase") != "Succeeded"
+                ):
+                    used += self._pod_tpu_request(other)
+            if used + want_tpu > alloc:
+                return False
+        return True
+
+    def _schedule(self, pod: Obj) -> Optional[str]:
+        for node in self.api.list("Node"):
+            if self._node_fits(node, pod):
+                return obj_util.name_of(node)
+        return None
+
+    # -- pod lifecycle ------------------------------------------------------
+
+    def _make_pod(
+        self,
+        owner: Obj,
+        name: str,
+        template: Obj,
+        ordinal: int,
+        subdomain: Optional[str],
+    ) -> Obj:
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "namespace": obj_util.namespace_of(owner),
+                "labels": dict(
+                    obj_util.get_path(template, "metadata", "labels", default={})
+                    or {}
+                ),
+                "annotations": dict(
+                    obj_util.get_path(template, "metadata", "annotations", default={})
+                    or {}
+                ),
+            },
+            "spec": obj_util.deepcopy(template.get("spec", {})),
+        }
+        if subdomain:
+            pod["spec"]["hostname"] = name
+            pod["spec"]["subdomain"] = subdomain
+        obj_util.set_controller_reference(pod, owner)
+        pod["metadata"]["labels"].setdefault(
+            "statefulset.kubernetes.io/pod-name", name
+        )
+        pod["metadata"]["labels"].setdefault(
+            "apps.kubernetes.io/pod-index", str(ordinal)
+        )
+        return pod
+
+    def _sync_pod_status(self, pod: Obj) -> None:
+        """Drive Pending→Running once scheduled; mark unschedulable."""
+        phase = obj_util.get_path(pod, "status", "phase")
+        if phase in ("Succeeded", "Failed"):
+            return
+        node = obj_util.get_path(pod, "spec", "nodeName")
+        if not node:
+            target = self._schedule(pod)
+            if target is None:
+                pod.setdefault("status", {})
+                pod["status"]["phase"] = "Pending"
+                pod["status"]["conditions"] = [
+                    {
+                        "type": "PodScheduled",
+                        "status": "False",
+                        "reason": "Unschedulable",
+                        "message": f"no node fits: insufficient {TPU_RESOURCE} "
+                        "or nodeSelector mismatch",
+                    }
+                ]
+                self.api.update_status(pod)
+                self.api.emit_event(
+                    pod,
+                    "FailedScheduling",
+                    "no node matches TPU nodeSelector/capacity",
+                    event_type="Warning",
+                    component="default-scheduler",
+                )
+                return
+            pod["spec"]["nodeName"] = target
+            pod = self.api.update(pod)
+        containers = obj_util.get_path(pod, "spec", "containers", default=[]) or []
+        pod.setdefault("status", {})
+        pod["status"].update(
+            {
+                "phase": "Running",
+                "podIP": f"10.0.0.{next(self._ip_counter)}",
+                "conditions": [
+                    {"type": "PodScheduled", "status": "True"},
+                    {"type": "Initialized", "status": "True"},
+                    {"type": "ContainersReady", "status": "True"},
+                    {"type": "Ready", "status": "True"},
+                ],
+                "containerStatuses": [
+                    {
+                        "name": c.get("name", ""),
+                        "ready": True,
+                        "restartCount": 0,
+                        "state": {"running": {"startedAt": obj_util.now_rfc3339()}},
+                    }
+                    for c in containers
+                ],
+            }
+        )
+        self.api.update_status(pod)
+
+    # -- workload reconciliation --------------------------------------------
+
+    def _owned_pods(self, owner: Obj) -> list[Obj]:
+        uid = obj_util.meta(owner).get("uid")
+        return [
+            p
+            for p in self.api.list("Pod", namespace=obj_util.namespace_of(owner))
+            if any(
+                r.get("uid") == uid
+                for r in obj_util.meta(p).get("ownerReferences") or []
+            )
+        ]
+
+    def _sync_statefulset(self, sts: Obj) -> None:
+        replicas = obj_util.get_path(sts, "spec", "replicas", default=1)
+        template = obj_util.get_path(sts, "spec", "template", default={}) or {}
+        service_name = obj_util.get_path(sts, "spec", "serviceName")
+        name = obj_util.name_of(sts)
+        existing = {obj_util.name_of(p): p for p in self._owned_pods(sts)}
+        want = {f"{name}-{i}": i for i in range(replicas)}
+        for pod_name in list(existing):
+            if pod_name not in want:
+                try:
+                    self.api.delete(
+                        "Pod", pod_name, obj_util.namespace_of(sts)
+                    )
+                except NotFound:
+                    pass
+        for pod_name, ordinal in want.items():
+            if pod_name not in existing:
+                pod = self._make_pod(sts, pod_name, template, ordinal, service_name)
+                try:
+                    created = self.api.create(pod)
+                except AlreadyExists:
+                    continue
+                existing[pod_name] = created
+        ready = 0
+        for pod_name in want:
+            pod = existing.get(pod_name)
+            if pod is None:
+                continue
+            fresh = self.api.get("Pod", pod_name, obj_util.namespace_of(sts))
+            self._sync_pod_status(fresh)
+            fresh = self.api.get("Pod", pod_name, obj_util.namespace_of(sts))
+            if obj_util.get_path(fresh, "status", "phase") == "Running":
+                ready += 1
+        sts = self.api.get("StatefulSet", name, obj_util.namespace_of(sts))
+        sts.setdefault("status", {})
+        sts["status"].update(
+            {"replicas": replicas, "readyReplicas": ready, "currentReplicas": ready}
+        )
+        self.api.update_status(sts)
+
+    def _sync_deployment(self, deploy: Obj) -> None:
+        replicas = obj_util.get_path(deploy, "spec", "replicas", default=1)
+        template = obj_util.get_path(deploy, "spec", "template", default={}) or {}
+        name = obj_util.name_of(deploy)
+        existing = self._owned_pods(deploy)
+        for i, pod in enumerate(existing[replicas:]):
+            self.api.delete("Pod", obj_util.name_of(pod), obj_util.namespace_of(deploy))
+        for i in range(len(existing), replicas):
+            pod = self._make_pod(
+                deploy, f"{name}-{i}-{obj_util.meta(deploy)['uid'][:5]}", template, i, None
+            )
+            self.api.create(pod)
+        ready = 0
+        for pod in self._owned_pods(deploy):
+            fresh = self.api.get(
+                "Pod", obj_util.name_of(pod), obj_util.namespace_of(deploy)
+            )
+            self._sync_pod_status(fresh)
+            fresh = self.api.get(
+                "Pod", obj_util.name_of(pod), obj_util.namespace_of(deploy)
+            )
+            if obj_util.get_path(fresh, "status", "phase") == "Running":
+                ready += 1
+        deploy = self.api.get("Deployment", name, obj_util.namespace_of(deploy))
+        deploy.setdefault("status", {})
+        deploy["status"].update(
+            {"replicas": replicas, "readyReplicas": ready, "availableReplicas": ready}
+        )
+        self.api.update_status(deploy)
+
+    def step(self) -> None:
+        """One full sync pass over all StatefulSets and Deployments."""
+        for sts in self.api.list("StatefulSet"):
+            self._sync_statefulset(sts)
+        for deploy in self.api.list("Deployment"):
+            self._sync_deployment(deploy)

@@ -128,6 +128,7 @@ class APIServer:
         self._rv = 0
         self._watches: list[Watch] = []
         self._hooks: list[_Hook] = []
+        self._event_index: dict[tuple, str] = {}
         self._register_builtins()
 
     # -- type registry ------------------------------------------------------
@@ -316,7 +317,8 @@ class APIServer:
                 )
             if status_only:
                 merged = obj_util.deepcopy(current)
-                merged["status"] = obj.get("status", {})
+                if "status" in obj or "status" in current:
+                    merged["status"] = obj.get("status", {})
                 obj = merged
             else:
                 # keep server-owned fields
@@ -336,6 +338,22 @@ class APIServer:
                     obj["metadata"]["generation"] = (
                         current["metadata"].get("generation", 1) + 1
                     )
+            # no-op writes don't bump rv or emit events (apiserver skips
+            # the storage write when nothing changed) — this is what lets
+            # level-triggered reconcilers quiesce. Compare cheaply: both
+            # dicts shallow-copied with metadata minus resourceVersion
+            # (obj is already a private deep copy; no further copying).
+            def _cmp_view(o: Obj):
+                top = {k: v for k, v in o.items() if k != "metadata"}
+                m = {
+                    k: v
+                    for k, v in o.get("metadata", {}).items()
+                    if k != "resourceVersion"
+                }
+                return top, m
+
+            if _cmp_view(obj) == _cmp_view(current):
+                return obj_util.deepcopy(current)
             obj["metadata"]["resourceVersion"] = self._next_rv()
             self._store[kind][key] = obj
             self._notify("MODIFIED", obj)
@@ -461,8 +479,30 @@ class APIServer:
         component: str = "",
     ) -> Obj:
         """Create a v1 Event pointing at ``involved`` (the mechanism the
-        notebook controller mirrors back onto Notebook CRs)."""
+        notebook controller mirrors back onto Notebook CRs). Identical
+        repeat emissions — same involved uid/kind/name, reason, message
+        AND type — dedupe to the existing Event with no write and no
+        watch notification; this is what keeps reconcilers that
+        emit-and-watch events from feeding themselves. A recreated
+        object (new uid) or changed severity gets a fresh Event."""
         ns = involved.get("metadata", {}).get("namespace") or "default"
+        dedupe_key = (
+            ns,
+            involved.get("kind", ""),
+            obj_util.name_of(involved),
+            involved.get("metadata", {}).get("uid", ""),
+            reason,
+            message,
+            event_type,
+        )
+        with self._lock:
+            cached_name = self._event_index.get(dedupe_key)
+        if cached_name is not None:
+            try:
+                return self.get("Event", cached_name, ns)
+            except NotFound:
+                with self._lock:
+                    self._event_index.pop(dedupe_key, None)
         event = {
             "apiVersion": "v1",
             "kind": "Event",
@@ -485,4 +525,7 @@ class APIServer:
             "lastTimestamp": obj_util.now_rfc3339(),
             "count": 1,
         }
-        return self.create(event)
+        created = self.create(event)
+        with self._lock:
+            self._event_index[dedupe_key] = created["metadata"]["name"]
+        return created

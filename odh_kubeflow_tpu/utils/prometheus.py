@@ -1,0 +1,105 @@
+"""Minimal Prometheus client: counters/gauges + custom collectors with
+text exposition, served by the manager's metrics endpoint.
+
+Replaces the reference's use of prometheus/client_golang
+(notebook-controller pkg/metrics/metrics.go:13-99, profile-controller
+controllers/monitoring.go:19-75) — same metric surface, no dependency.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Optional
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Metric:
+    def __init__(self, name: str, help_: str, typ: str):
+        self.name = name
+        self.help = help_
+        self.type = typ
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Optional[dict[str, str]]):
+        return tuple(sorted((labels or {}).items()))
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} {self.type}"
+        with self._lock:
+            if not self._values:
+                yield f"{self.name} 0"
+            for key, value in sorted(self._values.items()):
+                yield f"{self.name}{_fmt_labels(dict(key))} {value}"
+
+
+class Counter(Metric):
+    def __init__(self, name: str, help_: str):
+        super().__init__(name, help_, "counter")
+
+    def inc(self, labels: Optional[dict[str, str]] = None, by: float = 1.0) -> None:
+        with self._lock:
+            key = self._key(labels)
+            self._values[key] = self._values.get(key, 0.0) + by
+
+    def value(self, labels: Optional[dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+
+class Gauge(Metric):
+    def __init__(self, name: str, help_: str):
+        super().__init__(name, help_, "gauge")
+
+    def set(self, value: float, labels: Optional[dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = value
+
+    def value(self, labels: Optional[dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list[Metric] = []
+        self._collect_fns: list[Callable[[], Iterable[str]]] = []
+        self._lock = threading.Lock()
+
+    def register(self, metric: Metric) -> Metric:
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def register_collector(self, fn: Callable[[], Iterable[str]]) -> None:
+        """A custom collector producing exposition lines at scrape time
+        (the reference uses this for the live running-notebook gauge)."""
+        with self._lock:
+            self._collect_fns.append(fn)
+
+    def counter(self, name: str, help_: str) -> Counter:
+        return self.register(Counter(name, help_))  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_: str) -> Gauge:
+        return self.register(Gauge(name, help_))  # type: ignore[return-value]
+
+    def exposition(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+            fns = list(self._collect_fns)
+        for m in metrics:
+            lines.extend(m.collect())
+        for fn in fns:
+            lines.extend(fn())
+        return "\n".join(lines) + "\n"
+
+
+default_registry = Registry()

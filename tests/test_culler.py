@@ -1,0 +1,193 @@
+"""Culler tests: kernel idleness, TPU-duty-cycle-aware activity, stop
+annotation + atomic scale-to-zero, against a real HTTP fake of the
+Jupyter API (reference tier: pkg/culler/culler_test.go, but with the
+network probe exercised for real)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from odh_kubeflow_tpu.apis import (
+    LAST_ACTIVITY_ANNOTATION,
+    STOP_ANNOTATION,
+    TPU_ACCELERATOR_ANNOTATION,
+    register_crds,
+)
+from odh_kubeflow_tpu.controllers.culler import Culler, CullerConfig, _fmt_time
+from odh_kubeflow_tpu.controllers.notebook import (
+    NotebookController,
+    NotebookControllerConfig,
+)
+from odh_kubeflow_tpu.controllers.runtime import Manager
+from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
+from odh_kubeflow_tpu.machinery.store import APIServer
+
+
+class FakeJupyter(BaseHTTPRequestHandler):
+    kernels = []
+    terminals = []
+    tpu = None
+
+    def do_GET(self):
+        body = None
+        if self.path.endswith("/api/kernels"):
+            body = type(self).kernels
+        elif self.path.endswith("/api/terminals"):
+            body = type(self).terminals
+        elif self.path.endswith("/api/tpu/activity"):
+            body = type(self).tpu
+        if body is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        data = json.dumps(body).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture
+def jupyter_server():
+    server = HTTPServer(("127.0.0.1", 0), FakeJupyter)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    FakeJupyter.kernels = []
+    FakeJupyter.terminals = []
+    FakeJupyter.tpu = None
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def make_env(base_url, now_fn, tpu=False):
+    api = APIServer()
+    register_crds(api)
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-0")
+    if tpu:
+        cluster.add_tpu_node_pool("v5e", "tpu-v5-lite-podslice", "2x2")
+    cfg = NotebookControllerConfig(enable_culling=True)
+    culler = Culler(
+        api,
+        CullerConfig(cull_idle_seconds=600, idleness_check_seconds=60),
+        base_url_fn=lambda nb: base_url,
+        now_fn=now_fn,
+    )
+    mgr = Manager(api, time_fn=now_fn)  # fake clock drives requeues too
+    NotebookController(api, cfg, culler=culler).register(mgr)
+    return api, cluster, mgr, culler
+
+
+def notebook(name="nb1", annotations=None):
+    return {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {
+            "name": name,
+            "namespace": "team-a",
+            "annotations": annotations or {},
+        },
+        "spec": {
+            "template": {"spec": {"containers": [{"name": name, "image": "img"}]}}
+        },
+    }
+
+
+def test_busy_kernel_counts_as_now_and_idle_culls(jupyter_server):
+    clock = {"t": 1_000_000.0}
+    api, cluster, mgr, culler = make_env(jupyter_server, lambda: clock["t"])
+
+    FakeJupyter.kernels = [{"execution_state": "busy", "last_activity": None}]
+    api.create(notebook())
+    mgr.drain()
+    cluster.step()
+    clock["t"] += 61  # past the check period so the probe re-runs
+    mgr.drain()
+
+    nb = api.get("Notebook", "nb1", "team-a")
+    assert nb["metadata"]["annotations"][LAST_ACTIVITY_ANNOTATION] == _fmt_time(
+        clock["t"]
+    )
+    assert STOP_ANNOTATION not in nb["metadata"]["annotations"]
+
+    # kernel goes idle with an old last_activity; clock passes threshold
+    FakeJupyter.kernels = [
+        {"execution_state": "idle", "last_activity": _fmt_time(clock["t"])}
+    ]
+    clock["t"] += 700  # > cull_idle_seconds=600, > check period
+    mgr.drain()
+    nb = api.get("Notebook", "nb1", "team-a")
+    assert STOP_ANNOTATION in nb["metadata"]["annotations"]
+    mgr.drain()
+    assert api.get("StatefulSet", "nb1", "team-a")["spec"]["replicas"] == 0
+
+
+def test_tpu_duty_cycle_blocks_culling(jupyter_server):
+    """A quiet kernel but a hot TPU (long training step) must NOT be
+    culled — the TPU-first fix for SURVEY.md §7 hard part (b)."""
+    clock = {"t": 2_000_000.0}
+    api, cluster, mgr, culler = make_env(
+        jupyter_server, lambda: clock["t"], tpu=True
+    )
+    old = _fmt_time(clock["t"] - 10_000)
+    FakeJupyter.kernels = [{"execution_state": "idle", "last_activity": old}]
+    FakeJupyter.tpu = {"duty_cycle_pct": 87.5}
+
+    from odh_kubeflow_tpu.apis import TPU_TOPOLOGY_ANNOTATION
+
+    api.create(
+        notebook(
+            name="train",
+            annotations={
+                TPU_ACCELERATOR_ANNOTATION: "tpu-v5-lite-podslice",
+                TPU_TOPOLOGY_ANNOTATION: "2x2",
+            },
+        )
+    )
+    mgr.drain()
+    cluster.step()
+    mgr.drain()
+
+    clock["t"] += 700
+    mgr.drain()
+    nb = api.get("Notebook", "train", "team-a")
+    # duty cycle refreshed last-activity to "now" each check → no cull
+    assert STOP_ANNOTATION not in nb["metadata"]["annotations"]
+
+    # training ends: duty cycle 0 and nothing else active → culled
+    FakeJupyter.tpu = {"duty_cycle_pct": 0.0}
+    clock["t"] += 700
+    mgr.drain()
+    clock["t"] += 700
+    mgr.drain()
+    nb = api.get("Notebook", "train", "team-a")
+    assert STOP_ANNOTATION in nb["metadata"]["annotations"]
+
+
+def test_unreachable_server_initializes_then_culls(jupyter_server):
+    """No activity signal at all: last-activity initializes at first
+    sight (culler.go:118-141) so a dead server can't hold its TPU slice
+    forever; it culls once the idle threshold passes."""
+    clock = {"t": 3_000_000.0}
+    api, cluster, mgr, culler = make_env(
+        "http://127.0.0.1:1", lambda: clock["t"]  # nothing listens
+    )
+    api.create(notebook())
+    mgr.drain()
+    cluster.step()
+    clock["t"] += 61
+    mgr.drain()
+    nb = api.get("Notebook", "nb1", "team-a")
+    assert LAST_ACTIVITY_ANNOTATION in nb["metadata"]["annotations"]
+    assert STOP_ANNOTATION not in nb["metadata"]["annotations"]
+    clock["t"] += 700  # past cull_idle_seconds=600
+    mgr.drain()
+    nb = api.get("Notebook", "nb1", "team-a")
+    assert STOP_ANNOTATION in nb["metadata"]["annotations"]

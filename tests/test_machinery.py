@@ -1,0 +1,293 @@
+"""API machinery tests: CRUD/watch/admission/finalizer/GC semantics,
+RBAC evaluation, and the TPU-aware kubelet simulator."""
+
+import pytest
+
+from odh_kubeflow_tpu.machinery import (
+    AlreadyExists,
+    APIServer,
+    Conflict,
+    Denied,
+    NotFound,
+)
+from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
+from odh_kubeflow_tpu.machinery.objects import parse_selector_string
+from odh_kubeflow_tpu.machinery.rbac import RBACEvaluator
+
+
+def _cm(name, ns="default", labels=None, data=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "data": data or {},
+    }
+
+
+def test_crud_roundtrip_and_conflict():
+    api = APIServer()
+    created = api.create(_cm("a", data={"k": "1"}))
+    assert created["metadata"]["uid"]
+    assert created["metadata"]["resourceVersion"]
+    with pytest.raises(AlreadyExists):
+        api.create(_cm("a"))
+
+    got = api.get("ConfigMap", "a", "default")
+    got["data"]["k"] = "2"
+    updated = api.update(got)
+    assert updated["data"]["k"] == "2"
+
+    # stale write loses
+    got["metadata"]["resourceVersion"] = created["metadata"]["resourceVersion"]
+    with pytest.raises(Conflict):
+        api.update(got)
+
+    api.delete("ConfigMap", "a", "default")
+    with pytest.raises(NotFound):
+        api.get("ConfigMap", "a", "default")
+
+
+def test_label_selector_list():
+    api = APIServer()
+    api.create(_cm("a", labels={"app": "x"}))
+    api.create(_cm("b", labels={"app": "y"}))
+    out = api.list("ConfigMap", label_selector={"matchLabels": {"app": "x"}})
+    assert [o["metadata"]["name"] for o in out] == ["a"]
+    sel = parse_selector_string("app!=x")
+    out = api.list("ConfigMap", label_selector=sel)
+    assert [o["metadata"]["name"] for o in out] == ["b"]
+
+
+def test_watch_sees_lifecycle():
+    api = APIServer()
+    api.create(_cm("a"))
+    w = api.watch("ConfigMap")
+    etype, obj = w.get(timeout=1)
+    assert (etype, obj["metadata"]["name"]) == ("ADDED", "a")
+    api.patch("ConfigMap", "a", {"data": {"k": "v"}}, "default")
+    etype, obj = w.get(timeout=1)
+    assert etype == "MODIFIED" and obj["data"] == {"k": "v"}
+    api.delete("ConfigMap", "a", "default")
+    etype, obj = w.get(timeout=1)
+    assert etype == "DELETED"
+    w.stop()
+
+
+def test_finalizers_defer_deletion():
+    api = APIServer()
+    obj = _cm("a")
+    obj["metadata"]["finalizers"] = ["example.com/cleanup"]
+    api.create(obj)
+    api.delete("ConfigMap", "a", "default")
+    pending = api.get("ConfigMap", "a", "default")
+    assert pending["metadata"]["deletionTimestamp"]
+    pending["metadata"]["finalizers"] = []
+    api.update(pending)
+    with pytest.raises(NotFound):
+        api.get("ConfigMap", "a", "default")
+
+
+def test_owner_gc_cascades():
+    api = APIServer()
+    owner = api.create(_cm("owner"))
+    child = _cm("child")
+    child["metadata"]["ownerReferences"] = [
+        {"kind": "ConfigMap", "name": "owner", "uid": owner["metadata"]["uid"]}
+    ]
+    api.create(child)
+    api.delete("ConfigMap", "owner", "default")
+    with pytest.raises(NotFound):
+        api.get("ConfigMap", "child", "default")
+
+
+def test_admission_mutating_and_denying():
+    api = APIServer()
+
+    def add_label(req):
+        obj = req.obj
+        obj["metadata"].setdefault("labels", {})["injected"] = "yes"
+        return obj
+
+    def deny_forbidden(req):
+        if req.obj["metadata"]["name"] == "forbidden":
+            raise Denied("name forbidden")
+
+    api.register_admission_hook({"ConfigMap"}, add_label, mutating=True)
+    api.register_admission_hook({"ConfigMap"}, deny_forbidden, mutating=False)
+    out = api.create(_cm("ok"))
+    assert out["metadata"]["labels"]["injected"] == "yes"
+    with pytest.raises(Denied):
+        api.create(_cm("forbidden"))
+
+
+def test_generation_bumps_only_on_spec_change():
+    api = APIServer()
+    api.register_kind("kubeflow.org/v1beta1", "Notebook", "notebooks")
+    nb = {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {"name": "n", "namespace": "default"},
+        "spec": {"template": {"spec": {"containers": []}}},
+    }
+    created = api.create(nb)
+    assert created["metadata"]["generation"] == 1
+    created["status"] = {"readyReplicas": 1}
+    after_status = api.update_status(created)
+    assert after_status["metadata"]["generation"] == 1
+    after_status["spec"]["template"]["spec"]["containers"] = [{"name": "c"}]
+    after_spec = api.update(after_status)
+    assert after_spec["metadata"]["generation"] == 2
+
+
+def test_rbac_namespaced_and_cluster():
+    api = APIServer()
+    api.create(
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": "nb-edit"},
+            "rules": [
+                {
+                    "apiGroups": ["kubeflow.org"],
+                    "resources": ["notebooks"],
+                    "verbs": ["get", "list", "create", "delete"],
+                }
+            ],
+        }
+    )
+    api.create(
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": {"name": "alice-nb", "namespace": "team-a"},
+            "subjects": [{"kind": "User", "name": "alice@example.com"}],
+            "roleRef": {"kind": "ClusterRole", "name": "nb-edit"},
+        }
+    )
+    rbac = RBACEvaluator(api)
+    assert rbac.can(
+        "alice@example.com", "create", "notebooks", "team-a", "kubeflow.org"
+    )
+    assert not rbac.can(
+        "alice@example.com", "create", "notebooks", "team-b", "kubeflow.org"
+    )
+    assert not rbac.can(
+        "bob@example.com", "create", "notebooks", "team-a", "kubeflow.org"
+    )
+    # cluster-wide grant
+    api.create(
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": "admins"},
+            "subjects": [{"kind": "Group", "name": "platform-admins"}],
+            "roleRef": {"kind": "ClusterRole", "name": "nb-edit"},
+        }
+    )
+    assert rbac.can(
+        "carol@example.com",
+        "delete",
+        "notebooks",
+        "team-b",
+        "kubeflow.org",
+        groups=["platform-admins"],
+    )
+
+
+def _sts(name, ns="default", replicas=1, tpu_limit=None, node_selector=None):
+    container = {"name": "main", "image": "img"}
+    if tpu_limit:
+        container["resources"] = {"limits": {"google.com/tpu": str(tpu_limit)}}
+    spec = {"containers": [container]}
+    if node_selector:
+        spec["nodeSelector"] = node_selector
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "replicas": replicas,
+            "serviceName": name,
+            "template": {"metadata": {"labels": {"app": name}}, "spec": spec},
+        },
+    }
+
+
+def test_kubelet_materializes_statefulset_pods():
+    api = APIServer()
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-0")
+    api.create(_sts("nb", replicas=2))
+    cluster.step()
+    pods = api.list("Pod", namespace="default")
+    assert sorted(p["metadata"]["name"] for p in pods) == ["nb-0", "nb-1"]
+    assert all(p["status"]["phase"] == "Running" for p in pods)
+    sts = api.get("StatefulSet", "nb", "default")
+    assert sts["status"]["readyReplicas"] == 2
+    # scale down
+    sts["spec"]["replicas"] = 0
+    api.update(sts)
+    cluster.step()
+    assert api.list("Pod", namespace="default") == []
+
+
+def test_kubelet_tpu_scheduling_and_capacity():
+    api = APIServer()
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-0")  # no TPUs
+    cluster.add_tpu_node_pool(
+        "v5e-pool", "tpu-v5-lite-podslice", "2x2", num_hosts=1, chips_per_host=4
+    )
+    sel = {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x2",
+    }
+    api.create(_sts("tpu-nb", replicas=1, tpu_limit=4, node_selector=sel))
+    cluster.step()
+    pod = api.get("Pod", "tpu-nb-0", "default")
+    assert pod["status"]["phase"] == "Running"
+    assert pod["spec"]["nodeName"].startswith("v5e-pool")
+
+    # second notebook asking for the same 4 chips must not fit
+    api.create(_sts("tpu-nb2", replicas=1, tpu_limit=4, node_selector=sel))
+    cluster.step()
+    pod2 = api.get("Pod", "tpu-nb2-0", "default")
+    assert pod2["status"]["phase"] == "Pending"
+    events = [
+        e
+        for e in api.list("Event", namespace="default")
+        if e["involvedObject"]["name"] == "tpu-nb2-0"
+    ]
+    assert events and events[0]["reason"] == "FailedScheduling"
+
+
+def test_noop_update_skips_write_and_event():
+    """Level-triggered quiescence depends on this: identical writes must
+    not bump resourceVersion or wake watchers (else reconcilers that
+    update status every pass livelock on their own MODIFIED events)."""
+    api = APIServer()
+    created = api.create(_cm("a", data={"k": "1"}))
+    w = api.watch("ConfigMap", send_initial=False)
+    same = api.get("ConfigMap", "a", "default")
+    out = api.update(same)
+    assert out["metadata"]["resourceVersion"] == created["metadata"]["resourceVersion"]
+    out = api.update_status(same)
+    assert out["metadata"]["resourceVersion"] == created["metadata"]["resourceVersion"]
+    assert w.get(timeout=0.05) is None
+    w.stop()
+
+
+def test_event_dedupe_by_identity_and_uid():
+    api = APIServer()
+    cm = api.create(_cm("a"))
+    e1 = api.emit_event(cm, "Bang", "it broke", event_type="Warning")
+    e2 = api.emit_event(cm, "Bang", "it broke", event_type="Warning")
+    assert e1["metadata"]["name"] == e2["metadata"]["name"]
+    # different severity → new event
+    e3 = api.emit_event(cm, "Bang", "it broke", event_type="Normal")
+    assert e3["metadata"]["name"] != e1["metadata"]["name"]
+    # recreated object (new uid) → new event
+    api.delete("ConfigMap", "a", "default")
+    cm2 = api.create(_cm("a"))
+    e4 = api.emit_event(cm2, "Bang", "it broke", event_type="Warning")
+    assert e4["metadata"]["name"] != e1["metadata"]["name"]

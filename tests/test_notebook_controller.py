@@ -1,0 +1,227 @@
+"""notebook-controller end-to-end against the embedded apiserver +
+kubelet simulator (the envtest-style tier from SURVEY.md §4, plus the
+pod materialisation envtest can't do)."""
+
+import pytest
+
+from odh_kubeflow_tpu.apis import (
+    STOP_ANNOTATION,
+    TPU_ACCELERATOR_ANNOTATION,
+    TPU_TOPOLOGY_ANNOTATION,
+    register_crds,
+)
+from odh_kubeflow_tpu.controllers.notebook import (
+    NotebookController,
+    NotebookControllerConfig,
+)
+from odh_kubeflow_tpu.controllers.runtime import Manager
+from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
+from odh_kubeflow_tpu.machinery.store import APIServer, Invalid
+from odh_kubeflow_tpu.utils.prometheus import Registry
+
+
+def make_env(use_istio=False):
+    api = APIServer()
+    register_crds(api)
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-0")
+    mgr = Manager(api)
+    registry = Registry()
+    ctrl = NotebookController(
+        api,
+        NotebookControllerConfig(use_istio=use_istio),
+        registry=registry,
+    )
+    ctrl.register(mgr)
+    return api, cluster, mgr, registry
+
+
+def notebook(name="nb1", ns="team-a", image="jupyter:latest", annotations=None):
+    return {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            "annotations": annotations or {},
+        },
+        "spec": {
+            "template": {
+                "spec": {"containers": [{"name": name, "image": image}]}
+            }
+        },
+    }
+
+
+def test_notebook_materializes_sts_service_and_status():
+    api, cluster, mgr, registry = make_env()
+    api.create(notebook())
+    mgr.drain()
+    sts = api.get("StatefulSet", "nb1", "team-a")
+    assert sts["spec"]["replicas"] == 1
+    c0 = sts["spec"]["template"]["spec"]["containers"][0]
+    assert {"name": "NB_PREFIX", "value": "/notebook/team-a/nb1"} in c0["env"]
+    assert c0["workingDir"] == "/home/jovyan"
+    assert c0["ports"][0]["containerPort"] == 8888
+    assert sts["spec"]["template"]["spec"]["securityContext"]["fsGroup"] == 100
+
+    svc = api.get("Service", "nb1", "team-a")
+    assert svc["spec"]["ports"][0] == {
+        "name": "http-nb1",
+        "port": 80,
+        "targetPort": 8888,
+        "protocol": "TCP",
+    }
+
+    cluster.step()  # kubelet creates + runs the pod
+    mgr.drain()  # status mirroring picks it up
+    nb = api.get("Notebook", "nb1", "team-a")
+    assert nb["status"]["readyReplicas"] == 1
+    assert {"type": "Ready", "status": "True"} in nb["status"]["conditions"]
+    assert "running" in nb["status"]["containerState"]
+    assert "notebook_running 1" in registry.exposition()
+
+
+def test_stop_annotation_scales_to_zero_and_restart():
+    api, cluster, mgr, _ = make_env()
+    api.create(notebook())
+    mgr.drain()
+    cluster.step()
+
+    nb = api.get("Notebook", "nb1", "team-a")
+    nb["metadata"]["annotations"][STOP_ANNOTATION] = "2026-07-29T00:00:00Z"
+    api.update(nb)
+    mgr.drain()
+    assert api.get("StatefulSet", "nb1", "team-a")["spec"]["replicas"] == 0
+    cluster.step()
+    assert api.list("Pod", namespace="team-a") == []
+
+    # restart = JWA PATCH nulling the annotation (reference patch.py:61-70)
+    api.patch(
+        "Notebook", "nb1", {"metadata": {"annotations": {STOP_ANNOTATION: None}}},
+        "team-a",
+    )
+    mgr.drain()
+    assert api.get("StatefulSet", "nb1", "team-a")["spec"]["replicas"] == 1
+
+
+def test_single_host_tpu_scheduling():
+    api, cluster, mgr, _ = make_env()
+    cluster.add_tpu_node_pool(
+        "v5e", "tpu-v5-lite-podslice", "2x2", num_hosts=1, chips_per_host=4
+    )
+    api.create(
+        notebook(
+            name="jaxnb",
+            annotations={
+                TPU_ACCELERATOR_ANNOTATION: "tpu-v5-lite-podslice",
+                TPU_TOPOLOGY_ANNOTATION: "2x2",
+            },
+        )
+    )
+    mgr.drain()
+    sts = api.get("StatefulSet", "jaxnb", "team-a")
+    pod_spec = sts["spec"]["template"]["spec"]
+    assert pod_spec["nodeSelector"] == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x2",
+    }
+    c0 = pod_spec["containers"][0]
+    assert c0["resources"]["limits"]["google.com/tpu"] == "4"
+    env = {e["name"]: e.get("value") for e in c0["env"]}
+    assert env["TPU_WORKER_ID"] == "0"
+    cluster.step()
+    pod = api.get("Pod", "jaxnb-0", "team-a")
+    assert pod["status"]["phase"] == "Running"
+    assert pod["spec"]["nodeName"].startswith("v5e")
+
+
+def test_multihost_tpu_slice_statefulset():
+    """v5p 2x2x2 = 8 chips / 4 per host = 2 hosts → replicas 2, headless
+    service, full DCN env contract on every pod."""
+    api, cluster, mgr, _ = make_env()
+    cluster.add_tpu_node_pool(
+        "v5p", "tpu-v5p-slice", "2x2x2", num_hosts=2, chips_per_host=4
+    )
+    api.create(
+        notebook(
+            name="big",
+            annotations={
+                TPU_ACCELERATOR_ANNOTATION: "tpu-v5p-slice",
+                TPU_TOPOLOGY_ANNOTATION: "2x2x2",
+            },
+        )
+    )
+    mgr.drain()
+    sts = api.get("StatefulSet", "big", "team-a")
+    assert sts["spec"]["replicas"] == 2
+    assert sts["spec"]["serviceName"] == "big-hosts"
+    headless = api.get("Service", "big-hosts", "team-a")
+    assert headless["spec"]["clusterIP"] == "None"
+
+    c0 = sts["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e for e in c0["env"]}
+    assert env["TPU_WORKER_HOSTNAMES"]["value"] == (
+        "big-0.big-hosts,big-1.big-hosts"
+    )
+    assert env["JAX_COORDINATOR_ADDRESS"]["value"] == "big-0.big-hosts:8476"
+    assert env["NUM_TPU_HOSTS"]["value"] == "2"
+    assert (
+        env["TPU_WORKER_ID"]["valueFrom"]["fieldRef"]["fieldPath"]
+        == "metadata.labels['apps.kubernetes.io/pod-index']"
+    )
+    assert c0["resources"]["limits"]["google.com/tpu"] == "4"  # per host
+
+    cluster.step()
+    pods = api.list("Pod", namespace="team-a")
+    assert sorted(p["metadata"]["name"] for p in pods) == ["big-0", "big-1"]
+    assert all(p["status"]["phase"] == "Running" for p in pods)
+    # each host pod landed on its own node (4 chips each)
+    assert len({p["spec"]["nodeName"] for p in pods}) == 2
+
+
+def test_invalid_tpu_request_surfaces_event():
+    api, cluster, mgr, _ = make_env()
+    api.create(
+        notebook(
+            name="badnb",
+            annotations={TPU_ACCELERATOR_ANNOTATION: "tpu-v99-imaginary"},
+        )
+    )
+    mgr.drain()
+    with pytest.raises(Exception):
+        api.get("StatefulSet", "badnb", "team-a")
+    events = [
+        e
+        for e in api.list("Event", namespace="team-a")
+        if e["involvedObject"]["name"] == "badnb"
+    ]
+    assert events and events[0]["reason"] == "InvalidTPURequest"
+    nb = api.get("Notebook", "badnb", "team-a")
+    assert nb["status"]["conditions"][0]["reason"] == "TPURequestInvalid"
+
+
+def test_istio_virtualservice():
+    api, cluster, mgr, _ = make_env(use_istio=True)
+    api.create(notebook())
+    mgr.drain()
+    vs = api.get("VirtualService", "notebook-team-a-nb1", "team-a")
+    http = vs["spec"]["http"][0]
+    assert http["match"][0]["uri"]["prefix"] == "/notebook/team-a/nb1/"
+    assert http["rewrite"]["uri"] == "/"
+    assert http["route"][0]["destination"]["host"] == (
+        "nb1.team-a.svc.cluster.local"
+    )
+
+
+def test_validation_rejects_empty_notebook():
+    api = APIServer()
+    register_crds(api)
+    bad = {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {"name": "x", "namespace": "default"},
+        "spec": {},
+    }
+    with pytest.raises(Invalid):
+        api.create(bad)
