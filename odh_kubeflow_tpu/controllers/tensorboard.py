@@ -1,0 +1,251 @@
+"""tensorboard-controller: Tensorboard CR → Deployment + Service + route.
+
+Reference parity (components/tensorboard-controller/controllers/
+tensorboard_controller.go): Reconcile :67-149, generateDeployment
+:159-284 (image from TENSORBOARD_IMAGE :164, gs:// secret mount
+:224-239, RWO-PVC co-scheduling affinity :199-223 + :408-451 gated by
+RWO_PVC_SCHEDULING :456-466), logspath parsing :360-390, VirtualService
+with 300s timeout :306-358.
+
+TPU-first: ``gs://`` logdirs are the *primary* path — serving XLA/TPU
+profiler traces from GCS is BASELINE config #3. The deployment sets
+the profile-plugin flag and uses workload identity (the namespace
+``default-editor`` KSA from the profile controller) instead of mounting
+a ``user-gcp-sa`` key secret; the reference's secret mount remains as a
+fallback when the annotation asks for it."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from odh_kubeflow_tpu.controllers import reconcilehelper
+from odh_kubeflow_tpu.controllers.runtime import Manager, Request, Result
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.store import APIServer, NotFound
+
+Obj = dict[str, Any]
+
+DEFAULT_IMAGE = "tensorflow/tensorflow:2.15.0"
+GCP_SA_SECRET_ANNOTATION = "tensorboards.kubeflow.org/gcp-sa-secret"
+
+
+class TensorboardController:
+    def __init__(self, api: APIServer):
+        self.api = api
+        self.image = os.environ.get("TENSORBOARD_IMAGE", DEFAULT_IMAGE)
+        self.rwo_scheduling = (
+            os.environ.get("RWO_PVC_SCHEDULING", "true").lower() == "true"
+        )
+
+    def register(self, mgr: Manager) -> None:
+        ctrl = mgr.new_controller(
+            "tensorboard-controller", "Tensorboard", self.reconcile
+        )
+        ctrl.owns("Deployment").owns("Service").owns("HTTPRoute")
+
+    def reconcile(self, req: Request) -> Result:
+        try:
+            tb = self.api.get("Tensorboard", req.name, req.namespace)
+        except NotFound:
+            return Result()
+        deployment = self.generate_deployment(tb)
+        reconcilehelper.reconcile_object(self.api, deployment, owner=tb)
+        service = self.generate_service(tb)
+        reconcilehelper.reconcile_object(self.api, service, owner=tb)
+        route = self.generate_route(tb)
+        reconcilehelper.reconcile_object(self.api, route, owner=tb)
+        self._mirror_status(tb)
+        return Result()
+
+    # -- logspath parsing (:360-390) ----------------------------------------
+
+    @staticmethod
+    def parse_logspath(path: str) -> dict[str, str]:
+        if path.startswith("pvc://"):
+            rest = path[len("pvc://") :]
+            pvc, _, sub = rest.partition("/")
+            return {"kind": "pvc", "pvc": pvc, "subpath": sub}
+        if path.startswith("gs://"):
+            return {"kind": "gcs", "path": path}
+        if path.startswith("s3://"):
+            return {"kind": "s3", "path": path}
+        return {"kind": "local", "path": path}
+
+    # -- generators ---------------------------------------------------------
+
+    def generate_deployment(self, tb: Obj) -> Obj:
+        name = obj_util.name_of(tb)
+        ns = obj_util.namespace_of(tb)
+        logspath = obj_util.get_path(tb, "spec", "logspath", default="")
+        parsed = self.parse_logspath(logspath)
+
+        container: Obj = {
+            "name": "tensorboard",
+            "image": self.image,
+            "command": ["/usr/local/bin/tensorboard"],
+            "args": [
+                f"--logdir={logspath}",
+                "--bind_all",
+                "--port=6006",
+                # XLA/TPU profiler traces (BASELINE config #3)
+                "--load_fast=false",
+            ],
+            "ports": [{"containerPort": 6006, "name": "http", "protocol": "TCP"}],
+            "resources": {
+                "requests": {"cpu": "250m", "memory": "1Gi"},
+                "limits": {"cpu": "2", "memory": "4Gi"},
+            },
+        }
+        pod_spec: Obj = {"containers": [container]}
+
+        if parsed["kind"] == "pvc":
+            container["args"][0] = "--logdir=/logs/" + parsed["subpath"]
+            container["volumeMounts"] = [{"name": "logs", "mountPath": "/logs"}]
+            pod_spec["volumes"] = [
+                {
+                    "name": "logs",
+                    "persistentVolumeClaim": {"claimName": parsed["pvc"]},
+                }
+            ]
+            if self.rwo_scheduling:
+                affinity = self._rwo_affinity(ns, parsed["pvc"])
+                if affinity:
+                    pod_spec["affinity"] = affinity
+        elif parsed["kind"] == "gcs":
+            # workload identity first; key-secret fallback by annotation
+            pod_spec["serviceAccountName"] = "default-editor"
+            secret = obj_util.annotations_of(tb).get(GCP_SA_SECRET_ANNOTATION)
+            if secret:
+                container["volumeMounts"] = [
+                    {"name": "gcp-creds", "mountPath": "/secret", "readOnly": True}
+                ]
+                container.setdefault("env", []).append(
+                    {
+                        "name": "GOOGLE_APPLICATION_CREDENTIALS",
+                        "value": "/secret/key.json",
+                    }
+                )
+                pod_spec["volumes"] = [
+                    {"name": "gcp-creds", "secret": {"secretName": secret}}
+                ]
+
+        labels = {"app": name, "tensorboard": name}
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": ns, "labels": dict(labels)},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"tensorboard": name}},
+                "template": {
+                    "metadata": {"labels": dict(labels)},
+                    "spec": pod_spec,
+                },
+            },
+        }
+
+    def _rwo_affinity(self, ns: str, pvc_name: str) -> Optional[Obj]:
+        """Co-schedule with the pod already mounting the RWO PVC
+        (:199-223,408-451): node affinity to that pod's node."""
+        try:
+            pvc = self.api.get("PersistentVolumeClaim", pvc_name, ns)
+        except NotFound:
+            return None
+        modes = obj_util.get_path(pvc, "spec", "accessModes", default=[]) or []
+        if "ReadWriteMany" in modes:
+            return None
+        for pod in self.api.list("Pod", namespace=ns):
+            node = obj_util.get_path(pod, "spec", "nodeName")
+            if not node:
+                continue
+            for vol in obj_util.get_path(pod, "spec", "volumes", default=[]) or []:
+                claim = obj_util.get_path(vol, "persistentVolumeClaim", "claimName")
+                if claim == pvc_name:
+                    return {
+                        "nodeAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution": {
+                                "nodeSelectorTerms": [
+                                    {
+                                        "matchExpressions": [
+                                            {
+                                                "key": "kubernetes.io/hostname",
+                                                "operator": "In",
+                                                "values": [node],
+                                            }
+                                        ]
+                                    }
+                                ]
+                            }
+                        }
+                    }
+        return None
+
+    def generate_service(self, tb: Obj) -> Obj:
+        name = obj_util.name_of(tb)
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": name,
+                "namespace": obj_util.namespace_of(tb),
+            },
+            "spec": {
+                "type": "ClusterIP",
+                "selector": {"tensorboard": name},
+                "ports": [
+                    {
+                        "name": "http-tb",
+                        "port": 80,
+                        "targetPort": 6006,
+                        "protocol": "TCP",
+                    }
+                ],
+            },
+        }
+
+    def generate_route(self, tb: Obj) -> Obj:
+        name = obj_util.name_of(tb)
+        ns = obj_util.namespace_of(tb)
+        return {
+            "apiVersion": "gateway.networking.k8s.io/v1",
+            "kind": "HTTPRoute",
+            "metadata": {"name": f"tensorboard-{name}", "namespace": ns},
+            "spec": {
+                "parentRefs": [{"name": "kubeflow-gateway", "namespace": "kubeflow"}],
+                "rules": [
+                    {
+                        "matches": [
+                            {
+                                "path": {
+                                    "type": "PathPrefix",
+                                    "value": f"/tensorboard/{ns}/{name}",
+                                }
+                            }
+                        ],
+                        "backendRefs": [{"name": name, "port": 80}],
+                        # long profile loads (reference VS timeout :306-358)
+                        "timeouts": {"request": "300s"},
+                    }
+                ],
+            },
+        }
+
+    def _mirror_status(self, tb: Obj) -> None:
+        try:
+            deploy = self.api.get(
+                "Deployment", obj_util.name_of(tb), obj_util.namespace_of(tb)
+            )
+        except NotFound:
+            return
+        ready = obj_util.get_path(deploy, "status", "readyReplicas", default=0)
+        tb["status"] = {
+            "readyReplicas": ready,
+            "conditions": [
+                {
+                    "type": "Available" if ready else "Progressing",
+                    "status": "True",
+                }
+            ],
+        }
+        self.api.update_status(tb)

@@ -1,0 +1,5 @@
+from odh_kubeflow_tpu.webhooks.poddefault import (  # noqa: F401
+    PodDefaultWebhook,
+    tpu_runtime_poddefault,
+)
+from odh_kubeflow_tpu.webhooks.notebook import NotebookWebhook  # noqa: F401

@@ -1,0 +1,256 @@
+"""profile-controller + kfam + tensorboard-controller tests."""
+
+import pytest
+
+from odh_kubeflow_tpu.apis import register_crds
+from odh_kubeflow_tpu.controllers.kfam import KfamService, binding_name
+from odh_kubeflow_tpu.controllers.profile import (
+    GcpWorkloadIdentityPlugin,
+    ProfileController,
+    TPU_QUOTA_KEY,
+)
+from odh_kubeflow_tpu.controllers.runtime import Manager
+from odh_kubeflow_tpu.controllers.tensorboard import TensorboardController
+from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
+from odh_kubeflow_tpu.machinery.rbac import RBACEvaluator
+from odh_kubeflow_tpu.machinery.store import APIServer, Invalid, NotFound
+
+
+def _profile(name="team-a", owner="alice@example.com", quota=None, plugins=None):
+    spec = {"owner": {"kind": "User", "name": owner}}
+    if quota:
+        spec["resourceQuotaSpec"] = {"hard": quota}
+    if plugins:
+        spec["plugins"] = plugins
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "Profile",
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def make_env(**ctrl_kw):
+    api = APIServer()
+    register_crds(api)
+    mgr = Manager(api)
+    ctrl = ProfileController(api, **ctrl_kw)
+    ctrl.register(mgr)
+    return api, mgr, ctrl
+
+
+def test_profile_materializes_tenancy():
+    api, mgr, _ = make_env()
+    api.create(_profile(quota={TPU_QUOTA_KEY: "16", "cpu": "64"}))
+    mgr.drain()
+
+    ns = api.get("Namespace", "team-a")
+    assert ns["metadata"]["annotations"]["owner"] == "alice@example.com"
+    assert ns["metadata"]["labels"]["istio-injection"] == "enabled"
+
+    api.get("ServiceAccount", "default-editor", "team-a")
+    api.get("ServiceAccount", "default-viewer", "team-a")
+    rb = api.get("RoleBinding", "namespaceAdmin", "team-a")
+    assert rb["subjects"][0]["name"] == "alice@example.com"
+
+    quota = api.get("ResourceQuota", "kf-resource-quota", "team-a")
+    assert quota["spec"]["hard"][TPU_QUOTA_KEY] == "16"
+
+    policy = api.get("AuthorizationPolicy", "ns-owner-access-istio", "team-a")
+    assert policy["spec"]["rules"][0]["when"][0]["values"] == [
+        "alice@example.com"
+    ]
+
+    # owner can create notebooks via RBAC (kubeflow-admin ClusterRole)
+    api.create(
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": "kubeflow-admin"},
+            "rules": [
+                {"apiGroups": ["*"], "resources": ["*"], "verbs": ["*"]}
+            ],
+        }
+    )
+    assert RBACEvaluator(api).can(
+        "alice@example.com", "create", "notebooks", "team-a", "kubeflow.org"
+    )
+
+
+def test_profile_quota_removed_when_unset():
+    api, mgr, _ = make_env()
+    api.create(_profile(quota={TPU_QUOTA_KEY: "8"}))
+    mgr.drain()
+    api.get("ResourceQuota", "kf-resource-quota", "team-a")
+    profile = api.get("Profile", "team-a")
+    del profile["spec"]["resourceQuotaSpec"]
+    api.update(profile)
+    mgr.drain()
+    with pytest.raises(NotFound):
+        api.get("ResourceQuota", "kf-resource-quota", "team-a")
+
+
+def test_profile_finalizer_revokes_plugins():
+    calls = []
+    plugin = GcpWorkloadIdentityPlugin(
+        iam_client=lambda sa, member, action: calls.append((sa, member, action))
+    )
+    api, mgr, _ = make_env(plugins={"WorkloadIdentity": plugin})
+    api.create(
+        _profile(
+            plugins=[
+                {
+                    "kind": "WorkloadIdentity",
+                    "spec": {"gcpServiceAccount": "ml@proj.iam.gserviceaccount.com"},
+                }
+            ]
+        )
+    )
+    mgr.drain()
+    assert ("ml@proj.iam.gserviceaccount.com",
+            "serviceAccount:team-a.svc.id.goog[team-a/default-editor]",
+            "add") in calls
+    sa = api.get("ServiceAccount", "default-editor", "team-a")
+    assert (
+        sa["metadata"]["annotations"]["iam.gke.io/gcp-service-account"]
+        == "ml@proj.iam.gserviceaccount.com"
+    )
+
+    api.delete("Profile", "team-a")
+    mgr.drain()
+    assert calls[-1][2] == "remove"
+    with pytest.raises(NotFound):
+        api.get("Profile", "team-a")
+
+
+def test_profile_does_not_capture_foreign_namespace():
+    api, mgr, _ = make_env()
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Namespace",
+            "metadata": {"name": "team-a", "annotations": {"owner": "someone@else"}},
+        }
+    )
+    api.create(_profile())
+    mgr.drain()
+    ns = api.get("Namespace", "team-a")
+    # unchanged ownership; no SAs materialized
+    assert ns["metadata"]["annotations"]["owner"] == "someone@else"
+    with pytest.raises(NotFound):
+        api.get("ServiceAccount", "default-editor", "team-a")
+
+
+def test_kfam_bindings_flow():
+    api, mgr, _ = make_env()
+    api.create(_profile())
+    mgr.drain()
+    kfam = KfamService(api, cluster_admins={"root@example.com"})
+
+    binding = {
+        "user": {"kind": "User", "name": "bob@example.com"},
+        "referredNamespace": "team-a",
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "ClusterRole",
+            "name": "kubeflow-edit",
+        },
+    }
+    # non-owner cannot share
+    with pytest.raises(Invalid):
+        kfam.create_binding(binding, requester="mallory@example.com")
+    kfam.create_binding(binding, requester="alice@example.com")
+
+    rb = api.get(
+        "RoleBinding", binding_name("bob@example.com", "edit"), "team-a"
+    )
+    assert rb["roleRef"]["name"] == "kubeflow-edit"
+    api.get(
+        "AuthorizationPolicy", binding_name("bob@example.com", "edit"), "team-a"
+    )
+
+    listed = kfam.list_bindings(namespace="team-a")
+    assert any(b["user"]["name"] == "bob@example.com" for b in listed)
+    assert kfam.namespaces_for_user("bob@example.com") == ["team-a"]
+    assert kfam.namespaces_for_user("alice@example.com") == ["team-a"]
+
+    kfam.delete_binding(binding, requester="root@example.com")
+    with pytest.raises(NotFound):
+        api.get("RoleBinding", binding_name("bob@example.com", "edit"), "team-a")
+
+
+def _tensorboard(name="tb1", ns="team-a", logspath="gs://bucket/xla-traces"):
+    return {
+        "apiVersion": "tensorboard.kubeflow.org/v1alpha1",
+        "kind": "Tensorboard",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"logspath": logspath},
+    }
+
+
+def test_tensorboard_gcs_traces():
+    api = APIServer()
+    register_crds(api)
+    mgr = Manager(api)
+    TensorboardController(api).register(mgr)
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-0")
+    api.create(_tensorboard())
+    mgr.drain()
+    deploy = api.get("Deployment", "tb1", "team-a")
+    c0 = deploy["spec"]["template"]["spec"]["containers"][0]
+    assert "--logdir=gs://bucket/xla-traces" in c0["args"]
+    assert deploy["spec"]["template"]["spec"]["serviceAccountName"] == (
+        "default-editor"
+    )
+    route = api.get("HTTPRoute", "tensorboard-tb1", "team-a")
+    assert route["spec"]["rules"][0]["timeouts"]["request"] == "300s"
+    cluster.step()
+    mgr.drain()
+    tb = api.get("Tensorboard", "tb1", "team-a")
+    assert tb["status"]["readyReplicas"] == 1
+
+
+def test_tensorboard_rwo_pvc_coscheduling():
+    api = APIServer()
+    register_crds(api)
+    mgr = Manager(api)
+    TensorboardController(api).register(mgr)
+    cluster = FakeCluster(api)
+    cluster.add_node("node-a")
+    cluster.add_node("node-b")
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": {"name": "train-logs", "namespace": "team-a"},
+            "spec": {"accessModes": ["ReadWriteOnce"]},
+        }
+    )
+    # a pod already mounts the PVC on node-a
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "writer", "namespace": "team-a"},
+            "spec": {
+                "nodeName": "node-a",
+                "containers": [{"name": "w", "image": "img"}],
+                "volumes": [
+                    {
+                        "name": "v",
+                        "persistentVolumeClaim": {"claimName": "train-logs"},
+                    }
+                ],
+            },
+        }
+    )
+    api.create(_tensorboard(name="tb2", logspath="pvc://train-logs/run1"))
+    mgr.drain()
+    deploy = api.get("Deployment", "tb2", "team-a")
+    spec = deploy["spec"]["template"]["spec"]
+    terms = spec["affinity"]["nodeAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ]["nodeSelectorTerms"]
+    assert terms[0]["matchExpressions"][0]["values"] == ["node-a"]
+    assert spec["containers"][0]["args"][0] == "--logdir=/logs/run1"

@@ -1,0 +1,245 @@
+"""PodDefault merge engine + notebook webhook + exposure controller
+(reference tiers: admission-webhook/main_test.go merge semantics, odh
+suite_test.go webhook-in-envtest wiring)."""
+
+import pytest
+
+from odh_kubeflow_tpu.apis import register_crds, STOP_ANNOTATION
+from odh_kubeflow_tpu.controllers.exposure import ExposureController
+from odh_kubeflow_tpu.controllers.notebook import (
+    NotebookController,
+    NotebookControllerConfig,
+)
+from odh_kubeflow_tpu.controllers.runtime import Manager
+from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
+from odh_kubeflow_tpu.machinery.store import APIServer, Denied
+from odh_kubeflow_tpu.webhooks.notebook import (
+    INJECT_AUTH_ANNOTATION,
+    LOCK_VALUE,
+    NotebookWebhook,
+)
+from odh_kubeflow_tpu.webhooks.poddefault import (
+    PodDefaultWebhook,
+    tpu_runtime_poddefault,
+)
+
+
+def _pod(name="p", ns="team-a", labels=None, containers=None, annotations=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            "labels": labels or {},
+            "annotations": annotations or {},
+        },
+        "spec": {
+            "containers": containers
+            or [{"name": "main", "image": "img", "env": []}]
+        },
+    }
+
+
+def _poddefault(name, ns="team-a", selector=None, **spec):
+    return {
+        "apiVersion": "kubeflow.org/v1alpha1",
+        "kind": "PodDefault",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "selector": selector or {"matchLabels": {"grp": "x"}},
+            **spec,
+        },
+    }
+
+
+@pytest.fixture
+def api():
+    api = APIServer()
+    register_crds(api)
+    PodDefaultWebhook(api).register()
+    return api
+
+
+def test_poddefault_env_volume_merge(api):
+    api.create(
+        _poddefault(
+            "defaults",
+            env=[{"name": "FOO", "value": "bar"}],
+            volumes=[{"name": "data", "emptyDir": {}}],
+            volumeMounts=[{"name": "data", "mountPath": "/data"}],
+        )
+    )
+    created = api.create(_pod(labels={"grp": "x"}))
+    c0 = created["spec"]["containers"][0]
+    assert {"name": "FOO", "value": "bar"} in c0["env"]
+    assert {"name": "data", "mountPath": "/data"} in c0["volumeMounts"]
+    assert any(v["name"] == "data" for v in created["spec"]["volumes"])
+    assert (
+        created["metadata"]["annotations"][
+            "poddefaults.admission.kubeflow.org/poddefault-defaults"
+        ]
+        == "defaults"
+    )
+    # non-matching pod untouched
+    other = api.create(_pod(name="q"))
+    assert other["spec"]["containers"][0]["env"] == []
+
+
+def test_poddefault_conflict_rejects(api):
+    api.create(_poddefault("defaults", env=[{"name": "FOO", "value": "bar"}]))
+    pod = _pod(
+        labels={"grp": "x"},
+        containers=[
+            {"name": "main", "image": "img", "env": [{"name": "FOO", "value": "other"}]}
+        ],
+    )
+    with pytest.raises(Denied):
+        api.create(pod)
+
+
+def test_poddefault_exclusion_and_istio_skip(api):
+    api.create(_poddefault("defaults", env=[{"name": "FOO", "value": "bar"}]))
+    excluded = api.create(
+        _pod(
+            labels={"grp": "x"},
+            annotations={"poddefaults.admission.kubeflow.org/exclude": "true"},
+        )
+    )
+    assert excluded["spec"]["containers"][0]["env"] == []
+    mesh_pod = api.create(
+        _pod(
+            name="meshed",
+            labels={"grp": "x"},
+            containers=[
+                {"name": "main", "image": "img"},
+                {"name": "istio-proxy", "image": "proxy"},
+            ],
+        )
+    )
+    by_name = {c["name"]: c for c in mesh_pod["spec"]["containers"]}
+    assert {"name": "FOO", "value": "bar"} in by_name["main"]["env"]
+    assert "env" not in by_name["istio-proxy"]
+
+
+def test_poddefault_command_only_if_unset(api):
+    api.create(_poddefault("defaults", command=["run.sh"], args=["--x"]))
+    pod = api.create(_pod(labels={"grp": "x"}))
+    assert pod["spec"]["containers"][0]["command"] == ["run.sh"]
+    pod2 = api.create(
+        _pod(
+            name="has-cmd",
+            labels={"grp": "x"},
+            containers=[{"name": "main", "image": "img", "command": ["own"]}],
+        )
+    )
+    assert pod2["spec"]["containers"][0]["command"] == ["own"]
+
+
+def test_tpu_runtime_poddefault_injects_libtpu_env(api):
+    api.create(tpu_runtime_poddefault("team-a"))
+    pod = api.create(_pod(labels={"tpu-runtime": "enabled"}))
+    c0 = pod["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in c0["env"]}
+    assert env["JAX_PLATFORMS"] == "tpu,cpu"
+    assert env["JAX_COORDINATOR_PORT"] == "8476"
+    assert "latency_hiding_scheduler" in env["XLA_FLAGS"]
+    assert {"name": "dshm", "mountPath": "/dev/shm"} in c0["volumeMounts"]
+
+
+def _notebook(name="nb1", ns="team-a", annotations=None):
+    return {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns, "annotations": annotations or {}},
+        "spec": {
+            "template": {"spec": {"containers": [{"name": name, "image": "img"}]}}
+        },
+    }
+
+
+def test_notebook_auth_lock_dance():
+    """create (webhook locks, injects sidecar) → exposure controller
+    materialises auth objects → lock released → STS scales up. The
+    webhook-ordering race solved end-to-end (SURVEY.md §7 (c))."""
+    api = APIServer()
+    register_crds(api)
+    NotebookWebhook(api).register()
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-0")
+    mgr = Manager(api)
+    NotebookController(api, NotebookControllerConfig()).register(mgr)
+    ExposureController(api).register(mgr)
+
+    created = api.create(
+        _notebook(annotations={INJECT_AUTH_ANNOTATION: "true"})
+    )
+    # webhook ran in-process: lock + sidecar present immediately
+    assert created["metadata"]["annotations"][STOP_ANNOTATION] == LOCK_VALUE
+    names = [
+        c["name"] for c in created["spec"]["template"]["spec"]["containers"]
+    ]
+    assert names == ["nb1", "auth-proxy"]
+
+    mgr.drain()
+    # lock released once SA + secrets exist
+    nb = api.get("Notebook", "nb1", "team-a")
+    assert STOP_ANNOTATION not in nb["metadata"]["annotations"]
+    api.get("ServiceAccount", "nb1", "team-a")
+    api.get("Secret", "nb1-cookie-secret", "team-a")
+    api.get("Secret", "nb1-tls", "team-a")
+    sts = api.get("StatefulSet", "nb1", "team-a")
+    assert sts["spec"]["replicas"] == 1
+    route = api.get("HTTPRoute", "nb1", "team-a")
+    assert route["spec"]["rules"][0]["backendRefs"][0] == {
+        "name": "nb1-tls",
+        "port": 8443,
+    }
+    nps = api.list("NetworkPolicy", namespace="team-a")
+    assert {n["metadata"]["name"] for n in nps} == {"nb1-ctrl-np", "nb1-auth-np"}
+
+
+def test_notebook_without_auth_gets_plain_route_no_lock():
+    api = APIServer()
+    register_crds(api)
+    NotebookWebhook(api).register()
+    mgr = Manager(api)
+    NotebookController(api, NotebookControllerConfig()).register(mgr)
+    ExposureController(api).register(mgr)
+    created = api.create(_notebook(name="plain"))
+    assert STOP_ANNOTATION not in created["metadata"]["annotations"]
+    mgr.drain()
+    route = api.get("HTTPRoute", "plain", "team-a")
+    assert route["spec"]["rules"][0]["backendRefs"][0] == {
+        "name": "plain",
+        "port": 80,
+    }
+    assert api.get("StatefulSet", "plain", "team-a")["spec"]["replicas"] == 1
+
+
+def test_cluster_proxy_env_injection():
+    api = APIServer()
+    register_crds(api)
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {
+                "name": "cluster-proxy-config",
+                "namespace": "kube-system",
+            },
+            "data": {
+                "httpProxy": "http://proxy:3128",
+                "httpsProxy": "http://proxy:3128",
+                "noProxy": ".cluster.local,.svc",
+            },
+        }
+    )
+    NotebookWebhook(api).register()
+    created = api.create(_notebook(name="proxied"))
+    env = {
+        e["name"]: e["value"]
+        for e in created["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["HTTP_PROXY"] == "http://proxy:3128"
+    assert env["NO_PROXY"] == ".cluster.local,.svc"
