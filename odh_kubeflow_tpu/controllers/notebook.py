@@ -359,8 +359,6 @@ class NotebookController:
         else:
             set_env({"name": "TPU_WORKER_ID", "value": "0"})
             set_env({"name": "TPU_WORKER_HOSTNAMES", "value": "localhost"})
-        set_env({"name": "TPU_CHIPS_PER_HOST_BOUNDS", "value": ""})
-        set_env({"name": "JAX_PLATFORMS", "value": ""})
 
     def generate_service(self, notebook: Obj) -> Obj:
         name = obj_util.name_of(notebook)

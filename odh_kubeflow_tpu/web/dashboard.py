@@ -1,0 +1,198 @@
+"""Central dashboard BFF.
+
+Reference parity (components/centraldashboard/app/): workgroup API
+api_workgroup.ts:254-340 (/exists, /env-info, registration flow,
+contributor management), user-header middleware
+attach_user_middleware.ts, pluggable metrics service
+metrics_service.ts (here: prometheus registry snapshot + TPU
+utilization panel feed)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from odh_kubeflow_tpu.controllers.kfam import KfamService
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.store import APIServer, AlreadyExists
+from odh_kubeflow_tpu.utils import prometheus
+from odh_kubeflow_tpu.web.crud_backend import failure, success, user_of
+from odh_kubeflow_tpu.web.microweb import App, Response, install_csrf
+
+Obj = dict[str, Any]
+
+
+class DashboardApp:
+    def __init__(
+        self,
+        api: APIServer,
+        kfam: Optional[KfamService] = None,
+        static_dir: Optional[str] = None,
+        registry: Optional[prometheus.Registry] = None,
+    ):
+        self.api = api
+        self.kfam = kfam or KfamService(api)
+        self.registry = registry or prometheus.default_registry
+        self.app = App("centraldashboard", static_dir=static_dir)
+        install_csrf(self.app)
+        self._register_routes()
+
+    def _register_routes(self) -> None:
+        app = self.app
+
+        @app.route("/api/workgroup/exists")
+        def exists(request):
+            user = user_of(request)
+            namespaces = self.kfam.namespaces_for_user(user)
+            return success(
+                {
+                    "hasAuth": True,
+                    "user": user,
+                    "hasWorkgroup": bool(namespaces),
+                    "registrationFlowAllowed": True,
+                }
+            )
+
+        @app.route("/api/workgroup/env-info")
+        def env_info(request):
+            user = user_of(request)
+            namespaces = self.kfam.namespaces_for_user(user)
+            return success(
+                {
+                    "user": user,
+                    "isClusterAdmin": self.kfam.is_cluster_admin(user),
+                    "namespaces": [
+                        {"namespace": ns, "role": "owner"} for ns in namespaces
+                    ],
+                    "platform": {
+                        "kubeflowVersion": "tpu-native-0.1.0",
+                        "provider": "gke-tpu",
+                    },
+                }
+            )
+
+        @app.route("/api/workgroup/create", methods=["POST"])
+        def register(request):
+            """First-login registration: create the user's Profile
+            (api_workgroup.ts registration flow)."""
+            user = user_of(request)
+            body = request.json or {}
+            namespace = body.get("namespace", "")
+            if not namespace:
+                return failure("namespace required", 400)
+            profile = {
+                "apiVersion": "kubeflow.org/v1",
+                "kind": "Profile",
+                "metadata": {"name": namespace},
+                "spec": {"owner": {"kind": "User", "name": user}},
+            }
+            try:
+                self.api.create(profile)
+            except AlreadyExists:
+                return failure(f"profile {namespace} already exists", 409)
+            return success(status=201)
+
+        @app.route("/api/workgroup/add-contributor/<namespace>", methods=["POST"])
+        def add_contributor(request, namespace):
+            user = user_of(request)
+            body = request.json or {}
+            binding = {
+                "user": {"kind": "User", "name": body.get("contributor", "")},
+                "referredNamespace": namespace,
+                "roleRef": {
+                    "apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "ClusterRole",
+                    "name": "kubeflow-edit",
+                },
+            }
+            self.kfam.create_binding(binding, requester=user)
+            return success(status=201)
+
+        @app.route(
+            "/api/workgroup/remove-contributor/<namespace>", methods=["DELETE"]
+        )
+        def remove_contributor(request, namespace):
+            user = user_of(request)
+            body = request.json or {}
+            binding = {
+                "user": {"kind": "User", "name": body.get("contributor", "")},
+                "referredNamespace": namespace,
+                "roleRef": {
+                    "apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "ClusterRole",
+                    "name": "kubeflow-edit",
+                },
+            }
+            self.kfam.delete_binding(binding, requester=user)
+            return success()
+
+        @app.route("/api/workgroup/get-all-namespaces")
+        def all_namespaces(request):
+            user = user_of(request)
+            if not self.kfam.is_cluster_admin(user):
+                return failure("cluster admin only", 403)
+            out = []
+            for profile in self.api.list("Profile"):
+                out.append(
+                    [
+                        obj_util.name_of(profile),
+                        obj_util.get_path(
+                            profile, "spec", "owner", "name", default=""
+                        ),
+                    ]
+                )
+            return success({"namespaces": out})
+
+        @app.route("/api/metrics")
+        def metrics_panel(request):
+            """Cluster metrics panels (metrics_service.ts analog): TPU
+            chip capacity/usage per accelerator type + notebook counts."""
+            user_of(request)
+            capacity: dict[str, float] = {}
+            used: dict[str, float] = {}
+            for node in self.api.list("Node"):
+                labels = obj_util.labels_of(node)
+                accel = labels.get("cloud.google.com/gke-tpu-accelerator")
+                if not accel:
+                    continue
+                cap = obj_util.parse_quantity(
+                    obj_util.get_path(
+                        node, "status", "capacity", "google.com/tpu", default=0
+                    )
+                )
+                capacity[accel] = capacity.get(accel, 0) + cap
+            for pod in self.api.list("Pod"):
+                if obj_util.get_path(pod, "status", "phase") != "Running":
+                    continue
+                sel = obj_util.get_path(
+                    pod, "spec", "nodeSelector", default={}
+                ) or {}
+                accel = sel.get("cloud.google.com/gke-tpu-accelerator")
+                if not accel:
+                    continue
+                for c in obj_util.get_path(
+                    pod, "spec", "containers", default=[]
+                ) or []:
+                    used[accel] = used.get(accel, 0) + obj_util.parse_quantity(
+                        obj_util.get_path(
+                            c, "resources", "limits", "google.com/tpu", default=0
+                        )
+                    )
+            return success(
+                {
+                    "tpu": [
+                        {
+                            "accelerator": accel,
+                            "capacityChips": cap,
+                            "usedChips": used.get(accel, 0),
+                        }
+                        for accel, cap in sorted(capacity.items())
+                    ],
+                    "notebooks": len(self.api.list("Notebook")),
+                }
+            )
+
+        @app.route("/prometheus/metrics")
+        def prom(request):
+            return Response(
+                self.registry.exposition(), content_type="text/plain"
+            )

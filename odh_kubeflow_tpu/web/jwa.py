@@ -1,0 +1,473 @@
+"""Jupyter web app (JWA) backend: the notebook spawner.
+
+Reference parity (crud-web-apps/jupyter/backend/apps/): POST flow
+(dry-run → PVCs → Notebook) default/routes/post.py:14-73, form
+resolution common/form.py:17-252 (readOnly defaults, cpu/mem
+limitFactor, tolerationGroup, affinityConfig, configurations,
+shm), GET routes common/routes/get.py:9-73, PATCH stop/start
+patch.py:18-75, status derivation common/status.py:10-59 (+ error-event
+mining), list-row shaping common/utils.py:56-140, live-reloaded admin
+config (utils.py:22-53; spawner_ui_config.yaml).
+
+TPU-first: the ``gpus:`` vendor block becomes ``tpus:`` — accelerator
+type + topology dropdowns (spawner_ui_config.yaml:111-123 analog);
+``GET /api/tpus`` intersects config types with live node capacity the
+way the reference's /api/gpus does (get.py:52-73); a TPU selection sets
+the scheduling annotations the notebook controller consumes plus the
+``tpu-runtime`` opt-in label for the PodDefault webhook."""
+
+from __future__ import annotations
+
+import copy
+import os
+from typing import Any, Optional
+
+import yaml
+
+from odh_kubeflow_tpu.apis import (
+    STOP_ANNOTATION,
+    TPU_ACCEL_NODE_LABEL,
+    TPU_ACCELERATOR_ANNOTATION,
+    TPU_RESOURCE,
+    TPU_TOPOLOGY_ANNOTATION,
+)
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.store import APIServer, NotFound
+from odh_kubeflow_tpu.utils.tpu import TPU_TOPOLOGIES
+from odh_kubeflow_tpu.web.crud_backend import CrudBackend, failure, success
+from odh_kubeflow_tpu.web.microweb import HTTPError, Request
+
+Obj = dict[str, Any]
+
+DEFAULT_CONFIG: Obj = {
+    "spawnerFormDefaults": {
+        "image": {
+            "value": "kubeflownotebookswg/jupyter-scipy:v1.7.0",
+            "options": [
+                "kubeflownotebookswg/jupyter-scipy:v1.7.0",
+                "odh-kubeflow-tpu/jupyter-jax-tpu:v0.1.0",
+                "odh-kubeflow-tpu/jupyter-pytorch-xla:v0.1.0",
+            ],
+        },
+        "imageGroupOne": {
+            "value": "odh-kubeflow-tpu/codeserver:v0.1.0",
+            "options": ["odh-kubeflow-tpu/codeserver:v0.1.0"],
+        },
+        "imageGroupTwo": {
+            "value": "odh-kubeflow-tpu/rstudio:v0.1.0",
+            "options": ["odh-kubeflow-tpu/rstudio:v0.1.0"],
+        },
+        "cpu": {"value": "0.5", "limitFactor": "1.2", "readOnly": False},
+        "memory": {"value": "1Gi", "limitFactor": "1.2", "readOnly": False},
+        "workspaceVolume": {
+            "value": {
+                "mount": "/home/jovyan",
+                "newPvc": {
+                    "metadata": {"name": "{notebook-name}-workspace"},
+                    "spec": {
+                        "resources": {"requests": {"storage": "10Gi"}},
+                        "accessModes": ["ReadWriteOnce"],
+                    },
+                },
+            },
+            "readOnly": False,
+        },
+        "dataVolumes": {"value": [], "readOnly": False},
+        # the reference's `gpus:` vendor block, TPU-native
+        "tpus": {
+            "value": {"accelerator": "none", "topology": ""},
+            "accelerators": [
+                {
+                    "type": "tpu-v5-lite-podslice",
+                    "displayName": "TPU v5e",
+                    "topologies": ["1x1", "2x2", "2x4", "4x4", "4x8"],
+                },
+                {
+                    "type": "tpu-v5p-slice",
+                    "displayName": "TPU v5p",
+                    "topologies": ["2x2x1", "2x2x2", "2x4x4", "4x4x4"],
+                },
+                {
+                    "type": "tpu-v6e-slice",
+                    "displayName": "TPU v6e (Trillium)",
+                    "topologies": ["1x1", "2x2", "2x4", "4x4", "4x8", "8x8"],
+                },
+            ],
+            "readOnly": False,
+        },
+        "tolerationGroup": {"value": "", "options": [], "readOnly": False},
+        "affinityConfig": {"value": "", "options": [], "readOnly": False},
+        "configurations": {"value": [], "readOnly": False},
+        "shm": {"value": True, "readOnly": False},
+    }
+}
+
+
+class JupyterWebApp(CrudBackend):
+    def __init__(
+        self,
+        api: APIServer,
+        config_path: Optional[str] = None,
+        static_dir: Optional[str] = None,
+    ):
+        super().__init__(api, "jupyter-web-app", static_dir=static_dir)
+        self.config_path = config_path
+        self._config_mtime: Optional[float] = None
+        self._config = copy.deepcopy(DEFAULT_CONFIG)
+        self._register_routes()
+
+    # -- config (live reload per request, utils.py:22-53) --------------------
+
+    def config(self) -> Obj:
+        if self.config_path:
+            try:
+                mtime = os.path.getmtime(self.config_path)
+                if mtime != self._config_mtime:
+                    with open(self.config_path) as f:
+                        self._config = yaml.safe_load(f)
+                    self._config_mtime = mtime
+            except OSError:
+                pass
+        return self._config
+
+    def form_defaults(self) -> Obj:
+        return self.config().get("spawnerFormDefaults", {})
+
+    # -- routes --------------------------------------------------------------
+
+    def _register_routes(self) -> None:
+        app = self.app
+
+        @app.route("/api/config")
+        def get_config(request):
+            self.authorize(request, "list", "notebooks", None, "kubeflow.org")
+            return success({"config": self.form_defaults()})
+
+        @app.route("/api/tpus")
+        def get_tpus(request):
+            self.authorize(request, "list", "nodes")
+            return success({"tpus": self.available_tpus()})
+
+        @app.route("/api/namespaces/<namespace>/notebooks")
+        def list_notebooks(request, namespace):
+            self.authorize(request, "list", "notebooks", namespace, "kubeflow.org")
+            notebooks = [
+                self.notebook_row(nb)
+                for nb in self.api.list("Notebook", namespace=namespace)
+            ]
+            return success({"notebooks": notebooks})
+
+        @app.route("/api/namespaces/<namespace>/notebooks", methods=["POST"])
+        def post_notebook(request, namespace):
+            user = self.authorize(
+                request, "create", "notebooks", namespace, "kubeflow.org"
+            )
+            body = request.json or {}
+            return self.create_notebook(namespace, body, user)
+
+        @app.route(
+            "/api/namespaces/<namespace>/notebooks/<name>", methods=["GET"]
+        )
+        def get_notebook(request, namespace, name):
+            self.authorize(request, "get", "notebooks", namespace, "kubeflow.org")
+            nb = self.api.get("Notebook", name, namespace)
+            return success({"notebook": nb})
+
+        @app.route(
+            "/api/namespaces/<namespace>/notebooks/<name>", methods=["PATCH"]
+        )
+        def patch_notebook(request, namespace, name):
+            self.authorize(
+                request, "update", "notebooks", namespace, "kubeflow.org"
+            )
+            body = request.json or {}
+            stopped = body.get("stopped")
+            if stopped is None:
+                return failure("body must set 'stopped': true|false", 400)
+            patch = {
+                "metadata": {
+                    "annotations": {
+                        STOP_ANNOTATION: (
+                            obj_util.now_rfc3339() if stopped else None
+                        )
+                    }
+                }
+            }
+            self.api.patch("Notebook", name, patch, namespace)
+            return success()
+
+        @app.route(
+            "/api/namespaces/<namespace>/notebooks/<name>", methods=["DELETE"]
+        )
+        def delete_notebook(request, namespace, name):
+            self.authorize(
+                request, "delete", "notebooks", namespace, "kubeflow.org"
+            )
+            self.api.delete("Notebook", name, namespace)
+            return success()
+
+        @app.route("/api/namespaces/<namespace>/pvcs")
+        def list_pvcs(request, namespace):
+            self.authorize(request, "list", "persistentvolumeclaims", namespace)
+            pvcs = self.api.list("PersistentVolumeClaim", namespace=namespace)
+            return success({"pvcs": pvcs})
+
+        @app.route("/api/namespaces/<namespace>/poddefaults")
+        def list_poddefaults(request, namespace):
+            self.authorize(
+                request, "list", "poddefaults", namespace, "kubeflow.org"
+            )
+            pds = [
+                {
+                    "label": obj_util.name_of(pd),
+                    "desc": (pd.get("spec") or {}).get(
+                        "desc", obj_util.name_of(pd)
+                    ),
+                    "selector": (pd.get("spec") or {}).get("selector", {}),
+                }
+                for pd in self.api.list("PodDefault", namespace=namespace)
+            ]
+            return success({"poddefaults": pds})
+
+    # -- TPU inventory -------------------------------------------------------
+
+    def available_tpus(self) -> list[Obj]:
+        """config accelerators ∩ cluster node capacity (get.py:52-73)."""
+        present: dict[str, set[str]] = {}
+        for node in self.api.list("Node"):
+            labels = obj_util.labels_of(node)
+            accel = labels.get(TPU_ACCEL_NODE_LABEL)
+            capacity = obj_util.get_path(
+                node, "status", "capacity", TPU_RESOURCE, default=None
+            )
+            if accel and capacity:
+                topo = labels.get("cloud.google.com/gke-tpu-topology", "")
+                present.setdefault(accel, set()).add(topo)
+        out = []
+        for accel_cfg in self.form_defaults().get("tpus", {}).get(
+            "accelerators", []
+        ):
+            atype = accel_cfg.get("type", "")
+            if atype in present:
+                out.append(
+                    {
+                        "type": atype,
+                        "displayName": accel_cfg.get("displayName", atype),
+                        "topologies": [
+                            t
+                            for t in accel_cfg.get("topologies", [])
+                            if t in present[atype] or not present[atype]
+                        ],
+                    }
+                )
+        return out
+
+    # -- form → Notebook (form.py:17-252) ------------------------------------
+
+    def _resolve(self, body: Obj, field: str):
+        """readOnly fields always take the admin default (form.py:17-60)."""
+        defaults = self.form_defaults()
+        cfg = defaults.get(field, {})
+        if cfg.get("readOnly"):
+            return cfg.get("value")
+        if field in body:
+            return body[field]
+        return cfg.get("value")
+
+    def create_notebook(self, namespace: str, body: Obj, user: str):
+        name = body.get("name", "")
+        if not name:
+            return failure("notebook name is required", 400)
+
+        image = self._resolve(body, "image")
+        cpu = str(self._resolve(body, "cpu"))
+        memory = str(self._resolve(body, "memory"))
+        defaults = self.form_defaults()
+        cpu_limit = _apply_limit_factor(cpu, defaults.get("cpu", {}))
+        mem_limit = _apply_limit_factor(memory, defaults.get("memory", {}))
+
+        container: Obj = {
+            "name": name,
+            "image": image,
+            "resources": {
+                "requests": {"cpu": cpu, "memory": memory},
+                "limits": {"cpu": cpu_limit, "memory": mem_limit},
+            },
+            "volumeMounts": [],
+            "env": [],
+        }
+        pod_spec: Obj = {"containers": [container], "volumes": []}
+        labels: dict[str, str] = {"app": name}
+        annotations: dict[str, str] = {}
+
+        for config_name in self._resolve(body, "configurations") or []:
+            labels[config_name] = "true"
+
+        tpu = self._resolve(body, "tpus") or {}
+        accelerator = tpu.get("accelerator", "none")
+        if accelerator and accelerator != "none":
+            annotations[TPU_ACCELERATOR_ANNOTATION] = accelerator
+            if tpu.get("topology"):
+                annotations[TPU_TOPOLOGY_ANNOTATION] = tpu["topology"]
+            labels["tpu-runtime"] = "enabled"  # PodDefault opt-in
+
+        if self._resolve(body, "shm"):
+            pod_spec["volumes"].append(
+                {"name": "dshm", "emptyDir": {"medium": "Memory"}}
+            )
+            container["volumeMounts"].append(
+                {"name": "dshm", "mountPath": "/dev/shm"}
+            )
+
+        notebook: Obj = {
+            "apiVersion": "kubeflow.org/v1beta1",
+            "kind": "Notebook",
+            "metadata": {
+                "name": name,
+                "namespace": namespace,
+                "labels": labels,
+                "annotations": annotations,
+            },
+            "spec": {
+                "template": {
+                    "metadata": {"labels": dict(labels)},
+                    "spec": pod_spec,
+                }
+            },
+        }
+
+        # dry-run first so form errors surface before PVCs exist
+        self.api.create(notebook, dry_run=True)
+
+        pvcs: list[Obj] = []
+        workspace = self._resolve(body, "workspaceVolume")
+        if workspace:
+            pvcs.append(self._attach_volume(notebook, workspace, name))
+        for vol in self._resolve(body, "dataVolumes") or []:
+            pvcs.append(self._attach_volume(notebook, vol, name))
+        for pvc in pvcs:
+            if pvc is not None:
+                try:
+                    self.api.create(pvc)
+                except Exception as e:  # AlreadyExists → reuse
+                    if "exists" not in str(e):
+                        raise
+
+        created = self.api.create(notebook)
+        return success({"notebook": obj_util.name_of(created)}, status=201)
+
+    def _attach_volume(
+        self, notebook: Obj, volume: Obj, nb_name: str
+    ) -> Optional[Obj]:
+        mount = volume.get("mount", "/home/jovyan")
+        pod_spec = notebook["spec"]["template"]["spec"]
+        container = pod_spec["containers"][0]
+        if "existingSource" in volume:
+            claim = obj_util.get_path(
+                volume, "existingSource", "persistentVolumeClaim", "claimName"
+            )
+            vol_name = f"existing-{claim}"
+            pod_spec["volumes"].append(
+                {
+                    "name": vol_name,
+                    "persistentVolumeClaim": {"claimName": claim},
+                }
+            )
+            container["volumeMounts"].append(
+                {"name": vol_name, "mountPath": mount}
+            )
+            return None
+        new_pvc = obj_util.deepcopy(volume.get("newPvc") or {})
+        pvc_name = (
+            obj_util.get_path(new_pvc, "metadata", "name", default="")
+            or f"{nb_name}-volume"
+        ).replace("{notebook-name}", nb_name)
+        new_pvc.setdefault("apiVersion", "v1")
+        new_pvc["kind"] = "PersistentVolumeClaim"
+        new_pvc.setdefault("metadata", {})["name"] = pvc_name
+        new_pvc["metadata"]["namespace"] = obj_util.namespace_of(notebook)
+        pod_spec["volumes"].append(
+            {
+                "name": pvc_name,
+                "persistentVolumeClaim": {"claimName": pvc_name},
+            }
+        )
+        container["volumeMounts"].append(
+            {"name": pvc_name, "mountPath": mount}
+        )
+        return new_pvc
+
+    # -- list rows + status (utils.py:56-140, status.py:10-59) ---------------
+
+    def notebook_row(self, nb: Obj) -> Obj:
+        container = obj_util.get_path(
+            nb, "spec", "template", "spec", "containers", 0, default={}
+        ) or {}
+        ann = obj_util.annotations_of(nb)
+        tpus = None
+        if TPU_ACCELERATOR_ANNOTATION in ann:
+            from odh_kubeflow_tpu.utils.tpu import chips_in_topology
+
+            topo = ann.get(TPU_TOPOLOGY_ANNOTATION, "")
+            tpus = {
+                "accelerator": ann[TPU_ACCELERATOR_ANNOTATION],
+                "topology": topo,
+                # chip count derives from topology; the controller owns
+                # the per-host google.com/tpu limits on the StatefulSet
+                "chips": str(chips_in_topology(topo)) if topo else "",
+            }
+        return {
+            "name": obj_util.name_of(nb),
+            "namespace": obj_util.namespace_of(nb),
+            "image": container.get("image", ""),
+            "shortImage": (container.get("image", "").split("/")[-1]),
+            "cpu": obj_util.get_path(
+                container, "resources", "requests", "cpu", default=""
+            ),
+            "memory": obj_util.get_path(
+                container, "resources", "requests", "memory", default=""
+            ),
+            "tpus": tpus,
+            "status": self.notebook_status(nb),
+            "age": obj_util.meta(nb).get("creationTimestamp", ""),
+        }
+
+    def notebook_status(self, nb: Obj) -> Obj:
+        """stopped/terminating/waiting/running + error-event mining."""
+        ann = obj_util.annotations_of(nb)
+        if obj_util.meta(nb).get("deletionTimestamp"):
+            return {"phase": "terminating", "message": "Deleting this notebook"}
+        if STOP_ANNOTATION in ann:
+            return {"phase": "stopped", "message": "No Pods are currently running"}
+        ready = obj_util.get_path(nb, "status", "readyReplicas", default=0)
+        if ready and ready > 0:
+            return {"phase": "ready", "message": "Running"}
+        error_event = self._find_error_event(nb)
+        if error_event:
+            return {"phase": "warning", "message": error_event}
+        return {"phase": "waiting", "message": "Starting"}
+
+    def _find_error_event(self, nb: Obj) -> Optional[str]:
+        name = obj_util.name_of(nb)
+        for event in self.api.list(
+            "Event", namespace=obj_util.namespace_of(nb)
+        ):
+            if event.get("type") != "Warning":
+                continue
+            involved = event.get("involvedObject", {}).get("name", "")
+            if involved == name or involved.startswith(f"{name}-"):
+                return event.get("message", event.get("reason", ""))
+        return None
+
+
+def _apply_limit_factor(value: str, cfg: Obj) -> str:
+    factor = cfg.get("limitFactor", "none")
+    if factor in (None, "none", ""):
+        return value
+    q = obj_util.parse_quantity(value)
+    limit = q * float(factor)
+    if value.endswith("Gi"):
+        return f"{limit / 2**30:.1f}Gi"
+    if value.endswith("Mi"):
+        return f"{limit / 2**20:.0f}Mi"
+    return f"{limit:g}"

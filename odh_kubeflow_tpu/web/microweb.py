@@ -1,0 +1,286 @@
+"""Micro WSGI framework (Flask-shaped, stdlib-only).
+
+The reference's BFFs are Flask apps built by a shared factory
+(crud-web-apps/common/backend/.../__init__.py:16-35). This image ships
+no Flask, so the framework itself is part of the platform: routing with
+path params, blueprints, JSON request/response, before-request hooks,
+error handlers, CSRF double-submit protection, and static serving —
+the exact surface the CRUD backends need.
+"""
+
+from __future__ import annotations
+
+import json
+import mimetypes
+import os
+import re
+import secrets as _secrets
+import threading
+import traceback
+from http.cookies import SimpleCookie
+from typing import Any, Callable, Optional
+from wsgiref.simple_server import WSGIServer, make_server
+from socketserver import ThreadingMixIn
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class Request:
+    def __init__(self, environ: dict):
+        self.environ = environ
+        self.method = environ.get("REQUEST_METHOD", "GET").upper()
+        self.path = environ.get("PATH_INFO", "/")
+        self.query = {}
+        for pair in (environ.get("QUERY_STRING") or "").split("&"):
+            if "=" in pair:
+                k, _, v = pair.partition("=")
+                self.query[k] = v
+        self.headers = {
+            k[5:].replace("_", "-").lower(): v
+            for k, v in environ.items()
+            if k.startswith("HTTP_")
+        }
+        if environ.get("CONTENT_TYPE"):
+            self.headers["content-type"] = environ["CONTENT_TYPE"]
+        self._body: Optional[bytes] = None
+        self.params: dict[str, str] = {}
+        self.context: dict[str, Any] = {}
+
+    @property
+    def body(self) -> bytes:
+        if self._body is None:
+            try:
+                length = int(self.environ.get("CONTENT_LENGTH") or 0)
+            except ValueError:
+                length = 0
+            self._body = (
+                self.environ["wsgi.input"].read(length) if length else b""
+            )
+        return self._body
+
+    @property
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        try:
+            return json.loads(self.body.decode())
+        except ValueError:
+            raise HTTPError(400, "invalid JSON body") from None
+
+    @property
+    def cookies(self) -> dict[str, str]:
+        cookie = SimpleCookie(self.environ.get("HTTP_COOKIE", ""))
+        return {k: v.value for k, v in cookie.items()}
+
+
+class Response:
+    def __init__(
+        self,
+        body: Any = "",
+        status: int = 200,
+        headers: Optional[dict[str, str]] = None,
+        content_type: Optional[str] = None,
+    ):
+        self.status = status
+        self.headers = dict(headers or {})
+        if isinstance(body, (dict, list)):
+            self.body = json.dumps(body).encode()
+            self.headers.setdefault("Content-Type", "application/json")
+        elif isinstance(body, str):
+            self.body = body.encode()
+            self.headers.setdefault("Content-Type", content_type or "text/html")
+        else:
+            self.body = body or b""
+            if content_type:
+                self.headers.setdefault("Content-Type", content_type)
+        self.headers.setdefault("Content-Length", str(len(self.body)))
+
+    def set_cookie(self, name: str, value: str, path: str = "/", http_only=False):
+        cookie = f"{name}={value}; Path={path}; SameSite=Strict"
+        if http_only:
+            cookie += "; HttpOnly"
+        self.headers["Set-Cookie"] = cookie
+
+
+_STATUS_TEXT = {
+    200: "OK", 201: "Created", 204: "No Content", 301: "Moved Permanently",
+    302: "Found", 400: "Bad Request", 401: "Unauthorized", 403: "Forbidden",
+    404: "Not Found", 405: "Method Not Allowed", 409: "Conflict",
+    422: "Unprocessable Entity", 500: "Internal Server Error",
+}
+
+
+class Blueprint:
+    def __init__(self, name: str, url_prefix: str = ""):
+        self.name = name
+        self.url_prefix = url_prefix.rstrip("/")
+        self.routes: list[tuple[str, str, Callable]] = []
+
+    def route(self, rule: str, methods: Optional[list[str]] = None):
+        def deco(fn):
+            for m in methods or ["GET"]:
+                self.routes.append((m.upper(), self.url_prefix + rule, fn))
+            return fn
+
+        return deco
+
+
+class App:
+    """WSGI application with Flask-style routing."""
+
+    def __init__(self, name: str = "app", static_dir: Optional[str] = None):
+        self.name = name
+        self.static_dir = static_dir
+        self._routes: list[tuple[str, re.Pattern, list[str], Callable]] = []
+        self._before: list[Callable[[Request], Optional[Response]]] = []
+        self._errors: dict[type, Callable] = {}
+
+    # -- registration -------------------------------------------------------
+
+    @staticmethod
+    def _compile(rule: str) -> tuple[re.Pattern, list[str]]:
+        names: list[str] = []
+
+        def repl(m):
+            names.append(m.group(1))
+            return r"(?P<%s>[^/]+)" % m.group(1)
+
+        pattern = re.sub(r"<([a-zA-Z_][a-zA-Z0-9_]*)>", repl, rule)
+        return re.compile("^" + pattern + "$"), names
+
+    def route(self, rule: str, methods: Optional[list[str]] = None):
+        def deco(fn):
+            regex, names = self._compile(rule)
+            for m in methods or ["GET"]:
+                self._routes.append((m.upper(), regex, names, fn))
+            return fn
+
+        return deco
+
+    def register_blueprint(self, bp: Blueprint) -> None:
+        for method, rule, fn in bp.routes:
+            regex, names = self._compile(rule)
+            self._routes.append((method, regex, names, fn))
+
+    def before_request(self, fn: Callable[[Request], Optional[Response]]):
+        self._before.append(fn)
+        return fn
+
+    def error_handler(self, exc_type: type):
+        def deco(fn):
+            self._errors[exc_type] = fn
+            return fn
+
+        return deco
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _dispatch(self, request: Request) -> Response:
+        for hook in self._before:
+            resp = hook(request)
+            if resp is not None:
+                return resp
+        allowed: set[str] = set()
+        for method, regex, _names, fn in self._routes:
+            m = regex.match(request.path)
+            if not m:
+                continue
+            if method != request.method:
+                allowed.add(method)
+                continue
+            request.params = m.groupdict()
+            out = fn(request, **m.groupdict())
+            return out if isinstance(out, Response) else Response(out)
+        if allowed:
+            return Response({"success": False, "log": "method not allowed"}, 405)
+        if self.static_dir and request.method == "GET":
+            return self._serve_static(request.path)
+        return Response({"success": False, "log": "not found"}, 404)
+
+    def _serve_static(self, path: str) -> Response:
+        rel = path.lstrip("/") or "index.html"
+        full = os.path.realpath(os.path.join(self.static_dir, rel))
+        root = os.path.realpath(self.static_dir)
+        if not full.startswith(root + os.sep) and full != root:
+            return Response({"success": False, "log": "not found"}, 404)
+        if os.path.isdir(full):
+            full = os.path.join(full, "index.html")
+        if not os.path.isfile(full):
+            # SPA fallback (the Angular apps route client-side)
+            index = os.path.join(root, "index.html")
+            if os.path.isfile(index):
+                full = index
+            else:
+                return Response({"success": False, "log": "not found"}, 404)
+        ctype = mimetypes.guess_type(full)[0] or "application/octet-stream"
+        with open(full, "rb") as f:
+            return Response(f.read(), content_type=ctype)
+
+    def __call__(self, environ, start_response):
+        request = Request(environ)
+        try:
+            response = self._dispatch(request)
+        except HTTPError as e:
+            response = Response(
+                {"success": False, "status": e.status, "log": e.message}, e.status
+            )
+        except Exception as e:  # noqa: BLE001
+            handler = None
+            for etype, fn in self._errors.items():
+                if isinstance(e, etype):
+                    handler = fn
+                    break
+            if handler is not None:
+                response = handler(request, e)
+            else:
+                traceback.print_exc()
+                response = Response(
+                    {"success": False, "status": 500, "log": str(e)}, 500
+                )
+        status_line = f"{response.status} {_STATUS_TEXT.get(response.status, '')}"
+        start_response(status_line, list(response.headers.items()))
+        return [response.body]
+
+    # -- serving ------------------------------------------------------------
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        class ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
+            daemon_threads = True
+
+        server = make_server(host, port, self, server_class=ThreadingWSGIServer)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        return server
+
+
+# ---------------------------------------------------------------------------
+# CSRF (double-submit cookie, crud_backend csrf.py equivalent)
+
+CSRF_COOKIE = "XSRF-TOKEN"
+CSRF_HEADER = "x-xsrf-token"
+_SAFE_METHODS = {"GET", "HEAD", "OPTIONS"}
+
+
+def install_csrf(app: App) -> None:
+    @app.before_request
+    def _csrf(request: Request) -> Optional[Response]:
+        if request.method in _SAFE_METHODS:
+            return None
+        cookie = request.cookies.get(CSRF_COOKIE)
+        header = request.headers.get(CSRF_HEADER)
+        if not cookie or cookie != header:
+            return Response(
+                {"success": False, "log": "CSRF token missing or invalid"}, 403
+            )
+        return None
+
+
+def issue_csrf_cookie(response: Response) -> str:
+    token = _secrets.token_urlsafe(16)
+    response.set_cookie(CSRF_COOKIE, token)
+    return token

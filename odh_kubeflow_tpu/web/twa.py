@@ -1,0 +1,86 @@
+"""Tensorboards web app (TWA) backend.
+
+Reference parity: crud-web-apps/tensorboards/backend/app/routes/
+post.py:15-38, app/utils.py:4-38 (CR builder + status parse)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from odh_kubeflow_tpu.apis import TENSORBOARD_API_VERSION
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.store import APIServer
+from odh_kubeflow_tpu.web.crud_backend import CrudBackend, failure, success
+
+Obj = dict[str, Any]
+
+
+class TensorboardsWebApp(CrudBackend):
+    def __init__(self, api: APIServer, static_dir: Optional[str] = None):
+        super().__init__(api, "tensorboards-web-app", static_dir=static_dir)
+        self._register_routes()
+
+    def _register_routes(self) -> None:
+        app = self.app
+
+        @app.route("/api/namespaces/<namespace>/tensorboards")
+        def list_tbs(request, namespace):
+            self.authorize(
+                request, "list", "tensorboards", namespace, "tensorboard.kubeflow.org"
+            )
+            rows = [
+                self.tensorboard_row(tb)
+                for tb in self.api.list("Tensorboard", namespace=namespace)
+            ]
+            return success({"tensorboards": rows})
+
+        @app.route("/api/namespaces/<namespace>/tensorboards", methods=["POST"])
+        def post_tb(request, namespace):
+            self.authorize(
+                request,
+                "create",
+                "tensorboards",
+                namespace,
+                "tensorboard.kubeflow.org",
+            )
+            body = request.json or {}
+            name = body.get("name", "")
+            logspath = body.get("logspath", "")
+            if not name or not logspath:
+                return failure("name and logspath are required", 400)
+            tb = {
+                "apiVersion": TENSORBOARD_API_VERSION,
+                "kind": "Tensorboard",
+                "metadata": {"name": name, "namespace": namespace},
+                "spec": {"logspath": logspath},
+            }
+            self.api.create(tb)
+            return success({"tensorboard": name}, 201)
+
+        @app.route(
+            "/api/namespaces/<namespace>/tensorboards/<name>",
+            methods=["DELETE"],
+        )
+        def delete_tb(request, namespace, name):
+            self.authorize(
+                request,
+                "delete",
+                "tensorboards",
+                namespace,
+                "tensorboard.kubeflow.org",
+            )
+            self.api.delete("Tensorboard", name, namespace)
+            return success()
+
+    def tensorboard_row(self, tb: Obj) -> Obj:
+        ready = obj_util.get_path(tb, "status", "readyReplicas", default=0)
+        return {
+            "name": obj_util.name_of(tb),
+            "namespace": obj_util.namespace_of(tb),
+            "logspath": obj_util.get_path(tb, "spec", "logspath", default=""),
+            "status": {
+                "phase": "ready" if ready else "waiting",
+                "message": "Running" if ready else "Starting",
+            },
+            "age": obj_util.meta(tb).get("creationTimestamp", ""),
+        }

@@ -1,0 +1,85 @@
+"""Volumes web app (VWA) backend: PVC CRUD.
+
+Reference parity: crud-web-apps/volumes/backend/apps/default/routes/
+{get,post,delete}.py + common/utils.py parsing."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.store import APIServer
+from odh_kubeflow_tpu.web.crud_backend import CrudBackend, failure, success
+
+Obj = dict[str, Any]
+
+
+class VolumesWebApp(CrudBackend):
+    def __init__(self, api: APIServer, static_dir: Optional[str] = None):
+        super().__init__(api, "volumes-web-app", static_dir=static_dir)
+        self._register_routes()
+
+    def _register_routes(self) -> None:
+        app = self.app
+
+        @app.route("/api/namespaces/<namespace>/pvcs")
+        def list_pvcs(request, namespace):
+            self.authorize(request, "list", "persistentvolumeclaims", namespace)
+            rows = [
+                self.pvc_row(pvc)
+                for pvc in self.api.list(
+                    "PersistentVolumeClaim", namespace=namespace
+                )
+            ]
+            return success({"pvcs": rows})
+
+        @app.route("/api/namespaces/<namespace>/pvcs", methods=["POST"])
+        def post_pvc(request, namespace):
+            self.authorize(request, "create", "persistentvolumeclaims", namespace)
+            body = request.json or {}
+            pvc = body.get("pvc") or {}
+            pvc.setdefault("apiVersion", "v1")
+            pvc["kind"] = "PersistentVolumeClaim"
+            pvc.setdefault("metadata", {})["namespace"] = namespace
+            if not obj_util.name_of(pvc):
+                return failure("pvc.metadata.name required", 400)
+            created = self.api.create(pvc)
+            return success({"pvc": obj_util.name_of(created)}, 201)
+
+        @app.route(
+            "/api/namespaces/<namespace>/pvcs/<name>", methods=["DELETE"]
+        )
+        def delete_pvc(request, namespace, name):
+            self.authorize(request, "delete", "persistentvolumeclaims", namespace)
+            self.api.delete("PersistentVolumeClaim", name, namespace)
+            return success()
+
+    def pvc_row(self, pvc: Obj) -> Obj:
+        mounted_by = [
+            obj_util.name_of(pod)
+            for pod in self.api.list(
+                "Pod", namespace=obj_util.namespace_of(pvc)
+            )
+            if any(
+                obj_util.get_path(v, "persistentVolumeClaim", "claimName")
+                == obj_util.name_of(pvc)
+                for v in obj_util.get_path(pod, "spec", "volumes", default=[])
+                or []
+            )
+        ]
+        return {
+            "name": obj_util.name_of(pvc),
+            "namespace": obj_util.namespace_of(pvc),
+            "capacity": obj_util.get_path(
+                pvc, "spec", "resources", "requests", "storage", default=""
+            ),
+            "modes": obj_util.get_path(pvc, "spec", "accessModes", default=[]),
+            "class": obj_util.get_path(
+                pvc, "spec", "storageClassName", default=""
+            ),
+            "status": obj_util.get_path(
+                pvc, "status", "phase", default="Bound"
+            ),
+            "usedBy": mounted_by,
+            "age": obj_util.meta(pvc).get("creationTimestamp", ""),
+        }

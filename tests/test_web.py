@@ -1,0 +1,346 @@
+"""Web layer tests: JWA spawner flow (form → Notebook CR → running
+pod → status rows), TPU inventory endpoint, authn/authz gates, CSRF,
+VWA/TWA/kfam/dashboard APIs — over a real HTTP socket."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from odh_kubeflow_tpu.apis import register_crds
+from odh_kubeflow_tpu.controllers.kfam import KfamService
+from odh_kubeflow_tpu.controllers.notebook import (
+    NotebookController,
+    NotebookControllerConfig,
+)
+from odh_kubeflow_tpu.controllers.profile import ProfileController
+from odh_kubeflow_tpu.controllers.runtime import Manager
+from odh_kubeflow_tpu.controllers.tensorboard import TensorboardController
+from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
+from odh_kubeflow_tpu.machinery.store import APIServer
+from odh_kubeflow_tpu.web.dashboard import DashboardApp
+from odh_kubeflow_tpu.web.jwa import JupyterWebApp
+from odh_kubeflow_tpu.web.kfam_app import KfamApp
+from odh_kubeflow_tpu.web.twa import TensorboardsWebApp
+from odh_kubeflow_tpu.web.vwa import VolumesWebApp
+from odh_kubeflow_tpu.webhooks.poddefault import (
+    PodDefaultWebhook,
+    tpu_runtime_poddefault,
+)
+
+ALICE = "alice@example.com"
+
+
+class Client:
+    """Tiny HTTP client with user header + CSRF handling."""
+
+    def __init__(self, base: str, user: str = ALICE):
+        self.base = base
+        self.user = user
+        self.csrf = "testtoken"
+
+    def request(self, method: str, path: str, body=None, user=None, headers=None):
+        req = urllib.request.Request(
+            self.base + path,
+            method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+        )
+        u = user if user is not None else self.user
+        if u:
+            req.add_header("kubeflow-userid", u)
+        if method not in ("GET", "HEAD"):
+            req.add_header("Cookie", f"XSRF-TOKEN={self.csrf}")
+            req.add_header("X-XSRF-TOKEN", self.csrf)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status, json.loads(r.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode() or "{}")
+
+    def get(self, path, **kw):
+        return self.request("GET", path, **kw)
+
+    def post(self, path, body=None, **kw):
+        return self.request("POST", path, body, **kw)
+
+    def patch(self, path, body=None, **kw):
+        return self.request("PATCH", path, body, **kw)
+
+    def delete(self, path, body=None, **kw):
+        return self.request("DELETE", path, body, **kw)
+
+
+@pytest.fixture
+def env():
+    api = APIServer()
+    register_crds(api)
+    PodDefaultWebhook(api).register()
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-0")
+    cluster.add_tpu_node_pool("v5e", "tpu-v5-lite-podslice", "2x2")
+    mgr = Manager(api)
+    NotebookController(api, NotebookControllerConfig()).register(mgr)
+    ProfileController(api).register(mgr)
+    TensorboardController(api).register(mgr)
+    # tenancy: alice owns team-a
+    api.create(
+        {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "Profile",
+            "metadata": {"name": "team-a"},
+            "spec": {"owner": {"kind": "User", "name": ALICE}},
+        }
+    )
+    mgr.drain()
+    api.create(tpu_runtime_poddefault("team-a"))
+    # RBAC: ClusterRole for notebook editing bound cluster-wide to alice
+    api.create(
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": "kubeflow-edit"},
+            "rules": [
+                {
+                    "apiGroups": ["kubeflow.org", "tensorboard.kubeflow.org", ""],
+                    "resources": [
+                        "notebooks",
+                        "poddefaults",
+                        "tensorboards",
+                        "persistentvolumeclaims",
+                        "nodes",
+                    ],
+                    "verbs": ["*"],
+                }
+            ],
+        }
+    )
+    api.create(
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": "alice-edit"},
+            "subjects": [{"kind": "User", "name": ALICE}],
+            "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+        }
+    )
+    return api, cluster, mgr
+
+
+@pytest.fixture
+def jwa_client(env):
+    api, cluster, mgr = env
+    server = JupyterWebApp(api).app.serve()
+    yield Client(f"http://127.0.0.1:{server.server_port}"), api, cluster, mgr
+    server.shutdown()
+
+
+def test_jwa_spawn_tpu_notebook_end_to_end(jwa_client):
+    client, api, cluster, mgr = jwa_client
+
+    status, body = client.get("/api/config")
+    assert status == 200 and body["success"]
+    accel_types = [a["type"] for a in body["config"]["tpus"]["accelerators"]]
+    assert "tpu-v5-lite-podslice" in accel_types
+
+    status, body = client.get("/api/tpus")
+    assert status == 200
+    assert body["tpus"] == [
+        {
+            "type": "tpu-v5-lite-podslice",
+            "displayName": "TPU v5e",
+            "topologies": ["2x2"],
+        }
+    ]
+
+    status, body = client.post(
+        "/api/namespaces/team-a/notebooks",
+        body={
+            "name": "jaxnb",
+            "image": "odh-kubeflow-tpu/jupyter-jax-tpu:v0.1.0",
+            "cpu": "4",
+            "memory": "8Gi",
+            "tpus": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x2"},
+        },
+    )
+    assert status == 201, body
+
+    # workspace PVC created from the default template
+    pvc = api.get("PersistentVolumeClaim", "jaxnb-workspace", "team-a")
+    assert pvc["spec"]["resources"]["requests"]["storage"] == "10Gi"
+
+    mgr.drain()
+    cluster.step()
+    mgr.drain()
+
+    pod = api.get("Pod", "jaxnb-0", "team-a")
+    env_vars = {
+        e["name"]: e.get("value")
+        for e in pod["spec"]["containers"][0]["env"]
+    }
+    # PodDefault webhook injected libtpu env because JWA set the label
+    assert env_vars["JAX_PLATFORMS"] == "tpu,cpu"
+    assert pod["status"]["phase"] == "Running"
+
+    status, body = client.get("/api/namespaces/team-a/notebooks")
+    row = body["notebooks"][0]
+    assert row["status"]["phase"] == "ready"
+    assert row["tpus"] == {
+        "accelerator": "tpu-v5-lite-podslice",
+        "topology": "2x2",
+        "chips": "4",
+    }
+
+    # stop → status stopped; start → running again
+    status, _ = client.patch(
+        "/api/namespaces/team-a/notebooks/jaxnb", body={"stopped": True}
+    )
+    assert status == 200
+    mgr.drain()
+    cluster.step()
+    status, body = client.get("/api/namespaces/team-a/notebooks")
+    assert body["notebooks"][0]["status"]["phase"] == "stopped"
+
+    status, _ = client.patch(
+        "/api/namespaces/team-a/notebooks/jaxnb", body={"stopped": False}
+    )
+    mgr.drain()
+    cluster.step()
+    mgr.drain()
+    status, body = client.get("/api/namespaces/team-a/notebooks")
+    assert body["notebooks"][0]["status"]["phase"] == "ready"
+
+    status, _ = client.delete("/api/namespaces/team-a/notebooks/jaxnb")
+    assert status == 200
+    assert api.list("Notebook", namespace="team-a") == []
+
+
+def test_jwa_authn_authz_and_csrf(jwa_client):
+    client, api, cluster, mgr = jwa_client
+    # no user header → 401
+    status, body = client.get("/api/namespaces/team-a/notebooks", user="")
+    assert status == 401
+    # unauthorized user → 403
+    status, body = client.get(
+        "/api/namespaces/team-a/notebooks", user="mallory@example.com"
+    )
+    assert status == 403
+    # CSRF: POST without token → 403
+    import urllib.request as ur
+
+    req = ur.Request(
+        client.base + "/api/namespaces/team-a/notebooks",
+        method="POST",
+        data=b"{}",
+    )
+    req.add_header("kubeflow-userid", ALICE)
+    try:
+        with ur.urlopen(req, timeout=5) as r:
+            status = r.status
+    except urllib.error.HTTPError as e:
+        status = e.code
+    assert status == 403
+    # unschedulable TPU topology → waiting status with warning event
+    status, body = client.post(
+        "/api/namespaces/team-a/notebooks",
+        body={
+            "name": "toolarge",
+            "tpus": {"accelerator": "tpu-v5-lite-podslice", "topology": "4x4"},
+        },
+    )
+    assert status == 201
+    mgr.drain()
+    cluster.step()
+    status, body = client.get("/api/namespaces/team-a/notebooks")
+    rows = {r["name"]: r for r in body["notebooks"]}
+    assert rows["toolarge"]["status"]["phase"] == "warning"
+
+
+def test_vwa_and_twa(env):
+    api, cluster, mgr = env
+    vwa = VolumesWebApp(api).app.serve()
+    twa = TensorboardsWebApp(api).app.serve()
+    vc = Client(f"http://127.0.0.1:{vwa.server_port}")
+    tc = Client(f"http://127.0.0.1:{twa.server_port}")
+
+    status, _ = vc.post(
+        "/api/namespaces/team-a/pvcs",
+        body={
+            "pvc": {
+                "metadata": {"name": "data-1"},
+                "spec": {
+                    "accessModes": ["ReadWriteOnce"],
+                    "resources": {"requests": {"storage": "5Gi"}},
+                },
+            }
+        },
+    )
+    assert status == 201
+    status, body = vc.get("/api/namespaces/team-a/pvcs")
+    assert body["pvcs"][0]["capacity"] == "5Gi"
+
+    status, _ = tc.post(
+        "/api/namespaces/team-a/tensorboards",
+        body={"name": "tb1", "logspath": "gs://bucket/traces"},
+    )
+    assert status == 201
+    mgr.drain()
+    cluster.step()
+    mgr.drain()
+    status, body = tc.get("/api/namespaces/team-a/tensorboards")
+    assert body["tensorboards"][0]["status"]["phase"] == "ready"
+    vwa.shutdown()
+    twa.shutdown()
+
+
+def test_kfam_and_dashboard(env):
+    api, cluster, mgr = env
+    kfam_server = KfamApp(api, cluster_admins={"root@example.com"}).app.serve()
+    kc = Client(f"http://127.0.0.1:{kfam_server.server_port}")
+    dash_server = DashboardApp(
+        api, KfamService(api, {"root@example.com"})
+    ).app.serve()
+    dc = Client(f"http://127.0.0.1:{dash_server.server_port}")
+
+    status, body = kc.get("/kfam/v1/role/clusteradmin", user="root@example.com")
+    assert body["clusteradmin"] is True
+
+    status, _ = kc.post(
+        "/kfam/v1/bindings",
+        body={
+            "user": {"kind": "User", "name": "bob@example.com"},
+            "referredNamespace": "team-a",
+            "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+        },
+    )
+    assert status == 201
+    status, body = kc.get("/kfam/v1/bindings?namespace=team-a")
+    assert any(b["user"]["name"] == "bob@example.com" for b in body["bindings"])
+
+    status, body = dc.get("/api/workgroup/exists", user="bob@example.com")
+    assert body["hasWorkgroup"] is True
+    status, body = dc.get("/api/workgroup/env-info", user="bob@example.com")
+    assert body["namespaces"] == [{"namespace": "team-a", "role": "owner"}]
+
+    # registration flow for a new user
+    status, body = dc.post(
+        "/api/workgroup/create",
+        body={"namespace": "team-carol"},
+        user="carol@example.com",
+    )
+    assert status == 201
+    mgr.drain()
+    assert api.get("Namespace", "team-carol")["metadata"]["annotations"][
+        "owner"
+    ] == "carol@example.com"
+
+    # TPU metrics panel
+    status, body = dc.get("/api/metrics", user="root@example.com")
+    assert body["tpu"][0]["accelerator"] == "tpu-v5-lite-podslice"
+    assert body["tpu"][0]["capacityChips"] == 4.0
+    kfam_server.shutdown()
+    dash_server.shutdown()
