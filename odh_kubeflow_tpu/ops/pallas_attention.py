@@ -1,0 +1,764 @@
+"""Pallas TPU flash attention (forward + backward).
+
+Tiled attention that never materialises the [Sq, Sk] score matrix:
+the kernel streams K/V blocks through VMEM and keeps an online-softmax
+accumulator (running max ``m``, denominator ``l``, weighted sum ``acc``)
+per Q block, so HBM traffic is O(S·d) instead of O(S²). The backward
+pass recomputes scores from the saved logsumexp (flash-v2 style) in two
+kernels: one accumulating dQ over K blocks, one accumulating dK/dV over
+Q blocks.
+
+Drop-in for ``ops.attention.dense_attention`` (same signature; the
+reference platform has no attention code at all — SURVEY.md §2.4 — this
+is a new TPU-native component). GQA is handled by mapping each Q head's
+grid cell onto its KV head (``h // group``) in the K/V index maps, so
+KV blocks are fetched once per group from HBM's point of view (Mosaic
+caches the revisited block).
+
+Causal masking skips fully-masked K blocks via predication
+(``pl.when``), and the MXU sees [block_q, block_k] @ [block_k, hd]
+tiles — 128-aligned by construction (inputs are padded).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
+_NEG_INF = -1e30
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _ki_live_fn(causal: bool, q_offset: int, block_q: int, block_k: int):
+    """Remap causally-dead K-block indices onto the live boundary block.
+
+    The kernel predicates dead blocks out of *compute*; this keeps them
+    out of *memory traffic* too — consecutive grid steps that map to the
+    same block index skip the re-fetch, so the dead upper-triangle
+    blocks cost neither MXU nor HBM bandwidth.
+    """
+    if not causal:
+        return lambda qi, ki: ki
+
+    def live(qi, ki):
+        boundary = (qi * block_q + block_q - 1 + q_offset) // block_k
+        return jnp.maximum(0, jnp.minimum(ki, boundary))
+
+    return live
+
+
+def _qj_live_fn(causal: bool, q_offset: int, block_q: int, block_k: int,
+                num_q: int):
+    """Mirror of _ki_live_fn for the dK/dV kernel's Q-block axis."""
+    if not causal:
+        return lambda ki, qj: qj
+
+    def live(ki, qj):
+        boundary = (ki * block_k - q_offset) // block_q
+        return jnp.minimum(num_q - 1, jnp.maximum(qj, boundary))
+
+    return live
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref,  # [1, 1, block_q, hd]
+    k_ref,  # [1, 1, block_k, hd]
+    v_ref,  # [1, 1, block_k, hd]
+    qseg_ref,  # [1, block_q] or None
+    kseg_ref,  # [1, block_k] or None
+    o_ref,  # [1, 1, block_q, hd]
+    lse_ref,  # [1, 1, block_q, 1]
+    acc_scr,  # [block_q, hd] f32
+    m_scr,  # [block_q, 1] f32
+    l_scr,  # [block_q, 1] f32
+    *,
+    scale: float,
+    causal: bool,
+    q_offset: int,
+    sk: int,
+    block_q: int,
+    block_k: int,
+    num_k: int,
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Positions of this block's rows/cols in the (padded) sequence.
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    # A causal block is dead when its first column is beyond the last row.
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + (block_q - 1) + q_offset
+
+    @pl.when(run)
+    def _body():
+        # Dots take the native (bf16) operands — the MXU runs bf16
+        # inputs at full rate — and accumulate in f32 via
+        # preferred_element_type. Softmax statistics stay f32.
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+
+        s = jax.lax.dot_general(
+            q,
+            k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        s = s * scale
+
+        mask = k_pos < sk  # padded K columns never contribute
+        if causal:
+            mask = jnp.logical_and(mask, q_pos + q_offset >= k_pos)
+        if qseg_ref is not None:
+            mask = jnp.logical_and(mask, qseg_ref[0] == kseg_ref[0])
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype),
+            v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = m_new
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l = l_scr[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows
+        o_ref[0, 0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[...] + jnp.log(l_safe)
+
+
+def _fwd(
+    q,  # [B, Hq, Sq, hd]  (padded, head-major)
+    k,  # [B, Hkv, Sk, hd]
+    v,
+    qseg,  # [B, Sq] int32 or None
+    kseg,  # [B, Sk] int32 or None
+    *,
+    scale: float,
+    causal: bool,
+    q_offset: int,
+    sk: int,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+):
+    B, Hq, Sq, hd = q.shape
+    _, Hkv, Sk, _ = k.shape
+    group = Hq // Hkv
+    num_q, num_k = Sq // block_q, Sk // block_k
+
+    ki_live = _ki_live_fn(causal, q_offset, block_q, block_k)
+    qspec = pl.BlockSpec(
+        (1, 1, block_q, hd),
+        lambda b, h, qi, ki: (b, h, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+    kvspec = pl.BlockSpec(
+        (1, 1, block_k, hd),
+        lambda b, h, qi, ki: (b, h // group, ki_live(qi, ki), 0),
+        memory_space=pltpu.VMEM,
+    )
+    in_specs = [qspec, kvspec, kvspec]
+    args = [q, k, v]
+    if qseg is not None:
+        # qseg rides as a [B, Sq, 1] column, kseg as a [B, 1, Sk] row:
+        # both shapes satisfy Mosaic's (8, 128)-or-full tiling rule and
+        # broadcast against each other inside the kernel.
+        in_specs.append(
+            pl.BlockSpec(
+                (1, block_q, 1),
+                lambda b, h, qi, ki: (b, qi, 0),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        in_specs.append(
+            pl.BlockSpec(
+                (1, 1, block_k),
+                lambda b, h, qi, ki: (b, 0, ki),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        args += [qseg, kseg]
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        scale=scale,
+        causal=causal,
+        q_offset=q_offset,
+        sk=sk,
+        block_q=block_q,
+        block_k=block_k,
+        num_k=num_k,
+    )
+    if qseg is None:
+        base = kernel
+
+        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l):
+            return base(q_ref, k_ref, v_ref, None, None,
+                        o_ref, lse_ref, acc, m, l)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, Hq, num_q, num_k),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec(
+                (1, 1, block_q, hd),
+                lambda b, h, qi, ki: (b, h, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, 1),
+                lambda b, h, qi, ki: (b, h, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, Hq, Sq, hd), q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, Sq, 1), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, hd), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*args)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,  # [1, 1, block_q, 1]
+    delta_ref,  # [1, 1, block_q]
+    qseg_ref,
+    kseg_ref,
+    dq_ref,  # [1, 1, block_q, hd]
+    dq_scr,  # [block_q, hd] f32
+    *,
+    scale: float,
+    causal: bool,
+    q_offset: int,
+    sk: int,
+    block_q: int,
+    block_k: int,
+    num_k: int,
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + (block_q - 1) + q_offset
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        mask = k_pos < sk
+        if causal:
+            mask = jnp.logical_and(mask, q_pos + q_offset >= k_pos)
+        if qseg_ref is not None:
+            mask = jnp.logical_and(mask, qseg_ref[0] == kseg_ref[0])
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    qseg_ref,
+    kseg_ref,
+    dk_ref,  # [1, 1, block_k, hd]  per-KV-head
+    dv_ref,
+    dk_scr,
+    dv_scr,
+    *,
+    scale: float,
+    causal: bool,
+    q_offset: int,
+    sk: int,
+    block_q: int,
+    block_k: int,
+    num_q: int,
+    total_q: int,
+):
+    ki = pl.program_id(2)
+    t = pl.program_id(3)  # t = group_member * num_q + q_block
+    qj = t % num_q
+
+    @pl.when(t == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q_pos = qj * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    run = True
+    if causal:
+        # Q block dead when its last row is above this K block's first col.
+        run = qj * block_q + (block_q - 1) + q_offset >= ki * block_k
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        mask = k_pos < sk
+        if causal:
+            mask = jnp.logical_and(mask, q_pos + q_offset >= k_pos)
+        if qseg_ref is not None:
+            mask = jnp.logical_and(mask, qseg_ref[0] == kseg_ref[0])
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(t == total_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd(
+    q,
+    k,
+    v,
+    qseg,
+    kseg,
+    out,
+    lse,
+    do,
+    *,
+    scale: float,
+    causal: bool,
+    q_offset: int,
+    sk: int,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+):
+    B, Hq, Sq, hd = q.shape
+    _, Hkv, Sk, _ = k.shape
+    group = Hq // Hkv
+    num_q, num_k = Sq // block_q, Sk // block_k
+
+    # delta_i = rowsum(dO_i * O_i): cheap elementwise+reduce, XLA fuses it.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    ki_live = _ki_live_fn(causal, q_offset, block_q, block_k)
+    qj_live = _qj_live_fn(causal, q_offset, block_q, block_k, num_q)
+
+    # --- dQ: grid (B, Hq, num_q, num_k), accumulate over k blocks ---
+    specs = dict(
+        q=pl.BlockSpec(
+            (1, 1, block_q, hd),
+            lambda b, h, qi, ki: (b, h, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        kv=pl.BlockSpec(
+            (1, 1, block_k, hd),
+            lambda b, h, qi, ki: (b, h // group, ki_live(qi, ki), 0),
+            memory_space=pltpu.VMEM,
+        ),
+        row=pl.BlockSpec(
+            (1, 1, block_q, 1),
+            lambda b, h, qi, ki: (b, h, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        qseg=pl.BlockSpec(
+            (1, block_q, 1),
+            lambda b, h, qi, ki: (b, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        kseg=pl.BlockSpec(
+            (1, 1, block_k),
+            lambda b, h, qi, ki: (b, 0, ki),
+            memory_space=pltpu.VMEM,
+        ),
+    )
+
+    dq_args = [q, k, v, do, lse, delta]
+    dq_specs = [
+        specs["q"], specs["kv"], specs["kv"], specs["q"],
+        specs["row"], specs["row"],
+    ]
+    if qseg is not None:
+        dq_args += [qseg, kseg]
+        dq_specs += [specs["qseg"], specs["kseg"]]
+
+    common = dict(
+        scale=scale, causal=causal, q_offset=q_offset, sk=sk,
+        block_q=block_q, block_k=block_k,
+    )
+
+    def dq_kernel(*refs):
+        if qseg is not None:
+            (q_r, k_r, v_r, do_r, lse_r, dl_r, qs_r, ks_r, dq_r, scr) = refs
+        else:
+            (q_r, k_r, v_r, do_r, lse_r, dl_r, dq_r, scr) = refs
+            qs_r = ks_r = None
+        _dq_kernel(
+            q_r, k_r, v_r, do_r, lse_r, dl_r, qs_r, ks_r, dq_r, scr,
+            num_k=num_k, **common,
+        )
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B, Hq, num_q, num_k),
+        in_specs=dq_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, hd),
+            lambda b, h, qi, ki: (b, h, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, hd), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*dq_args)
+
+    # --- dK/dV: grid (B, Hkv, num_k, group*num_q). The GQA group is
+    # folded into the accumulation axis (t = g*num_q + qj), so dK/dV
+    # accumulate per KV head in VMEM scratch and hit HBM exactly once,
+    # in k.dtype — no per-Q-head f32 transients.
+    total_q = group * num_q
+
+    dkv_args = [q, k, v, do, lse, delta]
+    dkv_specs = [
+        pl.BlockSpec(
+            (1, 1, block_q, hd),
+            lambda b, h, ki, t: (
+                b, h * group + t // num_q, qj_live(ki, t % num_q), 0
+            ),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (1, 1, block_k, hd),
+            lambda b, h, ki, t: (b, h, ki, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (1, 1, block_k, hd),
+            lambda b, h, ki, t: (b, h, ki, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (1, 1, block_q, hd),
+            lambda b, h, ki, t: (
+                b, h * group + t // num_q, qj_live(ki, t % num_q), 0
+            ),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (1, 1, block_q, 1),
+            lambda b, h, ki, t: (
+                b, h * group + t // num_q, qj_live(ki, t % num_q), 0
+            ),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (1, 1, block_q, 1),
+            lambda b, h, ki, t: (
+                b, h * group + t // num_q, qj_live(ki, t % num_q), 0
+            ),
+            memory_space=pltpu.VMEM,
+        ),
+    ]
+    if qseg is not None:
+        dkv_args += [qseg, kseg]
+        dkv_specs += [
+            pl.BlockSpec(
+                (1, block_q, 1),
+                lambda b, h, ki, t: (b, qj_live(ki, t % num_q), 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k),
+                lambda b, h, ki, t: (b, 0, ki),
+                memory_space=pltpu.VMEM,
+            ),
+        ]
+
+    def dkv_kernel(*refs):
+        if qseg is not None:
+            (q_r, k_r, v_r, do_r, lse_r, dl_r, qs_r, ks_r,
+             dk_r, dv_r, kscr, vscr) = refs
+        else:
+            (q_r, k_r, v_r, do_r, lse_r, dl_r, dk_r, dv_r, kscr, vscr) = refs
+            qs_r = ks_r = None
+        _dkv_kernel(
+            q_r, k_r, v_r, do_r, lse_r, dl_r, qs_r, ks_r,
+            dk_r, dv_r, kscr, vscr, num_q=num_q, total_q=total_q, **common,
+        )
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B, Hkv, num_k, total_q),
+        in_specs=dkv_specs,
+        out_specs=(
+            pl.BlockSpec(
+                (1, 1, block_k, hd),
+                lambda b, h, ki, t: (b, h, ki, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, hd),
+                lambda b, h, ki, t: (b, h, ki, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, Hkv, Sk, hd), k.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, Sk, hd), v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, hd), jnp.float32),
+            pltpu.VMEM((block_k, hd), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*dkv_args)
+
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10)
+)
+def _flash(q, k, v, segment_ids, causal, q_offset, sq, sk,
+           block_q, block_k, interpret):
+    out, _ = _flash_fwd(
+        q, k, v, segment_ids, causal, q_offset, sq, sk,
+        block_q, block_k, interpret,
+    )
+    return out
+
+
+def _prep(q, k, v, segment_ids, sq, sk, block_q, block_k):
+    """[B,S,H,d] → padded head-major [B,H,S,d] plus padded segment ids."""
+    B = q.shape[0]
+    sq_p, sk_p = _ceil_to(sq, block_q), _ceil_to(sk, block_k)
+    qt = jnp.moveaxis(q, 1, 2)
+    kt = jnp.moveaxis(k, 1, 2)
+    vt = jnp.moveaxis(v, 1, 2)
+    if sq_p != sq:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+    if sk_p != sk:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+    qseg = kseg = None
+    if segment_ids is not None:
+        seg = segment_ids.astype(jnp.int32)
+        # Padded rows/cols get sentinel ids that never match real ones.
+        # Shapes: qseg [B, Sq, 1] (column), kseg [B, 1, Sk] (row) — see
+        # the spec comment in _fwd.
+        qseg = jnp.pad(seg, ((0, 0), (0, sq_p - sq)),
+                       constant_values=-1)[:, :, None]
+        kseg = jnp.pad(seg[:, :sk], ((0, 0), (0, sk_p - sk)),
+                       constant_values=-2)[:, None, :]
+    return qt, kt, vt, qseg, kseg
+
+
+def _flash_fwd(q, k, v, segment_ids, causal, q_offset, sq, sk,
+               block_q, block_k, interpret):
+    hd = q.shape[-1]
+    scale = hd**-0.5
+    qt, kt, vt, qseg, kseg = _prep(
+        q, k, v, segment_ids, sq, sk, block_q, block_k
+    )
+    out_p, lse = _fwd(
+        qt, kt, vt, qseg, kseg,
+        scale=scale, causal=causal, q_offset=q_offset, sk=sk,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    out = jnp.moveaxis(out_p[:, :, :sq], 2, 1)
+    return out, (q, k, v, segment_ids, out_p, lse)
+
+
+def _flash_bwd(causal, q_offset, sq, sk, block_q, block_k, interpret,
+               res, g):
+    q, k, v, segment_ids, out_p, lse = res
+    hd = q.shape[-1]
+    scale = hd**-0.5
+    qt, kt, vt, qseg, kseg = _prep(
+        q, k, v, segment_ids, sq, sk, block_q, block_k
+    )
+    sq_p = qt.shape[2]
+    do = jnp.moveaxis(g, 1, 2)
+    if sq_p != sq:
+        do = jnp.pad(do, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+    dq, dk, dv = _bwd(
+        qt, kt, vt, qseg, kseg, out_p, lse, do,
+        scale=scale, causal=causal, q_offset=q_offset, sk=sk,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    dq = jnp.moveaxis(dq[:, :, :sq], 2, 1)
+    dk = jnp.moveaxis(dk[:, :, :sk], 2, 1)
+    dv = jnp.moveaxis(dv[:, :, :sk], 2, 1)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,  # [B, Sq, Hq, hd]
+    k: jnp.ndarray,  # [B, Sk, Hkv, hd]
+    v: jnp.ndarray,  # [B, Sk, Hkv, hd]
+    *,
+    causal: bool = True,
+    q_offset: int = 0,
+    segment_ids: Optional[jnp.ndarray] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Flash attention; same contract as ``dense_attention``.
+
+    ``q_offset`` must be a static python int on this path (the pallas
+    grid's causal-skip predicate is specialised on it); the decode path
+    with a traced offset should use ``dense_attention``.
+    """
+    if not isinstance(q_offset, int):
+        raise TypeError(
+            "flash_attention requires a static int q_offset; use "
+            "dense_attention for traced offsets (KV-cache decode)."
+        )
+    B, sq, Hq, hd = q.shape
+    _, sk, Hkv, _ = k.shape
+    assert Hq % Hkv == 0, (Hq, Hkv)
+    if interpret is None:
+        interpret = _interpret_default()
+    block_q = min(block_q, _ceil_to(sq, 128))
+    block_k = min(block_k, _ceil_to(sk, 128))
+    return _flash(
+        q, k, v, segment_ids, causal, q_offset, sq, sk,
+        block_q, block_k, interpret,
+    )
