@@ -148,7 +148,10 @@ def _fwd_kernel(
         m_prev = m_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        # Re-mask after the exp: on a row with no live column yet,
+        # m_new == _NEG_INF and exp(s - m_new) == 1 for masked entries,
+        # which would poison l/acc with phantom mass.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             p.astype(v.dtype),

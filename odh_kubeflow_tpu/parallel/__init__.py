@@ -8,3 +8,8 @@ from odh_kubeflow_tpu.parallel.mesh import (  # noqa: F401
     build_mesh,
     local_mesh_config,
 )
+from odh_kubeflow_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    zigzag_permute,
+    zigzag_unpermute,
+)

@@ -1,0 +1,261 @@
+"""Ring attention: context-parallel attention over the ``context`` mesh axis.
+
+Long-context is first-class in the TPU rebuild (the reference platform has
+no attention code at all — SURVEY.md §5 "long-context" entry documents its
+absence and assigns the capability to this layer). A sequence sharded
+across the ``context`` axis never materialises more than a
+``[S/C, S/C]`` score block per device:
+
+- each device holds its local Q block permanently;
+- K/V blocks rotate around the ring via ``lax.ppermute`` (one ICI
+  neighbour hop per step — the same collective pattern the bidirectional
+  ICI torus is built for);
+- partial attention outputs merge with the online-softmax rescaling used
+  by flash attention (running max / numerator / denominator in float32).
+
+The permute for step t+1 is issued *before* the block-t compute, so
+XLA's latency-hiding scheduler overlaps the collective-permute with the
+two matmuls of the current block.
+
+Causality makes plain ring layouts unbalanced (device ``i`` attends
+``i+1`` of ``C`` blocks). Fully-masked blocks are skipped with a
+``lax.cond`` so they cost a predicated branch, not matmuls; the
+load-balanced zigzag layout is provided by ``zigzag_permute`` /
+``zigzag_unpermute`` which callers apply to tokens before/after the
+model (each device then owns one chunk from the front and one mirrored
+chunk from the back of the sequence — uniform work per device).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from odh_kubeflow_tpu.ops.attention import dense_attention
+from odh_kubeflow_tpu.parallel.mesh import (
+    AXIS_CONTEXT,
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_TENSOR,
+)
+
+_NEG_INF = jnp.float32(-1e30)
+
+
+def _block_positions(block_idx, block_len: int, layout: str, num_blocks: int):
+    """Global token positions covered by ring block ``block_idx``.
+
+    ``plain``  — contiguous chunks: block i owns [i*L, (i+1)*L).
+    ``zigzag`` — block i owns chunk i's first half from the sequence
+    front and the mirrored half from the back (chunks i and 2C-1-i of
+    half-block length), which equalises causal work across the ring.
+    """
+    if layout == "plain":
+        return block_idx * block_len + jnp.arange(block_len)
+    half = block_len // 2
+    front = block_idx * half + jnp.arange(half)
+    back = (2 * num_blocks - 1 - block_idx) * half + jnp.arange(half)
+    return jnp.concatenate([front, back])
+
+
+def _zigzag_index(S: int, num_blocks: int) -> jnp.ndarray:
+    """Permutation mapping natural order → zigzag shard order, built
+    from the same ``_block_positions`` the in-ring causal mask uses (one
+    source of truth for the layout)."""
+    assert S % (2 * num_blocks) == 0, (S, num_blocks)
+    block_len = S // num_blocks
+    return jnp.concatenate(
+        [
+            _block_positions(i, block_len, "zigzag", num_blocks)
+            for i in range(num_blocks)
+        ]
+    )
+
+
+def zigzag_permute(x: jnp.ndarray, num_blocks: int, axis: int = 1) -> jnp.ndarray:
+    """Reorder a sequence axis so contiguous context shards hold the
+    zigzag (front-chunk + mirrored back-chunk) layout. Apply to tokens,
+    targets, loss masks, and segment ids before a ``layout='zigzag'``
+    ring-attention model; invert with ``zigzag_unpermute``."""
+    idx = _zigzag_index(x.shape[axis], num_blocks)
+    return jnp.take(x, idx, axis=axis)
+
+
+def zigzag_unpermute(x: jnp.ndarray, num_blocks: int, axis: int = 1) -> jnp.ndarray:
+    S = x.shape[axis]
+    idx = _zigzag_index(S, num_blocks)
+    inv = jnp.zeros((S,), jnp.int32).at[idx].set(jnp.arange(S, dtype=jnp.int32))
+    return jnp.take(x, inv, axis=axis)
+
+
+def _ring_body(
+    q: jnp.ndarray,  # [B, Sq, Hkv, G, hd] local query block (GQA grouped)
+    seg_q,  # [B, Sq] or None
+    *,
+    causal: bool,
+    axis_name: str,
+    layout: str,
+):
+    """Returns the scanned ring loop: per-device flash-style accumulation
+    of attention over rotating K/V blocks."""
+    C = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % C) for i in range(C)]
+    scale = q.shape[-1] ** -0.5
+    q_pos = None
+    if causal:
+        q_pos = _block_positions(my, q.shape[1], layout, C)
+
+    def attend(carry_stats, k_blk, v_blk, seg_blk, kv_idx):
+        num, den, mx = carry_stats
+        scores = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", q, k_blk, preferred_element_type=jnp.float32
+        )
+        scores = scores * scale
+        mask = None
+        if causal:
+            k_pos = _block_positions(kv_idx, k_blk.shape[1], layout, C)
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, None, None]
+        if seg_q is not None:
+            seg = (seg_q[:, :, None] == seg_blk[:, None, :])[:, None, None]
+            mask = seg if mask is None else jnp.logical_and(mask, seg)
+        if mask is not None:
+            scores = jnp.where(mask, scores, _NEG_INF)
+        bmax = jnp.max(scores, axis=-1)
+        new_mx = jnp.maximum(mx, bmax)
+        p = jnp.exp(scores - new_mx[..., None])
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(mx - new_mx)
+        den = den * corr + jnp.sum(p, axis=-1)
+        # p→bf16 for the PV matmul (MXU path); accumulate f32.
+        pv = jnp.einsum(
+            "bhgqk,bkhd->bqhgd",
+            p.astype(v_blk.dtype),
+            v_blk,
+            preferred_element_type=jnp.float32,
+        )
+        num = num * corr.transpose(0, 3, 1, 2)[..., None] + pv
+        return num, den, new_mx
+
+    def body(carry, t):
+        k_blk, v_blk, seg_blk, stats = carry
+        # Issue next-step permutes first: independent of this block's
+        # matmuls, so the scheduler overlaps ICI with MXU.
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        seg_nxt = (
+            lax.ppermute(seg_blk, axis_name, perm) if seg_blk is not None else None
+        )
+        kv_idx = (my - t) % C
+        if causal and layout == "plain":
+            # Blocks strictly in the future are fully masked — skip
+            # both matmuls with a predicated branch.
+            stats = lax.cond(
+                kv_idx > my,
+                lambda s: s,
+                lambda s: attend(s, k_blk, v_blk, seg_blk, kv_idx),
+                stats,
+            )
+        else:
+            stats = attend(stats, k_blk, v_blk, seg_blk, kv_idx)
+        return (k_nxt, v_nxt, seg_nxt, stats), None
+
+    return body, C
+
+
+def _ring_attention_local(
+    q: jnp.ndarray,  # [B, Sq, Hq, hd] (per-device block)
+    k: jnp.ndarray,  # [B, Sk, Hkv, hd]
+    v: jnp.ndarray,
+    seg: Optional[jnp.ndarray],  # [B, S] per-device block
+    *,
+    causal: bool,
+    axis_name: str,
+    layout: str,
+) -> jnp.ndarray:
+    B, Sq, Hq, hd = q.shape
+    _, Sk, Hkv, _ = k.shape
+    assert Hq % Hkv == 0, (Hq, Hkv)
+    G = Hq // Hkv
+    qg = q.reshape(B, Sq, Hkv, G, hd)
+
+    num = jnp.zeros((B, Sq, Hkv, G, hd), jnp.float32)
+    den = jnp.zeros((B, Hkv, G, Sq), jnp.float32)
+    mx = jnp.full((B, Hkv, G, Sq), _NEG_INF)
+
+    body, C = _ring_body(
+        qg, seg, causal=causal, axis_name=axis_name, layout=layout
+    )
+    (_, _, _, (num, den, mx)), _ = lax.scan(
+        body, (k, v, seg, (num, den, mx)), jnp.arange(C)
+    )
+    out = num / jnp.maximum(den, 1e-30).transpose(0, 3, 1, 2)[..., None]
+    return out.astype(q.dtype).reshape(B, Sq, Hq, hd)
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, S, Hq, hd], sequence sharded on `context`
+    k: jnp.ndarray,  # [B, S, Hkv, hd]
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jnp.ndarray] = None,  # [B, S]
+    axis_name: str = AXIS_CONTEXT,
+    layout: str = "plain",  # or "zigzag" (caller pre-permutes tokens)
+) -> jnp.ndarray:
+    """Drop-in for ``ops.attention.dense_attention`` under a mesh whose
+    ``context`` axis is >1. Degrades to dense attention when no mesh is
+    active or the context axis is trivial (so the same model code runs
+    single-chip and context-parallel unchanged)."""
+    am = jax.sharding.get_abstract_mesh()
+    if (
+        am.empty
+        or axis_name not in am.axis_names
+        or am.shape[axis_name] == 1
+    ):
+        return dense_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+
+    if layout not in ("plain", "zigzag"):
+        raise ValueError(f"unknown ring layout {layout!r}")
+    C = am.shape[axis_name]
+    S = q.shape[1]
+    if S % C or (layout == "zigzag" and (S // C) % 2):
+        raise ValueError(f"seq len {S} not tileable over context={C} ({layout})")
+
+    names = set(am.axis_names)
+    batch_ax = tuple(a for a in (AXIS_DATA, AXIS_FSDP) if a in names) or None
+    # Heads ride the tensor axis when it divides the KV-head count
+    # (keeps tensor parallelism inside the shard_map); otherwise heads
+    # replicate across tensor and XLA all-gathers them at the boundary.
+    t = am.shape.get(AXIS_TENSOR, 1) if AXIS_TENSOR in names else 1
+    head_ax = AXIS_TENSOR if (t > 1 and k.shape[2] % t == 0) else None
+
+    qkv_spec = P(batch_ax, axis_name, head_ax, None)
+    seg_spec = P(batch_ax, axis_name)
+    fn = partial(
+        _ring_attention_local, causal=causal, axis_name=axis_name, layout=layout
+    )
+
+    if segment_ids is None:
+        sharded = jax.shard_map(
+            lambda q_, k_, v_: fn(q_, k_, v_, None),
+            mesh=am,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )
+        return sharded(q, k, v)
+    sharded = jax.shard_map(
+        fn,
+        mesh=am,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return sharded(q, k, v, segment_ids)
