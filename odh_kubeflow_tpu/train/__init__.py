@@ -1,3 +1,4 @@
+from odh_kubeflow_tpu.train.checkpoint import CheckpointManager  # noqa: F401
 from odh_kubeflow_tpu.train.trainer import (  # noqa: F401
     TrainConfig,
     Trainer,

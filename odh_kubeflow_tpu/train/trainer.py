@@ -213,6 +213,47 @@ class Trainer:
         self.step += 1
         return metrics
 
+    # -- checkpoint / resume ------------------------------------------------
+    #
+    # The trainable tree + optimizer state + step round-trip through
+    # `train.checkpoint.CheckpointManager` (orbax). Base params are NOT
+    # saved on the LoRA path — they are frozen and reproducible from the
+    # pretrained weights, so adapter checkpoints stay megabytes.
+
+    def _checkpoint_state(self) -> dict:
+        trainable = self.lora_params if self.lora_cfg is not None else self.params
+        return {"trainable": trainable, "opt_state": self.opt_state}
+
+    def save_checkpoint(self, manager, *, force: bool = True) -> bool:
+        """``manager`` is a ``train.checkpoint.CheckpointManager`` (kept
+        by the caller so its GC/interval policy spans the whole run)."""
+        return manager.save(self.step, self._checkpoint_state(), force=force)
+
+    def restore_checkpoint(self, manager, step: Optional[int] = None) -> int:
+        """Restores trainable + optimizer state *into this trainer's
+        mesh* — the checkpoint may have been written on a different
+        topology; orbax reshards each array onto the target shardings.
+        Returns the restored step."""
+        from odh_kubeflow_tpu.train.checkpoint import _abstract_like
+
+        target = {
+            "trainable": _abstract_like(
+                self._checkpoint_state()["trainable"], self.mesh, self._train_specs
+            ),
+            "opt_state": _abstract_like(
+                self.opt_state, self.mesh, self._opt_specs
+            ),
+        }
+        step = manager.latest_step() if step is None else step
+        state = manager.restore(target, step=step)
+        if self.lora_cfg is not None:
+            self.lora_params = state["trainable"]
+        else:
+            self.params = state["trainable"]
+        self.opt_state = state["opt_state"]
+        self.step = int(step)
+        return self.step
+
     # -- convenience --------------------------------------------------------
 
     def make_fake_batch(self, batch_size: int, seq_len: int, seed: int = 0) -> dict:
