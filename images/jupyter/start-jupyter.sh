@@ -1,0 +1,22 @@
+#!/bin/bash
+# Launch JupyterLab under the platform's path-prefix contract.
+# NB_PREFIX is injected by the notebook controller
+# (odh_kubeflow_tpu/controllers/notebook.py; reference
+# notebook_controller.go:402-416). tpu-init is a no-op on CPU images /
+# single-host slices.
+set -euo pipefail
+
+if command -v tpu-init >/dev/null 2>&1; then
+  tpu-init || echo "tpu-init failed; continuing (CPU fallback)" >&2
+fi
+
+exec jupyter lab \
+  --notebook-dir="${HOME}" \
+  --ip=0.0.0.0 \
+  --port=8888 \
+  --no-browser \
+  --ServerApp.base_url="${NB_PREFIX}" \
+  --ServerApp.token='' \
+  --ServerApp.password='' \
+  --ServerApp.allow_origin='*' \
+  --ServerApp.authenticate_prometheus=False

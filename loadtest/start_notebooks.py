@@ -1,0 +1,167 @@
+#!/usr/bin/env python3
+"""Load test: N Notebook CRs + workspace PVCs, time-to-ready stats.
+
+Reference parity: notebook-controller/loadtest/start_notebooks.py
+(applies N Notebooks + PVCs against a live cluster, records nothing).
+This version measures what the reference never did — the platform's
+north-star spawn latency — against either:
+
+- the in-process platform + sim kubelet (default; exercises webhook,
+  reconciler, scheduler, culler bookkeeping with zero cluster), or
+- a running API server (``--api-url``; e.g. the all-in-one platform's
+  REST port, or a real cluster proxying our CRDs).
+
+Prints one JSON line:
+  {"notebooks": N, "ready": N, "p50_s": ..., "p95_s": ..., "total_s": ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _notebook(name: str, ns: str, tpu: bool) -> dict:
+    nb = {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns, "labels": {"loadtest": "true"}},
+        "spec": {
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": name,
+                            "image": "odh-kubeflow-tpu/jupyter-scipy:latest",
+                            "resources": {
+                                "requests": {"cpu": "500m", "memory": "1Gi"}
+                            },
+                            "volumeMounts": [
+                                {"name": "workspace", "mountPath": "/home/jovyan"}
+                            ],
+                        }
+                    ],
+                    "volumes": [
+                        {
+                            "name": "workspace",
+                            "persistentVolumeClaim": {
+                                "claimName": f"{name}-workspace"
+                            },
+                        }
+                    ],
+                }
+            }
+        },
+    }
+    if tpu:
+        from odh_kubeflow_tpu.apis import (
+            TPU_ACCELERATOR_ANNOTATION,
+            TPU_TOPOLOGY_ANNOTATION,
+        )
+
+        nb["metadata"]["annotations"] = {
+            TPU_ACCELERATOR_ANNOTATION: "tpu-v5-lite-podslice",
+            TPU_TOPOLOGY_ANNOTATION: "2x2",
+        }
+    return nb
+
+
+def _pvc(name: str, ns: str) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": f"{name}-workspace", "namespace": ns},
+        "spec": {
+            "accessModes": ["ReadWriteOnce"],
+            "resources": {"requests": {"storage": "1Gi"}},
+        },
+    }
+
+
+def _ready(api, name: str, ns: str) -> bool:
+    from odh_kubeflow_tpu.machinery.store import NotFound
+
+    try:
+        sts = api.get("StatefulSet", name, ns)
+    except NotFound:
+        return False
+    return bool((sts.get("status") or {}).get("readyReplicas"))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--count", type=int, default=3)
+    parser.add_argument("--namespace", default="loadtest")
+    parser.add_argument("--tpu", action="store_true", help="request 2x2 v5e slices")
+    parser.add_argument(
+        "--api-url", default="", help="attach to a served REST API instead of sim"
+    )
+    parser.add_argument("--timeout", type=float, default=120.0)
+    args = parser.parse_args()
+
+    platform = None
+    if args.api_url:
+        from odh_kubeflow_tpu.machinery.client import RemoteAPIServer
+        from odh_kubeflow_tpu.apis import register_crds
+
+        api = RemoteAPIServer(args.api_url)
+        register_crds(api)
+    else:
+        from odh_kubeflow_tpu.platform import Platform
+
+        platform = Platform(sim=True)
+        # capacity for the whole fleet: one big CPU node + TPU pools
+        platform.cluster.add_node(
+            "cpu-0", cpu=str(max(32, args.count)), memory=f"{4 * args.count}Gi"
+        )
+        if args.tpu:
+            for i in range(args.count):
+                platform.cluster.add_tpu_node_pool(
+                    f"tpu-{i}",
+                    accelerator_type="tpu-v5-lite-podslice",
+                    topology="2x2",
+                )
+        platform.start(api_port=0, web_port=0)
+        api = platform.api
+
+    ns = args.namespace
+    api.create_or_get(
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": ns}}
+    )
+
+    names = [f"nb-{i:03d}" for i in range(args.count)]
+    t0 = time.time()
+    created_at: dict[str, float] = {}
+    for name in names:
+        api.create(_pvc(name, ns))
+        api.create(_notebook(name, ns, args.tpu))
+        created_at[name] = time.time()
+
+    ready_at: dict[str, float] = {}
+    deadline = t0 + args.timeout
+    while len(ready_at) < len(names) and time.time() < deadline:
+        for name in names:
+            if name not in ready_at and _ready(api, name, ns):
+                ready_at[name] = time.time()
+        time.sleep(0.05)
+
+    lat = sorted(ready_at[n] - created_at[n] for n in ready_at)
+    out = {
+        "notebooks": len(names),
+        "ready": len(ready_at),
+        "p50_s": round(lat[len(lat) // 2], 3) if lat else None,
+        "p95_s": round(lat[min(len(lat) - 1, int(len(lat) * 0.95))], 3)
+        if lat
+        else None,
+        "total_s": round(time.time() - t0, 3),
+    }
+    print(json.dumps(out))
+    if platform is not None:
+        platform.stop()
+    if len(ready_at) < len(names):
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

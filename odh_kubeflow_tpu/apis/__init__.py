@@ -65,6 +65,50 @@ def _validate_tensorboard(req):
         raise Invalid("Tensorboard spec.logspath is required")
 
 
+def install_default_cluster_roles(api: APIServer) -> None:
+    """The kubeflow-admin/edit/view ClusterRoles every profile
+    RoleBinding references (the reference ships these via manifests;
+    kfam maps its role names onto them, bindings.go:39-46). Idempotent."""
+    kf_groups = ["kubeflow.org", "tensorboard.kubeflow.org"]
+    kf_resources = ["notebooks", "poddefaults", "tensorboards", "profiles"]
+    core_resources = [
+        "persistentvolumeclaims",
+        "pods",
+        "pods/log",
+        "services",
+        "events",
+        "configmaps",
+        "nodes",
+    ]
+    # secrets deliberately excluded from view (upstream view roles do the
+    # same: a read-only observer must not hold credentials)
+    roles = {
+        "kubeflow-admin": [
+            {"apiGroups": kf_groups + [""],
+             "resources": kf_resources + core_resources + ["secrets"],
+             "verbs": ["*"]},
+        ],
+        "kubeflow-edit": [
+            {"apiGroups": kf_groups, "resources": kf_resources, "verbs": ["*"]},
+            {"apiGroups": [""], "resources": core_resources + ["secrets"],
+             "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+        ],
+        "kubeflow-view": [
+            {"apiGroups": kf_groups + [""], "resources": kf_resources + core_resources,
+             "verbs": ["get", "list", "watch"]},
+        ],
+    }
+    for name, rules in roles.items():
+        api.create_or_get(
+            {
+                "apiVersion": "rbac.authorization.k8s.io/v1",
+                "kind": "ClusterRole",
+                "metadata": {"name": name},
+                "rules": rules,
+            }
+        )
+
+
 def register_crds(api: APIServer) -> None:
     api.register_kind(NOTEBOOK_API_VERSION, "Notebook", "notebooks", True)
     api.register_kind(PROFILE_API_VERSION, "Profile", "profiles", False)

@@ -487,3 +487,31 @@ class NotebookController:
         else:
             conditions.append(cond)
         self.api.update_status(notebook)
+
+
+def main() -> None:
+    """Split-process entrypoint (manifests/notebook-controller): attach
+    to $KUBE_API_URL and run the reconciler + culler forever."""
+    from odh_kubeflow_tpu.machinery.runner import run_controller
+
+    def register(api, mgr):
+        from odh_kubeflow_tpu.controllers.culler import Culler, CullerConfig
+
+        cfg = NotebookControllerConfig.from_env()
+        culler = None
+        if cfg.enable_culling:
+            culler = Culler(
+                api,
+                CullerConfig(
+                    cull_idle_seconds=cfg.cull_idle_seconds,
+                    idleness_check_seconds=cfg.idleness_check_seconds,
+                    cluster_domain=cfg.cluster_domain,
+                ),
+            )
+        NotebookController(api, cfg, culler=culler).register(mgr)
+
+    run_controller("notebook-controller", register)
+
+
+if __name__ == "__main__":
+    main()

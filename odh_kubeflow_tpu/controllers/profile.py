@@ -374,3 +374,21 @@ class ProfileController:
                 plugin.revoke(self.api, profile, spec)
             else:
                 plugin.apply(self.api, profile, spec)
+
+
+def main() -> None:
+    """Split-process entrypoint (manifests/profile-controller)."""
+    import os
+
+    from odh_kubeflow_tpu.machinery.runner import run_controller
+
+    run_controller(
+        "profile-controller",
+        lambda api, mgr: ProfileController(
+            api, labels_path=os.environ.get("NAMESPACE_LABELS_PATH")
+        ).register(mgr),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -249,3 +249,17 @@ class TensorboardController:
             ],
         }
         self.api.update_status(tb)
+
+
+def main() -> None:
+    """Split-process entrypoint (manifests/tensorboard-controller)."""
+    from odh_kubeflow_tpu.machinery.runner import run_controller
+
+    run_controller(
+        "tensorboard-controller",
+        lambda api, mgr: TensorboardController(api).register(mgr),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,359 @@
+"""HTTP client for the platform API server, duck-typed to
+``machinery.store.APIServer``.
+
+Controllers, webhooks, and web backends take an ``APIServer``-shaped
+object; handing them a ``RemoteAPIServer`` instead runs the identical
+code against a remote API over the REST façade (``machinery.httpapi``)
+— the same split the reference deploys (every component is a separate
+process talking to kube-apiserver; SURVEY.md §1 control flow). Admission
+hooks are the one server-side concern: ``register_admission_hook`` here
+is a no-op because mutation/validation happens inside the serving
+process (or via the AdmissionReview webhook deployment).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from typing import Any, Optional
+
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.store import (
+    AlreadyExists,
+    APIError,
+    Conflict,
+    Denied,
+    Invalid,
+    NotFound,
+    TypeInfo,
+    Watch,
+)
+
+Obj = dict[str, Any]
+
+_ERR_BY_CODE = {404: NotFound, 409: Conflict, 422: Invalid, 403: Denied}
+_EVENT_INDEX_MAX = 4096
+
+
+class RemoteAPIServer:
+    def __init__(self, base_url: str = "http://127.0.0.1:8001", timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._types: dict[str, TypeInfo] = {}
+        self._watches: list[Watch] = []
+        self._lock = threading.RLock()
+        # LRU-bounded: long-running controllers emit events with dynamic
+        # detail; the dedupe cache must not grow with them
+        self._event_index: "OrderedDict[tuple, str]" = OrderedDict()
+        # mirror the embedded server's builtin registry so kind→path
+        # resolution works without a discovery round-trip
+        from odh_kubeflow_tpu.machinery.store import BUILTIN_KINDS
+
+        for api_version, kind, plural, namespaced in BUILTIN_KINDS:
+            self.register_kind(api_version, kind, plural, namespaced)
+
+    # -- registry (local only; the server owns admission) -------------------
+
+    def register_kind(
+        self, api_version: str, kind: str, plural: str, namespaced: bool = True
+    ) -> None:
+        with self._lock:
+            self._types[kind] = TypeInfo(api_version, kind, plural, namespaced)
+
+    def register_admission_hook(self, kinds, fn, mutating=True, name="") -> None:
+        """Admission runs in the serving process; a remote registration
+        is intentionally a no-op (parity: you cannot register Go code
+        into kube-apiserver either — you deploy a webhook)."""
+
+    def type_info(self, kind: str) -> TypeInfo:
+        try:
+            return self._types[kind]
+        except KeyError:
+            raise NotFound(f"kind {kind!r} not registered") from None
+
+    def kind_for_plural(self, plural: str) -> str:
+        for kind, info in self._types.items():
+            if info.plural == plural:
+                return kind
+        raise NotFound(f"no kind with plural {plural!r}")
+
+    # -- wire ---------------------------------------------------------------
+
+    def _path(
+        self, kind: str, namespace: Optional[str], name: Optional[str],
+        subresource: Optional[str] = None, require_ns: bool = True,
+    ) -> str:
+        """``require_ns=False`` is the all-namespaces collection form
+        used by list/watch."""
+        info = self.type_info(kind)
+        group_version = info.api_version
+        prefix = (
+            "/api/v1" if "/" not in group_version else f"/apis/{group_version}"
+        )
+        p = prefix
+        if info.namespaced:
+            if not namespace and require_ns:
+                raise Invalid(f"{kind} is namespaced; namespace required")
+            if namespace:
+                p += f"/namespaces/{namespace}"
+        p += f"/{info.plural}"
+        if name:
+            p += f"/{name}"
+        if subresource:
+            p += f"/{subresource}"
+        return p
+
+    def _request(
+        self, method: str, path: str, body: Optional[Obj] = None, query: str = ""
+    ) -> Obj:
+        url = self.base_url + path + (f"?{query}" if query else "")
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.loads(r.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            message, reason = str(e), ""
+            try:
+                status = json.loads(e.read().decode())
+                message = status.get("message", message)
+                reason = status.get("reason", "")
+            except Exception:  # noqa: BLE001
+                pass
+            # the structured Status.reason disambiguates the two 409s
+            klass = {
+                "AlreadyExists": AlreadyExists,
+                "Conflict": Conflict,
+                "NotFound": NotFound,
+                "Invalid": Invalid,
+                "Denied": Denied,
+            }.get(reason) or _ERR_BY_CODE.get(e.code, APIError)
+            raise klass(message) from None
+
+    # -- CRUD (APIServer duck type) -----------------------------------------
+
+    def create(self, obj: Obj, dry_run: bool = False) -> Obj:
+        kind = obj.get("kind", "")
+        info = self.type_info(kind)
+        ns = obj.get("metadata", {}).get("namespace") if info.namespaced else None
+        if info.namespaced and not ns:
+            raise Invalid(f"{kind} is namespaced; namespace required")
+        return self._request(
+            "POST",
+            self._path(kind, ns, None),
+            obj,
+            query="dryRun=All" if dry_run else "",
+        )
+
+    def get(self, kind: str, name: str, namespace: Optional[str] = None) -> Obj:
+        return self._request("GET", self._path(kind, namespace, name))
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Obj] = None,
+        field_matches: Optional[dict[str, Any]] = None,
+    ) -> list[Obj]:
+        p = self._path(kind, namespace, None, require_ns=False)
+        query = ""
+        if label_selector:
+            query = "labelSelector=" + _selector_to_string(label_selector)
+        items = self._request("GET", p, query=query).get("items", [])
+        if field_matches:
+            items = [
+                it
+                for it in items
+                if all(
+                    obj_util.get_path(it, *path.split(".")) == want
+                    for path, want in field_matches.items()
+                )
+            ]
+        return items
+
+    def update(self, obj: Obj) -> Obj:
+        meta = obj.get("metadata", {})
+        return self._request(
+            "PUT",
+            self._path(obj.get("kind", ""), meta.get("namespace"), meta["name"]),
+            obj,
+        )
+
+    def update_status(self, obj: Obj) -> Obj:
+        meta = obj.get("metadata", {})
+        return self._request(
+            "PUT",
+            self._path(
+                obj.get("kind", ""), meta.get("namespace"), meta["name"], "status"
+            ),
+            obj,
+        )
+
+    def patch(
+        self, kind: str, name: str, patch: Obj, namespace: Optional[str] = None
+    ) -> Obj:
+        return self._request("PATCH", self._path(kind, namespace, name), patch)
+
+    def delete(self, kind: str, name: str, namespace: Optional[str] = None) -> None:
+        self._request("DELETE", self._path(kind, namespace, name))
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        send_initial: bool = True,
+    ) -> Watch:
+        p = self._path(kind, namespace, None, require_ns=False)
+        url = (
+            self.base_url
+            + p
+            + f"?watch=true&sendInitialEvents={'true' if send_initial else 'false'}"
+        )
+        w = Watch(self, kind, namespace)
+
+        def pump():
+            resp = None
+            try:
+                # no read timeout: heartbeats arrive every 15s; a dead
+                # server surfaces as a connection error ending the pump
+                resp = urllib.request.urlopen(url)  # noqa: S310
+                w._resp = resp
+                for line in resp:
+                    if w._stopped:
+                        break
+                    try:
+                        evt = json.loads(line.decode())
+                    except ValueError:
+                        continue
+                    if evt.get("type") in ("HEARTBEAT", None):
+                        continue
+                    w._enqueue((evt["type"], evt["object"]))
+            except (OSError, ValueError):
+                pass
+            finally:
+                # the pump owns the close: closing from another thread
+                # would block on the buffered-reader lock held by the
+                # in-flight readline until the next heartbeat
+                if resp is not None:
+                    try:
+                        resp.close()
+                    except OSError:
+                        pass
+                w._q.put(None)
+
+        threading.Thread(target=pump, daemon=True).start()
+        with self._lock:
+            self._watches.append(w)
+        return w
+
+    def _remove_watch(self, w: Watch) -> None:
+        with self._lock:
+            if w in self._watches:
+                self._watches.remove(w)
+        resp = getattr(w, "_resp", None)
+        if resp is not None:
+            # interrupt the pump's blocking readline NOW (vs waiting out
+            # the server heartbeat) by shutting the socket down; the
+            # pump thread then closes the response itself
+            try:
+                sock = resp.fp.raw._sock  # noqa: SLF001 — stdlib internals
+                sock.shutdown(socket.SHUT_RDWR)
+            except (AttributeError, OSError):
+                pass
+
+    # -- convenience (same semantics as the embedded server) ----------------
+
+    def create_or_get(self, obj: Obj) -> Obj:
+        try:
+            return self.create(obj)
+        except AlreadyExists:
+            meta = obj.get("metadata", {})
+            return self.get(obj["kind"], meta["name"], meta.get("namespace"))
+
+    def emit_event(
+        self,
+        involved: Obj,
+        reason: str,
+        message: str,
+        event_type: str = "Normal",
+        component: str = "",
+    ) -> Obj:
+        ns = involved.get("metadata", {}).get("namespace") or "default"
+        # Same dedupe contract as the embedded server: identical repeat
+        # emissions return the existing Event with no write, so
+        # reconcilers that emit-and-watch Events quiesce remotely too.
+        dedupe_key = (
+            ns,
+            involved.get("kind", ""),
+            obj_util.name_of(involved),
+            involved.get("metadata", {}).get("uid", ""),
+            reason,
+            message,
+            event_type,
+        )
+        with self._lock:
+            cached_name = self._event_index.get(dedupe_key)
+            if cached_name is not None:
+                self._event_index.move_to_end(dedupe_key)
+        if cached_name is not None:
+            try:
+                return self.get("Event", cached_name, ns)
+            except NotFound:
+                with self._lock:
+                    self._event_index.pop(dedupe_key, None)
+        event = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "generateName": f"{obj_util.name_of(involved)}.",
+                "namespace": ns,
+            },
+            "involvedObject": {
+                "apiVersion": involved.get("apiVersion", ""),
+                "kind": involved.get("kind", ""),
+                "name": obj_util.name_of(involved),
+                "namespace": ns,
+                "uid": involved.get("metadata", {}).get("uid", ""),
+            },
+            "reason": reason,
+            "message": message,
+            "type": event_type,
+            "source": {"component": component},
+            "firstTimestamp": obj_util.now_rfc3339(),
+            "lastTimestamp": obj_util.now_rfc3339(),
+            "count": 1,
+        }
+        created = self.create(event)
+        with self._lock:
+            self._event_index[dedupe_key] = created["metadata"]["name"]
+            while len(self._event_index) > _EVENT_INDEX_MAX:
+                self._event_index.popitem(last=False)
+        return created
+
+
+def _selector_to_string(selector: Obj) -> str:
+    """Inverse of objects.parse_selector_string for the matchLabels part."""
+    labels = selector.get("matchLabels", selector) or {}
+    return ",".join(f"{k}={v}" for k, v in labels.items())
+
+
+def api_from_env() -> RemoteAPIServer:
+    """Client for split-process components (`python -m odh_kubeflow_tpu.
+    controllers.notebook` etc.): connects to $KUBE_API_URL and registers
+    the platform CRD kinds for path mapping."""
+    import os
+
+    api = RemoteAPIServer(os.environ.get("KUBE_API_URL", "http://127.0.0.1:8001"))
+    from odh_kubeflow_tpu.apis import register_crds
+
+    register_crds(api)  # admission registration is a client-side no-op
+    return api

@@ -1,0 +1,275 @@
+"""Kubernetes-convention REST façade over the embedded APIServer.
+
+The reference talks to a real kube-apiserver; this module gives the
+embedded store the same wire surface so the platform's components run as
+*separate processes* exactly as the manifests deploy them
+(`manifests/*/manifests.yaml` command lines), with
+``machinery.client.RemoteAPIServer`` as the in-process client on the
+other end.
+
+Paths follow upstream conventions:
+
+    /api/v1/namespaces/{ns}/{plural}[/{name}[/status]]
+    /api/v1/{plural}[/{name}]                        (cluster-scoped core)
+    /apis/{group}/{version}/namespaces/{ns}/{plural}[/{name}[/status]]
+    /apis/{group}/{version}/{plural}[/{name}[/status]]
+    ?labelSelector=k=v,k2   on lists
+    ?watch=true             streams {"type","object"} JSON lines
+                            (k8s watch framing), HEARTBEAT lines as
+                            keep-alive
+    /healthz /readyz /version
+
+Verb → store mapping: GET(list/get), POST(create), PUT(update or
+update_status), PATCH(json-merge-patch), DELETE. Store errors map to the
+same HTTP codes kube-apiserver uses (404/409/409/422/403).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Iterator, Optional
+from urllib.parse import parse_qs
+from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
+from socketserver import ThreadingMixIn
+
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.store import (
+    AlreadyExists,
+    APIError,
+    APIServer,
+    Conflict,
+    Denied,
+    Invalid,
+    NotFound,
+)
+
+Obj = dict[str, Any]
+
+_STATUS = {
+    NotFound: 404,
+    AlreadyExists: 409,
+    Conflict: 409,
+    Invalid: 422,
+    Denied: 403,
+}
+
+WATCH_HEARTBEAT_SECONDS = 15.0
+
+
+def _err_status(e: APIError) -> int:
+    for klass, code in _STATUS.items():
+        if isinstance(e, klass):
+            return code
+    return 500
+
+
+class _Route:
+    """Parsed resource path."""
+
+    def __init__(self, plural: str, namespace: Optional[str], name: Optional[str],
+                 subresource: Optional[str]):
+        self.plural = plural
+        self.namespace = namespace
+        self.name = name
+        self.subresource = subresource
+
+
+def _parse_path(path: str) -> Optional[_Route]:
+    parts = [p for p in path.split("/") if p]
+    if not parts:
+        return None
+    if parts[0] == "api":
+        parts = parts[2:] if len(parts) >= 2 and parts[1] == "v1" else None
+    elif parts[0] == "apis":
+        # /apis/{group}/{version}/...
+        parts = parts[3:] if len(parts) >= 3 else None
+    else:
+        return None
+    if parts is None:
+        return None
+    ns = None
+    if len(parts) >= 2 and parts[0] == "namespaces" and len(parts) > 2:
+        # /namespaces/{ns}/{plural}/... — but /namespaces and
+        # /namespaces/{name} address the Namespace kind itself
+        ns, parts = parts[1], parts[2:]
+    if not parts:
+        return None
+    plural = parts[0]
+    name = parts[1] if len(parts) > 1 else None
+    sub = parts[2] if len(parts) > 2 else None
+    return _Route(plural, ns, name, sub)
+
+
+class RestAPI:
+    """WSGI app. Thread-safe (the store locks internally)."""
+
+    def __init__(self, server: APIServer):
+        self.server = server
+
+    # -- helpers ------------------------------------------------------------
+
+    def _resolve_kind(self, plural: str) -> str:
+        return self.server.kind_for_plural(plural)
+
+    @staticmethod
+    def _json(status: int, body: Obj, start_response) -> list[bytes]:
+        payload = json.dumps(body).encode()
+        start_response(
+            f"{status} {'OK' if status < 400 else 'Error'}",
+            [
+                ("Content-Type", "application/json"),
+                ("Content-Length", str(len(payload))),
+            ],
+        )
+        return [payload]
+
+    @staticmethod
+    def _error(
+        status: int, message: str, start_response, reason: str = ""
+    ) -> list[bytes]:
+        return RestAPI._json(
+            status,
+            {
+                "kind": "Status",
+                "apiVersion": "v1",
+                "status": "Failure",
+                "message": message,
+                # structured reason (k8s Status.reason) so clients never
+                # have to sniff message substrings
+                "reason": reason,
+                "code": status,
+            },
+            start_response,
+        )
+
+    def _watch_stream(
+        self, kind: str, namespace: Optional[str], send_initial: bool
+    ) -> Iterator[bytes]:
+        w = self.server.watch(kind, namespace=namespace, send_initial=send_initial)
+        try:
+            while True:
+                item = w.get(timeout=WATCH_HEARTBEAT_SECONDS)
+                if item is None:
+                    # queue timeout → heartbeat; a dead client raises on
+                    # the write and the finally stops the watch
+                    yield b'{"type":"HEARTBEAT"}\n'
+                    continue
+                etype, obj = item
+                yield json.dumps({"type": etype, "object": obj}).encode() + b"\n"
+        finally:
+            w.stop()
+
+    # -- WSGI ---------------------------------------------------------------
+
+    def __call__(self, environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        method = environ.get("REQUEST_METHOD", "GET")
+        qs = parse_qs(environ.get("QUERY_STRING", ""))
+
+        if path in ("/healthz", "/readyz", "/livez"):
+            start_response("200 OK", [("Content-Type", "text/plain")])
+            return [b"ok"]
+        if path == "/version":
+            return self._json(
+                200, {"gitVersion": "odh-kubeflow-tpu", "major": "1"}, start_response
+            )
+
+        route = _parse_path(path)
+        if route is None:
+            return self._error(404, f"unrecognised path {path}", start_response)
+
+        try:
+            kind = self._resolve_kind(route.plural)
+        except NotFound as e:
+            return self._error(404, str(e), start_response)
+
+        try:
+            return self._dispatch(kind, route, method, qs, environ, start_response)
+        except APIError as e:
+            return self._error(
+                _err_status(e), str(e), start_response, reason=type(e).__name__
+            )
+        except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
+            return self._error(500, f"{type(e).__name__}: {e}", start_response)
+
+    def _read_body(self, environ) -> Obj:
+        length = int(environ.get("CONTENT_LENGTH") or 0)
+        raw = environ["wsgi.input"].read(length) if length else b"{}"
+        return json.loads(raw.decode() or "{}")
+
+    def _dispatch(self, kind, route, method, qs, environ, start_response):
+        ns, name = route.namespace, route.name
+
+        if method == "GET" and name is None:
+            if qs.get("watch", ["false"])[0] in ("true", "1"):
+                send_initial = qs.get("sendInitialEvents", ["true"])[0] != "false"
+                start_response(
+                    "200 OK",
+                    [("Content-Type", "application/json"), ("X-Stream", "watch")],
+                )
+                return self._watch_stream(kind, ns, send_initial)
+            selector = None
+            if "labelSelector" in qs:
+                selector = obj_util.parse_selector_string(qs["labelSelector"][0])
+            items = self.server.list(kind, namespace=ns, label_selector=selector)
+            return self._json(
+                200,
+                {"kind": f"{kind}List", "apiVersion": "v1", "items": items},
+                start_response,
+            )
+
+        if method == "GET":
+            return self._json(200, self.server.get(kind, name, ns), start_response)
+
+        if method == "POST" and name is None:
+            obj = self._read_body(environ)
+            obj.setdefault("kind", kind)
+            if ns and not obj.setdefault("metadata", {}).get("namespace"):
+                obj["metadata"]["namespace"] = ns
+            dry = qs.get("dryRun", [""])[0] == "All"
+            return self._json(201, self.server.create(obj, dry_run=dry), start_response)
+
+        if method == "PUT" and name is not None:
+            obj = self._read_body(environ)
+            obj.setdefault("kind", kind)
+            if route.subresource == "status":
+                return self._json(200, self.server.update_status(obj), start_response)
+            return self._json(200, self.server.update(obj), start_response)
+
+        if method == "PATCH" and name is not None:
+            patch = self._read_body(environ)
+            return self._json(
+                200, self.server.patch(kind, name, patch, ns), start_response
+            )
+
+        if method == "DELETE" and name is not None:
+            self.server.delete(kind, name, ns)
+            return self._json(200, {"status": "Success"}, start_response)
+
+        raise Invalid(f"unsupported {method} on {route.plural}")
+
+
+class _ThreadingServer(ThreadingMixIn, WSGIServer):
+    daemon_threads = True
+    # long-lived watch streams must not serialize behind each other
+    request_queue_size = 64
+
+
+class _QuietHandler(WSGIRequestHandler):
+    def log_message(self, *args):  # noqa: D102 — stdlib signature
+        pass
+
+
+def serve(
+    server: APIServer, host: str = "127.0.0.1", port: int = 0
+) -> tuple[threading.Thread, int, Any]:
+    """Serve the REST façade on a daemon thread; returns (thread,
+    bound_port, httpd). ``httpd.shutdown()`` stops it."""
+    app = RestAPI(server)
+    httpd = make_server(
+        host, port, app, server_class=_ThreadingServer, handler_class=_QuietHandler
+    )
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return t, httpd.server_address[1], httpd

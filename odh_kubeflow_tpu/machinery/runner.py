@@ -1,0 +1,47 @@
+"""Shared process entrypoint plumbing for split-process components.
+
+Every `python -m odh_kubeflow_tpu.<component>` command line in the
+manifests boots the same way: attach to $KUBE_API_URL, build the
+component, serve/reconcile forever. One implementation here so the
+contract (env names, banner, lifecycle) can't drift across the eight
+entrypoints.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable
+
+
+def run_controller(name: str, register: Callable) -> None:
+    """``register(api, mgr)`` wires controllers into the manager."""
+    from odh_kubeflow_tpu.controllers.runtime import Manager
+    from odh_kubeflow_tpu.machinery.client import api_from_env
+
+    api = api_from_env()
+    mgr = Manager(api)
+    register(api, mgr)
+    mgr.start()
+    print(f"{name} running", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        mgr.stop()
+
+
+def run_web(name: str, default_port: int, build: Callable) -> None:
+    """``build(api)`` returns an object exposing a ``.app`` WSGI app."""
+    from odh_kubeflow_tpu.machinery.client import api_from_env
+
+    backend = build(api_from_env())
+    host = os.environ.get("HOST", "0.0.0.0")
+    port = int(os.environ.get("PORT", str(default_port)))
+    httpd = backend.app.serve(host, port)
+    print(f"{name} on http://{host}:{httpd.server_address[1]}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        httpd.shutdown()

@@ -83,6 +83,31 @@ class _Hook:
     name: str = ""
 
 
+# Kinds every API surface (embedded store and remote client) knows about.
+BUILTIN_KINDS: list[tuple[str, str, str, bool]] = [
+    ("v1", "Namespace", "namespaces", False),
+    ("v1", "Pod", "pods", True),
+    ("v1", "Service", "services", True),
+    ("v1", "ServiceAccount", "serviceaccounts", True),
+    ("v1", "Secret", "secrets", True),
+    ("v1", "ConfigMap", "configmaps", True),
+    ("v1", "PersistentVolumeClaim", "persistentvolumeclaims", True),
+    ("v1", "Event", "events", True),
+    ("v1", "Node", "nodes", False),
+    ("v1", "ResourceQuota", "resourcequotas", True),
+    ("apps/v1", "StatefulSet", "statefulsets", True),
+    ("apps/v1", "Deployment", "deployments", True),
+    ("rbac.authorization.k8s.io/v1", "Role", "roles", True),
+    ("rbac.authorization.k8s.io/v1", "RoleBinding", "rolebindings", True),
+    ("rbac.authorization.k8s.io/v1", "ClusterRole", "clusterroles", False),
+    ("rbac.authorization.k8s.io/v1", "ClusterRoleBinding", "clusterrolebindings", False),
+    ("networking.k8s.io/v1", "NetworkPolicy", "networkpolicies", True),
+    ("networking.istio.io/v1beta1", "VirtualService", "virtualservices", True),
+    ("security.istio.io/v1beta1", "AuthorizationPolicy", "authorizationpolicies", True),
+    ("gateway.networking.k8s.io/v1", "HTTPRoute", "httproutes", True),
+]
+
+
 class Watch:
     """Iterator over (event_type, obj) with a bounded drain queue."""
 
@@ -141,39 +166,7 @@ class APIServer:
             self._store.setdefault(kind, {})
 
     def _register_builtins(self) -> None:
-        core = [
-            ("v1", "Namespace", "namespaces", False),
-            ("v1", "Pod", "pods", True),
-            ("v1", "Service", "services", True),
-            ("v1", "ServiceAccount", "serviceaccounts", True),
-            ("v1", "Secret", "secrets", True),
-            ("v1", "ConfigMap", "configmaps", True),
-            ("v1", "PersistentVolumeClaim", "persistentvolumeclaims", True),
-            ("v1", "Event", "events", True),
-            ("v1", "Node", "nodes", False),
-            ("v1", "ResourceQuota", "resourcequotas", True),
-            ("apps/v1", "StatefulSet", "statefulsets", True),
-            ("apps/v1", "Deployment", "deployments", True),
-            ("rbac.authorization.k8s.io/v1", "Role", "roles", True),
-            ("rbac.authorization.k8s.io/v1", "RoleBinding", "rolebindings", True),
-            ("rbac.authorization.k8s.io/v1", "ClusterRole", "clusterroles", False),
-            (
-                "rbac.authorization.k8s.io/v1",
-                "ClusterRoleBinding",
-                "clusterrolebindings",
-                False,
-            ),
-            ("networking.k8s.io/v1", "NetworkPolicy", "networkpolicies", True),
-            ("networking.istio.io/v1beta1", "VirtualService", "virtualservices", True),
-            (
-                "security.istio.io/v1beta1",
-                "AuthorizationPolicy",
-                "authorizationpolicies",
-                True,
-            ),
-            ("gateway.networking.k8s.io/v1", "HTTPRoute", "httproutes", True),
-        ]
-        for api_version, kind, plural, namespaced in core:
+        for api_version, kind, plural, namespaced in BUILTIN_KINDS:
             self.register_kind(api_version, kind, plural, namespaced)
 
     def type_info(self, kind: str) -> TypeInfo:

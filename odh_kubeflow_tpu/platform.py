@@ -1,0 +1,232 @@
+"""All-in-one platform server (and the split-process building blocks).
+
+``python -m odh_kubeflow_tpu.platform`` boots the whole control plane in
+one process — the standalone analog of the reference's full deployment
+(SURVEY.md §1 control flow):
+
+- embedded APIServer with the kubeflow CRDs + admission webhooks
+  registered in-process, served over REST (``machinery.httpapi``) so
+  out-of-process components (``python -m odh_kubeflow_tpu.controllers.
+  notebook`` et al., as the manifests deploy them) can attach via
+  ``machinery.client.RemoteAPIServer``;
+- controller manager running the notebook / profile / tensorboard
+  reconcilers + culler;
+- the web layer (central dashboard, JWA, VWA, TWA, kfam) on one port
+  behind a prefix router — the same path layout the Istio
+  VirtualServices give the reference (`/jupyter/...`, `/volumes/...`);
+- optionally (``--sim``) the fake kubelet/scheduler so spawned
+  notebooks actually "run" without a cluster — the local-dev story.
+
+Config: flags + the reference's env contract
+(USE_ISTIO/ENABLE_CULLING/CULL_IDLE_TIME/..., SURVEY.md §5 config).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import threading
+import time
+from typing import Any, Optional
+
+from odh_kubeflow_tpu.apis import install_default_cluster_roles, register_crds
+from odh_kubeflow_tpu.controllers.culler import Culler, CullerConfig
+from odh_kubeflow_tpu.controllers.notebook import (
+    NotebookController,
+    NotebookControllerConfig,
+)
+from odh_kubeflow_tpu.controllers.profile import ProfileController
+from odh_kubeflow_tpu.controllers.runtime import Manager
+from odh_kubeflow_tpu.controllers.tensorboard import TensorboardController
+from odh_kubeflow_tpu.machinery import httpapi
+from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
+from odh_kubeflow_tpu.machinery.store import APIServer
+from odh_kubeflow_tpu.web.dashboard import DashboardApp
+from odh_kubeflow_tpu.web.jwa import JupyterWebApp
+from odh_kubeflow_tpu.web.kfam_app import KfamApp
+from odh_kubeflow_tpu.web.twa import TensorboardsWebApp
+from odh_kubeflow_tpu.web.vwa import VolumesWebApp
+from odh_kubeflow_tpu.webhooks.notebook import NotebookWebhook
+from odh_kubeflow_tpu.webhooks.poddefault import PodDefaultWebhook
+
+Obj = dict[str, Any]
+
+
+class PrefixRouter:
+    """WSGI dispatcher: longest-prefix match; ``strip=True`` mounts an
+    app that thinks it lives at ``/`` (JWA/VWA/TWA), ``strip=False``
+    mounts one whose routes already carry the prefix (kfam)."""
+
+    def __init__(self, fallback):
+        self.fallback = fallback
+        self._mounts: list[tuple[str, Any, bool]] = []
+
+    def mount(self, prefix: str, app, strip: bool = True) -> "PrefixRouter":
+        self._mounts.append((prefix.rstrip("/"), app, strip))
+        self._mounts.sort(key=lambda m: -len(m[0]))
+        return self
+
+    def __call__(self, environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        for prefix, app, strip in self._mounts:
+            if path == prefix or path.startswith(prefix + "/"):
+                if strip:
+                    environ = dict(environ)
+                    environ["SCRIPT_NAME"] = (
+                        environ.get("SCRIPT_NAME", "") + prefix
+                    )
+                    environ["PATH_INFO"] = path[len(prefix):] or "/"
+                return app(environ, start_response)
+        return self.fallback(environ, start_response)
+
+
+class Platform:
+    """Owns every in-process component; ``start()``/``stop()`` for
+    serving, or use the components directly in tests."""
+
+    def __init__(
+        self,
+        *,
+        nb_config: Optional[NotebookControllerConfig] = None,
+        sim: bool = False,
+        spawner_config_path: Optional[str] = None,
+    ):
+        self.api = APIServer()
+        register_crds(self.api)
+        install_default_cluster_roles(self.api)
+        PodDefaultWebhook(self.api).register()
+        NotebookWebhook(self.api).register()
+
+        self.nb_config = nb_config or NotebookControllerConfig.from_env()
+        culler_cfg = CullerConfig(
+            cull_idle_seconds=self.nb_config.cull_idle_seconds,
+            idleness_check_seconds=self.nb_config.idleness_check_seconds,
+            cluster_domain=self.nb_config.cluster_domain,
+        )
+        self.culler = Culler(self.api, culler_cfg)
+        self.manager = Manager(self.api)
+        self.notebook_controller = NotebookController(
+            self.api,
+            self.nb_config,
+            culler=self.culler if self.nb_config.enable_culling else None,
+        )
+        self.notebook_controller.register(self.manager)
+        self.profile_controller = ProfileController(self.api)
+        self.profile_controller.register(self.manager)
+        self.tensorboard_controller = TensorboardController(self.api)
+        self.tensorboard_controller.register(self.manager)
+
+        self.jwa = JupyterWebApp(self.api, config_path=spawner_config_path)
+        self.vwa = VolumesWebApp(self.api)
+        self.twa = TensorboardsWebApp(self.api)
+        self.kfam = KfamApp(self.api)
+        self.dashboard = DashboardApp(self.api, kfam=self.kfam.service)
+
+        self.web = PrefixRouter(self.dashboard.app)
+        self.web.mount("/jupyter", self.jwa.app)
+        self.web.mount("/volumes", self.vwa.app)
+        self.web.mount("/tensorboards", self.twa.app)
+        self.web.mount("/kfam", self.kfam.app, strip=False)
+
+        self.cluster = FakeCluster(self.api) if sim else None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._api_httpd = None
+        self._web_httpd = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(
+        self, api_port: int = 8001, web_port: int = 8080, host: str = "127.0.0.1"
+    ) -> tuple[int, int]:
+        """Starts controllers + servers on daemon threads; returns the
+        bound (api_port, web_port)."""
+        self.manager.start()
+        _, api_port, self._api_httpd = httpapi.serve(self.api, host, api_port)
+
+        web_thread, web_port, self._web_httpd = _serve_wsgi(
+            self.web, host, web_port
+        )
+        self._threads.append(web_thread)
+
+        if self.cluster is not None:
+            t = threading.Thread(target=self._sim_loop, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return api_port, web_port
+
+    def _sim_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.cluster.step()
+            except Exception:  # noqa: BLE001 — sim must keep ticking
+                pass
+            self._stop.wait(0.5)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.manager.stop()
+        for httpd in (self._api_httpd, self._web_httpd):
+            if httpd is not None:
+                httpd.shutdown()
+
+
+def _serve_wsgi(app, host: str, port: int) -> tuple[threading.Thread, int, Any]:
+    from wsgiref.simple_server import make_server
+
+    httpd = make_server(
+        host,
+        port,
+        app,
+        server_class=httpapi._ThreadingServer,
+        handler_class=httpapi._QuietHandler,
+    )
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return t, httpd.server_address[1], httpd
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    parser = argparse.ArgumentParser(description="odh-kubeflow-tpu platform")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--api-port", type=int, default=8001)
+    parser.add_argument("--web-port", type=int, default=8080)
+    parser.add_argument(
+        "--sim",
+        action="store_true",
+        help="run the fake kubelet/scheduler (local dev: notebooks 'run')",
+    )
+    parser.add_argument(
+        "--sim-tpu-nodes",
+        type=int,
+        default=int(os.environ.get("SIM_TPU_NODES", "1")),
+        help="with --sim: v5e TPU nodes to register",
+    )
+    parser.add_argument("--spawner-config", default=os.environ.get("UI_CONFIG"))
+    args = parser.parse_args(argv)
+
+    platform = Platform(sim=args.sim, spawner_config_path=args.spawner_config)
+    if platform.cluster is not None:
+        platform.cluster.add_node("cpu-0", cpu="32", memory="128Gi")
+        for i in range(args.sim_tpu_nodes):
+            platform.cluster.add_tpu_node_pool(
+                f"tpu-v5e-{i}",
+                accelerator_type="tpu-v5-lite-podslice",
+                topology="2x2",
+            )
+    api_port, web_port = platform.start(args.api_port, args.web_port, args.host)
+    print(
+        f"platform up: api http://{args.host}:{api_port} "
+        f"web http://{args.host}:{web_port}"
+        + (" (sim cluster)" if platform.cluster else ""),
+        flush=True,
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        platform.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -224,9 +224,10 @@ class Trainer:
         trainable = self.lora_params if self.lora_cfg is not None else self.params
         return {"trainable": trainable, "opt_state": self.opt_state}
 
-    def save_checkpoint(self, manager, *, force: bool = True) -> bool:
+    def save_checkpoint(self, manager, *, force: bool = False) -> bool:
         """``manager`` is a ``train.checkpoint.CheckpointManager`` (kept
-        by the caller so its GC/interval policy spans the whole run)."""
+        by the caller so its GC/interval policy spans the whole run);
+        ``force=True`` bypasses its save_interval_steps policy."""
         return manager.save(self.step, self._checkpoint_state(), force=force)
 
     def restore_checkpoint(self, manager, step: Optional[int] = None) -> int:

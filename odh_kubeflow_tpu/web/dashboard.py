@@ -196,3 +196,14 @@ class DashboardApp:
             return Response(
                 self.registry.exposition(), content_type="text/plain"
             )
+
+
+def main() -> None:
+    """Split-process entrypoint (manifests/web)."""
+    from odh_kubeflow_tpu.machinery.runner import run_web
+
+    run_web("centraldashboard", 8082, DashboardApp)
+
+
+if __name__ == "__main__":
+    main()

@@ -34,7 +34,12 @@ from odh_kubeflow_tpu.apis import (
 from odh_kubeflow_tpu.machinery import objects as obj_util
 from odh_kubeflow_tpu.machinery.store import APIServer, NotFound
 from odh_kubeflow_tpu.utils.tpu import TPU_TOPOLOGIES
-from odh_kubeflow_tpu.web.crud_backend import CrudBackend, failure, success
+from odh_kubeflow_tpu.web.crud_backend import (
+    CrudBackend,
+    failure,
+    success,
+    user_of,
+)
 from odh_kubeflow_tpu.web.microweb import HTTPError, Request
 
 Obj = dict[str, Any]
@@ -138,14 +143,18 @@ class JupyterWebApp(CrudBackend):
     def _register_routes(self) -> None:
         app = self.app
 
+        # config + TPU inventory are authn-only: the spawner form needs
+        # them before any namespace is chosen, and node capacity is read
+        # with the backend's own privileges (reference /api/gpus,
+        # get.py:52-73, likewise guards with authentication only)
         @app.route("/api/config")
         def get_config(request):
-            self.authorize(request, "list", "notebooks", None, "kubeflow.org")
+            user_of(request)
             return success({"config": self.form_defaults()})
 
         @app.route("/api/tpus")
         def get_tpus(request):
-            self.authorize(request, "list", "nodes")
+            user_of(request)
             return success({"tpus": self.available_tpus()})
 
         @app.route("/api/namespaces/<namespace>/notebooks")
@@ -471,3 +480,20 @@ def _apply_limit_factor(value: str, cfg: Obj) -> str:
     if value.endswith("Mi"):
         return f"{limit / 2**20:.0f}Mi"
     return f"{limit:g}"
+
+
+def main() -> None:
+    """Split-process entrypoint (manifests/web)."""
+    import os
+
+    from odh_kubeflow_tpu.machinery.runner import run_web
+
+    run_web(
+        "jupyter-web-app",
+        5000,
+        lambda api: JupyterWebApp(api, config_path=os.environ.get("UI_CONFIG")),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -88,3 +88,14 @@ class KfamApp:
 
             reg = prometheus.default_registry
             return Response(reg.exposition(), content_type="text/plain")
+
+
+def main() -> None:
+    """Split-process entrypoint (manifests/profile-controller kfam)."""
+    from odh_kubeflow_tpu.machinery.runner import run_web
+
+    run_web("kfam", 8081, KfamApp)
+
+
+if __name__ == "__main__":
+    main()

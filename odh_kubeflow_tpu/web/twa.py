@@ -84,3 +84,14 @@ class TensorboardsWebApp(CrudBackend):
             },
             "age": obj_util.meta(tb).get("creationTimestamp", ""),
         }
+
+
+def main() -> None:
+    """Split-process entrypoint (manifests/web)."""
+    from odh_kubeflow_tpu.machinery.runner import run_web
+
+    run_web("tensorboards-web-app", 5000, TensorboardsWebApp)
+
+
+if __name__ == "__main__":
+    main()

@@ -83,3 +83,14 @@ class VolumesWebApp(CrudBackend):
             "usedBy": mounted_by,
             "age": obj_util.meta(pvc).get("creationTimestamp", ""),
         }
+
+
+def main() -> None:
+    """Split-process entrypoint (manifests/web)."""
+    from odh_kubeflow_tpu.machinery.runner import run_web
+
+    run_web("volumes-web-app", 5000, VolumesWebApp)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""AdmissionReview HTTP server: the real k8s webhook wire protocol.
+
+In-process (all-in-one platform / tests) the webhook classes register
+straight into the embedded APIServer's admission chain. Deployed
+against a real kube-apiserver (manifests/admission-webhook), the same
+mutate functions serve v1 AdmissionReview over HTTP: request object in,
+JSONPatch out — the reference's exact contract
+(admission-webhook/main.go:555-573 builds the same patch response;
+odh notebook_webhook.go:226-265 the same Handle shape).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any, Callable, Optional
+
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.store import AdmissionRequest, Denied
+from odh_kubeflow_tpu.web.microweb import App, Request, Response
+
+Obj = dict[str, Any]
+
+
+def json_patch_diff(old: Any, new: Any, path: str = "") -> list[Obj]:
+    """RFC-6902 patch turning ``old`` into ``new``. Dicts recurse;
+    lists replace wholesale (k8s merge semantics for webhook patches —
+    upstream webhooks do the same rather than emit fragile indexed
+    ops)."""
+    if isinstance(old, dict) and isinstance(new, dict):
+        ops: list[Obj] = []
+        for k in old:
+            if k not in new:
+                ops.append({"op": "remove", "path": f"{path}/{_esc(k)}"})
+        for k, v in new.items():
+            if k not in old:
+                ops.append({"op": "add", "path": f"{path}/{_esc(k)}", "value": v})
+            elif old[k] != v:
+                ops.extend(json_patch_diff(old[k], v, f"{path}/{_esc(k)}"))
+        return ops
+    return [{"op": "replace", "path": path or "/", "value": new}]
+
+
+def _esc(key: str) -> str:
+    return key.replace("~", "~0").replace("/", "~1")
+
+
+class AdmissionServer:
+    """WSGI app mapping webhook paths to mutate callables."""
+
+    def __init__(self):
+        self.app = App("admission-webhook")
+        self._handlers: dict[str, Callable[[AdmissionRequest], Optional[Obj]]] = {}
+
+        @self.app.route("/healthz")
+        @self.app.route("/readyz")
+        def health(request):  # noqa: ANN001
+            return Response(b"ok", content_type="text/plain")
+
+    def handle(self, path: str, mutate: Callable[[AdmissionRequest], Optional[Obj]]):
+        self._handlers[path] = mutate
+
+        @self.app.route(path, methods=["POST"])
+        def review(request: Request, _mutate=mutate):
+            return self._review(request, _mutate)
+
+        return self
+
+    def _review(self, request: Request, mutate) -> Response:
+        body = request.json
+        ar = body.get("request") or {}
+        uid = ar.get("uid", "")
+        obj = ar.get("object") or {}
+        old = ar.get("oldObject")
+        operation = ar.get("operation", "CREATE")
+        dry_run = bool(ar.get("dryRun"))
+
+        response: Obj = {"uid": uid, "allowed": True}
+        try:
+            mutated = mutate(
+                AdmissionRequest(operation, obj_util.deepcopy(obj), old, dry_run)
+            )
+        except Denied as e:
+            response = {
+                "uid": uid,
+                "allowed": False,
+                "status": {"message": str(e), "code": 403},
+            }
+            mutated = None
+        if mutated is not None:
+            ops = json_patch_diff(obj, mutated)
+            if ops:
+                response["patchType"] = "JSONPatch"
+                response["patch"] = base64.b64encode(
+                    json.dumps(ops).encode()
+                ).decode()
+        return Response(
+            json.dumps(
+                {
+                    "apiVersion": "admission.k8s.io/v1",
+                    "kind": "AdmissionReview",
+                    "response": response,
+                }
+            ).encode(),
+            content_type="application/json",
+        )
+
+
+def main() -> None:
+    """Split-process entrypoint (manifests/admission-webhook): serve the
+    PodDefault + Notebook mutators as AdmissionReview endpoints, reading
+    PodDefaults via $KUBE_API_URL. TLS terminates in front (the
+    Service/cert Secret pair in the manifests)."""
+    import os
+    import time
+
+    from odh_kubeflow_tpu.machinery.client import api_from_env
+    from odh_kubeflow_tpu.webhooks.notebook import NotebookWebhook
+    from odh_kubeflow_tpu.webhooks.poddefault import PodDefaultWebhook
+
+    api = api_from_env()
+    server = AdmissionServer()
+    server.handle("/apply-poddefault", PodDefaultWebhook(api).mutate)
+    server.handle("/mutate-notebook-v1", NotebookWebhook(api).mutate)
+    host = os.environ.get("HOST", "0.0.0.0")
+    port = int(os.environ.get("PORT", "8443"))
+    httpd = server.app.serve(host, port)
+    print(
+        f"admission-webhook on http://{host}:{httpd.server_address[1]}", flush=True
+    )
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

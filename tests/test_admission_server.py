@@ -1,0 +1,135 @@
+"""AdmissionReview wire protocol: the split-process webhook deployment.
+
+Drives the PodDefault webhook through real v1 AdmissionReview requests
+(the reference's contract, admission-webhook/main.go:470-574) and checks
+the returned JSONPatch reproduces exactly what in-process admission
+would have done.
+"""
+
+import base64
+import json
+
+import pytest
+
+from odh_kubeflow_tpu.apis import register_crds
+from odh_kubeflow_tpu.machinery.store import APIServer
+from odh_kubeflow_tpu.webhooks.poddefault import (
+    PodDefaultWebhook,
+    tpu_runtime_poddefault,
+)
+from odh_kubeflow_tpu.webhooks.server import AdmissionServer, json_patch_diff
+
+
+def _apply_patch(obj, ops):
+    import copy
+
+    obj = copy.deepcopy(obj)
+    for op in ops:
+        parts = [
+            p.replace("~1", "/").replace("~0", "~")
+            for p in op["path"].split("/")[1:]
+        ]
+        target = obj
+        for p in parts[:-1]:
+            target = target[p]
+        if op["op"] == "remove":
+            del target[parts[-1]]
+        else:
+            target[parts[-1]] = op["value"]
+    return obj
+
+
+@pytest.mark.parametrize(
+    "old,new",
+    [
+        ({"a": 1}, {"a": 2}),
+        ({"a": {"b": [1, 2]}}, {"a": {"b": [1, 2, 3]}, "c": "x"}),
+        ({"a": 1, "b": 2}, {"b": 2}),
+        ({"x/y": {"m~n": 1}}, {"x/y": {"m~n": 2}}),
+        ({}, {"spec": {"containers": [{"name": "c"}]}}),
+    ],
+)
+def test_json_patch_diff_roundtrip(old, new):
+    assert _apply_patch(old, json_patch_diff(old, new)) == new
+
+
+def _review(app, path, obj, operation="CREATE"):
+    body = {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": "u1", "operation": operation, "object": obj},
+    }
+    environ_body = json.dumps(body).encode()
+    captured = {}
+
+    def start_response(status, headers):
+        captured["status"] = status
+
+    import io
+
+    environ = {
+        "REQUEST_METHOD": "POST",
+        "PATH_INFO": path,
+        "CONTENT_LENGTH": str(len(environ_body)),
+        "wsgi.input": io.BytesIO(environ_body),
+        "QUERY_STRING": "",
+    }
+    out = b"".join(app(environ, start_response))
+    assert captured["status"].startswith("200")
+    return json.loads(out.decode())["response"]
+
+
+def test_poddefault_admission_review_patch():
+    api = APIServer()
+    register_crds(api)
+    api.create({"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": "t"}})
+    api.create(tpu_runtime_poddefault("t"))
+
+    webhook = PodDefaultWebhook(api)
+    server = AdmissionServer().handle("/apply-poddefault", webhook.mutate)
+
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": "p1",
+            "namespace": "t",
+            "labels": {"tpu-runtime": "enabled"},
+        },
+        "spec": {"containers": [{"name": "main", "image": "x"}]},
+    }
+    resp = _review(server.app, "/apply-poddefault", pod)
+    assert resp["allowed"] is True
+    ops = json.loads(base64.b64decode(resp["patch"]).decode())
+    patched = _apply_patch(pod, ops)
+
+    # byte-identical with the in-process admission result
+    expected = webhook.mutate(
+        __import__(
+            "odh_kubeflow_tpu.machinery.store", fromlist=["AdmissionRequest"]
+        ).AdmissionRequest("CREATE", json.loads(json.dumps(pod)), None, False)
+    )
+    assert patched == expected
+
+    # the TPU runtime PodDefault actually landed
+    env_names = {
+        e["name"] for e in patched["spec"]["containers"][0].get("env", [])
+    }
+    assert "JAX_PLATFORMS" in env_names
+
+
+def test_non_matching_pod_gets_no_patch():
+    api = APIServer()
+    register_crds(api)
+    server = AdmissionServer().handle(
+        "/apply-poddefault", PodDefaultWebhook(api).mutate
+    )
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "p", "namespace": "t"},
+        "spec": {"containers": [{"name": "c", "image": "x"}]},
+    }
+    resp = _review(server.app, "/apply-poddefault", pod)
+    assert resp["allowed"] is True
+    assert "patch" not in resp

@@ -1,0 +1,79 @@
+"""Notebook image hierarchy: contract guards.
+
+No docker in CI, so the tests pin the *contracts* the platform relies
+on: the NB_PREFIX/8888/jovyan conventions (reference
+base/Dockerfile:4-9), the TPU-env replacement of CUDA (BASELINE north
+star: 0 GPU images), and the tpu-init multi-host bring-up script's
+no-op path.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+IMAGES = pathlib.Path(__file__).resolve().parent.parent / "images"
+
+
+def _dockerfiles():
+    return list(IMAGES.rglob("Dockerfile"))
+
+
+def test_hierarchy_complete():
+    names = {f.parent.name for f in _dockerfiles()}
+    assert {
+        "base",
+        "jupyter",
+        "jupyter-scipy",
+        "jupyter-jax-tpu",
+        "jupyter-pytorch-xla",
+        "codeserver",
+        "codeserver-jax-tpu",
+        "rstudio",
+    } <= names
+
+
+def test_no_cuda_anywhere():
+    """No CUDA/NVIDIA runtime in any image (comment lines may cite the
+    reference's cuda.Dockerfile they replace)."""
+    for f in _dockerfiles():
+        code = "\n".join(
+            line
+            for line in f.read_text().lower().splitlines()
+            if not line.strip().startswith("#")
+        )
+        assert "cuda" not in code, f
+        assert "nvidia" not in code, f
+
+
+def test_base_contract():
+    text = (IMAGES / "base" / "Dockerfile").read_text()
+    assert "NB_USER=jovyan" in text
+    assert "NB_UID=1000" in text
+    assert "EXPOSE 8888" in text
+    assert "NB_PREFIX" in text
+
+
+def test_jax_tpu_env_contract():
+    text = (IMAGES / "jupyter-jax-tpu" / "Dockerfile").read_text()
+    assert "jax[tpu]" in text
+    assert "JAX_PLATFORMS=tpu,cpu" in text
+    # slice identity must be injected by the platform, not baked in
+    assert "ENV TPU_WORKER_ID" not in text
+
+
+def test_start_script_serves_culler_probe_prefix():
+    text = (IMAGES / "jupyter" / "start-jupyter.sh").read_text()
+    assert '--ServerApp.base_url="${NB_PREFIX}"' in text
+    assert "--port=8888" in text
+
+
+def test_tpu_init_noop_without_hostnames(tmp_path):
+    """Single-host path exits 0 without touching jax.distributed."""
+    script = IMAGES / "jupyter-jax-tpu" / "tpu-init"
+    out = subprocess.run(
+        [sys.executable, str(script)],
+        env={"PATH": "/usr/bin:/bin", "TPU_WORKER_HOSTNAMES": ""},
+        capture_output=True,
+        timeout=60,
+    )
+    assert out.returncode == 0, out.stderr

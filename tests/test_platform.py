@@ -1,0 +1,135 @@
+"""All-in-one platform smoke test: every server really serves.
+
+Boots ``platform.Platform`` with the sim cluster and drives the full
+spawn path over real sockets — web prefix router → JWA → Notebook CR →
+admission → controller → sim kubelet → ready status — plus the REST API
+façade and the dashboard/kfam/VWA/TWA mounts. This is the test-shaped
+version of ``python -m odh_kubeflow_tpu.platform --sim``.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from odh_kubeflow_tpu.controllers.profile import ProfileController
+from odh_kubeflow_tpu.platform import Platform
+
+ALICE = "alice@example.com"
+
+
+@pytest.fixture()
+def platform():
+    p = Platform(sim=True)
+    p.cluster.add_node("cpu-0", cpu="32", memory="128Gi")
+    p.cluster.add_tpu_node_pool(
+        "tpu-v5e-0", accelerator_type="tpu-v5-lite-podslice", topology="2x2"
+    )
+    api_port, web_port = p.start(api_port=0, web_port=0)
+    yield p, f"http://127.0.0.1:{api_port}", f"http://127.0.0.1:{web_port}"
+    p.stop()
+
+
+def _req(base, method, path, body=None, user=ALICE):
+    req = urllib.request.Request(
+        base + path,
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+    )
+    if user:
+        req.add_header("kubeflow-userid", user)
+    if method not in ("GET", "HEAD"):
+        req.add_header("Cookie", "XSRF-TOKEN=t")
+        req.add_header("X-XSRF-TOKEN", "t")
+    if body is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+def _wait(fn, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(0.1)
+    raise AssertionError("condition not met in time")
+
+
+def test_full_spawn_over_sockets(platform):
+    p, api_base, web_base = platform
+
+    # tenant onboarding straight through the embedded API
+    p.api.create(
+        {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "Profile",
+            "metadata": {"name": "team-a"},
+            "spec": {"owner": {"kind": "User", "name": ALICE}},
+        }
+    )
+    _wait(lambda: p.api.list("RoleBinding", namespace="team-a"))
+
+    # spawner through the web port (prefix router → JWA)
+    status, body = _req(
+        web_base,
+        "POST",
+        "/jupyter/api/namespaces/team-a/notebooks",
+        body={
+            "name": "nb1",
+            "image": "odh-kubeflow-tpu/jupyter-jax-tpu:latest",
+            "cpu": "2",
+            "memory": "4Gi",
+            "tpus": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x2"},
+        },
+    )
+    assert status == 201, body
+
+    # controller + sim kubelet converge to a ready notebook
+    def ready():
+        rows = _req(web_base, "GET", "/jupyter/api/namespaces/team-a/notebooks")[1]
+        nbs = rows.get("notebooks", [])
+        return nbs if nbs and nbs[0]["status"]["phase"] == "ready" else None
+
+    rows = _wait(ready, timeout=15)
+    assert rows[0]["tpus"]["chips"] == "4"  # 2x2 v5e slice
+
+    # REST façade sees the same Notebook (split-process path)
+    status, obj = _req(
+        api_base,
+        "GET",
+        "/apis/kubeflow.org/v1beta1/namespaces/team-a/notebooks/nb1",
+        user=None,
+    )
+    assert status == 200
+    # the controller derived the TPU scheduling contract onto the STS
+    status, sts = _req(
+        api_base, "GET", "/apis/apps/v1/namespaces/team-a/statefulsets/nb1",
+        user=None,
+    )
+    assert status == 200
+    pod_spec = sts["spec"]["template"]["spec"]
+    assert (
+        pod_spec["containers"][0]["resources"]["limits"]["google.com/tpu"] == "4"
+    )
+    assert (
+        pod_spec["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2"
+    )
+
+    # the other mounts answer under their prefixes
+    assert _req(web_base, "GET", "/volumes/api/namespaces/team-a/pvcs")[0] == 200
+    assert (
+        _req(web_base, "GET", "/tensorboards/api/namespaces/team-a/tensorboards")[0]
+        == 200
+    )
+    assert _req(web_base, "GET", "/api/workgroup/exists")[0] == 200
+    status, env = _req(web_base, "GET", "/api/workgroup/env-info")
+    assert status == 200 and any(
+        ns.get("namespace") == "team-a" for ns in env.get("namespaces", [])
+    )
