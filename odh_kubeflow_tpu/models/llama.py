@@ -227,7 +227,11 @@ def _decoder_layer(
     sin: jnp.ndarray,
     cos: jnp.ndarray,
     segment_ids,
-) -> jnp.ndarray:
+    cache_layer=None,  # {"k","v"}: [B, S_max, Hkv, hd] slices, or None
+    cache_index=None,  # scalar: write offset into the cache
+):
+    """Returns ``x`` (and the updated cache slice when one is passed —
+    the KV-cache decode path, ``models/generate.py``)."""
     B, S, D = x.shape
     x = constrain(x, _activation_spec())
 
@@ -240,7 +244,20 @@ def _decoder_layer(
     vv = vv.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
     q = apply_rope(q, sin, cos)
     kk = apply_rope(kk, sin, cos)
-    attn = attention_fn(q, kk, vv, segment_ids=segment_ids)
+    if cache_layer is not None:
+        # append this step's K/V at cache_index, attend over the whole
+        # cache with absolute positions (q_offset masks the unwritten
+        # tail — positions > cache_index+S are never attended)
+        ck = jax.lax.dynamic_update_slice(
+            cache_layer["k"], kk.astype(cache_layer["k"].dtype), (0, cache_index, 0, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cache_layer["v"], vv.astype(cache_layer["v"].dtype), (0, cache_index, 0, 0)
+        )
+        attn = attention_fn(q, ck, cv, q_offset=cache_index)
+        cache_layer = {"k": ck, "v": cv}
+    else:
+        attn = attention_fn(q, kk, vv, segment_ids=segment_ids)
     attn = attn.reshape(B, S, cfg.q_dim)
     x = x + _maybe_lora("wo", attn, layer["wo"], lora_layer)
 
