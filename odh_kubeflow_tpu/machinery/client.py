@@ -17,6 +17,7 @@ import json
 import socket
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from collections import OrderedDict
 from typing import Any, Optional
@@ -25,6 +26,7 @@ from odh_kubeflow_tpu.machinery import objects as obj_util
 from odh_kubeflow_tpu.machinery.store import (
     AlreadyExists,
     APIError,
+    BadRequest,
     Conflict,
     Denied,
     Invalid,
@@ -35,7 +37,13 @@ from odh_kubeflow_tpu.machinery.store import (
 
 Obj = dict[str, Any]
 
-_ERR_BY_CODE = {404: NotFound, 409: Conflict, 422: Invalid, 403: Denied}
+_ERR_BY_CODE = {
+    400: BadRequest,
+    404: NotFound,
+    409: Conflict,
+    422: Invalid,
+    403: Denied,
+}
 _EVENT_INDEX_MAX = 4096
 
 
@@ -130,6 +138,7 @@ class RemoteAPIServer:
             # the structured Status.reason disambiguates the two 409s
             klass = {
                 "AlreadyExists": AlreadyExists,
+                "BadRequest": BadRequest,
                 "Conflict": Conflict,
                 "NotFound": NotFound,
                 "Invalid": Invalid,
@@ -165,7 +174,9 @@ class RemoteAPIServer:
         p = self._path(kind, namespace, None, require_ns=False)
         query = ""
         if label_selector:
-            query = "labelSelector=" + _selector_to_string(label_selector)
+            query = "labelSelector=" + urllib.parse.quote(
+                _selector_to_string(label_selector), safe=""
+            )
         items = self._request("GET", p, query=query).get("items", [])
         if field_matches:
             items = [
@@ -341,9 +352,31 @@ class RemoteAPIServer:
 
 
 def _selector_to_string(selector: Obj) -> str:
-    """Inverse of objects.parse_selector_string for the matchLabels part."""
-    labels = selector.get("matchLabels", selector) or {}
-    return ",".join(f"{k}={v}" for k, v in labels.items())
+    """Inverse of ``objects.parse_selector_string``.
+
+    Covers matchLabels plus the matchExpressions the string form can
+    express (NotIn-single-value → ``k!=v``, Exists → ``k``); anything
+    richer raises rather than silently dropping a filter the embedded
+    store's in-process ``list()`` would have honoured.
+    """
+    if "matchLabels" in selector or "matchExpressions" in selector:
+        labels = selector.get("matchLabels") or {}
+        exprs = selector.get("matchExpressions") or []
+    else:
+        labels, exprs = selector or {}, []
+    parts = [f"{k}={v}" for k, v in labels.items()]
+    for e in exprs:
+        op, key, values = e.get("operator"), e.get("key"), e.get("values", [])
+        if op == "Exists":
+            parts.append(key)
+        elif op == "NotIn" and len(values) == 1:
+            parts.append(f"{key}!={values[0]}")
+        else:
+            raise ValueError(
+                f"matchExpressions entry {e!r} has no labelSelector string "
+                "form; use the in-process APIServer for rich selectors"
+            )
+    return ",".join(parts)
 
 
 def api_from_env() -> RemoteAPIServer:

@@ -38,6 +38,7 @@ from odh_kubeflow_tpu.machinery.store import (
     AlreadyExists,
     APIError,
     APIServer,
+    BadRequest,
     Conflict,
     Denied,
     Invalid,
@@ -52,6 +53,7 @@ _STATUS = {
     Conflict: 409,
     Invalid: 422,
     Denied: 403,
+    BadRequest: 400,
 }
 
 WATCH_HEARTBEAT_SECONDS = 15.0
@@ -233,12 +235,30 @@ class RestAPI:
         if method == "PUT" and name is not None:
             obj = self._read_body(environ)
             obj.setdefault("kind", kind)
+            # kube-apiserver semantics: the body may omit namespace (the
+            # URL supplies it) but must not contradict the URL — 400.
+            meta = obj.setdefault("metadata", {})
+            if ns and not meta.get("namespace"):
+                meta["namespace"] = ns
+            if meta.get("name") != name or (ns and meta.get("namespace") != ns):
+                raise BadRequest(
+                    f"body metadata ({meta.get('namespace')}/{meta.get('name')}) "
+                    f"does not match URL ({ns}/{name})"
+                )
             if route.subresource == "status":
                 return self._json(200, self.server.update_status(obj), start_response)
             return self._json(200, self.server.update(obj), start_response)
 
         if method == "PATCH" and name is not None:
             patch = self._read_body(environ)
+            pmeta = patch.get("metadata", {}) if isinstance(patch, dict) else {}
+            if pmeta.get("name", name) != name or (
+                ns and pmeta.get("namespace", ns) != ns
+            ):
+                raise BadRequest(
+                    "patch may not change metadata.name/namespace "
+                    f"({pmeta.get('namespace')}/{pmeta.get('name')} vs URL {ns}/{name})"
+                )
             return self._json(
                 200, self.server.patch(kind, name, patch, ns), start_response
             )

@@ -53,6 +53,13 @@ class Invalid(APIError):
     code = 422
 
 
+class BadRequest(APIError):
+    """Malformed request (e.g. body metadata contradicting the URL —
+    kube-apiserver rejects these with 400, not 422)."""
+
+    code = 400
+
+
 class Denied(APIError):
     """Raised by admission (validating webhook semantics)."""
 

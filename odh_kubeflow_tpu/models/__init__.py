@@ -1,6 +1,14 @@
+from odh_kubeflow_tpu.models.generate import (  # noqa: F401
+    GenerateConfig,
+    cache_specs,
+    generate,
+    init_cache,
+    sample_logits,
+)
 from odh_kubeflow_tpu.models.llama import (  # noqa: F401
     LlamaConfig,
     forward,
+    forward_with_cache,
     init_params,
     param_specs,
 )

@@ -1,0 +1,212 @@
+"""KV-cache autoregressive generation (the fine-tune → try-it story).
+
+The reference platform has no inference code at all (SURVEY.md §2.4);
+generation exists here because the TPU notebook workflow it serves —
+LoRA fine-tune in the notebook, then sample from the adapter — needs
+it. Design is TPU-first:
+
+- **Two compiles total.** Prefill (S = prompt length) and the decode
+  step (S = 1) are the only two traced shapes; the decode loop is a
+  ``lax.scan`` over a preallocated ``[L, B, S_max, Hkv, hd]`` cache, so
+  there are no per-step retraces and no dynamic shapes anywhere.
+- **Physical vs logical positions.** Ragged (right-padded) prompts
+  share one physical write index — slot ``prompt_pad + step`` — while
+  rope uses each row's *logical* position ``prompt_len + step``. The
+  pad slots in between are never attended: ``kv_mask`` marks valid
+  cache slots and flows into ``dense_attention``.
+- **Sharding by annotation**, same as training: params via
+  ``param_specs``, the cache via ``cache_specs`` (batch on data/fsdp,
+  KV heads on tensor). XLA inserts the collectives.
+
+Sampling: greedy, temperature, top-k, and nucleus (top-p), composed in
+that order, matching the semantics of the usual HF ``generate`` knobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from odh_kubeflow_tpu.models.llama import (
+    LlamaConfig,
+    Params,
+    forward_with_cache,
+)
+from odh_kubeflow_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_TENSOR,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerateConfig:
+    max_new_tokens: int = 32
+    temperature: float = 0.0  # 0 = greedy
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_id: Optional[int] = None
+    pad_id: int = 0
+    cache_dtype: Any = jnp.bfloat16
+
+
+def init_cache(
+    cfg: LlamaConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16
+) -> Params:
+    """Preallocated KV cache: ``{"k","v"}: [L, B, S_max, Hkv, hd]``.
+
+    The leading layer axis is consumed by the ``lax.scan`` over layers
+    in ``forward_with_cache`` (one slice per step), mirroring the
+    stacked parameter layout.
+    """
+    shape = (
+        cfg.num_layers,
+        batch_size,
+        max_len,
+        cfg.num_kv_heads,
+        cfg.head_dim,
+    )
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def cache_specs(cfg: LlamaConfig) -> Params:
+    """PartitionSpec tree for ``init_cache`` output.
+
+    Batch shards with the data axes; KV heads shard on tensor (they are
+    produced by tensor-sharded wk/wv projections, so the cache write is
+    collective-free).
+    """
+    s = P(None, (AXIS_DATA, AXIS_FSDP), None, AXIS_TENSOR, None)
+    return {"k": s, "v": s}
+
+
+def sample_logits(
+    logits: jnp.ndarray,  # [B, V] float32
+    key: jax.Array,
+    *,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jnp.ndarray:
+    """Sample next-token ids [B] from final-position logits."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.float32(temperature)
+    if top_k is not None and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix whose mass reaches top_p (the token
+        # that crosses the threshold is included, per nucleus sampling)
+        keep = cum - probs < top_p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+def generate(
+    params: Params,
+    prompt_tokens: jnp.ndarray,  # [B, S_prompt] int32, right-padded
+    cfg: LlamaConfig,
+    gen_cfg: GenerateConfig,
+    *,
+    prompt_lengths: Optional[jnp.ndarray] = None,  # [B] int32
+    lora: Optional[Params] = None,
+    key: Optional[jax.Array] = None,
+) -> dict[str, jnp.ndarray]:
+    """Autoregressive generation. Pure and jittable.
+
+    Returns ``{"tokens": [B, max_new_tokens], "lengths": [B]}`` where
+    ``lengths`` counts generated tokens up to and including the first
+    ``eos_id`` (or ``max_new_tokens`` when eos never fires); positions
+    past a row's eos hold ``pad_id``.
+    """
+    B, S_prompt = prompt_tokens.shape
+    N = gen_cfg.max_new_tokens
+    max_len = S_prompt + N
+    if prompt_lengths is None:
+        prompt_lengths = jnp.full((B,), S_prompt, jnp.int32)
+    prompt_lengths = prompt_lengths.astype(jnp.int32)
+    if key is None:
+        key = jax.random.key(0)
+
+    cache = init_cache(cfg, B, max_len, gen_cfg.cache_dtype)
+    slots = jnp.arange(max_len, dtype=jnp.int32)[None, :]  # [1, S_max]
+    kv_mask = slots < prompt_lengths[:, None]  # prompt region valid
+
+    # --- prefill: whole prompt at physical slots [0, S_prompt) -------
+    positions = jnp.broadcast_to(
+        jnp.arange(S_prompt, dtype=jnp.int32), (B, S_prompt)
+    )
+    logits, cache = forward_with_cache(
+        params,
+        prompt_tokens,
+        cfg,
+        cache,
+        jnp.int32(0),
+        positions=positions,
+        kv_mask=kv_mask,
+        lora=lora,
+    )
+    # next token comes from each row's last *real* prompt position
+    last = jnp.take_along_axis(
+        logits, (prompt_lengths - 1)[:, None, None], axis=1
+    )[:, 0, :]
+    key, sub = jax.random.split(key)
+    token = sample_logits(
+        last,
+        sub,
+        temperature=gen_cfg.temperature,
+        top_k=gen_cfg.top_k,
+        top_p=gen_cfg.top_p,
+    )
+
+    # --- decode: one token per step at physical slot S_prompt + i ----
+    def step(carry, xs):
+        cache, kv_mask, token, done, key = carry
+        i, = xs
+        write_index = jnp.int32(S_prompt) + i
+        kv_mask = kv_mask | (slots == write_index)
+        positions = (prompt_lengths + i)[:, None]  # logical rope position
+        logits, cache = forward_with_cache(
+            params,
+            token[:, None],
+            cfg,
+            cache,
+            write_index,
+            positions=positions,
+            kv_mask=kv_mask,
+            lora=lora,
+        )
+        key, sub = jax.random.split(key)
+        next_token = sample_logits(
+            logits[:, 0, :],
+            sub,
+            temperature=gen_cfg.temperature,
+            top_k=gen_cfg.top_k,
+            top_p=gen_cfg.top_p,
+        )
+        emitted = jnp.where(done, jnp.int32(gen_cfg.pad_id), token)
+        if gen_cfg.eos_id is not None:
+            done = done | (token == gen_cfg.eos_id)
+        next_token = jnp.where(done, jnp.int32(gen_cfg.pad_id), next_token)
+        return (cache, kv_mask, next_token, done, key), emitted
+
+    done = jnp.zeros((B,), bool)
+    (_, _, _, done, _), tokens = jax.lax.scan(
+        step,
+        (cache, kv_mask, token, done, key),
+        (jnp.arange(N, dtype=jnp.int32),),
+    )
+    tokens = tokens.T  # [N, B] → [B, N]
+    lengths = jnp.sum(tokens != gen_cfg.pad_id, axis=1).astype(jnp.int32)
+    return {"tokens": tokens, "lengths": lengths}

@@ -125,10 +125,17 @@ class LlamaConfig:
 
     def flops_per_token(self, seq_len: int) -> float:
         """Forward-pass matmul FLOPs per token (2*params-style estimate
-        plus the quadratic attention term), for MFU accounting."""
+        plus the quadratic attention term), for MFU accounting.
+
+        The attention term counts only the *causally required* pairs
+        (seq_len/2 keys per query on average): a causal-block-skipping
+        kernel (``ops/pallas_attention.py``) computes exactly these, so
+        crediting the full S^2 would inflate MFU for the flash path and
+        understate how much work the dense path wastes on masked pairs.
+        """
         D, F, L = self.hidden_size, self.intermediate_size, self.num_layers
         proj = 2 * (D * self.q_dim + 2 * D * self.kv_dim + self.q_dim * D + 3 * D * F)
-        attn = 2 * 2 * self.num_heads * self.head_dim * seq_len  # qk^T + av
+        attn = 2 * 2 * self.num_heads * self.head_dim * (seq_len / 2)  # qk^T + av
         head = 2 * D * self.vocab_size
         embed = 0  # lookup, not a matmul
         return L * (proj + attn) + head + embed
@@ -229,9 +236,20 @@ def _decoder_layer(
     segment_ids,
     cache_layer=None,  # {"k","v"}: [B, S_max, Hkv, hd] slices, or None
     cache_index=None,  # scalar: write offset into the cache
+    kv_mask=None,  # [B, S_max] bool: which cache slots are valid
 ):
-    """Returns ``x`` (and the updated cache slice when one is passed —
-    the KV-cache decode path, ``models/generate.py``)."""
+    """Returns ``(x, updated_cache_layer)``.
+
+    ``updated_cache_layer`` is None on the training path; on the
+    KV-cache decode path (``models/generate.py``) it is the
+    ``{"k","v"}`` dict with this step's keys/values written at
+    ``cache_index``. The cache path always attends with
+    ``dense_attention`` — decode attention is a bandwidth-bound gather
+    over the cache where a traced ``cache_index``/``q_offset`` is
+    required (the flash kernel needs it static and ring attention has
+    no cache semantics); ``attention_fn`` only selects the *training*
+    (no-cache) implementation.
+    """
     B, S, D = x.shape
     x = constrain(x, _activation_spec())
 
@@ -254,7 +272,9 @@ def _decoder_layer(
         cv = jax.lax.dynamic_update_slice(
             cache_layer["v"], vv.astype(cache_layer["v"].dtype), (0, cache_index, 0, 0)
         )
-        attn = attention_fn(q, ck, cv, q_offset=cache_index)
+        attn = dense_attention(
+            q, ck, cv, causal=True, q_offset=cache_index, kv_mask=kv_mask
+        )
         cache_layer = {"k": ck, "v": cv}
     else:
         attn = attention_fn(q, kk, vv, segment_ids=segment_ids)
@@ -265,7 +285,7 @@ def _decoder_layer(
     gate = _maybe_lora("w_gate", h, layer["w_gate"], lora_layer)
     up = _maybe_lora("w_up", h, layer["w_up"], lora_layer)
     x = x + _maybe_lora("w_down", jax.nn.silu(gate) * up, layer["w_down"], lora_layer)
-    return x
+    return x, cache_layer
 
 
 def _select_attention(cfg: LlamaConfig) -> Callable:
@@ -323,7 +343,8 @@ def forward(
 
     def body(x, scanned):
         layer, lora_layer = scanned
-        return layer_fn(x, layer, lora_layer, sin, cos, segment_ids), None
+        x, _ = layer_fn(x, layer, lora_layer, sin, cos, segment_ids)
+        return x, None
 
     x, _ = jax.lax.scan(body, x, (params["layers"], lora_layers))
 
@@ -333,3 +354,56 @@ def forward(
         "bsd,dv->bsv", x, head.astype(cfg.dtype), preferred_element_type=jnp.float32
     )
     return logits
+
+
+def forward_with_cache(
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32 (S=prompt len for prefill, 1 for decode)
+    cfg: LlamaConfig,
+    cache: Params,  # {"k","v"}: [L, B, S_max, Hkv, hd]
+    cache_index,  # scalar int32: write offset into the cache
+    *,
+    positions: jnp.ndarray,  # [B, S] absolute positions (rope)
+    kv_mask: Optional[jnp.ndarray] = None,  # [B, S_max] valid cache slots
+    lora: Optional[Params] = None,
+) -> tuple[jnp.ndarray, Params]:
+    """KV-cached forward: returns (logits [B, S, V] float32, new cache).
+
+    This is the decode path ``models/generate.py`` drives — both
+    prefill (S = prompt length, cache_index = 0) and autoregressive
+    steps (S = 1) go through here, so the layer stack compiles exactly
+    twice per shape. No remat (there is no backward pass to trade
+    FLOPs against) and always dense attention over the cache (see
+    ``_decoder_layer``).
+    """
+    sin, cos = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    lora_layers = lora["layers"] if lora is not None else None
+
+    def body(x, scanned):
+        layer, lora_layer, cache_layer = scanned
+        x, new_cache = _decoder_layer(
+            cfg,
+            None,  # attention_fn unused: cache path is always dense
+            x,
+            layer,
+            lora_layer,
+            sin,
+            cos,
+            None,
+            cache_layer=cache_layer,
+            cache_index=cache_index,
+            kv_mask=kv_mask,
+        )
+        return x, new_cache
+
+    x, new_cache = jax.lax.scan(
+        body, x, (params["layers"], lora_layers, cache)
+    )
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, head.astype(cfg.dtype), preferred_element_type=jnp.float32
+    )
+    return logits, new_cache

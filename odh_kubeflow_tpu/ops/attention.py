@@ -28,11 +28,15 @@ def dense_attention(
     causal: bool = True,
     q_offset: int | jnp.ndarray = 0,
     segment_ids: Optional[jnp.ndarray] = None,  # [B, S] same-id attends
+    kv_mask: Optional[jnp.ndarray] = None,  # [B, Sk] bool, True = attend
 ) -> jnp.ndarray:
     """Returns [B, Sq, Hq, hd]. Scores accumulate in float32.
 
     ``q_offset`` is the absolute position of q[0] relative to k[0]
     (used by the KV-cache decode path and by ring attention blocks).
+    ``kv_mask`` marks which cache slots hold real tokens (the KV-cache
+    decode path with ragged right-padded prompts leaves invalid slots
+    between each prompt's end and the shared write index).
     """
     B, Sq, Hq, hd = q.shape
     _, Sk, Hkv, _ = k.shape
@@ -59,6 +63,9 @@ def dense_attention(
             segment_ids[:, :, None] == segment_ids[:, None, :]
         )[:, None, None, :, :]
         mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if kv_mask is not None:
+        kvm = kv_mask[:, None, None, None, :]  # [B, 1, 1, 1, Sk]
+        mask = kvm if mask is None else jnp.logical_and(mask, kvm)
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.float32(-1e30))
 

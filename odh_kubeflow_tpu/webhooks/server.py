@@ -38,7 +38,8 @@ def json_patch_diff(old: Any, new: Any, path: str = "") -> list[Obj]:
             elif old[k] != v:
                 ops.extend(json_patch_diff(old[k], v, f"{path}/{_esc(k)}"))
         return ops
-    return [{"op": "replace", "path": path or "/", "value": new}]
+    # RFC 6901: the document root is "" ("/" addresses the ""-named key)
+    return [{"op": "replace", "path": path, "value": new}]
 
 
 def _esc(key: str) -> str:

@@ -152,3 +152,114 @@ def test_remote_reconcile_loop(served):
         assert svc["spec"]["ports"][0]["port"] == 80
     finally:
         mgr.stop()
+
+
+def test_put_body_must_match_url(served):
+    """kube-apiserver rejects body metadata contradicting the URL (400)."""
+    from odh_kubeflow_tpu.machinery.store import BadRequest
+
+    _, client = served
+    client.create(_notebook("x"))
+    client.create(_notebook("y"))
+    got = client.get("Notebook", "x", "team-a")
+    got["metadata"]["name"] = "y"  # client derives URL from body → /y
+    got["metadata"]["annotations"] = {"v": "hijack"}
+    with pytest.raises((Conflict, BadRequest)):
+        # stale rv for y → Conflict; fresh rv would be caught by the
+        # 400 path below — either way y is never silently overwritten
+        client.update(got)
+    fresh_y = client.get("Notebook", "y", "team-a")
+    assert fresh_y["metadata"].get("annotations", {}).get("v") != "hijack"
+
+    # drive the raw URL mismatch (PUT /x with body naming y)
+    import json
+    import urllib.error
+    import urllib.request
+
+    url = client.base_url + "/apis/kubeflow.org/v1beta1/namespaces/team-a/notebooks/x"
+    body = dict(fresh_y)
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode(),
+        method="PUT",
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400
+
+
+def test_put_defaults_namespace_from_url(served):
+    """PUT body may omit metadata.namespace — the URL supplies it."""
+    import json
+    import urllib.request
+
+    _, client = served
+    client.create(_notebook("nsless"))
+    got = client.get("Notebook", "nsless", "team-a")
+    del got["metadata"]["namespace"]
+    got["metadata"]["annotations"] = {"via": "put"}
+    url = (
+        client.base_url
+        + "/apis/kubeflow.org/v1beta1/namespaces/team-a/notebooks/nsless"
+    )
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(got).encode(),
+        method="PUT",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 200
+    assert (
+        client.get("Notebook", "nsless", "team-a")["metadata"]["annotations"]["via"]
+        == "put"
+    )
+
+
+def test_patch_cannot_rename(served):
+    from odh_kubeflow_tpu.machinery.store import BadRequest
+
+    _, client = served
+    client.create(_notebook("p1"))
+    with pytest.raises(BadRequest):
+        client.patch("Notebook", "p1", {"metadata": {"name": "p2"}}, "team-a")
+
+
+def test_label_selector_encoding_and_expressions(served):
+    """Selector values survive URL encoding; matchExpressions translate
+    (or loudly refuse) instead of being dropped."""
+    _, client = served
+    nb = _notebook("sel")
+    nb["metadata"]["labels"] = {"app": "sel", "tier": "a b&c"}
+    client.create(nb)
+    got = client.list(
+        "Notebook", "team-a", label_selector={"matchLabels": {"tier": "a b&c"}}
+    )
+    assert [o["metadata"]["name"] for o in got] == ["sel"]
+    got = client.list(
+        "Notebook",
+        "team-a",
+        label_selector={"matchExpressions": [{"key": "app", "operator": "Exists"}]},
+    )
+    assert [o["metadata"]["name"] for o in got] == ["sel"]
+    got = client.list(
+        "Notebook",
+        "team-a",
+        label_selector={
+            "matchExpressions": [
+                {"key": "app", "operator": "NotIn", "values": ["other"]}
+            ]
+        },
+    )
+    assert [o["metadata"]["name"] for o in got] == ["sel"]
+    with pytest.raises(ValueError):
+        client.list(
+            "Notebook",
+            "team-a",
+            label_selector={
+                "matchExpressions": [
+                    {"key": "app", "operator": "In", "values": ["a", "b"]}
+                ]
+            },
+        )
