@@ -176,7 +176,10 @@ class NotebookController:
     def _map_event(self, _etype: str, event: Obj) -> list[Request]:
         """Re-queue the Notebook named by an Event on its StatefulSet or
         Pods (reference nbNameFromInvolvedObject :653-677: strip the
-        ordinal suffix and verify a Notebook of that name exists)."""
+        ordinal suffix and verify a Notebook of that name exists), and
+        re-emit the event onto the Notebook CR itself so
+        ``kubectl describe notebook`` tells the whole story (reference
+        notebook_controller.go:94-118,649-723)."""
         involved = event.get("involvedObject") or {}
         ns = involved.get("namespace", "")
         name = involved.get("name", "")
@@ -186,10 +189,49 @@ class NotebookController:
         if not ns or not name:
             return []
         try:
-            self.api.get("Notebook", name, ns)
+            notebook = self.api.get("Notebook", name, ns)
         except NotFound:
             return []
+        if kind in ("StatefulSet", "Pod"):
+            self._mirror_event(notebook, event)
         return [Request(ns, name)]
+
+    def _mirror_event(self, notebook: Obj, event: Obj) -> None:
+        """Copy an owned-object Event onto the Notebook. Dedupe is
+        server-side — an identical (reason, message, type) event already
+        on the CR suppresses the re-emit — so a restarted controller
+        replaying the Event watch does not flood the CR with
+        duplicates. Events older than the CR (a recreated notebook
+        inheriting stale pod events, reference :700-712) are skipped."""
+        created = obj_util.meta(notebook).get("creationTimestamp", "")
+        stamp = event.get("lastTimestamp") or event.get("firstTimestamp") or ""
+        if created and stamp and stamp < created:
+            return
+        reason = event.get("reason", "")
+        message = event.get("message", "")
+        if not reason and not message:
+            return
+        etype = event.get("type", "Normal")
+        name = obj_util.name_of(notebook)
+        for existing in self.api.list(
+            "Event", namespace=obj_util.namespace_of(notebook)
+        ):
+            involved = existing.get("involvedObject", {})
+            if (
+                involved.get("kind") == "Notebook"
+                and involved.get("name") == name
+                and existing.get("reason") == reason
+                and existing.get("message") == message
+                and existing.get("type") == etype
+            ):
+                return
+        self.api.emit_event(
+            notebook,
+            reason,
+            message,
+            event_type=etype,
+            component="notebook-controller",
+        )
 
     # -- reconcile ----------------------------------------------------------
 

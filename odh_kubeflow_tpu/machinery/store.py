@@ -112,6 +112,13 @@ BUILTIN_KINDS: list[tuple[str, str, str, bool]] = [
     ("networking.istio.io/v1beta1", "VirtualService", "virtualservices", True),
     ("security.istio.io/v1beta1", "AuthorizationPolicy", "authorizationpolicies", True),
     ("gateway.networking.k8s.io/v1", "HTTPRoute", "httproutes", True),
+    (
+        "admissionregistration.k8s.io/v1",
+        "MutatingWebhookConfiguration",
+        "mutatingwebhookconfigurations",
+        False,
+    ),
+    ("coordination.k8s.io/v1", "Lease", "leases", True),
 ]
 
 

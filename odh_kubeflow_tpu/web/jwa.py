@@ -457,16 +457,23 @@ class JupyterWebApp(CrudBackend):
         return {"phase": "waiting", "message": "Starting"}
 
     def _find_error_event(self, nb: Obj) -> Optional[str]:
+        """CR events first (the controller re-emits owned STS/Pod events
+        onto the Notebook), then raw namespace-event mining as fallback
+        for anything the mirror missed."""
         name = obj_util.name_of(nb)
+        fallback: Optional[str] = None
         for event in self.api.list(
             "Event", namespace=obj_util.namespace_of(nb)
         ):
             if event.get("type") != "Warning":
                 continue
-            involved = event.get("involvedObject", {}).get("name", "")
-            if involved == name or involved.startswith(f"{name}-"):
+            involved = event.get("involvedObject", {})
+            iname = involved.get("name", "")
+            if involved.get("kind") == "Notebook" and iname == name:
                 return event.get("message", event.get("reason", ""))
-        return None
+            if iname == name or iname.startswith(f"{name}-"):
+                fallback = event.get("message", event.get("reason", ""))
+        return fallback
 
 
 def _apply_limit_factor(value: str, cfg: Obj) -> str:

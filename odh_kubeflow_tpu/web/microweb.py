@@ -248,11 +248,31 @@ class App:
 
     # -- serving ------------------------------------------------------------
 
-    def serve(self, host: str = "127.0.0.1", port: int = 0):
+    def serve(self, host: str = "127.0.0.1", port: int = 0, ssl_context=None):
+        """Start a daemon-thread server. ``ssl_context`` (an
+        ``ssl.SSLContext``) upgrades it to HTTPS — the admission webhook
+        serves AdmissionReview this way, since a real kube-apiserver
+        only calls webhooks over TLS."""
+
         class ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
             daemon_threads = True
 
+            # TLS handshake must happen in the per-connection handler
+            # thread (finish_request), never in the accept loop — a
+            # client that connects and sends no ClientHello would
+            # otherwise park serve_forever and block every caller.
+            def finish_request(self, request, client_address):
+                if ssl_context is not None:
+                    request.settimeout(10)
+                    request.do_handshake()
+                    request.settimeout(None)
+                super().finish_request(request, client_address)
+
         server = make_server(host, port, self, server_class=ThreadingWSGIServer)
+        if ssl_context is not None:
+            server.socket = ssl_context.wrap_socket(
+                server.socket, server_side=True, do_handshake_on_connect=False
+            )
         thread = threading.Thread(target=server.serve_forever, daemon=True)
         thread.start()
         return server

@@ -107,11 +107,24 @@ class AdmissionServer:
         )
 
 
+def make_ssl_context(cert_file: str, key_file: str):
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.load_cert_chain(cert_file, key_file)
+    return ctx
+
+
 def main() -> None:
     """Split-process entrypoint (manifests/admission-webhook): serve the
-    PodDefault + Notebook mutators as AdmissionReview endpoints, reading
-    PodDefaults via $KUBE_API_URL. TLS terminates in front (the
-    Service/cert Secret pair in the manifests)."""
+    PodDefault + Notebook mutators as AdmissionReview endpoints over
+    HTTPS, reading PodDefaults via $KUBE_API_URL. The cert pair comes
+    from the mounted Secret ($CERT_DIR, provisioned by the
+    `webhooks.certs` bootstrap job — reference admission-webhook
+    main.go:625-640 serves the same way); if the mount is absent a
+    self-signed pair is generated so the process still comes up in dev.
+    Set TLS_DISABLE=true to serve plain HTTP (local debugging only)."""
     import os
     import time
 
@@ -125,9 +138,28 @@ def main() -> None:
     server.handle("/mutate-notebook-v1", NotebookWebhook(api).mutate)
     host = os.environ.get("HOST", "0.0.0.0")
     port = int(os.environ.get("PORT", "8443"))
-    httpd = server.app.serve(host, port)
+
+    ssl_context = None
+    scheme = "http"
+    if os.environ.get("TLS_DISABLE", "").lower() != "true":
+        from odh_kubeflow_tpu.webhooks.certs import generate_webhook_certs
+
+        cert_dir = os.environ.get("CERT_DIR", "/etc/webhook/certs")
+        cert_file = os.path.join(cert_dir, "tls.crt")
+        key_file = os.path.join(cert_dir, "tls.key")
+        if not (os.path.exists(cert_file) and os.path.exists(key_file)):
+            bundle = generate_webhook_certs()
+            try:
+                cert_file, key_file, _ = bundle.write(cert_dir)
+            except OSError:  # read-only Secret mount without the pair
+                cert_file, key_file, _ = bundle.write("/tmp/webhook-certs")
+        ssl_context = make_ssl_context(cert_file, key_file)
+        scheme = "https"
+
+    httpd = server.app.serve(host, port, ssl_context=ssl_context)
     print(
-        f"admission-webhook on http://{host}:{httpd.server_address[1]}", flush=True
+        f"admission-webhook on {scheme}://{host}:{httpd.server_address[1]}",
+        flush=True,
     )
     while True:
         time.sleep(3600)

@@ -118,6 +118,106 @@ def test_poddefault_admission_review_patch():
     assert "JAX_PLATFORMS" in env_names
 
 
+def test_admission_review_over_tls():
+    """The deployed wire path: HTTPS serving with a generated cert the
+    client verifies against the bootstrap CA (reference
+    admission-webhook/main.go:625-640 — a real apiserver refuses plain
+    HTTP webhooks)."""
+    import ssl
+    import tempfile
+    import urllib.request
+
+    from odh_kubeflow_tpu.webhooks.certs import generate_webhook_certs
+    from odh_kubeflow_tpu.webhooks.server import make_ssl_context
+
+    api = APIServer()
+    register_crds(api)
+    api.create({"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": "t"}})
+    api.create(tpu_runtime_poddefault("t"))
+    server = AdmissionServer().handle(
+        "/apply-poddefault", PodDefaultWebhook(api).mutate
+    )
+
+    with tempfile.TemporaryDirectory() as d:
+        bundle = generate_webhook_certs(dns_names=["localhost"])
+        cert_file, key_file, ca_file = bundle.write(d)
+        httpd = server.app.serve(
+            "127.0.0.1", 0, ssl_context=make_ssl_context(cert_file, key_file)
+        )
+        port = httpd.server_address[1]
+        try:
+            client_ctx = ssl.create_default_context(cafile=ca_file)
+            pod = {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": "p1",
+                    "namespace": "t",
+                    "labels": {"tpu-runtime": "enabled"},
+                },
+                "spec": {"containers": [{"name": "main", "image": "x"}]},
+            }
+            body = json.dumps(
+                {
+                    "apiVersion": "admission.k8s.io/v1",
+                    "kind": "AdmissionReview",
+                    "request": {"uid": "u2", "operation": "CREATE", "object": pod},
+                }
+            ).encode()
+            req = urllib.request.Request(
+                f"https://localhost:{port}/apply-poddefault",
+                data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, context=client_ctx, timeout=10) as r:
+                resp = json.loads(r.read().decode())["response"]
+            assert resp["allowed"] is True
+            ops = json.loads(base64.b64decode(resp["patch"]).decode())
+            patched = _apply_patch(pod, ops)
+            env_names = {
+                e["name"] for e in patched["spec"]["containers"][0].get("env", [])
+            }
+            assert "JAX_PLATFORMS" in env_names
+
+            # an unverified client (default context) must fail the handshake
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    f"https://localhost:{port}/healthz", timeout=10
+                )
+        finally:
+            httpd.shutdown()
+
+
+def test_cert_bootstrap_idempotent_and_patches_cabundle():
+    """ensure_cert_secret + patch_ca_bundle: first run generates, second
+    run reuses; the MutatingWebhookConfiguration ends up carrying the
+    CA that signed the Secret's serving cert."""
+    from odh_kubeflow_tpu.webhooks import certs
+
+    api = APIServer()
+    register_crds(api)
+    api.create(
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": "kubeflow"}}
+    )
+    api.create(
+        {
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "MutatingWebhookConfiguration",
+            "metadata": {"name": certs.WEBHOOK_CONFIG_NAME},
+            "webhooks": [
+                {"name": "poddefaults.kubeflow.org", "clientConfig": {}},
+                {"name": "notebooks.kubeflow.org", "clientConfig": {}},
+            ],
+        }
+    )
+    b1 = certs.bootstrap(api)
+    b2 = certs.bootstrap(api)
+    assert b1.cert_pem == b2.cert_pem  # second run reused the Secret
+    cfg = api.get("MutatingWebhookConfiguration", certs.WEBHOOK_CONFIG_NAME, None)
+    for hook in cfg["webhooks"]:
+        assert hook["clientConfig"]["caBundle"] == b1.ca_bundle_b64
+
+
 def test_non_matching_pod_gets_no_patch():
     api = APIServer()
     register_crds(api)

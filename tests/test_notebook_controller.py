@@ -201,6 +201,55 @@ def test_invalid_tpu_request_surfaces_event():
     assert nb["status"]["conditions"][0]["reason"] == "TPURequestInvalid"
 
 
+def test_pod_events_reemitted_onto_notebook_cr():
+    """An owned Pod's Warning event is copied onto the Notebook CR with
+    dedupe, so `kubectl describe notebook` tells the story (reference
+    notebook_controller.go:94-118,649-723)."""
+    api, cluster, mgr, _ = make_env()
+    # TPU request with no matching node pool → scheduler Warning on pod
+    api.create(
+        notebook(
+            name="starved",
+            annotations={
+                TPU_ACCELERATOR_ANNOTATION: "tpu-v5-lite-podslice",
+                TPU_TOPOLOGY_ANNOTATION: "2x2",
+            },
+        )
+    )
+    mgr.drain()
+    cluster.step()  # kubelet: pod unschedulable → FailedScheduling event
+    mgr.drain()  # controller maps the event and mirrors it onto the CR
+
+    cr_events = [
+        e
+        for e in api.list("Event", namespace="team-a")
+        if e["involvedObject"]["kind"] == "Notebook"
+        and e["involvedObject"]["name"] == "starved"
+    ]
+    assert len(cr_events) == 1
+    assert cr_events[0]["reason"] == "FailedScheduling"
+    assert cr_events[0]["type"] == "Warning"
+
+    # repeat kubelet sync does not duplicate the mirrored event
+    cluster.step()
+    mgr.drain()
+    cr_events2 = [
+        e
+        for e in api.list("Event", namespace="team-a")
+        if e["involvedObject"]["kind"] == "Notebook"
+        and e["involvedObject"]["name"] == "starved"
+    ]
+    assert len(cr_events2) == 1
+
+    # JWA surfaces the CR event as the status message
+    from odh_kubeflow_tpu.web.jwa import JupyterWebApp
+
+    jwa = JupyterWebApp(api)
+    status = jwa.notebook_status(api.get("Notebook", "starved", "team-a"))
+    assert status["phase"] == "warning"
+    assert "no node matches" in status["message"]
+
+
 def test_istio_virtualservice():
     api, cluster, mgr, _ = make_env(use_istio=True)
     api.create(notebook())
