@@ -1,0 +1,16 @@
+#!/bin/bash
+# Launch code-server under the platform's 8888/$NB_PREFIX contract.
+# TPU variants additionally ship tpu-activity-agent — without it a
+# busy-but-quiet training session would be culled (the culler's only
+# activity signal for non-Jupyter servers is the TPU duty cycle).
+set -euo pipefail
+
+if command -v tpu-init >/dev/null 2>&1; then
+  tpu-init || echo "tpu-init failed; continuing (CPU fallback)" >&2
+fi
+
+if command -v tpu-activity-agent >/dev/null 2>&1; then
+  tpu-activity-agent &
+fi
+
+exec code-server --bind-addr 0.0.0.0:8888 --auth none --disable-telemetry "${HOME}"

@@ -10,6 +10,11 @@ if command -v tpu-init >/dev/null 2>&1; then
   tpu-init || echo "tpu-init failed; continuing (CPU fallback)" >&2
 fi
 
+# TPU variants ship the activity agent the culler probes on :8890
+if command -v tpu-activity-agent >/dev/null 2>&1; then
+  tpu-activity-agent &
+fi
+
 exec jupyter lab \
   --notebook-dir="${HOME}" \
   --ip=0.0.0.0 \

@@ -31,6 +31,7 @@ from odh_kubeflow_tpu.apis import (
     LAST_ACTIVITY_ANNOTATION,
     LAST_ACTIVITY_CHECK_ANNOTATION,
     STOP_ANNOTATION,
+    TPU_ACCELERATOR_ANNOTATION,
 )
 from odh_kubeflow_tpu.controllers.runtime import Result
 from odh_kubeflow_tpu.machinery import objects as obj_util
@@ -58,6 +59,9 @@ class CullerConfig:
     probe_timeout: float = 5.0
     # TPU activity: duty cycle above this percentage counts as active
     tpu_duty_cycle_threshold: float = 5.0
+    # port the in-image tpu-activity-agent listens on (exposed by the
+    # notebook Service for TPU notebooks; images/*/tpu-activity-agent)
+    tpu_agent_port: int = 8890
 
 
 class Culler:
@@ -68,10 +72,20 @@ class Culler:
         base_url_fn: Optional[Callable[[Obj], str]] = None,
         now_fn: Callable[[], float] = time.time,
         cull_counter=None,
+        tpu_url_fn: Optional[Callable[[Obj], str]] = None,
     ):
         self.api = api
         self.config = config or CullerConfig()
         self._base_url_fn = base_url_fn or self._default_base_url
+        # TPU probe URL: the agent serves on its own port (the Jupyter
+        # port can't proxy it). When a test injects base_url_fn only,
+        # the TPU probe rides the same fake base.
+        if tpu_url_fn is None:
+            if base_url_fn is None:
+                tpu_url_fn = self._default_tpu_url
+            else:
+                tpu_url_fn = lambda nb: base_url_fn(nb) + "/api/tpu/activity"  # noqa: E731
+        self._tpu_url_fn = tpu_url_fn
         self.now = now_fn
         self.m_cull = cull_counter
 
@@ -82,6 +96,14 @@ class Culler:
         return (
             f"http://{name}.{ns}.svc.{self.config.cluster_domain}"
             f"/notebook/{ns}/{name}"
+        )
+
+    def _default_tpu_url(self, notebook: Obj) -> str:
+        name = obj_util.name_of(notebook)
+        ns = obj_util.namespace_of(notebook)
+        return (
+            f"http://{name}.{ns}.svc.{self.config.cluster_domain}:"
+            f"{self.config.tpu_agent_port}/api/tpu/activity"
         )
 
     # -- probes -------------------------------------------------------------
@@ -119,7 +141,14 @@ class Culler:
                     t = _parse_time(la)
                     latest = t if latest is None else max(latest, t)
 
-        tpu = self._get_json(f"{base}/api/tpu/activity")
+        # TPU probe only for TPU notebooks — non-TPU Services don't
+        # expose the agent port, and an undeclared ClusterIP port can
+        # stall the probe for its full timeout
+        tpu = (
+            self._get_json(self._tpu_url_fn(notebook))
+            if TPU_ACCELERATOR_ANNOTATION in obj_util.annotations_of(notebook)
+            else None
+        )
         if tpu is not None:
             duty = float(tpu.get("duty_cycle_pct", 0.0))
             if duty >= self.config.tpu_duty_cycle_threshold:

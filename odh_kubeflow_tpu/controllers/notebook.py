@@ -38,7 +38,7 @@ from odh_kubeflow_tpu.apis import (
 from odh_kubeflow_tpu.controllers import reconcilehelper
 from odh_kubeflow_tpu.controllers.runtime import Manager, Request, Result
 from odh_kubeflow_tpu.machinery import objects as obj_util
-from odh_kubeflow_tpu.machinery.store import APIServer, NotFound
+from odh_kubeflow_tpu.machinery.store import APIServer, Conflict, NotFound
 from odh_kubeflow_tpu.utils import prometheus
 from odh_kubeflow_tpu.utils.tpu import TPU_TOPOLOGIES, chips_in_topology, hosts_in_slice
 
@@ -48,6 +48,7 @@ DEFAULT_CONTAINER_PORT = 8888
 DEFAULT_SERVICE_PORT = 80
 DEFAULT_FSGROUP = 100
 PREFIX_ENV = "NB_PREFIX"
+TPU_AGENT_PORT = 8890
 
 
 @dataclasses.dataclass
@@ -197,12 +198,18 @@ class NotebookController:
         return [Request(ns, name)]
 
     def _mirror_event(self, notebook: Obj, event: Obj) -> None:
-        """Copy an owned-object Event onto the Notebook. Dedupe is
-        server-side — an identical (reason, message, type) event already
-        on the CR suppresses the re-emit — so a restarted controller
-        replaying the Event watch does not flood the CR with
-        duplicates. Events older than the CR (a recreated notebook
-        inheriting stale pod events, reference :700-712) are skipped."""
+        """Copy an owned-object Warning event onto the Notebook (Normal
+        events are noise at the CR level — the reference's useful signal
+        is failures). Dedupe is server-side with real kube count
+        semantics: an identical (reason, message, type) event already on
+        the CR absorbs the re-observation as a count bump + lastTimestamp
+        advance instead of a duplicate object, so a restarted controller
+        replaying the Event watch cannot flood the CR, while a recurring
+        failure stays visibly fresh. Events older than the CR (a
+        recreated notebook inheriting stale pod events, reference
+        :700-712) are skipped."""
+        if event.get("type") != "Warning":
+            return
         created = obj_util.meta(notebook).get("creationTimestamp", "")
         stamp = event.get("lastTimestamp") or event.get("firstTimestamp") or ""
         if created and stamp and stamp < created:
@@ -211,7 +218,6 @@ class NotebookController:
         message = event.get("message", "")
         if not reason and not message:
             return
-        etype = event.get("type", "Normal")
         name = obj_util.name_of(notebook)
         for existing in self.api.list(
             "Event", namespace=obj_util.namespace_of(notebook)
@@ -222,14 +228,21 @@ class NotebookController:
                 and involved.get("name") == name
                 and existing.get("reason") == reason
                 and existing.get("message") == message
-                and existing.get("type") == etype
+                and existing.get("type") == "Warning"
             ):
+                if stamp and stamp > existing.get("lastTimestamp", ""):
+                    existing["count"] = int(existing.get("count", 1)) + 1
+                    existing["lastTimestamp"] = stamp
+                    try:
+                        self.api.update(existing)
+                    except Conflict:
+                        pass  # another worker bumped it; same truth
                 return
         self.api.emit_event(
             notebook,
             reason,
             message,
-            event_type=etype,
+            event_type="Warning",
             component="notebook-controller",
         )
 
@@ -269,7 +282,7 @@ class NotebookController:
                 self.m_create_failed.inc()
             raise
 
-        svc = self.generate_service(notebook)
+        svc = self.generate_service(notebook, tpu)
         reconcilehelper.reconcile_object(self.api, svc, owner=notebook)
         if tpu is not None and tpu.hosts > 1:
             headless = self.generate_headless_service(notebook)
@@ -280,9 +293,85 @@ class NotebookController:
 
         self.mirror_status(notebook)
 
+        if tpu is not None:
+            slice_result = self._reconcile_slice_health(notebook, tpu)
+            if slice_result is not None:
+                return slice_result
+
         if self.config.enable_culling and self.culler is not None:
             return self.culler.reconcile_notebook(notebook)
         return Result()
+
+    # -- TPU slice health (SURVEY.md §7 hard part (d)) ----------------------
+
+    def _reconcile_slice_health(
+        self, notebook: Obj, tpu: TpuRequest
+    ) -> Optional[Result]:
+        """Preempted TPU slices surface as CR conditions and restart
+        cleanly. A slice is a gang: one preempted host makes the whole
+        SPMD group useless (jax.distributed needs every worker present),
+        so recovery deletes ALL the group's pods — survivors included —
+        and lets the StatefulSet re-materialise them together. The
+        reference never needed this (GPUs are per-pod); preemptible
+        slices are a TPU-platform fact of life."""
+        name = obj_util.name_of(notebook)
+        ns = obj_util.namespace_of(notebook)
+        pods = [
+            p
+            for p in self.api.list("Pod", namespace=ns)
+            if obj_util.labels_of(p).get("statefulset") == name
+        ]
+        failed = [
+            p
+            for p in pods
+            if obj_util.get_path(p, "status", "phase") == "Failed"
+        ]
+        if failed:
+            hosts = ", ".join(sorted(obj_util.name_of(p) for p in failed))
+            msg = (
+                f"TPU slice preempted: host pod(s) {hosts} failed; "
+                "restarting the slice group atomically"
+            )
+            self.api.emit_event(
+                notebook,
+                "TPUSlicePreempted",
+                msg,
+                event_type="Warning",
+                component="notebook-controller",
+            )
+            self._upsert_condition(
+                notebook, "SlicePreempted", "True", "SlicePreempted", msg
+            )
+            for p in pods:
+                try:
+                    self.api.delete("Pod", obj_util.name_of(p), ns)
+                except NotFound:
+                    pass
+            return Result(requeue_after=1.0)
+
+        # recovery: the full gang is ready again → flip the condition
+        for cond in obj_util.get_path(
+            notebook, "status", "conditions", default=[]
+        ) or []:
+            if cond.get("type") == "SlicePreempted" and cond.get("status") == "True":
+                # count live pods, not the (possibly stale) STS status:
+                # right after the gang teardown the STS still reports
+                # its pre-preemption readyReplicas
+                running = sum(
+                    1
+                    for p in pods
+                    if obj_util.get_path(p, "status", "phase") == "Running"
+                )
+                if running == tpu.hosts:
+                    self._upsert_condition(
+                        notebook,
+                        "SlicePreempted",
+                        "False",
+                        "SliceRecovered",
+                        f"all {tpu.hosts} slice host(s) ready",
+                    )
+                break
+        return None
 
     # -- generators ---------------------------------------------------------
 
@@ -402,9 +491,32 @@ class NotebookController:
             set_env({"name": "TPU_WORKER_ID", "value": "0"})
             set_env({"name": "TPU_WORKER_HOSTNAMES", "value": "localhost"})
 
-    def generate_service(self, notebook: Obj) -> Obj:
+    def generate_service(
+        self, notebook: Obj, tpu: Optional[TpuRequest] = None
+    ) -> Obj:
         name = obj_util.name_of(notebook)
         ns = obj_util.namespace_of(notebook)
+        ports = [
+            {
+                # http- prefix: Istio protocol selection
+                # (reference :500-501)
+                "name": f"http-{name}",
+                "port": DEFAULT_SERVICE_PORT,
+                "targetPort": DEFAULT_CONTAINER_PORT,
+                "protocol": "TCP",
+            }
+        ]
+        if tpu is not None:
+            # the in-image tpu-activity-agent the culler probes
+            # (images/jupyter-jax-tpu/tpu-activity-agent)
+            ports.append(
+                {
+                    "name": "http-tpu-activity",
+                    "port": TPU_AGENT_PORT,
+                    "targetPort": TPU_AGENT_PORT,
+                    "protocol": "TCP",
+                }
+            )
         return {
             "apiVersion": "v1",
             "kind": "Service",
@@ -412,16 +524,7 @@ class NotebookController:
             "spec": {
                 "type": "ClusterIP",
                 "selector": {"statefulset": name},
-                "ports": [
-                    {
-                        # http- prefix: Istio protocol selection
-                        # (reference :500-501)
-                        "name": f"http-{name}",
-                        "port": DEFAULT_SERVICE_PORT,
-                        "targetPort": DEFAULT_CONTAINER_PORT,
-                        "protocol": "TCP",
-                    }
-                ],
+                "ports": ports,
             },
         }
 
@@ -488,6 +591,12 @@ class NotebookController:
             "conditions": [],
             "containerState": {},
         }
+        # controller-owned conditions survive the pod-mirror rebuild
+        for cond in (
+            obj_util.get_path(notebook, "status", "conditions", default=[]) or []
+        ):
+            if cond.get("type") == "SlicePreempted":
+                status["conditions"].append(cond)
         try:
             sts = self.api.get("StatefulSet", name, ns)
             status["readyReplicas"] = obj_util.get_path(
@@ -512,23 +621,36 @@ class NotebookController:
         except NotFound:
             pass
         notebook["status"] = status
-        self.api.update_status(notebook)
+        updated = self.api.update_status(notebook)
+        # keep the in-hand dict fresh for follow-up status writes in the
+        # same reconcile (slice health, conditions)
+        notebook["metadata"]["resourceVersion"] = updated["metadata"][
+            "resourceVersion"
+        ]
 
     def _set_condition(self, notebook: Obj, reason: str, message: str) -> None:
+        self._upsert_condition(notebook, "Degraded", "True", reason, message)
+
+    def _upsert_condition(
+        self, notebook: Obj, ctype: str, status: str, reason: str, message: str
+    ) -> None:
         conditions = notebook.setdefault("status", {}).setdefault("conditions", [])
         cond = {
-            "type": "Degraded",
-            "status": "True",
+            "type": ctype,
+            "status": status,
             "reason": reason,
             "message": message,
         }
         for i, existing in enumerate(conditions):
-            if existing.get("type") == "Degraded":
+            if existing.get("type") == ctype:
                 conditions[i] = cond
                 break
         else:
             conditions.append(cond)
-        self.api.update_status(notebook)
+        updated = self.api.update_status(notebook)
+        notebook["metadata"]["resourceVersion"] = updated["metadata"][
+            "resourceVersion"
+        ]
 
 
 def main() -> None:

@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -48,9 +49,22 @@ _EVENT_INDEX_MAX = 4096
 
 
 class RemoteAPIServer:
-    def __init__(self, base_url: str = "http://127.0.0.1:8001", timeout: float = 30.0):
+    def __init__(
+        self,
+        base_url: str = "http://127.0.0.1:8001",
+        timeout: float = 30.0,
+        qps: Optional[float] = None,
+        burst: int = 10,
+    ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # client-side rate limit (reference flag parity: --kube-api-qps /
+        # --kube-api-burst, notebook-controller/main.go:56-70). Token
+        # bucket: ``burst`` instant requests, refilled at ``qps``/s.
+        self._qps = qps
+        self._burst = max(burst, 1)
+        self._tokens = float(self._burst)
+        self._refill_t = time.monotonic()
         self._types: dict[str, TypeInfo] = {}
         self._watches: list[Watch] = []
         self._lock = threading.RLock()
@@ -115,9 +129,26 @@ class RemoteAPIServer:
             p += f"/{subresource}"
         return p
 
+    def _throttle(self) -> None:
+        if self._qps is None:
+            return
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self._burst, self._tokens + (now - self._refill_t) * self._qps
+            )
+            self._refill_t = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return
+            wait = (1.0 - self._tokens) / self._qps
+            self._tokens = 0.0
+        time.sleep(wait)
+
     def _request(
         self, method: str, path: str, body: Optional[Obj] = None, query: str = ""
     ) -> Obj:
+        self._throttle()
         url = self.base_url + path + (f"?{query}" if query else "")
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
@@ -385,7 +416,12 @@ def api_from_env() -> RemoteAPIServer:
     the platform CRD kinds for path mapping."""
     import os
 
-    api = RemoteAPIServer(os.environ.get("KUBE_API_URL", "http://127.0.0.1:8001"))
+    qps_env = os.environ.get("KUBE_API_QPS", "")
+    api = RemoteAPIServer(
+        os.environ.get("KUBE_API_URL", "http://127.0.0.1:8001"),
+        qps=float(qps_env) if qps_env else None,
+        burst=int(os.environ.get("KUBE_API_BURST", "10")),
+    )
     from odh_kubeflow_tpu.apis import register_crds
 
     register_crds(api)  # admission registration is a client-side no-op

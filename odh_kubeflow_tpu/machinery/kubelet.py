@@ -81,6 +81,37 @@ class FakeCluster:
             )
         return nodes
 
+    def preempt_node(self, name: str) -> None:
+        """Simulate GKE reclaiming a spot/preemptible TPU host: the Node
+        object vanishes and every pod bound to it is marked Failed with
+        reason Preempted (what the node controller reports for a lost
+        node). The notebook controller's slice-health reconcile turns
+        this into a SlicePreempted condition + atomic gang restart."""
+        try:
+            self.api.delete("Node", name, None)
+        except NotFound:
+            pass
+        for pod in self.api.list("Pod"):
+            if obj_util.get_path(pod, "spec", "nodeName") != name:
+                continue
+            if obj_util.get_path(pod, "status", "phase") in ("Succeeded", "Failed"):
+                continue
+            pod.setdefault("status", {})
+            pod["status"]["phase"] = "Failed"
+            pod["status"]["reason"] = "Preempted"
+            pod["status"]["message"] = f"Node {name} was preempted"
+            pod["status"]["conditions"] = [
+                {"type": "Ready", "status": "False", "reason": "Preempted"}
+            ]
+            self.api.update_status(pod)
+            self.api.emit_event(
+                pod,
+                "Preempted",
+                f"Node {name} was preempted; pod terminated",
+                event_type="Warning",
+                component="node-controller",
+            )
+
     # -- scheduling ---------------------------------------------------------
 
     def _pod_tpu_request(self, pod: Obj) -> float:

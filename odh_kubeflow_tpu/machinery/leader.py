@@ -1,0 +1,185 @@
+"""Lease-based leader election for split-process controllers.
+
+The reference's controllers run with controller-runtime leader election
+(`--leader-elect`, notebook-controller/main.go:56-70): replicas > 1 are
+safe because only the Lease holder reconciles. Same contract here over
+the coordination.k8s.io/v1 Lease API the embedded apiserver serves:
+
+- acquire: create the Lease, or take it over when the recorded
+  renewTime is older than leaseDurationSeconds (holder died), bumping
+  leaseTransitions;
+- renew: update renewTime every renew_period while holding;
+- lose: a conflicting update or an observed foreign holder stops the
+  elector, and the runner exits the process — exactly what
+  controller-runtime does, because continuing without the lease risks
+  two actors reconciling the same keys.
+
+Times are stored RFC3339-micro like real kube (Lease spec uses
+MicroTime).
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import socket
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from odh_kubeflow_tpu.machinery.store import AlreadyExists, Conflict, NotFound
+
+Obj = dict[str, Any]
+
+
+def _fmt_micro(t: float) -> str:
+    return datetime.datetime.fromtimestamp(
+        t, datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def _parse_micro(s: str) -> float:
+    return (
+        datetime.datetime.strptime(s, "%Y-%m-%dT%H:%M:%S.%fZ")
+        .replace(tzinfo=datetime.timezone.utc)
+        .timestamp()
+    )
+
+
+def default_identity() -> str:
+    return f"{socket.gethostname()}_{os.getpid()}"
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        api,
+        lease_name: str,
+        namespace: str = "kubeflow",
+        identity: Optional[str] = None,
+        lease_duration: float = 15.0,
+        renew_period: float = 5.0,
+        retry_period: float = 2.0,
+        now_fn: Callable[[], float] = time.time,
+    ):
+        self.api = api
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.identity = identity or default_identity()
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.retry_period = retry_period
+        self.now = now_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lease mechanics ----------------------------------------------------
+
+    def _lease_obj(self, transitions: int) -> Obj:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": self.lease_name, "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": int(self.lease_duration),
+                "acquireTime": _fmt_micro(self.now()),
+                "renewTime": _fmt_micro(self.now()),
+                "leaseTransitions": transitions,
+            },
+        }
+
+    def try_acquire(self) -> bool:
+        """One acquire-or-renew attempt. True iff we hold the lease."""
+        try:
+            lease = self.api.get("Lease", self.lease_name, self.namespace)
+        except NotFound:
+            try:
+                self.api.create(self._lease_obj(0))
+                return True
+            except (AlreadyExists, Conflict):
+                return False
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity")
+        if holder == self.identity:
+            spec["renewTime"] = _fmt_micro(self.now())
+            try:
+                self.api.update(lease)
+                return True
+            except Conflict:
+                return False  # someone raced us: treat as lost
+        renew = spec.get("renewTime")
+        expired = (
+            not renew
+            or self.now() - _parse_micro(renew)
+            > float(spec.get("leaseDurationSeconds", self.lease_duration))
+        )
+        if not expired:
+            return False
+        # take over a dead holder's lease
+        lease["spec"] = self._lease_obj(int(spec.get("leaseTransitions", 0)) + 1)[
+            "spec"
+        ]
+        try:
+            self.api.update(lease)
+            return True
+        except Conflict:
+            return False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def acquire(self, timeout: Optional[float] = None) -> bool:
+        """Block until leadership is acquired (or timeout)."""
+        deadline = None if timeout is None else self.now() + timeout
+        while not self._stop.is_set():
+            if self.try_acquire():
+                return True
+            if deadline is not None and self.now() >= deadline:
+                return False
+            time.sleep(self.retry_period)
+        return False
+
+    def run(self, on_lost: Callable[[], None]) -> None:
+        """Start the renew loop (after a successful acquire).
+
+        A transient API error (apiserver blip → URLError, timeout) must
+        NOT kill the loop silently — that would leave the process
+        reconciling while never renewing, the exact split-brain leader
+        election exists to prevent. Errors are retried until the renew
+        deadline (80% of lease_duration since the last successful
+        renew); only a definitive loss (foreign holder / conflict) or a
+        blown deadline fires on_lost."""
+
+        def loop():
+            last_renew = self.now()
+            while not self._stop.is_set():
+                time.sleep(self.renew_period)
+                if self._stop.is_set():
+                    return
+                try:
+                    if self.try_acquire():
+                        last_renew = self.now()
+                        continue
+                    on_lost()  # definitive: someone else holds it
+                    return
+                except Exception:  # noqa: BLE001 — transient API error
+                    if self.now() - last_renew > 0.8 * self.lease_duration:
+                        on_lost()
+                        return
+                    time.sleep(self.retry_period)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def release(self) -> None:
+        """Graceful handoff: drop holderIdentity so a peer can acquire
+        without waiting out the lease duration."""
+        self._stop.set()
+        try:
+            lease = self.api.get("Lease", self.lease_name, self.namespace)
+            if (lease.get("spec") or {}).get("holderIdentity") == self.identity:
+                lease["spec"]["holderIdentity"] = ""
+                lease["spec"]["renewTime"] = None
+                self.api.update(lease)
+        except (NotFound, Conflict):
+            pass

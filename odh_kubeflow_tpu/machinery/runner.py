@@ -15,11 +15,36 @@ from typing import Callable
 
 
 def run_controller(name: str, register: Callable) -> None:
-    """``register(api, mgr)`` wires controllers into the manager."""
+    """``register(api, mgr)`` wires controllers into the manager.
+
+    LEADER_ELECT=true (flag parity: notebook-controller/main.go:56-70)
+    gates reconciling on holding a coordination.k8s.io Lease named
+    ``<name>-leader`` — replicas > 1 become an HA pair. Losing the
+    lease exits the process (controller-runtime semantics: never keep
+    reconciling without it)."""
     from odh_kubeflow_tpu.controllers.runtime import Manager
     from odh_kubeflow_tpu.machinery.client import api_from_env
 
     api = api_from_env()
+
+    elector = None
+    if os.environ.get("LEADER_ELECT", "").lower() == "true":
+        from odh_kubeflow_tpu.machinery.leader import LeaderElector
+
+        elector = LeaderElector(
+            api,
+            os.environ.get("LEADER_ELECTION_ID", f"{name}-leader"),
+            namespace=os.environ.get("LEADER_ELECTION_NAMESPACE", "kubeflow"),
+        )
+        print(f"{name}: waiting for leader lease…", flush=True)
+        elector.acquire()
+
+        def lost():
+            print(f"{name}: leader lease lost; exiting", flush=True)
+            os._exit(1)
+
+        elector.run(on_lost=lost)
+
     mgr = Manager(api)
     register(api, mgr)
     mgr.start()
@@ -29,6 +54,8 @@ def run_controller(name: str, register: Callable) -> None:
             time.sleep(3600)
     except KeyboardInterrupt:
         mgr.stop()
+        if elector is not None:
+            elector.release()
 
 
 def run_web(name: str, default_port: int, build: Callable) -> None:

@@ -250,6 +250,70 @@ def test_pod_events_reemitted_onto_notebook_cr():
     assert "no node matches" in status["message"]
 
 
+def test_slice_preemption_surfaces_and_recovers():
+    """SURVEY §7 hard part (d): a preempted multi-host TPU slice must
+    surface as a SlicePreempted condition + Warning event on the CR, the
+    whole host group restarts atomically (one dead host invalidates the
+    SPMD gang), and the condition flips once every host is ready."""
+    api, cluster, mgr, _ = make_env()
+    cluster.add_tpu_node_pool(
+        "v5p", "tpu-v5p-slice", "2x2x2", num_hosts=2, chips_per_host=4
+    )
+    api.create(
+        notebook(
+            name="big",
+            annotations={
+                TPU_ACCELERATOR_ANNOTATION: "tpu-v5p-slice",
+                TPU_TOPOLOGY_ANNOTATION: "2x2x2",
+            },
+        )
+    )
+    mgr.drain()
+    cluster.step()
+    mgr.drain()
+    nb = api.get("Notebook", "big", "team-a")
+    assert nb["status"]["readyReplicas"] == 2
+
+    # GKE reclaims one of the two slice hosts
+    cluster.preempt_node("v5p-0")
+    mgr.drain()
+
+    nb = api.get("Notebook", "big", "team-a")
+    conds = {c["type"]: c for c in nb["status"]["conditions"]}
+    assert conds["SlicePreempted"]["status"] == "True"
+    assert "big-0" in conds["SlicePreempted"]["message"]
+    events = [
+        e
+        for e in api.list("Event", namespace="team-a")
+        if e["involvedObject"]["kind"] == "Notebook"
+        and e["reason"] == "TPUSlicePreempted"
+    ]
+    assert events and events[0]["type"] == "Warning"
+    # the SURVIVING host was torn down too — gang semantics
+    assert api.list("Pod", namespace="team-a") == []
+
+    # the reclaimed host comes back (v5p-1 never left); the whole group
+    # re-materialises together
+    cluster.add_node(
+        "v5p-0",
+        labels={
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice",
+            "cloud.google.com/gke-tpu-topology": "2x2x2",
+            "cloud.google.com/gke-nodepool": "v5p",
+        },
+        extra_capacity={"google.com/tpu": "4"},
+    )
+    cluster.step()
+    mgr.drain()
+    nb = api.get("Notebook", "big", "team-a")
+    assert nb["status"]["readyReplicas"] == 2
+    conds = {c["type"]: c for c in nb["status"]["conditions"]}
+    assert conds["SlicePreempted"]["status"] == "False"
+    assert conds["SlicePreempted"]["reason"] == "SliceRecovered"
+    pods = sorted(p["metadata"]["name"] for p in api.list("Pod", namespace="team-a"))
+    assert pods == ["big-0", "big-1"]
+
+
 def test_istio_virtualservice():
     api, cluster, mgr, _ = make_env(use_istio=True)
     api.create(notebook())
