@@ -1,0 +1,40 @@
+# Top-level build/test fan-out (reference parity: components/Makefile:1-46
+# fans docker-build over every component; here the components share one
+# python package, so the fan-out is test tiers + image builds).
+
+PYTHON ?= python
+export PYTHONPATH := $(CURDIR)$(if $(PYTHONPATH),:$(PYTHONPATH))
+
+.PHONY: all test test-unit test-manifests lint loadtest images bench dryrun
+
+all: lint test
+
+test: test-unit
+
+test-unit:
+	$(PYTHON) -m pytest tests/ -q
+
+test-manifests:
+	$(PYTHON) -m pytest tests/test_manifests.py -q
+
+lint:
+	$(PYTHON) -m compileall -q odh_kubeflow_tpu tests loadtest bench.py __graft_entry__.py
+
+# platform load test against the embedded apiserver + sim kubelet
+# (loadtest/start_notebooks.py; reference notebook-controller/loadtest)
+loadtest:
+	$(PYTHON) loadtest/start_notebooks.py --count 20 --tpu
+
+images:
+	$(MAKE) -C images build
+
+bench:
+	$(PYTHON) bench.py
+
+# multi-chip sharding compile check on a virtual 8-device CPU mesh
+dryrun:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" $(PYTHON) -c \
+	  "import importlib.util; \
+	   s = importlib.util.spec_from_file_location('g', '__graft_entry__.py'); \
+	   m = importlib.util.module_from_spec(s); s.loader.exec_module(m); \
+	   m.dryrun_multichip(8)"
