@@ -26,6 +26,25 @@ USERID_HEADER = os.environ.get("USERID_HEADER", "kubeflow-userid")
 USERID_PREFIX = os.environ.get("USERID_PREFIX", "")
 DEV_MODE = os.environ.get("APP_DEV_MODE", "").lower() in ("1", "true")
 
+FRONTEND_DIR = os.path.join(os.path.dirname(__file__), "frontend")
+
+# app_name → bundled SPA directory under web/frontend/
+FRONTEND_BY_APP = {
+    "jupyter-web-app": "jwa",
+    "volumes-web-app": "vwa",
+    "tensorboards-web-app": "twa",
+    "centraldashboard": "dashboard",
+}
+
+
+def frontend_static(app_name: str):
+    """(static_dir, static_mounts) for an app's bundled frontend: the
+    SPA at the root plus the shared lib at /common."""
+    sub = FRONTEND_BY_APP.get(app_name)
+    static_dir = os.path.join(FRONTEND_DIR, sub) if sub else None
+    mounts = [("/common", os.path.join(FRONTEND_DIR, "common"))]
+    return static_dir, mounts
+
 
 def success(extra: Optional[dict] = None, status: int = 200) -> Response:
     body: dict[str, Any] = {"success": True, "status": status}
@@ -54,7 +73,12 @@ class CrudBackend:
     def __init__(self, api: APIServer, app_name: str, static_dir=None):
         self.api = api
         self.rbac = RBACEvaluator(api)
-        self.app = App(app_name, static_dir=static_dir)
+        default_static, mounts = frontend_static(app_name)
+        self.app = App(
+            app_name,
+            static_dir=static_dir or default_static,
+            static_mounts=mounts,
+        )
         install_csrf(self.app)
         self._install_probes()
         self._install_errors()

@@ -15,7 +15,12 @@ from odh_kubeflow_tpu.controllers.kfam import KfamService
 from odh_kubeflow_tpu.machinery import objects as obj_util
 from odh_kubeflow_tpu.machinery.store import APIServer, AlreadyExists
 from odh_kubeflow_tpu.utils import prometheus
-from odh_kubeflow_tpu.web.crud_backend import failure, success, user_of
+from odh_kubeflow_tpu.web.crud_backend import (
+    failure,
+    frontend_static,
+    success,
+    user_of,
+)
 from odh_kubeflow_tpu.web.microweb import App, Response, install_csrf
 
 Obj = dict[str, Any]
@@ -32,7 +37,12 @@ class DashboardApp:
         self.api = api
         self.kfam = kfam or KfamService(api)
         self.registry = registry or prometheus.default_registry
-        self.app = App("centraldashboard", static_dir=static_dir)
+        default_static, mounts = frontend_static("centraldashboard")
+        self.app = App(
+            "centraldashboard",
+            static_dir=static_dir or default_static,
+            static_mounts=mounts,
+        )
         install_csrf(self.app)
         self._register_routes()
 

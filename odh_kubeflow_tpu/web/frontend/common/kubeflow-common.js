@@ -1,0 +1,237 @@
+/* Shared frontend library — the rebuild's kubeflow-common-lib
+ * (reference: crud-web-apps/common/frontend/kubeflow-common-lib,
+ * 4.7k LoC of Angular: resource-table, namespace-select, status icons,
+ * polling, snack-bars). Dependency-free ES module; every app imports
+ * from /common/kubeflow-common.js.
+ *
+ * Conventions shared with the BFFs:
+ * - JSON envelope {success, status, log, ...} (crud_backend.py);
+ * - CSRF double-submit: the lib materialises an XSRF-TOKEN cookie and
+ *   echoes it in the x-xsrf-token header (microweb.install_csrf);
+ * - namespace arrives as the ?ns= query param — the centraldashboard
+ *   shell owns the selector and stamps the iframe src, exactly like
+ *   the reference dashboard does.
+ */
+
+/* -- api client ---------------------------------------------------------- */
+
+function csrfToken() {
+  const m = document.cookie.match(/(?:^|;\s*)XSRF-TOKEN=([^;]*)/);
+  if (m) return m[1];
+  const token = Array.from(crypto.getRandomValues(new Uint8Array(16)), (b) =>
+    b.toString(16).padStart(2, "0")
+  ).join("");
+  document.cookie = `XSRF-TOKEN=${token}; Path=/; SameSite=Strict`;
+  return token;
+}
+
+export async function api(path, { method = "GET", body = null } = {}) {
+  const headers = { "Content-Type": "application/json" };
+  if (method !== "GET" && method !== "HEAD") {
+    headers["x-xsrf-token"] = csrfToken();
+  }
+  // dev convenience: a kfUser localStorage entry impersonates the
+  // trusted auth proxy's user header (APP_DEV_MODE backends accept it)
+  const devUser = localStorage.getItem("kfUser");
+  if (devUser) headers["kubeflow-userid"] = devUser;
+  const resp = await fetch(path, {
+    method,
+    headers,
+    body: body == null ? null : JSON.stringify(body),
+    credentials: "same-origin",
+  });
+  let data = {};
+  try {
+    data = await resp.json();
+  } catch {
+    /* non-JSON error body */
+  }
+  if (!resp.ok || data.success === false) {
+    throw new Error(data.log || `${method} ${path} failed (${resp.status})`);
+  }
+  return data;
+}
+
+/* -- DOM builder --------------------------------------------------------- */
+
+export function h(tag, attrs = {}, ...children) {
+  const el = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs || {})) {
+    if (k === "class") el.className = v;
+    else if (k === "dataset") Object.assign(el.dataset, v);
+    else if (k.startsWith("on") && typeof v === "function")
+      el.addEventListener(k.slice(2).toLowerCase(), v);
+    else if (v === true) el.setAttribute(k, "");
+    else if (v !== false && v != null) el.setAttribute(k, v);
+  }
+  for (const child of children.flat(Infinity)) {
+    if (child == null || child === false) continue;
+    el.append(child.nodeType ? child : document.createTextNode(String(child)));
+  }
+  return el;
+}
+
+export function clear(el) {
+  while (el.firstChild) el.removeChild(el.firstChild);
+  return el;
+}
+
+/* -- snackbar ------------------------------------------------------------ */
+
+let snackTimer = null;
+
+export function snackbar(message, type = "info") {
+  document.querySelectorAll(".kf-snackbar").forEach((el) => el.remove());
+  const el = h(
+    "div",
+    { class: `kf-snackbar${type === "error" ? " kf-error" : ""}` },
+    message
+  );
+  document.body.append(el);
+  clearTimeout(snackTimer);
+  snackTimer = setTimeout(() => el.remove(), type === "error" ? 8000 : 4000);
+}
+
+/* -- status icon --------------------------------------------------------- */
+
+export function statusIcon(status) {
+  const phase = (status && status.phase) || "waiting";
+  const message = (status && status.message) || phase;
+  return h(
+    "span",
+    { class: `kf-status kf-status-${phase}`, title: message },
+    h("span", { class: "kf-status-dot" }),
+    phase
+  );
+}
+
+/* -- resource table (resource-table equivalent) --------------------------- */
+
+export function resourceTable({ columns, rows, empty = "No resources" }) {
+  const thead = h(
+    "thead",
+    {},
+    h(
+      "tr",
+      {},
+      columns.map((c) => h("th", {}, c.title))
+    )
+  );
+  const tbody = h("tbody");
+  if (!rows.length) {
+    tbody.append(
+      h(
+        "tr",
+        { class: "kf-empty" },
+        h("td", { colspan: String(columns.length) }, empty)
+      )
+    );
+  }
+  for (const row of rows) {
+    tbody.append(
+      h(
+        "tr",
+        {},
+        columns.map((c) => {
+          const v = c.render ? c.render(row) : row[c.field];
+          return h("td", {}, v == null ? "" : v);
+        })
+      )
+    );
+  }
+  return h("table", { class: "kf-table" }, thead, tbody);
+}
+
+/* -- confirm dialog ------------------------------------------------------- */
+
+export function confirmDialog(title, message, confirmLabel = "Delete") {
+  return new Promise((resolve) => {
+    const close = (result) => {
+      backdrop.remove();
+      resolve(result);
+    };
+    const backdrop = h(
+      "div",
+      { class: "kf-dialog-backdrop", onClick: (e) => {
+          if (e.target === backdrop) close(false);
+        } },
+      h(
+        "div",
+        { class: "kf-dialog" },
+        h("h3", {}, title),
+        h("div", { class: "kf-muted" }, message),
+        h(
+          "div",
+          { class: "kf-dialog-actions" },
+          h(
+            "button",
+            { class: "kf-btn kf-btn-secondary", onClick: () => close(false) },
+            "Cancel"
+          ),
+          h(
+            "button",
+            { class: "kf-btn kf-btn-danger", onClick: () => close(true) },
+            confirmLabel
+          )
+        )
+      )
+    );
+    document.body.append(backdrop);
+  });
+}
+
+/* -- polling -------------------------------------------------------------- */
+
+export function poll(fn, intervalMs = 5000) {
+  let timer = null;
+  let stopped = false;
+  const tick = async () => {
+    if (stopped) return;
+    try {
+      await fn();
+    } catch {
+      /* next tick retries */
+    }
+    if (!stopped) timer = setTimeout(tick, intervalMs);
+  };
+  const onVisibility = () => {
+    if (document.hidden) clearTimeout(timer);
+    else if (!stopped) tick();
+  };
+  document.addEventListener("visibilitychange", onVisibility);
+  tick();
+  return () => {
+    stopped = true;
+    clearTimeout(timer);
+    document.removeEventListener("visibilitychange", onVisibility);
+  };
+}
+
+/* -- namespace plumbing ---------------------------------------------------- */
+
+export function currentNamespace() {
+  return new URLSearchParams(location.search).get("ns") || "";
+}
+
+export function namespaceSelector({ namespaces, value, onChange }) {
+  const select = h(
+    "select",
+    { class: "kf-select", onChange: (e) => onChange(e.target.value) },
+    namespaces.map((ns) =>
+      h("option", { value: ns, selected: ns === value }, ns)
+    )
+  );
+  return h("span", { class: "kf-ns-select" }, "Namespace:", select);
+}
+
+/* -- misc ------------------------------------------------------------------ */
+
+export function age(timestamp) {
+  if (!timestamp) return "";
+  const s = (Date.now() - Date.parse(timestamp)) / 1000;
+  if (!isFinite(s) || s < 0) return "";
+  if (s < 90) return `${Math.round(s)}s`;
+  if (s < 5400) return `${Math.round(s / 60)}m`;
+  if (s < 129600) return `${Math.round(s / 3600)}h`;
+  return `${Math.round(s / 86400)}d`;
+}

@@ -1,0 +1,313 @@
+/* Central dashboard shell (reference: centraldashboard/public/
+ * components/main-page.js + manage-users-view.js + the registration
+ * flow in api_workgroup.ts).
+ *
+ * Owns: the namespace selector (stamped into iframe src as ?ns=, the
+ * reference's convention), sidebar navigation, home view with the TPU
+ * metrics panels (/api/metrics), first-login registration
+ * (/api/workgroup/create), and contributor management
+ * (/api/workgroup/{add,remove}-contributor). */
+
+import {
+  api,
+  h,
+  clear,
+  snackbar,
+  namespaceSelector,
+  confirmDialog,
+  resourceTable,
+} from "./common/kubeflow-common.js";
+
+const root = document.getElementById("app");
+
+const APPS = {
+  notebooks: { title: "Notebooks", prefix: "/jupyter/" },
+  volumes: { title: "Volumes", prefix: "/volumes/" },
+  tensorboards: { title: "TensorBoards", prefix: "/tensorboards/" },
+};
+
+const state = {
+  user: "",
+  isClusterAdmin: false,
+  namespaces: [],
+  namespace: localStorage.getItem("kfNamespace") || "",
+  view: location.hash.replace("#", "") || "home",
+};
+
+window.addEventListener("hashchange", () => {
+  state.view = location.hash.replace("#", "") || "home";
+  render();
+});
+
+function setNamespace(ns) {
+  state.namespace = ns;
+  localStorage.setItem("kfNamespace", ns);
+  render();
+}
+
+/* -- views ----------------------------------------------------------------- */
+
+function sidebar() {
+  const link = (view, label) =>
+    h(
+      "a",
+      {
+        href: `#${view}`,
+        class: state.view === view ? "active" : "",
+        id: `nav-${view}`,
+      },
+      label
+    );
+  return h(
+    "div",
+    { class: "kd-sidebar" },
+    h(
+      "div",
+      { class: "kd-logo" },
+      "Kubeflow on TPU",
+      h("div", { class: "kf-muted" }, "odh-kubeflow-tpu")
+    ),
+    h(
+      "nav",
+      { class: "kd-nav" },
+      link("home", "Home"),
+      link("notebooks", "Notebooks"),
+      link("volumes", "Volumes"),
+      link("tensorboards", "TensorBoards"),
+      link("contributors", "Manage Contributors")
+    ),
+    h("div", { class: "kd-user" }, state.user || "anonymous")
+  );
+}
+
+function toolbar() {
+  return h(
+    "div",
+    { class: "kf-toolbar" },
+    h("h1", {}, (APPS[state.view] || { title: "Dashboard" }).title || "Dashboard"),
+    h("span", { class: "kf-spacer" }),
+    state.namespaces.length
+      ? namespaceSelector({
+          namespaces: state.namespaces,
+          value: state.namespace,
+          onChange: setNamespace,
+        })
+      : null
+  );
+}
+
+async function homeView() {
+  const view = h("div", { class: "kf-page kd-view" });
+  view.append(
+    h(
+      "div",
+      { class: "kf-card" },
+      h("h2", {}, `Welcome, ${state.user}`),
+      h(
+        "div",
+        { class: "kf-muted" },
+        state.namespace
+          ? `Active namespace: ${state.namespace}`
+          : "No namespace yet — register below."
+      )
+    )
+  );
+  try {
+    const m = await api("api/metrics");
+    const tpuRows = m.tpu || [];
+    view.append(
+      h(
+        "div",
+        { class: "kf-card" },
+        h("h2", {}, "TPU capacity"),
+        tpuRows.length
+          ? resourceTable({
+              columns: [
+                { title: "Accelerator", field: "accelerator" },
+                { title: "Chips used", field: "usedChips" },
+                { title: "Chips total", field: "capacityChips" },
+                {
+                  title: "Utilisation",
+                  render: (r) =>
+                    h(
+                      "div",
+                      { class: "kf-meter", style: "width:140px" },
+                      h("div", {
+                        style: `width:${
+                          r.capacityChips
+                            ? Math.round((100 * r.usedChips) / r.capacityChips)
+                            : 0
+                        }%`,
+                      })
+                    ),
+                },
+              ],
+              rows: tpuRows,
+              empty: "No TPU node pools in the cluster.",
+            })
+          : h("div", { class: "kf-muted" }, "No TPU node pools in the cluster."),
+        h(
+          "div",
+          { class: "kf-hint", style: "margin-top:8px" },
+          `${m.notebooks} notebook(s) platform-wide`
+        )
+      )
+    );
+  } catch (e) {
+    view.append(h("div", { class: "kf-card kf-muted" }, `Metrics unavailable: ${e.message}`));
+  }
+  return view;
+}
+
+function registrationView() {
+  const input = h("input", {
+    class: "kf-input",
+    id: "reg-namespace",
+    placeholder: "my-team",
+  });
+  return h(
+    "div",
+    { class: "kf-page kd-view" },
+    h(
+      "div",
+      { class: "kf-card" },
+      h("h2", {}, "Create your workspace"),
+      h(
+        "p",
+        { class: "kf-muted" },
+        `First login for ${state.user}: pick a namespace name. A Profile is created with you as owner — namespace, RBAC, TPU quota and service accounts come with it.`
+      ),
+      h("div", { class: "kf-field" }, input),
+      h(
+        "button",
+        {
+          class: "kf-btn",
+          id: "register",
+          onClick: async () => {
+            const namespace = input.value.trim();
+            if (!namespace) {
+              snackbar("Namespace name required", "error");
+              return;
+            }
+            try {
+              await api("api/workgroup/create", {
+                method: "POST",
+                body: { namespace },
+              });
+              snackbar(`Workspace ${namespace} created`);
+              await boot();
+            } catch (e) {
+              snackbar(e.message, "error");
+            }
+          },
+        },
+        "Create workspace"
+      )
+    )
+  );
+}
+
+async function contributorsView() {
+  const view = h("div", { class: "kf-page kd-view" });
+  const ns = state.namespace;
+  if (!ns) {
+    view.append(h("div", { class: "kf-card kf-muted" }, "Pick a namespace first."));
+    return view;
+  }
+  const input = h("input", {
+    class: "kf-input",
+    id: "contrib-email",
+    placeholder: "teammate@example.com",
+  });
+  view.append(
+    h(
+      "div",
+      { class: "kf-card" },
+      h("h2", {}, `Contributors to ${ns}`),
+      h(
+        "p",
+        { class: "kf-muted" },
+        "Contributors get kubeflow-edit in this namespace via kfam (RoleBinding + AuthorizationPolicy)."
+      ),
+      h(
+        "div",
+        { class: "kf-row" },
+        h("div", { class: "kf-field" }, input),
+        h(
+          "button",
+          {
+            class: "kf-btn",
+            id: "add-contributor",
+            onClick: async () => {
+              const contributor = input.value.trim();
+              if (!contributor) return;
+              try {
+                await api(`api/workgroup/add-contributor/${ns}`, {
+                  method: "POST",
+                  body: { contributor },
+                });
+                snackbar(`Added ${contributor}`);
+                render();
+              } catch (e) {
+                snackbar(e.message, "error");
+              }
+            },
+          },
+          "Add contributor"
+        )
+      )
+    )
+  );
+  return view;
+}
+
+function appView(appKey) {
+  const app = APPS[appKey];
+  return h("iframe", {
+    id: `iframe-${appKey}`,
+    src: `${app.prefix}?ns=${encodeURIComponent(state.namespace)}`,
+    title: app.title,
+  });
+}
+
+/* -- render ----------------------------------------------------------------- */
+
+let renderGen = 0;
+
+async function render() {
+  // a slow earlier render (homeView awaits /api/metrics) must not
+  // clobber a newer view the user navigated to meanwhile
+  const gen = ++renderGen;
+  const main = h("div", { class: "kd-main" });
+  if (!state.namespaces.length && state.view === "home") {
+    main.append(toolbar(), h("div", { class: "kd-content" }, registrationView()));
+  } else if (APPS[state.view]) {
+    main.append(toolbar(), h("div", { class: "kd-content" }, appView(state.view)));
+  } else if (state.view === "contributors") {
+    main.append(
+      toolbar(),
+      h("div", { class: "kd-content" }, await contributorsView())
+    );
+  } else {
+    main.append(toolbar(), h("div", { class: "kd-content" }, await homeView()));
+  }
+  if (gen !== renderGen) return;
+  clear(root).append(h("div", { class: "kd-shell" }, sidebar(), main));
+}
+
+async function boot() {
+  try {
+    const info = await api("api/workgroup/env-info");
+    state.user = info.user;
+    state.isClusterAdmin = info.isClusterAdmin;
+    state.namespaces = (info.namespaces || []).map((n) => n.namespace);
+    if (!state.namespace || !state.namespaces.includes(state.namespace)) {
+      state.namespace = state.namespaces[0] || "";
+    }
+  } catch (e) {
+    snackbar(`Cannot reach the dashboard API: ${e.message}`, "error");
+  }
+  await render();
+}
+
+boot();

@@ -1,0 +1,450 @@
+/* JWA frontend: notebook index + TPU-first spawner form.
+ *
+ * Reference parity: jupyter/frontend/src/app/pages/index (resource
+ * table with status/stop/delete) and pages/form/form-default (name,
+ * image pickers, cpu/mem, the `form-gpus` vendor picker — here a TPU
+ * accelerator/topology picker driven by GET api/config ∩ api/tpus,
+ * the reference's /api/gpus pattern), configurations (PodDefaults),
+ * shm. POSTs the same body web/jwa.py's create_notebook consumes.
+ */
+
+import {
+  api,
+  h,
+  clear,
+  snackbar,
+  statusIcon,
+  resourceTable,
+  confirmDialog,
+  poll,
+  currentNamespace,
+  age,
+} from "./common/kubeflow-common.js";
+
+const root = document.getElementById("app");
+const ns = currentNamespace() || "kubeflow-user";
+
+let config = {};
+let availableTpus = [];
+let stopPolling = null;
+
+/* -- index view ----------------------------------------------------------- */
+
+async function loadNotebooks() {
+  const data = await api(`api/namespaces/${ns}/notebooks`);
+  return data.notebooks || [];
+}
+
+function connectHref(row) {
+  // the platform routes /notebook/<ns>/<name>/ through the exposure
+  // layer (HTTPRoute/VirtualService) at the cluster origin
+  return `/notebook/${row.namespace}/${row.name}/`;
+}
+
+function renderIndex(notebooks) {
+  clear(root).append(
+    h(
+      "div",
+      { class: "kf-toolbar" },
+      h("h1", {}, "Notebooks"),
+      h("span", { class: "kf-muted" }, `namespace: ${ns}`),
+      h("span", { class: "kf-spacer" }),
+      h(
+        "button",
+        { class: "kf-btn", id: "new-notebook", onClick: () => showForm() },
+        "+ New Notebook"
+      )
+    ),
+    h(
+      "div",
+      { class: "kf-page" },
+      h(
+        "div",
+        { class: "kf-card" },
+        resourceTable({
+          empty: "No notebooks in this namespace. Create one to get started.",
+          columns: [
+            { title: "Status", render: (r) => statusIcon(r.status) },
+            {
+              title: "Name",
+              render: (r) =>
+                r.status.phase === "ready"
+                  ? h("a", { href: connectHref(r), target: "_blank" }, r.name)
+                  : r.name,
+            },
+            { title: "Image", render: (r) => h("code", {}, r.shortImage) },
+            {
+              title: "TPU",
+              render: (r) =>
+                r.tpus
+                  ? h(
+                      "span",
+                      { class: "kf-chip", title: r.tpus.accelerator },
+                      `${r.tpus.accelerator.replace(/^tpu-/, "")} ${r.tpus.topology} (${r.tpus.chips} chips)`
+                    )
+                  : "—",
+            },
+            { title: "CPU", field: "cpu" },
+            { title: "Memory", field: "memory" },
+            { title: "Age", render: (r) => age(r.age) },
+            {
+              title: "",
+              render: (r) =>
+                h(
+                  "span",
+                  {},
+                  h(
+                    "button",
+                    {
+                      class: "kf-icon-btn",
+                      dataset: { action: "toggle", name: r.name },
+                      title: r.status.phase === "stopped" ? "Start" : "Stop",
+                      onClick: () => toggleNotebook(r),
+                    },
+                    r.status.phase === "stopped" ? "▶ start" : "■ stop"
+                  ),
+                  h(
+                    "button",
+                    {
+                      class: "kf-icon-btn kf-danger",
+                      dataset: { action: "delete", name: r.name },
+                      title: "Delete",
+                      onClick: () => deleteNotebook(r),
+                    },
+                    "✕ delete"
+                  )
+                ),
+            },
+          ],
+          rows: notebooks,
+        })
+      )
+    )
+  );
+}
+
+async function showIndex() {
+  if (stopPolling) stopPolling();
+  try {
+    renderIndex(await loadNotebooks());
+  } catch (e) {
+    renderIndex([]);
+    snackbar(e.message, "error");
+    return;
+  }
+  stopPolling = poll(async () => renderIndex(await loadNotebooks()), 5000);
+}
+
+async function toggleNotebook(row) {
+  const stopping = row.status.phase !== "stopped";
+  try {
+    await api(`api/namespaces/${ns}/notebooks/${row.name}`, {
+      method: "PATCH",
+      body: { stopped: stopping },
+    });
+    snackbar(`${stopping ? "Stopping" : "Starting"} ${row.name}…`);
+    renderIndex(await loadNotebooks());
+  } catch (e) {
+    snackbar(e.message, "error");
+  }
+}
+
+async function deleteNotebook(row) {
+  const ok = await confirmDialog(
+    `Delete notebook ${row.name}?`,
+    "The notebook server and its compute are removed. Workspace volumes survive and show up in the Volumes app."
+  );
+  if (!ok) return;
+  try {
+    await api(`api/namespaces/${ns}/notebooks/${row.name}`, {
+      method: "DELETE",
+    });
+    snackbar(`Deleting ${row.name}…`);
+    renderIndex(await loadNotebooks());
+  } catch (e) {
+    snackbar(e.message, "error");
+  }
+}
+
+/* -- spawner form ---------------------------------------------------------- */
+
+const IMAGE_GROUPS = [
+  { key: "image", label: "JupyterLab" },
+  { key: "imageGroupOne", label: "code-server (VS Code)" },
+  { key: "imageGroupTwo", label: "RStudio" },
+];
+
+function tpuSection(form) {
+  const accelerators = (config.tpus && config.tpus.accelerators) || [];
+  const availableTypes = new Set(availableTpus.map((t) => t.type));
+
+  const topoSelect = h("select", {
+    class: "kf-select",
+    id: "tpu-topology",
+    disabled: true,
+  });
+
+  const accelSelect = h(
+    "select",
+    {
+      class: "kf-select",
+      id: "tpu-accelerator",
+      onChange: () => {
+        const chosen = accelerators.find((a) => a.type === accelSelect.value);
+        clear(topoSelect);
+        if (!chosen) {
+          topoSelect.disabled = true;
+          return;
+        }
+        topoSelect.disabled = false;
+        // live capacity (api/tpus = config ∩ node pools) trumps the
+        // static config list — picking a topology the cluster doesn't
+        // have would spawn an unschedulable slice
+        const live = availableTpus.find((t) => t.type === chosen.type);
+        const topologies =
+          live && live.topologies.length ? live.topologies : chosen.topologies;
+        for (const t of topologies) {
+          topoSelect.append(h("option", { value: t }, t));
+        }
+      },
+    },
+    h("option", { value: "none" }, "None (CPU only)"),
+    accelerators.map((a) =>
+      h(
+        "option",
+        { value: a.type },
+        `${a.displayName}${availableTypes.has(a.type) ? "" : " — no capacity in cluster"}`
+      )
+    )
+  );
+
+  form.tpuAccelerator = accelSelect;
+  form.tpuTopology = topoSelect;
+
+  return h(
+    "div",
+    { class: "kf-row" },
+    h(
+      "div",
+      { class: "kf-field" },
+      h("label", { for: "tpu-accelerator" }, "TPU accelerator"),
+      accelSelect,
+      h(
+        "div",
+        { class: "kf-hint" },
+        "A slice is scheduled whole; multi-host topologies get the JAX distributed env injected automatically."
+      )
+    ),
+    h(
+      "div",
+      { class: "kf-field" },
+      h("label", { for: "tpu-topology" }, "Topology"),
+      topoSelect
+    )
+  );
+}
+
+async function showForm() {
+  if (stopPolling) stopPolling();
+  let poddefaults = [];
+  try {
+    poddefaults = (await api(`api/namespaces/${ns}/poddefaults`)).poddefaults || [];
+  } catch {
+    /* optional */
+  }
+
+  const form = {};
+
+  const imageSelects = IMAGE_GROUPS.map(({ key, label }) => {
+    const cfg = config[key] || { value: "", options: [] };
+    const select = h(
+      "select",
+      { class: "kf-select", id: `image-${key}` },
+      (cfg.options || []).map((o) =>
+        h("option", { value: o, selected: o === cfg.value }, o)
+      )
+    );
+    const radio = h("input", {
+      type: "radio",
+      name: "server-type",
+      id: `type-${key}`,
+      value: key,
+      checked: key === "image",
+    });
+    form[key] = { select, radio };
+    return h(
+      "div",
+      { class: "kf-field" },
+      h(
+        "span",
+        { class: "kf-checkbox" },
+        radio,
+        h("label", { for: `type-${key}` }, label)
+      ),
+      select
+    );
+  });
+
+  const nameInput = h("input", {
+    class: "kf-input",
+    id: "nb-name",
+    placeholder: "my-notebook",
+    autocomplete: "off",
+  });
+  const cpuInput = h("input", {
+    class: "kf-input",
+    id: "nb-cpu",
+    value: (config.cpu && config.cpu.value) || "0.5",
+  });
+  const memInput = h("input", {
+    class: "kf-input",
+    id: "nb-memory",
+    value: (config.memory && config.memory.value) || "1Gi",
+  });
+  const shmBox = h("input", {
+    type: "checkbox",
+    id: "nb-shm",
+    checked: !(config.shm && config.shm.value === false),
+  });
+
+  const pdBoxes = poddefaults.map((pd) =>
+    h(
+      "div",
+      { class: "kf-checkbox" },
+      h("input", { type: "checkbox", dataset: { pd: pd.label }, id: `pd-${pd.label}` }),
+      h("label", { for: `pd-${pd.label}` }, `${pd.label} — ${pd.desc}`)
+    )
+  );
+
+  const workspace =
+    (config.workspaceVolume && config.workspaceVolume.value) || null;
+
+  clear(root).append(
+    h(
+      "div",
+      { class: "kf-toolbar" },
+      h(
+        "button",
+        { class: "kf-btn kf-btn-secondary", onClick: () => showIndex() },
+        "← Back"
+      ),
+      h("h1", {}, "New Notebook"),
+      h("span", { class: "kf-muted" }, `namespace: ${ns}`)
+    ),
+    h(
+      "div",
+      { class: "kf-page" },
+      h(
+        "div",
+        { class: "kf-card" },
+        h("h2", {}, "Name"),
+        h("div", { class: "kf-field" }, nameInput)
+      ),
+      h("div", { class: "kf-card" }, h("h2", {}, "Server type & image"), imageSelects),
+      h(
+        "div",
+        { class: "kf-card" },
+        h("h2", {}, "Resources"),
+        h(
+          "div",
+          { class: "kf-row" },
+          h("div", { class: "kf-field" }, h("label", { for: "nb-cpu" }, "CPU"), cpuInput),
+          h(
+            "div",
+            { class: "kf-field" },
+            h("label", { for: "nb-memory" }, "Memory"),
+            memInput
+          )
+        ),
+        tpuSection(form)
+      ),
+      h(
+        "div",
+        { class: "kf-card" },
+        h("h2", {}, "Workspace volume"),
+        workspace
+          ? h(
+              "div",
+              { class: "kf-muted" },
+              `A PVC ${((workspace.newPvc || {}).metadata || {}).name || "{notebook-name}-workspace"} (${(((workspace.newPvc || {}).spec || {}).resources || {requests:{}}).requests.storage || ""}) is created and mounted at ${workspace.mount}.`
+            )
+          : h("div", { class: "kf-muted" }, "No workspace volume configured.")
+      ),
+      h(
+        "div",
+        { class: "kf-card" },
+        h("h2", {}, "Configurations"),
+        pdBoxes.length
+          ? pdBoxes
+          : h("div", { class: "kf-muted" }, "No PodDefaults in this namespace."),
+        h(
+          "div",
+          { class: "kf-checkbox", style: "margin-top:10px" },
+          shmBox,
+          h("label", { for: "nb-shm" }, "Mount a shared memory volume (/dev/shm)")
+        )
+      ),
+      h(
+        "button",
+        {
+          class: "kf-btn",
+          id: "launch",
+          onClick: async () => {
+            const name = nameInput.value.trim();
+            if (!name) {
+              snackbar("Name is required", "error");
+              return;
+            }
+            const chosenGroup = IMAGE_GROUPS.find(
+              ({ key }) => form[key].radio.checked
+            );
+            const body = {
+              name,
+              image: form[chosenGroup.key].select.value,
+              cpu: cpuInput.value.trim(),
+              memory: memInput.value.trim(),
+              shm: shmBox.checked,
+              configurations: pdBoxes
+                .map((el) => el.querySelector("input"))
+                .filter((i) => i.checked)
+                .map((i) => i.dataset.pd),
+              tpus: {
+                accelerator: form.tpuAccelerator.value,
+                topology: form.tpuTopology.disabled
+                  ? ""
+                  : form.tpuTopology.value,
+              },
+            };
+            try {
+              await api(`api/namespaces/${ns}/notebooks`, {
+                method: "POST",
+                body,
+              });
+              snackbar(`Creating ${name}…`);
+              showIndex();
+            } catch (e) {
+              snackbar(e.message, "error");
+            }
+          },
+        },
+        "Launch"
+      )
+    )
+  );
+}
+
+/* -- boot ------------------------------------------------------------------ */
+
+(async function boot() {
+  try {
+    config = (await api("api/config")).config || {};
+  } catch (e) {
+    snackbar(`Failed to load spawner config: ${e.message}`, "error");
+  }
+  try {
+    availableTpus = (await api("api/tpus")).tpus || [];
+  } catch {
+    availableTpus = [];
+  }
+  await showIndex();
+})();

@@ -1,0 +1,188 @@
+/* TWA frontend: TensorBoard index + create form (reference:
+ * tensorboards/frontend). logspath accepts pvc:// and gs:// — the
+ * gs:// branch is the XLA/TPU profile-trace serving path the
+ * tensorboard controller treats as primary. */
+
+import {
+  api,
+  h,
+  clear,
+  snackbar,
+  statusIcon,
+  resourceTable,
+  confirmDialog,
+  poll,
+  currentNamespace,
+  age,
+} from "./common/kubeflow-common.js";
+
+const root = document.getElementById("app");
+const ns = currentNamespace() || "kubeflow-user";
+let stopPolling = null;
+
+async function loadTbs() {
+  return (await api(`api/namespaces/${ns}/tensorboards`)).tensorboards || [];
+}
+
+function connectHref(row) {
+  return `/tensorboard/${row.namespace}/${row.name}/`;
+}
+
+function render(tbs) {
+  clear(root).append(
+    h(
+      "div",
+      { class: "kf-toolbar" },
+      h("h1", {}, "TensorBoards"),
+      h("span", { class: "kf-muted" }, `namespace: ${ns}`),
+      h("span", { class: "kf-spacer" }),
+      h(
+        "button",
+        { class: "kf-btn", id: "new-tensorboard", onClick: showForm },
+        "+ New TensorBoard"
+      )
+    ),
+    h(
+      "div",
+      { class: "kf-page" },
+      h(
+        "div",
+        { class: "kf-card" },
+        resourceTable({
+          empty: "No TensorBoards in this namespace.",
+          columns: [
+            { title: "Status", render: (r) => statusIcon(r.status) },
+            {
+              title: "Name",
+              render: (r) =>
+                r.status.phase === "ready"
+                  ? h("a", { href: connectHref(r), target: "_blank" }, r.name)
+                  : r.name,
+            },
+            { title: "Logs path", render: (r) => h("code", {}, r.logspath) },
+            { title: "Age", render: (r) => age(r.age) },
+            {
+              title: "",
+              render: (r) =>
+                h(
+                  "button",
+                  {
+                    class: "kf-icon-btn kf-danger",
+                    dataset: { action: "delete", name: r.name },
+                    onClick: () => deleteTb(r),
+                  },
+                  "✕ delete"
+                ),
+            },
+          ],
+          rows: tbs,
+        })
+      )
+    )
+  );
+}
+
+async function showIndex() {
+  if (stopPolling) stopPolling();
+  try {
+    render(await loadTbs());
+  } catch (e) {
+    render([]);
+    snackbar(e.message, "error");
+    return;
+  }
+  stopPolling = poll(async () => render(await loadTbs()), 8000);
+}
+
+async function deleteTb(row) {
+  const ok = await confirmDialog(
+    `Delete TensorBoard ${row.name}?`,
+    "The serving Deployment is removed; the logs stay where they are."
+  );
+  if (!ok) return;
+  try {
+    await api(`api/namespaces/${ns}/tensorboards/${row.name}`, {
+      method: "DELETE",
+    });
+    snackbar(`Deleting ${row.name}…`);
+    render(await loadTbs());
+  } catch (e) {
+    snackbar(e.message, "error");
+  }
+}
+
+function showForm() {
+  if (stopPolling) stopPolling();
+  const nameInput = h("input", {
+    class: "kf-input",
+    id: "tb-name",
+    placeholder: "my-tensorboard",
+  });
+  const pathInput = h("input", {
+    class: "kf-input",
+    id: "tb-logspath",
+    placeholder: "gs://bucket/xla-traces  or  pvc://my-volume/logs",
+  });
+
+  clear(root).append(
+    h(
+      "div",
+      { class: "kf-toolbar" },
+      h(
+        "button",
+        { class: "kf-btn kf-btn-secondary", onClick: showIndex },
+        "← Back"
+      ),
+      h("h1", {}, "New TensorBoard"),
+      h("span", { class: "kf-muted" }, `namespace: ${ns}`)
+    ),
+    h(
+      "div",
+      { class: "kf-page" },
+      h(
+        "div",
+        { class: "kf-card" },
+        h("div", { class: "kf-field" }, h("label", { for: "tb-name" }, "Name"), nameInput),
+        h(
+          "div",
+          { class: "kf-field" },
+          h("label", { for: "tb-logspath" }, "Logs path"),
+          pathInput,
+          h(
+            "div",
+            { class: "kf-hint" },
+            "gs:// serves XLA/TPU profiler traces straight from GCS; pvc:// mounts a volume from this namespace."
+          )
+        ),
+        h(
+          "button",
+          {
+            class: "kf-btn",
+            id: "create-tensorboard",
+            onClick: async () => {
+              const name = nameInput.value.trim();
+              const logspath = pathInput.value.trim();
+              if (!name || !logspath) {
+                snackbar("Name and logs path are required", "error");
+                return;
+              }
+              try {
+                await api(`api/namespaces/${ns}/tensorboards`, {
+                  method: "POST",
+                  body: { name, logspath },
+                });
+                snackbar(`Created ${name}`);
+                showIndex();
+              } catch (e) {
+                snackbar(e.message, "error");
+              }
+            },
+          },
+          "Create"
+        )
+      )
+    )
+  );
+}
+
+showIndex();

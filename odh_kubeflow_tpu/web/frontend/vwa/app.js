@@ -1,0 +1,207 @@
+/* VWA frontend: PVC index + create form (reference:
+ * volumes/frontend — list with status/used-by, new-volume dialog,
+ * delete). Drives web/vwa.py's routes. */
+
+import {
+  api,
+  h,
+  clear,
+  snackbar,
+  statusIcon,
+  resourceTable,
+  confirmDialog,
+  poll,
+  currentNamespace,
+  age,
+} from "./common/kubeflow-common.js";
+
+const root = document.getElementById("app");
+const ns = currentNamespace() || "kubeflow-user";
+let stopPolling = null;
+
+async function loadPvcs() {
+  return (await api(`api/namespaces/${ns}/pvcs`)).pvcs || [];
+}
+
+function render(pvcs) {
+  clear(root).append(
+    h(
+      "div",
+      { class: "kf-toolbar" },
+      h("h1", {}, "Volumes"),
+      h("span", { class: "kf-muted" }, `namespace: ${ns}`),
+      h("span", { class: "kf-spacer" }),
+      h(
+        "button",
+        { class: "kf-btn", id: "new-volume", onClick: showForm },
+        "+ New Volume"
+      )
+    ),
+    h(
+      "div",
+      { class: "kf-page" },
+      h(
+        "div",
+        { class: "kf-card" },
+        resourceTable({
+          empty: "No volumes in this namespace.",
+          columns: [
+            {
+              title: "Status",
+              render: (r) =>
+                statusIcon({
+                  phase: r.status === "Bound" ? "ready" : "waiting",
+                  message: r.status,
+                }),
+            },
+            { title: "Name", field: "name" },
+            { title: "Size", field: "capacity" },
+            { title: "Access modes", render: (r) => (r.modes || []).join(", ") },
+            { title: "Storage class", field: "class" },
+            {
+              title: "Used by",
+              render: (r) =>
+                (r.usedBy || []).length
+                  ? r.usedBy.map((p) => h("span", { class: "kf-chip" }, p))
+                  : "—",
+            },
+            { title: "Age", render: (r) => age(r.age) },
+            {
+              title: "",
+              render: (r) =>
+                h(
+                  "button",
+                  {
+                    class: "kf-icon-btn kf-danger",
+                    dataset: { action: "delete", name: r.name },
+                    title: (r.usedBy || []).length
+                      ? "In use by a pod"
+                      : "Delete",
+                    disabled: (r.usedBy || []).length > 0,
+                    onClick: () => deletePvc(r),
+                  },
+                  "✕ delete"
+                ),
+            },
+          ],
+          rows: pvcs,
+        })
+      )
+    )
+  );
+}
+
+async function showIndex() {
+  if (stopPolling) stopPolling();
+  try {
+    render(await loadPvcs());
+  } catch (e) {
+    render([]);
+    snackbar(e.message, "error");
+    return;
+  }
+  stopPolling = poll(async () => render(await loadPvcs()), 8000);
+}
+
+async function deletePvc(row) {
+  const ok = await confirmDialog(
+    `Delete volume ${row.name}?`,
+    "The PVC and its data are permanently removed."
+  );
+  if (!ok) return;
+  try {
+    await api(`api/namespaces/${ns}/pvcs/${row.name}`, { method: "DELETE" });
+    snackbar(`Deleting ${row.name}…`);
+    render(await loadPvcs());
+  } catch (e) {
+    snackbar(e.message, "error");
+  }
+}
+
+function showForm() {
+  if (stopPolling) stopPolling();
+  const nameInput = h("input", {
+    class: "kf-input",
+    id: "pvc-name",
+    placeholder: "my-volume",
+  });
+  const sizeInput = h("input", { class: "kf-input", id: "pvc-size", value: "10Gi" });
+  const modeSelect = h(
+    "select",
+    { class: "kf-select", id: "pvc-mode" },
+    h("option", { value: "ReadWriteOnce" }, "ReadWriteOnce"),
+    h("option", { value: "ReadWriteMany" }, "ReadWriteMany"),
+    h("option", { value: "ReadOnlyMany" }, "ReadOnlyMany")
+  );
+
+  clear(root).append(
+    h(
+      "div",
+      { class: "kf-toolbar" },
+      h(
+        "button",
+        { class: "kf-btn kf-btn-secondary", onClick: showIndex },
+        "← Back"
+      ),
+      h("h1", {}, "New Volume"),
+      h("span", { class: "kf-muted" }, `namespace: ${ns}`)
+    ),
+    h(
+      "div",
+      { class: "kf-page" },
+      h(
+        "div",
+        { class: "kf-card" },
+        h("div", { class: "kf-field" }, h("label", { for: "pvc-name" }, "Name"), nameInput),
+        h(
+          "div",
+          { class: "kf-row" },
+          h("div", { class: "kf-field" }, h("label", { for: "pvc-size" }, "Size"), sizeInput),
+          h(
+            "div",
+            { class: "kf-field" },
+            h("label", { for: "pvc-mode" }, "Access mode"),
+            modeSelect
+          )
+        ),
+        h(
+          "button",
+          {
+            class: "kf-btn",
+            id: "create-volume",
+            onClick: async () => {
+              const name = nameInput.value.trim();
+              if (!name) {
+                snackbar("Name is required", "error");
+                return;
+              }
+              try {
+                await api(`api/namespaces/${ns}/pvcs`, {
+                  method: "POST",
+                  body: {
+                    pvc: {
+                      metadata: { name },
+                      spec: {
+                        accessModes: [modeSelect.value],
+                        resources: {
+                          requests: { storage: sizeInput.value.trim() },
+                        },
+                      },
+                    },
+                  },
+                });
+                snackbar(`Created ${name}`);
+                showIndex();
+              } catch (e) {
+                snackbar(e.message, "error");
+              }
+            },
+          },
+          "Create"
+        )
+      )
+    )
+  );
+}
+
+showIndex();

@@ -133,9 +133,18 @@ class Blueprint:
 class App:
     """WSGI application with Flask-style routing."""
 
-    def __init__(self, name: str = "app", static_dir: Optional[str] = None):
+    def __init__(
+        self,
+        name: str = "app",
+        static_dir: Optional[str] = None,
+        static_mounts: Optional[list[tuple[str, str]]] = None,
+    ):
         self.name = name
         self.static_dir = static_dir
+        # extra (url_prefix, directory) static mounts — the shared
+        # frontend lib rides at /common in every app so split-process
+        # deployments are self-contained
+        self.static_mounts = list(static_mounts or [])
         self._routes: list[tuple[str, re.Pattern, list[str], Callable]] = []
         self._before: list[Callable[[Request], Optional[Response]]] = []
         self._errors: dict[type, Callable] = {}
@@ -198,22 +207,35 @@ class App:
             return out if isinstance(out, Response) else Response(out)
         if allowed:
             return Response({"success": False, "log": "method not allowed"}, 405)
-        if self.static_dir and request.method == "GET":
+        if request.method == "GET" and (self.static_dir or self.static_mounts):
             return self._serve_static(request.path)
         return Response({"success": False, "log": "not found"}, 404)
 
     def _serve_static(self, path: str) -> Response:
+        for prefix, directory in self.static_mounts:
+            prefix = prefix.rstrip("/")
+            if path == prefix or path.startswith(prefix + "/"):
+                return self._serve_file(
+                    directory, path[len(prefix):], spa_fallback=False
+                )
+        if not self.static_dir:
+            return Response({"success": False, "log": "not found"}, 404)
+        return self._serve_file(self.static_dir, path, spa_fallback=True)
+
+    def _serve_file(
+        self, directory: str, path: str, spa_fallback: bool
+    ) -> Response:
         rel = path.lstrip("/") or "index.html"
-        full = os.path.realpath(os.path.join(self.static_dir, rel))
-        root = os.path.realpath(self.static_dir)
+        full = os.path.realpath(os.path.join(directory, rel))
+        root = os.path.realpath(directory)
         if not full.startswith(root + os.sep) and full != root:
             return Response({"success": False, "log": "not found"}, 404)
         if os.path.isdir(full):
             full = os.path.join(full, "index.html")
         if not os.path.isfile(full):
-            # SPA fallback (the Angular apps route client-side)
+            # SPA fallback (client-side routing)
             index = os.path.join(root, "index.html")
-            if os.path.isfile(index):
+            if spa_fallback and os.path.isfile(index):
                 full = index
             else:
                 return Response({"success": False, "log": "not found"}, 404)
