@@ -57,11 +57,18 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
-    # "dense" (XLA einsum), "flash" (pallas kernel), "ring"
-    # (context-parallel ring attention over the `context` mesh axis).
-    attention_impl: str = "dense"
+    # "auto" (flash on a TPU backend, dense elsewhere), "dense" (XLA
+    # einsum), "flash" (pallas kernel), "ring" (context-parallel ring
+    # attention over the `context` mesh axis).
+    attention_impl: str = "auto"
     # rematerialise each decoder layer in the backward pass
     remat: bool = True
+    # "dots": save weight-matmul outputs (fast backward, ~25k floats
+    # per token per layer of residency — fine to ~4k context);
+    # "none": save only layer boundaries and recompute everything
+    # (the long-context setting: at 16k context "dots" residency alone
+    # is ~13GB on the 1B model).
+    remat_policy: str = "dots"
 
     @staticmethod
     def llama3_8b(**kw) -> "LlamaConfig":
@@ -288,7 +295,22 @@ def _decoder_layer(
     return x, cache_layer
 
 
+def resolved_attention_impl(cfg: LlamaConfig) -> str:
+    """'auto' → the pallas flash kernel on a TPU backend (the regime it
+    was written for), dense XLA einsum everywhere else (CPU tests would
+    only ever run flash in slow interpret mode)."""
+    if cfg.attention_impl != "auto":
+        return cfg.attention_impl
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — no backend yet
+        backend = "cpu"
+    return "flash" if backend == "tpu" else "dense"
+
+
 def _select_attention(cfg: LlamaConfig) -> Callable:
+    impl = resolved_attention_impl(cfg)
+    cfg = dataclasses.replace(cfg, attention_impl=impl)
     if cfg.attention_impl == "dense":
         return partial(dense_attention, causal=True)
     if cfg.attention_impl == "flash":
@@ -322,8 +344,14 @@ def forward(
     lora: Optional[Params] = None,
     positions: Optional[jnp.ndarray] = None,
     segment_ids: Optional[jnp.ndarray] = None,
+    return_hidden: bool = False,
 ) -> jnp.ndarray:
-    """Returns logits [B, S, V] in float32."""
+    """Returns logits [B, S, V] in float32 — or, with
+    ``return_hidden=True``, the final-norm hidden states [B, S, D] so
+    the caller can run the LM head chunk-wise (long-context training:
+    a full [S, V] logits tensor at S=16k and V=128k is 8GB+ and is the
+    thing that OOMs, not attention — see
+    ``train.trainer.chunked_cross_entropy``)."""
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -334,10 +362,13 @@ def forward(
 
     layer_fn = partial(_decoder_layer, cfg, attention_fn)
     if cfg.remat:
-        layer_fn = jax.checkpoint(
-            layer_fn,
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-        )
+        if cfg.remat_policy == "dots":
+            layer_fn = jax.checkpoint(
+                layer_fn,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        else:  # "none": full recompute, minimum residency
+            layer_fn = jax.checkpoint(layer_fn)
 
     lora_layers = lora["layers"] if lora is not None else None
 
@@ -349,11 +380,18 @@ def forward(
     x, _ = jax.lax.scan(body, x, (params["layers"], lora_layers))
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if return_hidden:
+        return x
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum(
         "bsd,dv->bsv", x, head.astype(cfg.dtype), preferred_element_type=jnp.float32
     )
     return logits
+
+
+def lm_head_weight(params: Params, cfg: LlamaConfig) -> jnp.ndarray:
+    """[D, V] head matrix (shared with the embedding when tied)."""
+    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
 
 
 def forward_with_cache(
