@@ -2,8 +2,10 @@
 # fans docker-build over every component; here the components share one
 # python package, so the fan-out is test tiers + image builds).
 
+# NOTE: no PYTHONPATH export — on TPU hosts it can break accelerator
+# plugin registration. Targets run from the repo root and use `-m`, so
+# the cwd lands on sys.path instead.
 PYTHON ?= python
-export PYTHONPATH := $(CURDIR)$(if $(PYTHONPATH),:$(PYTHONPATH))
 
 .PHONY: all test test-unit test-manifests lint loadtest images bench dryrun
 
@@ -23,7 +25,10 @@ lint:
 # platform load test against the embedded apiserver + sim kubelet
 # (loadtest/start_notebooks.py; reference notebook-controller/loadtest)
 loadtest:
-	$(PYTHON) loadtest/start_notebooks.py --count 20 --tpu
+	$(PYTHON) -m loadtest.start_notebooks --count 20 --tpu
+
+spawn-latency:
+	$(PYTHON) -m loadtest.spawn_latency --record
 
 images:
 	$(MAKE) -C images build

@@ -1,0 +1,183 @@
+"""North-star latency: notebook spawn → first JAX train step.
+
+BASELINE.md's headline latency metric. Two measured segments:
+
+1. **spawn→ready** — POST a TPU Notebook through the JWA REST API (the
+   exact request the spawner UI sends) against the all-in-one platform
+   and poll the same list endpoint the UI polls until the row reports
+   ready. The kubelet is the simulator, so this segment measures the
+   *platform* (admission → reconcile → schedule → status-mirror →
+   BFF row shaping) and excludes image pull + container boot, which
+   depend on cluster/network, not on this codebase.
+2. **ready→first-step** — on the attached real TPU chip, do what the
+   user's first cell does: import the runtime, build the Llama-1B LoRA
+   trainer, and run one train step to a fetched loss. Cold-compile
+   time is the dominant term and is measured for real.
+
+Prints one JSON line; ``--record`` rewrites the table row in
+BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def measure_spawn_to_ready() -> dict:
+    from odh_kubeflow_tpu.platform import Platform
+
+    platform = Platform(sim=True)
+    platform.cluster.add_node("cpu-0")
+    platform.cluster.add_tpu_node_pool(
+        "v5e", "tpu-v5-lite-podslice", "2x2", num_hosts=1, chips_per_host=4
+    )
+    platform.api.create(
+        {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "Profile",
+            "metadata": {"name": "bench-team"},
+            "spec": {"owner": {"kind": "User", "name": "bench@example.com"}},
+        }
+    )
+    _, web_port = platform.start(api_port=0, web_port=0)
+    base = f"http://127.0.0.1:{web_port}"
+
+    def call(path, method="GET", body=None):
+        headers = {
+            "kubeflow-userid": "bench@example.com",
+            "Content-Type": "application/json",
+        }
+        if method != "GET":
+            headers["Cookie"] = "XSRF-TOKEN=t"
+            headers["x-xsrf-token"] = "t"
+        req = urllib.request.Request(
+            base + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+            headers=headers,
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read().decode())
+
+    t0 = time.monotonic()
+    call(
+        "/jupyter/api/namespaces/bench-team/notebooks",
+        method="POST",
+        body={
+            "name": "latency-nb",
+            "image": "odh-kubeflow-tpu/jupyter-jax-tpu:v0.1.0",
+            "cpu": "4",
+            "memory": "8Gi",
+            "shm": True,
+            "configurations": [],
+            "tpus": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x2"},
+        },
+    )
+    ready_s = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        rows = call("/jupyter/api/namespaces/bench-team/notebooks")["notebooks"]
+        row = next(r for r in rows if r["name"] == "latency-nb")
+        if row["status"]["phase"] == "ready":
+            ready_s = time.monotonic() - t0
+            break
+        time.sleep(0.05)
+    platform.stop()
+    if ready_s is None:
+        raise RuntimeError("notebook never became ready")
+    return {"spawn_to_ready_s": round(ready_s, 3), "kubelet": "simulated"}
+
+
+def measure_first_jax_step() -> dict:
+    """The user's first cell, timed from a cold process state: build
+    the sharded trainer and fetch the first loss."""
+    t_import = time.monotonic()
+    import jax
+    import jax.numpy as jnp
+
+    from odh_kubeflow_tpu.models import LlamaConfig, LoraConfig
+    from odh_kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from odh_kubeflow_tpu.train import TrainConfig, Trainer
+
+    devices = jax.devices()
+    import_s = time.monotonic() - t_import
+
+    t_build = time.monotonic()
+    trainer = Trainer(
+        LlamaConfig.llama3_1b(dtype=jnp.bfloat16),
+        TrainConfig(warmup_steps=2, total_steps=100),
+        lora_cfg=LoraConfig(rank=16),
+        mesh=build_mesh(MeshConfig(fsdp=len(devices)), devices),
+    )
+    build_s = time.monotonic() - t_build
+
+    B, S = max(8, len(devices)), 1024
+    batch = {
+        "tokens": jnp.zeros((B, S), jnp.int32),
+        "targets": jnp.zeros((B, S), jnp.int32),
+        "loss_mask": jnp.ones((B, S), jnp.float32),
+    }
+    t_step = time.monotonic()
+    metrics = trainer.train_step(batch)
+    loss = float(metrics["loss"])  # host transfer = the only real sync
+    first_step_s = time.monotonic() - t_step
+    return {
+        "device": getattr(devices[0], "device_kind", "cpu"),
+        "import_s": round(import_s, 2),
+        "trainer_build_s": round(build_s, 2),
+        "first_step_compile_s": round(first_step_s, 2),
+        "loss": round(loss, 3),
+    }
+
+
+def record(result: dict) -> None:
+    import pathlib
+    import re
+
+    path = pathlib.Path(__file__).resolve().parent.parent / "BASELINE.md"
+    text = path.read_text()
+    line = (
+        f"| Spawn → first JAX step latency | "
+        f"**{result['total_s']:.1f}s** measured (spawn→ready "
+        f"{result['spawn_to_ready_s']}s platform path on sim kubelet, + "
+        f"trainer build {result['first_step']['trainer_build_s']}s + "
+        f"first-step compile {result['first_step']['first_step_compile_s']}s "
+        f"on real {result['first_step']['device']}; excludes image pull) "
+        f"| v5e-1 (single chip) and v5p-8 | loadtest/spawn_latency.py |"
+    )
+    pattern = r"\| Spawn → first JAX step latency \|[^\n]*"
+    if re.search(pattern, text):
+        text = re.sub(pattern, line, text, count=1)
+    else:
+        text += "\n" + line + "\n"
+    path.write_text(text)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--record", action="store_true", help="update BASELINE.md")
+    args = parser.parse_args()
+
+    spawn = measure_spawn_to_ready()
+    first = measure_first_jax_step()
+    result = {
+        **spawn,
+        "first_step": first,
+        "total_s": round(
+            spawn["spawn_to_ready_s"]
+            + first["trainer_build_s"]
+            + first["first_step_compile_s"],
+            3,
+        ),
+    }
+    print(json.dumps(result))
+    if args.record:
+        record(result)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
