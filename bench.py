@@ -55,6 +55,35 @@ def _attention_op_compare(jax, jnp, seq: int = 4096):
     return out
 
 
+def _generate_smoke(jax, jnp, trainer):
+    """KV-cache decode on the real chip (models/generate.py): prefill a
+    prompt, decode 32 tokens, report decode tokens/s — the notebook
+    fine-tune→try-it loop's serving half."""
+    from odh_kubeflow_tpu.models.generate import GenerateConfig, generate
+
+    gen_cfg = GenerateConfig(max_new_tokens=32, temperature=0.0)
+    B, S = 4, 128
+    prompt = jnp.ones((B, S), jnp.int32)
+    run = jax.jit(
+        lambda params, prompt: generate(params, prompt, trainer.model_cfg, gen_cfg)
+    )
+    t0 = time.time()
+    out = run(trainer.params, prompt)
+    int(out["lengths"][0])  # host transfer = sync (compile incl.)
+    compile_s = time.time() - t0
+    t0 = time.time()
+    out = run(trainer.params, prompt)
+    int(out["lengths"][0])
+    steady_s = time.time() - t0
+    return {
+        "batch": B,
+        "prompt_len": S,
+        "new_tokens": gen_cfg.max_new_tokens,
+        "compile_s": round(compile_s, 2),
+        "decode_tokens_per_s": round(B * gen_cfg.max_new_tokens / steady_s, 1),
+    }
+
+
 def main() -> None:
     os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
     import jax
@@ -130,6 +159,10 @@ def main() -> None:
             detail["attention_op_ms"] = _attention_op_compare(jax, jnp)
         except Exception as e:  # noqa: BLE001 — comparison is best-effort
             detail["attention_op_ms"] = {"error": str(e)[:200]}
+        try:
+            detail["generate"] = _generate_smoke(jax, jnp, long_trainer)
+        except Exception as e:  # noqa: BLE001 — smoke is best-effort
+            detail["generate"] = {"error": str(e)[:200]}
 
     if peak > 0:
         value = stats["flops_per_s"] / peak
