@@ -176,6 +176,11 @@ class RestAPI:
             return self._json(
                 200, {"gitVersion": "odh-kubeflow-tpu", "major": "1"}, start_response
             )
+        if (
+            method == "POST"
+            and path == "/apis/authorization.k8s.io/v1/subjectaccessreviews"
+        ):
+            return self._subject_access_review(environ, start_response)
 
         route = _parse_path(path)
         if route is None:
@@ -194,6 +199,39 @@ class RestAPI:
             )
         except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
             return self._error(500, f"{type(e).__name__}: {e}", start_response)
+
+    def _subject_access_review(self, environ, start_response):
+        """kube's SAR endpoint: the auth-proxy sidecar (and any other
+        out-of-process client) asks "may <user> <verb> this resource"
+        and the embedded RBAC evaluator answers — the same contract the
+        reference's oauth-proxy --openshift-sar flag relies on."""
+        from odh_kubeflow_tpu.machinery.rbac import RBACEvaluator
+
+        try:
+            body = self._read_body(environ)
+        except ValueError:
+            return self._error(400, "invalid JSON body", start_response)
+        spec = body.get("spec") or {}
+        user = spec.get("user", "")
+        attrs = spec.get("resourceAttributes") or {}
+        allowed = bool(user) and RBACEvaluator(self.server).can(
+            user,
+            attrs.get("verb", ""),
+            attrs.get("resource", ""),
+            attrs.get("namespace") or None,
+            attrs.get("group", ""),
+            name=attrs.get("name") or None,
+        )
+        return self._json(
+            201,
+            {
+                "kind": "SubjectAccessReview",
+                "apiVersion": "authorization.k8s.io/v1",
+                "spec": spec,
+                "status": {"allowed": allowed},
+            },
+            start_response,
+        )
 
     def _read_body(self, environ) -> Obj:
         length = int(environ.get("CONTENT_LENGTH") or 0)

@@ -50,7 +50,13 @@ TRUSTED_CA_BUNDLE_CONFIGMAP = "odh-trusted-ca-bundle"
 
 
 class NotebookWebhook:
-    def __init__(self, api: APIServer, auth_proxy_image: str = "auth-proxy:latest"):
+    def __init__(
+        self,
+        api: APIServer,
+        auth_proxy_image: str = "odh-kubeflow-tpu/auth-proxy:latest",
+    ):
+        # the image is real: images/auth-proxy/ (stdlib reverse proxy
+        # with header/HMAC-cookie authn + SubjectAccessReview authz)
         self.api = api
         self.auth_proxy_image = auth_proxy_image
 
