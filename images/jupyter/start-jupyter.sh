@@ -15,6 +15,22 @@ if command -v tpu-activity-agent >/dev/null 2>&1; then
   tpu-activity-agent &
 fi
 
+# Seed the IPython kernel-startup hook that auto-starts the JAX
+# profiler server (TensorBoard "capture profile" against this
+# notebook; odh_kubeflow_tpu.utils.profiling). HOME is the user's
+# PVC, so only seed when absent — the user may edit or remove it.
+STARTUP_DIR="${HOME}/.ipython/profile_default/startup"
+if [ ! -f "${STARTUP_DIR}/00-tpu-profiler.py" ]; then
+  mkdir -p "${STARTUP_DIR}"
+  python - <<'PYEOF' > "${STARTUP_DIR}/00-tpu-profiler.py" 2>/dev/null || true
+try:
+    from odh_kubeflow_tpu.utils.profiling import kernel_startup_snippet
+    print(kernel_startup_snippet())
+except Exception:
+    pass
+PYEOF
+fi
+
 exec jupyter lab \
   --notebook-dir="${HOME}" \
   --ip=0.0.0.0 \

@@ -1,0 +1,99 @@
+"""XLA/TPU profiler integration (BASELINE config #3's client half).
+
+The platform story: a user captures traces from their notebook with
+:func:`capture_trace` (or serves live with :func:`start_server` for
+on-demand capture), writes them to a PVC or ``gs://`` bucket, and the
+tensorboard-controller serves them (``controllers/tensorboard.py``
+treats ``gs://`` as primary — that's where XLA traces land on TPU
+pods). The layout produced here is exactly TensorBoard's profile
+plugin contract: ``<logdir>/plugins/profile/<session>/<host>.xplane.pb``
+plus ``.trace.json.gz``.
+
+``jupyter-jax-tpu`` images auto-start the profiler server in every
+IPython kernel (images/jupyter/start-jupyter.sh seeds the startup
+file), so TensorBoard's "capture profile" button works against a
+running notebook with zero user code.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from contextlib import contextmanager
+from typing import Any, Optional
+
+DEFAULT_PORT = int(os.environ.get("JAX_PROFILER_PORT", "9999"))
+
+
+def start_server(port: Optional[int] = None):
+    """Start the in-process profiler gRPC server TensorBoard's
+    profile plugin captures from. Idempotent-ish: a second call in the
+    same process raises inside jax; callers (the kernel-startup hook)
+    guard with :func:`server_started`."""
+    import jax
+
+    port = port or DEFAULT_PORT
+    server = jax.profiler.start_server(port)
+    _STATE["server"] = server
+    _STATE["port"] = port
+    return server
+
+
+def server_started() -> bool:
+    return _STATE.get("server") is not None
+
+
+_STATE: dict[str, Any] = {}
+
+
+@contextmanager
+def capture_trace(logdir: str):
+    """Capture one profiling session into TensorBoard layout."""
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    with jax.profiler.trace(logdir):
+        yield
+    # jax writes plugins/profile/<ts>/ under logdir
+
+
+def trace_sessions(logdir: str) -> list[str]:
+    """Session directories in TensorBoard profile-plugin layout,
+    newest last."""
+    return sorted(glob.glob(os.path.join(logdir, "plugins", "profile", "*")))
+
+
+def latest_trace_events(logdir: str) -> list[dict]:
+    """Parse the newest session's ``.trace.json.gz`` (the Chrome
+    trace-event format TensorBoard's trace viewer renders) — the
+    cheap validity check that what we captured is servable."""
+    sessions = trace_sessions(logdir)
+    if not sessions:
+        return []
+    files = glob.glob(os.path.join(sessions[-1], "*.trace.json.gz"))
+    if not files:
+        return []
+    with gzip.open(files[0], "rt") as f:
+        doc = json.load(f)
+    return doc.get("traceEvents", [])
+
+
+def kernel_startup_snippet() -> str:
+    """The IPython-startup hook baked into TPU notebook images
+    (images/jupyter/start-jupyter.sh seeds it into
+    ``~/.ipython/profile_default/startup/``)."""
+    return (
+        "# auto-start the JAX profiler server so TensorBoard's\n"
+        "# 'capture profile' works against this kernel (set\n"
+        "# TPU_PROFILER_AUTOSTART=false to disable)\n"
+        "import os as _os\n"
+        "if _os.environ.get('TPU_PROFILER_AUTOSTART', 'true') == 'true':\n"
+        "    try:\n"
+        "        from odh_kubeflow_tpu.utils import profiling as _prof\n"
+        "        if not _prof.server_started():\n"
+        "            _prof.start_server()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )

@@ -1,0 +1,69 @@
+"""XLA profiler integration: capture layout, servability, and the
+tensorboard-controller path that serves it (BASELINE config #3 —
+round 1 left it unexercised end-to-end)."""
+
+import jax
+import jax.numpy as jnp
+
+from odh_kubeflow_tpu.utils import profiling
+
+
+def test_capture_trace_produces_tensorboard_profile_layout(tmp_path):
+    logdir = str(tmp_path / "logs")
+    f = jax.jit(lambda x: (x @ x.T).sum())
+    x = jnp.ones((128, 128), jnp.float32)
+    float(f(x))  # compile outside the trace
+    with profiling.capture_trace(logdir):
+        float(f(x))
+
+    sessions = profiling.trace_sessions(logdir)
+    assert len(sessions) == 1
+    import glob
+
+    assert glob.glob(sessions[0] + "/*.xplane.pb"), "xplane missing"
+    events = profiling.latest_trace_events(logdir)
+    assert events, "trace.json.gz empty — profile plugin would render nothing"
+    assert any("name" in e for e in events)
+
+
+def test_tensorboard_controller_serves_the_trace_volume(tmp_path):
+    """The platform half: a Tensorboard CR pointing at the PVC holding
+    the captured traces materialises a serving Deployment mounting that
+    PVC (gs:// is the production path; pvc:// is the testable one)."""
+    from odh_kubeflow_tpu.apis import register_crds
+    from odh_kubeflow_tpu.controllers.runtime import Manager
+    from odh_kubeflow_tpu.controllers.tensorboard import TensorboardController
+    from odh_kubeflow_tpu.machinery.store import APIServer
+
+    api = APIServer()
+    register_crds(api)
+    mgr = Manager(api)
+    TensorboardController(api).register(mgr)
+    api.create(
+        {
+            "apiVersion": "tensorboard.kubeflow.org/v1alpha1",
+            "kind": "Tensorboard",
+            "metadata": {"name": "xla-traces", "namespace": "team-a"},
+            "spec": {"logspath": "pvc://trace-pvc/logs"},
+        }
+    )
+    mgr.drain()
+    deploy = api.get("Deployment", "xla-traces", "team-a")
+    spec = deploy["spec"]["template"]["spec"]
+    claims = [
+        v.get("persistentVolumeClaim", {}).get("claimName")
+        for v in spec.get("volumes", [])
+    ]
+    assert "trace-pvc" in claims
+    args = " ".join(spec["containers"][0].get("args", []) or []) + " ".join(
+        spec["containers"][0].get("command", []) or []
+    )
+    assert "logs" in args  # serving the subdir the traces landed in
+
+
+def test_kernel_startup_snippet_is_valid_python_and_guarded():
+    snippet = profiling.kernel_startup_snippet()
+    compile(snippet, "<startup>", "exec")
+    assert "TPU_PROFILER_AUTOSTART" in snippet
+    # the snippet must never raise into the kernel
+    assert "except Exception" in snippet
