@@ -36,6 +36,7 @@ from odh_kubeflow_tpu.ops.rope import apply_rope, rope_angles
 from odh_kubeflow_tpu.parallel.mesh import (
     AXIS_CONTEXT,
     AXIS_DATA,
+    AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_TENSOR,
     constrain,
@@ -229,7 +230,8 @@ def _maybe_lora(name: str, x: jnp.ndarray, w: jnp.ndarray, lora_layer) -> jnp.nd
 
 
 def _activation_spec() -> P:
-    return P((AXIS_DATA, AXIS_FSDP), AXIS_CONTEXT, None)
+    # expert doubles as a batch axis for dense compute (mesh.batch_spec)
+    return P((AXIS_DATA, AXIS_FSDP, AXIS_EXPERT), AXIS_CONTEXT, None)
 
 
 def _decoder_layer(

@@ -1,0 +1,283 @@
+"""Sparse Mixture-of-Experts Llama (Mixtral-shaped), expert-parallel.
+
+The reference platform ships no model code at all (SURVEY.md §2.4); the
+TPU rebuild carries models as first-class runtime components. This
+module adds the MoE family on top of the dense Llama blocks
+(``models/llama.py``): same attention stack, but every decoder layer's
+MLP is a top-k router over E expert FFNs.
+
+TPU-first design (GShard/Switch einsum dispatch, not gather/scatter):
+
+- **Static shapes everywhere.** Token→expert routing uses one-hot
+  dispatch/combine tensors of shape [B, S, E, C] (C = per-expert
+  capacity derived from ``capacity_factor``); overflow tokens are
+  dropped (their combine weight is 0) rather than reshaping — XLA/MXU
+  want fixed shapes, and the aux loss keeps overflow rare.
+- **Expert parallelism via sharding, not message passing.** Expert
+  weights are [E, D, F] sharded over the ``expert`` mesh axis
+  (``parallel/mesh.py``); the dispatch einsum's contraction against
+  expert-sharded operands makes GSPMD insert the token⇄expert
+  all-to-all on ICI. No hand-written collective anywhere.
+- **The expert axis doubles as a data axis** for the dense parts
+  (attention, norms, embeddings) — see ``mesh.batch_spec``.
+
+Aux load-balancing loss is the Switch-Transformer form:
+``E * Σ_e f_e·p_e`` (fraction dispatched × mean router prob).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from odh_kubeflow_tpu.models import llama
+from odh_kubeflow_tpu.models.llama import LlamaConfig
+from odh_kubeflow_tpu.ops.norms import rms_norm
+from odh_kubeflow_tpu.ops.rope import rope_angles
+from odh_kubeflow_tpu.parallel.mesh import (
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_TENSOR,
+    constrain,
+)
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    """MoE extension of a Llama backbone config."""
+
+    base: LlamaConfig = dataclasses.field(default_factory=LlamaConfig)
+    num_experts: int = 8
+    num_experts_per_tok: int = 2
+    capacity_factor: float = 1.25
+    router_aux_loss_coef: float = 0.02
+
+    @staticmethod
+    def mixtral_tiny(**kw) -> "MoeConfig":
+        """Unit-test shape (Mixtral topology, milliseconds on CPU)."""
+        d = dict(base=LlamaConfig.tiny(), num_experts=4, num_experts_per_tok=2)
+        d.update(kw)
+        return MoeConfig(**d)
+
+    @staticmethod
+    def mixtral_8x1b(**kw) -> "MoeConfig":
+        """8-expert MoE on the Llama-3.2-1B backbone (the single-chip
+        benchable shape; Mixtral-8x7B is the same topology scaled)."""
+        d = dict(
+            base=LlamaConfig.llama3_1b(),
+            num_experts=8,
+            num_experts_per_tok=2,
+        )
+        d.update(kw)
+        return MoeConfig(**d)
+
+    def capacity(self, tokens_per_group: int) -> int:
+        """Per-expert slot count for a routing group (static)."""
+        c = (
+            tokens_per_group
+            * self.num_experts_per_tok
+            * self.capacity_factor
+            / self.num_experts
+        )
+        return max(int(-(-c // 1)), 1)
+
+    def num_params(self) -> int:
+        b = self.base
+        dense = b.num_params()
+        per_layer_mlp = 3 * b.hidden_size * b.intermediate_size
+        # replace the dense MLP with E experts + router
+        return dense + b.num_layers * (
+            (self.num_experts - 1) * per_layer_mlp
+            + b.hidden_size * self.num_experts
+        )
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Forward matmul FLOPs per token: dense model minus its MLP,
+        plus k active experts + router (the sparse-MoE accounting)."""
+        b = self.base
+        dense = b.flops_per_token(seq_len)
+        mlp = 2 * 3 * b.hidden_size * b.intermediate_size
+        router = 2 * b.hidden_size * self.num_experts
+        return dense + b.num_layers * (
+            (self.num_experts_per_tok - 1) * mlp + router
+        )
+
+
+# ---------------------------------------------------------------------------
+# params
+
+
+def init_params(key: jax.Array, cfg: MoeConfig, dtype=jnp.float32) -> Params:
+    b = cfg.base
+    params = llama.init_params(key, b, dtype=dtype)
+    D, F, E, L = b.hidden_size, b.intermediate_size, cfg.num_experts, b.num_layers
+    k_router, k_gate, k_up, k_down = jax.random.split(jax.random.fold_in(key, 7), 4)
+    scale = 1.0 / (D ** 0.5)
+    layers = params["layers"]
+    # the dense MLP weights are replaced by expert banks + router
+    for name in ("w_gate", "w_up", "w_down"):
+        del layers[name]
+    layers["router"] = (
+        jax.random.normal(k_router, (L, D, E), dtype) * scale
+    )
+    layers["moe_gate"] = jax.random.normal(k_gate, (L, E, D, F), dtype) * scale
+    layers["moe_up"] = jax.random.normal(k_up, (L, E, D, F), dtype) * scale
+    layers["moe_down"] = jax.random.normal(k_down, (L, E, F, D), dtype) * (
+        1.0 / (F ** 0.5)
+    )
+    return params
+
+
+def param_specs(cfg: MoeConfig) -> Params:
+    specs = llama.param_specs(cfg.base)
+    layers = specs["layers"]
+    for name in ("w_gate", "w_up", "w_down"):
+        del layers[name]
+    layers["router"] = P(None, AXIS_FSDP, None)
+    # expert banks: E over the expert axis, F over tensor, D over fsdp
+    layers["moe_gate"] = P(None, AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR)
+    layers["moe_up"] = P(None, AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR)
+    layers["moe_down"] = P(None, AXIS_EXPERT, AXIS_TENSOR, AXIS_FSDP)
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# routing + expert compute
+
+
+def route_tokens(
+    router_logits: jnp.ndarray,  # [B, S, E] float32
+    cfg: MoeConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-k routing with per-(batch-row) capacity.
+
+    Returns ``(dispatch [B,S,E,C] bool, combine [B,S,E,C] f32,
+    aux_loss scalar)``. Group = batch row (the GShard grouping): the
+    cumulative-sum position is per row, so capacity stays static under
+    any batch sharding.
+    """
+    B, S, E = router_logits.shape
+    k = cfg.num_experts_per_tok
+    C = cfg.capacity(S)
+
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [B,S,E]
+    top_p, top_idx = jax.lax.top_k(probs, k)  # [B,S,k]
+    top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+
+    # aux loss (Switch): balance fraction-routed vs mean prob per expert
+    first_choice = jax.nn.one_hot(top_idx[..., 0], E, dtype=jnp.float32)
+    f = first_choice.mean(axis=(0, 1))  # fraction of tokens per expert
+    p = probs.mean(axis=(0, 1))
+    aux_loss = E * jnp.sum(f * p) * cfg.router_aux_loss_coef
+
+    dispatch = jnp.zeros((B, S, E, C), jnp.bool_)
+    combine = jnp.zeros((B, S, E, C), jnp.float32)
+    # running per-expert fill count per batch row, across the k slots
+    fill = jnp.zeros((B, E), jnp.int32)
+    for slot in range(k):
+        onehot = jax.nn.one_hot(top_idx[..., slot], E, dtype=jnp.int32)  # [B,S,E]
+        # position of each token within its expert's capacity buffer
+        pos = jnp.cumsum(onehot, axis=1) - onehot + fill[:, None, :]  # [B,S,E]
+        keep = (pos < C) & (onehot > 0)
+        pos_oh = jax.nn.one_hot(pos, C, dtype=jnp.float32) * keep[..., None]
+        dispatch = dispatch | (pos_oh > 0)
+        combine = combine + pos_oh * top_p[..., slot, None, None] * onehot[..., None]
+        fill = fill + onehot.sum(axis=1)
+    return dispatch, combine, aux_loss
+
+
+def moe_mlp(
+    x: jnp.ndarray,  # [B, S, D]
+    layer: Params,  # router [D,E], moe_gate/up [E,D,F], moe_down [E,F,D]
+    cfg: MoeConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (out [B,S,D], aux_loss)."""
+    dtype = x.dtype
+    router_logits = jnp.einsum(
+        "bsd,de->bse", x, layer["router"].astype(dtype),
+        preferred_element_type=jnp.float32,
+    )
+    dispatch, combine, aux = route_tokens(router_logits, cfg)
+
+    # token→expert all-to-all: contraction against expert-sharded
+    # operands; GSPMD inserts the collective
+    xin = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(dtype), x)
+    xin = constrain(xin, P(AXIS_EXPERT, (AXIS_FSDP,), None, None))
+    gate = jnp.einsum("ebcd,edf->ebcf", xin, layer["moe_gate"].astype(dtype))
+    up = jnp.einsum("ebcd,edf->ebcf", xin, layer["moe_up"].astype(dtype))
+    h = jax.nn.silu(gate) * up
+    out_e = jnp.einsum("ebcf,efd->ebcd", h, layer["moe_down"].astype(dtype))
+    # expert→token all-to-all back
+    out = jnp.einsum("bsec,ebcd->bsd", combine.astype(dtype), out_e)
+    return out, aux
+
+
+# ---------------------------------------------------------------------------
+# decoder layer + forward (mirrors llama.forward's API)
+
+
+def _moe_decoder_layer(cfg: MoeConfig, attention_fn, x, layer, sin, cos, segment_ids):
+    b = cfg.base
+    B, S, D = x.shape
+    x = constrain(x, llama._activation_spec())
+
+    h = rms_norm(x, layer["attn_norm"], b.rms_norm_eps)
+    q = (h @ layer["wq"].astype(x.dtype)).reshape(B, S, b.num_heads, b.head_dim)
+    k = (h @ layer["wk"].astype(x.dtype)).reshape(B, S, b.num_kv_heads, b.head_dim)
+    v = (h @ layer["wv"].astype(x.dtype)).reshape(B, S, b.num_kv_heads, b.head_dim)
+    q = llama.apply_rope(q, sin, cos)
+    k = llama.apply_rope(k, sin, cos)
+    attn = attention_fn(q, k, v, segment_ids=segment_ids).reshape(B, S, b.q_dim)
+    x = x + attn @ layer["wo"].astype(x.dtype)
+
+    h = rms_norm(x, layer["mlp_norm"], b.rms_norm_eps)
+    moe_out, aux = moe_mlp(h, layer, cfg)
+    return x + moe_out, aux
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32
+    cfg: MoeConfig,
+    positions: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    return_hidden: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (logits [B,S,V] f32 — or hidden [B,S,D] with
+    ``return_hidden`` — , total_aux_loss)."""
+    b = cfg.base
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    sin, cos = rope_angles(positions, b.head_dim, b.rope_theta)
+
+    x = jnp.take(params["embed"], tokens, axis=0).astype(b.dtype)
+    attention_fn = llama._select_attention(b)
+    layer_fn = partial(_moe_decoder_layer, cfg, attention_fn)
+    if b.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def body(carry, scanned):
+        x, aux = carry
+        x, layer_aux = layer_fn(x, scanned, sin, cos, segment_ids)
+        return (x, aux + layer_aux), None
+
+    (x, aux_total), _ = jax.lax.scan(
+        body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+    )
+
+    x = rms_norm(x, params["final_norm"], b.rms_norm_eps)
+    if return_hidden:
+        return x, aux_total
+    head = llama.lm_head_weight(params, b)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, head.astype(b.dtype), preferred_element_type=jnp.float32
+    )
+    return logits, aux_total

@@ -35,12 +35,15 @@ AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
 AXIS_TENSOR = "tensor"
 AXIS_CONTEXT = "context"
+AXIS_EXPERT = "expert"
 
 # Order matters: earlier axes change slowest across the physical device
 # grid, so put the bandwidth-hungry axes (tensor, context) last — they
 # land on ICI-adjacent chips, and `data` (the gradient all-reduce that
-# can tolerate DCN latency) lands across hosts/slices.
-AXIS_ORDER = (AXIS_DATA, AXIS_FSDP, AXIS_CONTEXT, AXIS_TENSOR)
+# can tolerate DCN latency) lands across hosts/slices. `expert` sits in
+# the middle: its all-to-all wants ICI but tolerates more hops than
+# tensor-parallel all-reduces.
+AXIS_ORDER = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_CONTEXT, AXIS_TENSOR)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,12 +52,13 @@ class MeshConfig:
 
     data: int = 1
     fsdp: int = 1
+    expert: int = 1
     context: int = 1
     tensor: int = 1
 
     @property
     def shape(self) -> tuple[int, ...]:
-        return (self.data, self.fsdp, self.context, self.tensor)
+        return (self.data, self.fsdp, self.expert, self.context, self.tensor)
 
     @property
     def num_devices(self) -> int:
@@ -102,8 +106,13 @@ def build_mesh(
 
 
 def batch_spec() -> P:
-    """PartitionSpec for a [batch, seq] token batch."""
-    return P((AXIS_DATA, AXIS_FSDP), AXIS_CONTEXT)
+    """PartitionSpec for a [batch, seq] token batch.
+
+    The expert axis doubles as a data axis for non-MoE computation
+    (the standard MoE-training layout): dense layers see it as more
+    batch shards, and the MoE dispatch einsum turns it into the
+    token⇄expert all-to-all."""
+    return P((AXIS_DATA, AXIS_FSDP, AXIS_EXPERT), AXIS_CONTEXT)
 
 
 def constrain(x, spec: P):
