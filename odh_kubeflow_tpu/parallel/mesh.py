@@ -36,14 +36,25 @@ AXIS_FSDP = "fsdp"
 AXIS_TENSOR = "tensor"
 AXIS_CONTEXT = "context"
 AXIS_EXPERT = "expert"
+AXIS_PIPE = "pipe"
 
 # Order matters: earlier axes change slowest across the physical device
 # grid, so put the bandwidth-hungry axes (tensor, context) last — they
 # land on ICI-adjacent chips, and `data` (the gradient all-reduce that
-# can tolerate DCN latency) lands across hosts/slices. `expert` sits in
-# the middle: its all-to-all wants ICI but tolerates more hops than
+# can tolerate DCN latency) lands across hosts/slices. `pipe` comes
+# right after data: stage-to-stage transfers are point-to-point and
+# latency-tolerant (the GPipe bubble hides them), so pipeline stages
+# are the natural thing to spread across slices. `expert` sits in the
+# middle: its all-to-all wants ICI but tolerates more hops than
 # tensor-parallel all-reduces.
-AXIS_ORDER = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_CONTEXT, AXIS_TENSOR)
+AXIS_ORDER = (
+    AXIS_DATA,
+    AXIS_PIPE,
+    AXIS_FSDP,
+    AXIS_EXPERT,
+    AXIS_CONTEXT,
+    AXIS_TENSOR,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,6 +62,7 @@ class MeshConfig:
     """Logical mesh shape. Product must equal the device count."""
 
     data: int = 1
+    pipe: int = 1
     fsdp: int = 1
     expert: int = 1
     context: int = 1
@@ -58,7 +70,14 @@ class MeshConfig:
 
     @property
     def shape(self) -> tuple[int, ...]:
-        return (self.data, self.fsdp, self.expert, self.context, self.tensor)
+        return (
+            self.data,
+            self.pipe,
+            self.fsdp,
+            self.expert,
+            self.context,
+            self.tensor,
+        )
 
     @property
     def num_devices(self) -> int:
@@ -118,11 +137,19 @@ def batch_spec() -> P:
 def constrain(x, spec: P):
     """``with_sharding_constraint`` that degrades to a no-op when no mesh
     is active (single-device eager use), and drops spec axes the active
-    mesh doesn't define (partial meshes in tests)."""
+    mesh doesn't define (partial meshes in tests) or that are Manual
+    (inside ``shard_map`` — e.g. model code running under the pipeline
+    combinator — constraints may only name Auto axes)."""
     am = jax.sharding.get_abstract_mesh()
     if am.empty:
         return x
-    names = set(am.axis_names)
+    names = {
+        name
+        for name, t in zip(am.axis_names, am.axis_types)
+        if t == jax.sharding.AxisType.Auto
+    }
+    if not names:
+        return x
 
     def keep(entry):
         if entry is None:

@@ -1,0 +1,129 @@
+"""Pipeline parallelism: a GPipe-style combinator over the ``pipe``
+mesh axis.
+
+The reference platform has no parallelism layer (SURVEY.md §2.4); this
+module completes the rebuild's dp/fsdp/ep/cp/tp/pp axis set. Design is
+the standard JAX/TPU pipelining pattern ("How to Scale Your Model"):
+
+- the layer stack is pre-split into S equal stages whose parameters
+  carry a leading stage dim sharded over ``pipe`` — ``shard_map``
+  hands each device exactly its stage's weights, nothing moves;
+- the batch is split into M microbatches; inside one ``lax.scan`` over
+  M+S-1 ticks, every device runs its stage on the microbatch it holds
+  and passes the activation to the next stage with a single
+  ``ppermute`` hop (point-to-point, ICI/DCN-friendly);
+- schedule bubble = (S-1)/(M+S-1), the GPipe trade; gradients flow
+  through the scan + ppermute (whose transpose is the reverse
+  ppermute), so ``jax.grad`` of a pipelined forward just works — no
+  hand-written backward schedule.
+
+Constraints (by design, to stay XLA-friendly): the stage function must
+be shape-preserving ([mb, ...] in = out, true of transformer blocks),
+every stage runs the same ``stage_fn`` over its own weights, and
+M % microbatches must divide the batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from odh_kubeflow_tpu.parallel.mesh import AXIS_PIPE
+
+Params = Any
+
+
+def stack_stages(layer_params: Params, num_stages: int) -> Params:
+    """[L, ...] layer-stacked params → [S, L/S, ...] stage-stacked."""
+
+    def split(leaf):
+        L = leaf.shape[0]
+        if L % num_stages:
+            raise ValueError(f"{L} layers do not split into {num_stages} stages")
+        return leaf.reshape(num_stages, L // num_stages, *leaf.shape[1:])
+
+    return jax.tree_util.tree_map(split, layer_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Params, jnp.ndarray], jnp.ndarray],
+    stage_params: Params,  # leaves [S, ...], S = mesh extent of `pipe`
+    x: jnp.ndarray,  # [B, ...] (replicated over `pipe`)
+    *,
+    num_microbatches: int,
+    axis: str = AXIS_PIPE,
+) -> jnp.ndarray:
+    """Run ``x`` through S pipeline stages; returns [B, ...].
+
+    ``stage_fn(params_for_one_stage, x_mb) -> y_mb`` must preserve the
+    microbatch shape. Call under ``jax.set_mesh`` of a mesh containing
+    ``axis``; differentiable.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
+    S = mesh.shape[axis]
+    B = x.shape[0]
+    M = num_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+    xm = x.reshape(M, mb, *x.shape[1:])
+
+    param_specs = jax.tree_util.tree_map(
+        lambda _leaf: P(axis), stage_params
+    )
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def run(stage_params_local, xm):
+        # shard_map hands this device leaves of shape [1, ...]: its stage
+        my_params = jax.tree_util.tree_map(lambda a: a[0], stage_params_local)
+        idx = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        y0 = jnp.zeros_like(xm)
+        state0 = jnp.zeros_like(xm[0])
+
+        def tick(carry, t):
+            state, y = carry
+            # stage 0 ingests microbatch t while t < M
+            x_t = jax.lax.dynamic_index_in_dim(
+                xm, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            )
+            take_input = (idx == 0) & (t < M)
+            state = jnp.where(take_input, x_t, state)
+            out = stage_fn(my_params, state)
+            # the last stage owns microbatch t-(S-1)'s final activation
+            write_t = t - (S - 1)
+            write = (idx == S - 1) & (write_t >= 0)
+            y = jnp.where(
+                write,
+                jax.lax.dynamic_update_index_in_dim(
+                    y, out, jnp.clip(write_t, 0, M - 1), 0
+                ),
+                y,
+            )
+            # hand the activation to the next stage (single p2p hop)
+            state = jax.lax.ppermute(out, axis, perm)
+            return (state, y), None
+
+        (_, y), _ = jax.lax.scan(
+            tick, (state0, y0), jnp.arange(M + S - 1)
+        )
+        # y is populated only on the last stage; psum replicates it
+        # (every other stage contributes zeros)
+        return jax.lax.psum(jnp.where(idx == S - 1, y, jnp.zeros_like(y)), axis)
+
+    y = run(stage_params, xm)
+    return y.reshape(B, *x.shape[1:])
