@@ -17,3 +17,4 @@ from odh_kubeflow_tpu.models.lora import (  # noqa: F401
     init_lora_params,
     lora_specs,
 )
+from odh_kubeflow_tpu.models.moe import MoeConfig  # noqa: F401

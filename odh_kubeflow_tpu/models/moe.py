@@ -78,6 +78,10 @@ class MoeConfig:
         d.update(kw)
         return MoeConfig(**d)
 
+    @property
+    def vocab_size(self) -> int:
+        return self.base.vocab_size
+
     def capacity(self, tokens_per_group: int) -> int:
         """Per-expert slot count for a routing group (static)."""
         c = (

@@ -132,13 +132,21 @@ class Trainer:
 
     def __init__(
         self,
-        model_cfg: llama.LlamaConfig,
+        model_cfg,  # LlamaConfig (dense, LoRA-able) or MoeConfig
         train_cfg: TrainConfig = TrainConfig(),
         lora_cfg: Optional[lora_lib.LoraConfig] = None,
         mesh: Optional[Mesh] = None,
         seed: int = 0,
     ):
+        from odh_kubeflow_tpu.models import moe as moe_lib
+
         self.model_cfg = model_cfg
+        self.is_moe = isinstance(model_cfg, moe_lib.MoeConfig)
+        if self.is_moe and lora_cfg is not None:
+            raise NotImplementedError(
+                "LoRA adapters are wired for the dense family only; "
+                "MoE trains full-parameter"
+            )
         self.train_cfg = train_cfg
         self.lora_cfg = lora_cfg
         self.mesh = mesh if mesh is not None else build_mesh()
@@ -147,10 +155,19 @@ class Trainer:
         key = jax.random.key(seed)
         k_params, k_lora = jax.random.split(key)
 
-        p_specs = llama.param_specs(model_cfg)
+        if self.is_moe:
+            p_specs = moe_lib.param_specs(model_cfg)
+            init_partial = partial(
+                moe_lib.init_params, cfg=model_cfg, dtype=model_cfg.base.dtype
+            )
+        else:
+            p_specs = llama.param_specs(model_cfg)
+            init_partial = partial(
+                llama.init_params, cfg=model_cfg, dtype=model_cfg.dtype
+            )
         with jax.set_mesh(self.mesh):
             init_fn = jax.jit(
-                partial(llama.init_params, cfg=model_cfg, dtype=model_cfg.dtype),
+                init_partial,
                 out_shardings=self._sh(p_specs),
             )
             self.params = init_fn(k_params)
@@ -200,6 +217,8 @@ class Trainer:
     # -- train step ---------------------------------------------------------
 
     def _loss_fn(self, trainable, frozen, batch):
+        if self.is_moe:
+            return self._moe_loss_fn(trainable, batch)
         if self.lora_cfg is not None:
             params, lora_params = frozen, trainable
         else:
@@ -236,6 +255,47 @@ class Trainer:
             z_loss=self.train_cfg.z_loss,
         )
         return loss
+
+    def _moe_loss_fn(self, params, batch):
+        """MoE: router aux (load-balancing) loss rides on the LM loss;
+        the long-context chunked path applies the same way."""
+        from odh_kubeflow_tpu.models import moe as moe_lib
+
+        cfg = self.model_cfg
+        seq_len = batch["tokens"].shape[1]
+        if seq_len > 2048 and seq_len % 1024 == 0:
+            hidden, aux = moe_lib.forward(
+                params,
+                batch["tokens"],
+                cfg,
+                segment_ids=batch.get("segment_ids"),
+                return_hidden=True,
+            )
+            return (
+                chunked_cross_entropy(
+                    hidden,
+                    llama.lm_head_weight(params, cfg.base),
+                    batch["targets"],
+                    batch.get("loss_mask"),
+                    z_loss=self.train_cfg.z_loss,
+                )
+                + aux
+            )
+        logits, aux = moe_lib.forward(
+            params,
+            batch["tokens"],
+            cfg,
+            segment_ids=batch.get("segment_ids"),
+        )
+        return (
+            cross_entropy_loss(
+                logits,
+                batch["targets"],
+                batch.get("loss_mask"),
+                z_loss=self.train_cfg.z_loss,
+            )
+            + aux
+        )
 
     def _build_step(self):
         def step_fn(trainable, frozen, opt_state, batch):

@@ -135,6 +135,23 @@ class DashboardApp:
             self.kfam.delete_binding(binding, requester=user)
             return success()
 
+        @app.route("/api/workgroup/contributors/<namespace>")
+        def list_contributors(request, namespace):
+            """Contributor rows for the manage-users view (reference
+            main-page's manage-users data comes from kfam bindings)."""
+            user = user_of(request)
+            if not (
+                self.kfam.is_owner_or_admin(user, namespace)
+                or self.kfam.is_cluster_admin(user)
+            ):
+                return failure(f"{user} is not an owner of {namespace}", 403)
+            contributors = [
+                b["user"]["name"]
+                for b in self.kfam.list_bindings(namespace=namespace)
+                if b.get("user", {}).get("name")
+            ]
+            return success({"contributors": sorted(set(contributors))})
+
         @app.route("/api/workgroup/get-all-namespaces")
         def all_namespaces(request):
             user = user_of(request)

@@ -214,6 +214,12 @@ async function contributorsView() {
     view.append(h("div", { class: "kf-card kf-muted" }, "Pick a namespace first."));
     return view;
   }
+  let contributors = [];
+  try {
+    contributors = (await api(`api/workgroup/contributors/${ns}`)).contributors || [];
+  } catch (e) {
+    view.append(h("div", { class: "kf-card kf-muted" }, e.message));
+  }
   const input = h("input", {
     class: "kf-input",
     id: "contrib-email",
@@ -229,9 +235,40 @@ async function contributorsView() {
         { class: "kf-muted" },
         "Contributors get kubeflow-edit in this namespace via kfam (RoleBinding + AuthorizationPolicy)."
       ),
+      resourceTable({
+        empty: "No contributors yet.",
+        columns: [
+          { title: "Contributor", render: (r) => r },
+          {
+            title: "",
+            render: (r) =>
+              h(
+                "button",
+                {
+                  class: "kf-icon-btn kf-danger",
+                  dataset: { action: "remove", name: r },
+                  onClick: async () => {
+                    try {
+                      await api(`api/workgroup/remove-contributor/${ns}`, {
+                        method: "DELETE",
+                        body: { contributor: r },
+                      });
+                      snackbar(`Removed ${r}`);
+                      render();
+                    } catch (e) {
+                      snackbar(e.message, "error");
+                    }
+                  },
+                },
+                "✕ remove"
+              ),
+          },
+        ],
+        rows: contributors,
+      }),
       h(
         "div",
-        { class: "kf-row" },
+        { class: "kf-row", style: "margin-top:16px" },
         h("div", { class: "kf-field" }, input),
         h(
           "button",

@@ -342,5 +342,27 @@ def test_kfam_and_dashboard(env):
     status, body = dc.get("/api/metrics", user="root@example.com")
     assert body["tpu"][0]["accelerator"] == "tpu-v5-lite-podslice"
     assert body["tpu"][0]["capacityChips"] == 4.0
+
+    # manage-users view: owners (and cluster admins) list contributors;
+    # an unrelated user is refused; removal drops the binding
+    status, body = dc.get(
+        "/api/workgroup/contributors/team-a", user="root@example.com"
+    )
+    assert status == 200 and body["contributors"] == ["bob@example.com"]
+    status, _ = dc.get(
+        "/api/workgroup/contributors/team-a", user="stranger@example.com"
+    )
+    assert status == 403
+    status, _ = dc.request(
+        "DELETE",
+        "/api/workgroup/remove-contributor/team-a",
+        body={"contributor": "bob@example.com"},
+        user="root@example.com",
+    )
+    assert status == 200
+    status, body = dc.get(
+        "/api/workgroup/contributors/team-a", user="root@example.com"
+    )
+    assert body["contributors"] == []
     kfam_server.shutdown()
     dash_server.shutdown()
