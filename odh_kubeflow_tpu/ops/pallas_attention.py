@@ -30,7 +30,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 512
+# swept on v5e (fwd, S∈{1k,4k}): 1024×1024 beats 512×1024 by ~10%;
+# both clamp to the sequence length for shorter inputs
+DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
 
