@@ -1,0 +1,106 @@
+"""Elastic training loop: preemption-aware run/checkpoint/resume.
+
+The platform half of the preemption story lives in the controllers
+(``controllers/notebook.py`` surfaces SlicePreempted and restarts the
+host gang atomically). This is the runtime half, running inside the
+notebook: GKE delivers SIGTERM with a grace period when a spot/
+preemptible TPU slice is reclaimed, so the loop
+
+- installs a SIGTERM/SIGINT handler that requests a graceful stop;
+- saves a final checkpoint (orbax, sharded) before exiting with the
+  distinctive ``PREEMPTED_EXIT_CODE`` so a supervisor (the restarted
+  StatefulSet pod) knows the run can resume;
+- on start, restores the latest checkpoint if one exists — including
+  across a *different* mesh/topology, because
+  ``Trainer.restore_checkpoint`` reshards onto the current mesh (the
+  recovered slice may come back elsewhere).
+
+The reference has no analog (SURVEY.md §7 hard part (d) — preemptible
+TPU slices are a fact the GPU platform never faced).
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Any, Callable, Iterable, Optional
+
+PREEMPTED_EXIT_CODE = 42
+
+
+class PreemptionGuard:
+    """Latches SIGTERM/SIGINT into a flag the step loop polls."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._stop = threading.Event()
+        self._previous = {}
+        self._signals = signals
+
+    def install(self) -> "PreemptionGuard":
+        for sig in self._signals:
+            self._previous[sig] = signal.signal(sig, self._handle)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+
+    def _handle(self, _signum, _frame) -> None:
+        self._stop.set()
+
+    @property
+    def preempted(self) -> bool:
+        return self._stop.is_set()
+
+
+def run_elastic(
+    trainer,
+    manager,
+    batches: Iterable[Any],
+    *,
+    total_steps: int,
+    on_step: Optional[Callable[[int, dict], None]] = None,
+    guard: Optional[PreemptionGuard] = None,
+) -> dict:
+    """Train until ``total_steps`` or preemption.
+
+    Returns ``{"step", "preempted", "resumed_from"}``. On preemption a
+    final checkpoint is forced before returning; callers exit with
+    ``PREEMPTED_EXIT_CODE`` so supervisors distinguish reclaim from
+    crash. ``manager`` is a ``train.checkpoint.CheckpointManager``;
+    its ``save_interval_steps`` policy drives periodic saves, the
+    preemption save bypasses it.
+    """
+    own_guard = guard is None
+    guard = (guard or PreemptionGuard()).install()
+
+    resumed_from = None
+    if manager.latest_step() is not None:
+        resumed_from = trainer.restore_checkpoint(manager)
+
+    metrics: dict = {}
+    try:
+        it = iter(batches)
+        while trainer.step < total_steps and not guard.preempted:
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            metrics = trainer.train_step(batch)
+            trainer.save_checkpoint(manager)
+            if on_step is not None:
+                on_step(trainer.step, metrics)
+        if guard.preempted:
+            # reclaim notice: flush a final checkpoint inside the grace
+            # period, whatever the save-interval policy says
+            trainer.save_checkpoint(manager, force=True)
+            manager.wait_until_finished()
+    finally:
+        if own_guard:
+            guard.uninstall()
+    return {
+        "step": trainer.step,
+        "preempted": guard.preempted,
+        "resumed_from": resumed_from,
+    }

@@ -1,0 +1,101 @@
+"""Elastic run/preempt/resume: the runtime half of the slice-preemption
+story (the controller half is test_notebook_controller's
+SlicePreempted test)."""
+
+import os
+import signal
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from odh_kubeflow_tpu.models import LlamaConfig, LoraConfig
+from odh_kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from odh_kubeflow_tpu.train import TrainConfig, Trainer
+from odh_kubeflow_tpu.train.checkpoint import CheckpointManager
+from odh_kubeflow_tpu.train.elastic import PreemptionGuard, run_elastic
+
+
+@pytest.fixture
+def devices8():
+    devices = jax.devices()
+    assert len(devices) >= 8
+    return devices[:8]
+
+
+def _trainer(devices, mesh_cfg=None):
+    return Trainer(
+        LlamaConfig.tiny(dtype=jnp.float32),
+        TrainConfig(warmup_steps=1, total_steps=50),
+        lora_cfg=LoraConfig(rank=2),
+        mesh=build_mesh(mesh_cfg or MeshConfig(fsdp=8), devices),
+    )
+
+
+def _batches(trainer, n=100):
+    batch = trainer.make_fake_batch(8, 16)
+    return (batch for _ in range(n))
+
+
+def test_runs_to_completion_without_preemption(tmp_path, devices8):
+    trainer = _trainer(devices8)
+    with CheckpointManager(str(tmp_path), save_interval_steps=2) as mgr:
+        result = run_elastic(
+            trainer, mgr, _batches(trainer), total_steps=5
+        )
+        mgr.wait_until_finished()
+        assert result == {"step": 5, "preempted": False, "resumed_from": None}
+        assert mgr.latest_step() is not None
+
+
+def test_sigterm_forces_checkpoint_and_resume_on_new_topology(
+    tmp_path, devices8
+):
+    """SIGTERM mid-run → final checkpoint; a fresh trainer on a
+    DIFFERENT mesh resumes from it at the preempted step (orbax
+    reshards — the slice may come back elsewhere)."""
+    trainer = _trainer(devices8, MeshConfig(fsdp=8))
+    # never save on interval: the only checkpoint must be the forced one
+    with CheckpointManager(str(tmp_path), save_interval_steps=10**6) as mgr:
+        guard = PreemptionGuard().install()
+        try:
+            steps_before_kill = 3
+
+            def on_step(step, _metrics):
+                if step == steps_before_kill:
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+            result = run_elastic(
+                trainer,
+                mgr,
+                _batches(trainer),
+                total_steps=50,
+                on_step=on_step,
+                guard=guard,
+            )
+        finally:
+            guard.uninstall()
+        assert result["preempted"] is True
+        assert result["step"] == steps_before_kill
+        assert mgr.latest_step() == steps_before_kill
+
+    # "pod restarts on the recovered slice", different factorisation
+    trainer2 = _trainer(devices8, MeshConfig(fsdp=4, tensor=2))
+    with CheckpointManager(str(tmp_path), save_interval_steps=10**6) as mgr2:
+        result2 = run_elastic(
+            trainer2, mgr2, _batches(trainer2), total_steps=6
+        )
+    assert result2["resumed_from"] == steps_before_kill
+    assert result2["step"] == 6
+    assert result2["preempted"] is False
+
+
+def test_guard_restores_previous_handlers():
+    before = signal.getsignal(signal.SIGTERM)
+    guard = PreemptionGuard().install()
+    assert signal.getsignal(signal.SIGTERM) != before
+    guard.uninstall()
+    assert signal.getsignal(signal.SIGTERM) == before
