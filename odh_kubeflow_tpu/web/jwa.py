@@ -100,8 +100,51 @@ DEFAULT_CONFIG: Obj = {
             ],
             "readOnly": False,
         },
-        "tolerationGroup": {"value": "", "options": [], "readOnly": False},
-        "affinityConfig": {"value": "", "options": [], "readOnly": False},
+        "tolerationGroup": {
+            "value": "",
+            "options": [
+                {
+                    "groupKey": "spot-tpu",
+                    "displayName": "Schedule on spot/preemptible TPU nodes",
+                    "tolerations": [
+                        {
+                            "key": "cloud.google.com/gke-spot",
+                            "operator": "Equal",
+                            "value": "true",
+                            "effect": "NoSchedule",
+                        }
+                    ],
+                }
+            ],
+            "readOnly": False,
+        },
+        "affinityConfig": {
+            "value": "",
+            "options": [
+                {
+                    "configKey": "same-zone",
+                    "displayName": "Pack into a single zone",
+                    "affinity": {
+                        "podAffinity": {
+                            "preferredDuringSchedulingIgnoredDuringExecution": [
+                                {
+                                    "weight": 100,
+                                    "podAffinityTerm": {
+                                        "labelSelector": {
+                                            "matchLabels": {"tpu-runtime": "enabled"}
+                                        },
+                                        "topologyKey": (
+                                            "topology.kubernetes.io/zone"
+                                        ),
+                                    },
+                                }
+                            ]
+                        }
+                    },
+                }
+            ],
+            "readOnly": False,
+        },
         "configurations": {"value": [], "readOnly": False},
         "shm": {"value": True, "readOnly": False},
     }
@@ -319,6 +362,29 @@ class JupyterWebApp(CrudBackend):
             if tpu.get("topology"):
                 annotations[TPU_TOPOLOGY_ANNOTATION] = tpu["topology"]
             labels["tpu-runtime"] = "enabled"  # PodDefault opt-in
+
+        # tolerationGroup / affinityConfig: admin-defined groups applied
+        # by key (reference form.py:179-223)
+        group_key = self._resolve(body, "tolerationGroup")
+        if group_key and group_key != "none":  # "none" = upstream sentinel
+            for opt in defaults.get("tolerationGroup", {}).get("options", []):
+                if opt.get("groupKey") == group_key:
+                    pod_spec["tolerations"] = obj_util.deepcopy(
+                        opt.get("tolerations", [])
+                    )
+                    break
+            else:
+                return failure(f"unknown tolerationGroup {group_key!r}", 400)
+        affinity_key = self._resolve(body, "affinityConfig")
+        if affinity_key and affinity_key != "none":
+            for opt in defaults.get("affinityConfig", {}).get("options", []):
+                if opt.get("configKey") == affinity_key:
+                    pod_spec["affinity"] = obj_util.deepcopy(
+                        opt.get("affinity", {})
+                    )
+                    break
+            else:
+                return failure(f"unknown affinityConfig {affinity_key!r}", 400)
 
         if self._resolve(body, "shm"):
             pod_spec["volumes"].append(

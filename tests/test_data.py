@@ -1,0 +1,124 @@
+"""Input pipeline: packing correctness (segment walls, targets, loss
+mask), device prefetch sharding, hybrid DCN×ICI mesh, and the packed
+batch actually training with segment-masked attention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from odh_kubeflow_tpu.parallel.mesh import (
+    MeshConfig,
+    build_hybrid_mesh,
+    build_mesh,
+)
+from odh_kubeflow_tpu.train.data import pack_documents, prefetch_to_device
+
+
+@pytest.fixture
+def devices8():
+    devices = jax.devices()
+    assert len(devices) >= 8
+    return devices[:8]
+
+
+def test_pack_documents_segments_targets_mask():
+    docs = [[1, 2, 3], [4, 5], [6, 7, 8, 9, 10, 11, 12]]
+    batches = list(pack_documents(docs, batch_size=2, seq_len=6))
+    assert len(batches) == 1
+    b = batches[0]
+    assert b["tokens"].shape == (2, 6)
+    # row 0: doc1 (seg 1) + doc2 (seg 2) fill 5 slots + 1 pad… then doc3
+    # starts row 1 and overflows into nothing (row 2 dropped w/ B=2)
+    np.testing.assert_array_equal(b["tokens"][0], [1, 2, 3, 4, 5, 6])
+    np.testing.assert_array_equal(b["segment_ids"][0], [1, 1, 1, 2, 2, 3])
+    # targets are next-token *within* a segment; boundaries masked
+    np.testing.assert_array_equal(b["targets"][0][:2], [2, 3])
+    assert b["loss_mask"][0][2] == 0.0  # doc1's last token: no target
+    np.testing.assert_array_equal(b["tokens"][1], [7, 8, 9, 10, 11, 12])
+    # split document continues as its own segment on the next row
+    assert (b["segment_ids"][1] > 0).all()
+    # padding rows would be fully masked
+    assert (b["loss_mask"] <= 1.0).all()
+
+
+def test_pack_documents_pads_and_masks_remainder():
+    docs = [[1, 2, 3, 4]]
+    batches = list(
+        pack_documents(docs, batch_size=2, seq_len=8, drop_remainder=False)
+    )
+    assert len(batches) == 1
+    b = batches[0]
+    assert (b["segment_ids"][0][:4] == 1).all()
+    assert (b["segment_ids"][0][4:] == 0).all()  # padding
+    assert (b["loss_mask"][0][4:] == 0).all()
+    assert (b["tokens"][1] == 0).all()  # padded row
+    assert (b["loss_mask"][1] == 0).all()
+
+
+def test_prefetch_to_device_shards_and_preserves_order(devices8):
+    mesh = build_mesh(MeshConfig(data=2, fsdp=4), devices8)
+    batches = [
+        {
+            "tokens": np.full((8, 8), i, np.int32),
+            "targets": np.full((8, 8), i, np.int32),
+        }
+        for i in range(5)
+    ]
+    out = list(prefetch_to_device(iter(batches), mesh, buffer_size=2))
+    assert len(out) == 5
+    for i, b in enumerate(out):
+        assert int(b["tokens"][0, 0]) == i  # order preserved
+        assert "data" in str(b["tokens"].sharding.spec)
+
+
+def test_hybrid_mesh_shape_and_collectives(devices8):
+    """dcn(data=2) × ici(fsdp=4): the composed mesh trains a step —
+    gradient all-reduce rides the DCN axis, param sharding the ICI
+    one (on CPU both are simulated; the factorisation is what's under
+    test)."""
+    mesh = build_hybrid_mesh(
+        MeshConfig(fsdp=4), MeshConfig(data=2), devices8
+    )
+    assert mesh.shape["data"] == 2 and mesh.shape["fsdp"] == 4
+
+    from odh_kubeflow_tpu.models import LlamaConfig, LoraConfig
+    from odh_kubeflow_tpu.train import TrainConfig, Trainer
+
+    trainer = Trainer(
+        LlamaConfig.tiny(dtype=jnp.float32),
+        TrainConfig(warmup_steps=1, total_steps=4),
+        lora_cfg=LoraConfig(rank=2),
+        mesh=mesh,
+    )
+    metrics = trainer.train_step(trainer.make_fake_batch(8, 16))
+    assert np.isfinite(float(metrics["loss"]))
+
+    with pytest.raises(ValueError):
+        build_hybrid_mesh(MeshConfig(fsdp=4), MeshConfig(data=4), devices8)
+
+
+def test_packed_batch_trains_with_segment_masking(devices8):
+    """End-to-end: packed documents (segment walls + loss mask) through
+    the sharded trainer with prefetch — the full input-pipeline path."""
+    from odh_kubeflow_tpu.models import LlamaConfig, LoraConfig
+    from odh_kubeflow_tpu.train import TrainConfig, Trainer
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    mesh = build_mesh(MeshConfig(data=2, fsdp=4), devices8)
+    trainer = Trainer(
+        cfg,
+        TrainConfig(warmup_steps=1, total_steps=4),
+        lora_cfg=LoraConfig(rank=2),
+        mesh=mesh,
+    )
+    rng = np.random.default_rng(0)
+    docs = [
+        rng.integers(1, cfg.vocab_size, size=rng.integers(3, 20)).tolist()
+        for _ in range(64)
+    ]
+    stream = prefetch_to_device(
+        pack_documents(docs, batch_size=8, seq_len=16), mesh
+    )
+    losses = [float(trainer.train_step(b)["loss"]) for b in stream]
+    assert losses and all(np.isfinite(losses))

@@ -366,3 +366,36 @@ def test_kfam_and_dashboard(env):
     assert body["contributors"] == []
     kfam_server.shutdown()
     dash_server.shutdown()
+
+
+def test_jwa_toleration_and_affinity_groups(jwa_client):
+    """tolerationGroup/affinityConfig resolve by admin key onto the pod
+    spec (reference form.py:179-223); unknown keys are 400s."""
+    client, api, cluster, mgr = jwa_client
+    status, _ = client.post(
+        "/api/namespaces/team-a/notebooks",
+        body={
+            "name": "spot-nb",
+            "image": "odh-kubeflow-tpu/jupyter-jax-tpu:v0.1.0",
+            "cpu": "1",
+            "memory": "1Gi",
+            "tolerationGroup": "spot-tpu",
+            "affinityConfig": "same-zone",
+        },
+    )
+    assert status == 201
+    nb = api.get("Notebook", "spot-nb", "team-a")
+    pod_spec = nb["spec"]["template"]["spec"]
+    assert pod_spec["tolerations"][0]["key"] == "cloud.google.com/gke-spot"
+    assert "podAffinity" in pod_spec["affinity"]
+
+    status, body = client.post(
+        "/api/namespaces/team-a/notebooks",
+        body={
+            "name": "bad-nb",
+            "image": "x",
+            "tolerationGroup": "no-such-group",
+        },
+    )
+    assert status == 400
+    assert "tolerationGroup" in body["log"]
