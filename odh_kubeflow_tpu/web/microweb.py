@@ -35,11 +35,11 @@ class Request:
         self.environ = environ
         self.method = environ.get("REQUEST_METHOD", "GET").upper()
         self.path = environ.get("PATH_INFO", "/")
-        self.query = {}
-        for pair in (environ.get("QUERY_STRING") or "").split("&"):
-            if "=" in pair:
-                k, _, v = pair.partition("=")
-                self.query[k] = v
+        from urllib.parse import parse_qsl
+
+        self.query = dict(
+            parse_qsl(environ.get("QUERY_STRING") or "", keep_blank_values=True)
+        )
         self.headers = {
             k[5:].replace("_", "-").lower(): v
             for k, v in environ.items()
