@@ -298,11 +298,21 @@ def _decoder_layer(
 
 
 def resolved_attention_impl(cfg: LlamaConfig) -> str:
-    """'auto' → the pallas flash kernel on a TPU backend (the regime it
-    was written for), dense XLA einsum everywhere else (CPU tests would
-    only ever run flash in slow interpret mode)."""
+    """'auto' resolution, in priority order:
+
+    1. ring — when the active mesh shards the ``context`` axis >1,
+       attention must be context-parallel (any other impl would
+       silently compute block-diagonal attention over the shards);
+    2. flash — pallas kernel on a TPU backend (the regime it was
+       written for);
+    3. dense — everywhere else (CPU tests would only ever run flash in
+       slow interpret mode).
+    """
     if cfg.attention_impl != "auto":
         return cfg.attention_impl
+    am = jax.sharding.get_abstract_mesh()
+    if not am.empty and am.shape.get(AXIS_CONTEXT, 1) > 1:
+        return "ring"
     try:
         backend = jax.default_backend()
     except Exception:  # noqa: BLE001 — no backend yet

@@ -106,3 +106,41 @@ def test_num_params_matches_init():
     params = init_params(jax.random.key(0), cfg)
     actual = sum(x.size for x in jax.tree_util.tree_leaves(params))
     assert actual == cfg.num_params()
+
+
+def test_auto_attention_resolves_to_ring_on_context_mesh(devices8):
+    """attention_impl='auto' must pick ring attention whenever the
+    active mesh shards the context axis — anything else would silently
+    compute block-diagonal attention over the sequence shards."""
+    from odh_kubeflow_tpu.models.llama import resolved_attention_impl
+    from odh_kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    cfg = LlamaConfig.tiny()
+    assert cfg.attention_impl == "auto"
+    mesh = build_mesh(MeshConfig(context=2, fsdp=4), devices8)
+    with jax.set_mesh(mesh):
+        assert resolved_attention_impl(cfg) == "ring"
+    mesh2 = build_mesh(MeshConfig(fsdp=8), devices8)
+    with jax.set_mesh(mesh2):
+        assert resolved_attention_impl(cfg) in ("dense", "flash")
+
+
+def test_auto_attention_trains_context_parallel(devices8):
+    """A trainer on a context>1 mesh with the default 'auto' impl runs
+    and matches the explicit-ring loss."""
+    from odh_kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from odh_kubeflow_tpu.train import TrainConfig, Trainer
+    from odh_kubeflow_tpu.models import LoraConfig
+
+    mesh = build_mesh(MeshConfig(context=2, fsdp=2, tensor=2), devices8)
+    losses = {}
+    for impl in ("auto", "ring"):
+        trainer = Trainer(
+            LlamaConfig.tiny(dtype=jnp.float32, attention_impl=impl),
+            TrainConfig(warmup_steps=1, total_steps=4),
+            lora_cfg=LoraConfig(rank=2),
+            mesh=mesh,
+        )
+        batch = trainer.make_fake_batch(4, 32)
+        losses[impl] = float(trainer.train_step(batch)["loss"])
+    assert abs(losses["auto"] - losses["ring"]) < 1e-5
