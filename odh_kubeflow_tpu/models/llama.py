@@ -432,6 +432,11 @@ def forward_with_cache(
 
     def body(x, scanned):
         layer, lora_layer, cache_layer = scanned
+        # int8-quantized weights (models/quant.py) dequantize HERE,
+        # inside the scan body: only the current layer's bf16 copy ever
+        # materialises, so an 8B model serves from ~8GB of int8 on one
+        # v5e instead of 16GB of bf16 that wouldn't fit.
+        layer = _maybe_dequant(layer, cfg.dtype)
         x, new_cache = _decoder_layer(
             cfg,
             None,  # attention_fn unused: cache path is always dense
@@ -453,7 +458,21 @@ def forward_with_cache(
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    if isinstance(head, dict):  # quantized lm_head
+        head = _maybe_dequant({"lm_head": head}, cfg.dtype)["lm_head"]
     logits = jnp.einsum(
         "bsd,dv->bsv", x, head.astype(cfg.dtype), preferred_element_type=jnp.float32
     )
     return logits, new_cache
+
+
+def _maybe_dequant(tree: Params, dtype) -> Params:
+    """Dequantize any {"q","scale"} leaves one level down (the shape a
+    per-layer slice of a quantized param tree has)."""
+    out = {}
+    for k, v in tree.items():
+        if isinstance(v, dict) and set(v) == {"q", "scale"}:
+            out[k] = (v["q"].astype(dtype) * v["scale"].astype(dtype)).astype(dtype)
+        else:
+            out[k] = v
+    return out

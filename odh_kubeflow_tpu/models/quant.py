@@ -1,0 +1,107 @@
+"""Weight-only int8 quantization for decode.
+
+Autoregressive decode is HBM-bandwidth-bound: every generated token
+streams the full weight matrix set through the MXU at trivial
+arithmetic intensity, so halving the bytes (bf16 → int8 + per-channel
+scales) is roughly a 2× decode-throughput lever — the classic
+weight-only-quant serving recipe. The reference platform has no
+serving stack at all; this completes the rebuild's
+fine-tune→generate story (``models/generate.py``) with a quantized
+path.
+
+Scheme: symmetric per-output-channel int8. For a weight ``W[..., D_in,
+D_out]`` the scale is ``max|W|/127`` over ``D_in`` (one scale per
+output channel, broadcastable at dequant). Matmuls compute
+``x @ (q * scale)`` — XLA fuses the dequant multiply into the einsum,
+so the HBM read is int8 and the MXU still sees bf16 operands.
+Embeddings and norms stay bf16 (lookup tables and 1-D vectors are not
+the bandwidth story); the LM head is quantized like any other matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+# leaves quantized by name (matmul weights); everything else passes
+# through in its original dtype
+_QUANT_LEAVES = {
+    "wq", "wk", "wv", "wo",
+    "w_gate", "w_up", "w_down",
+    "lm_head",
+    "moe_gate", "moe_up", "moe_down", "router",
+}
+
+
+def quantize_tensor(w: jnp.ndarray) -> dict[str, jnp.ndarray]:
+    """Symmetric per-output-channel int8: scale over the next-to-last
+    axis (D_in), one scale per output channel."""
+    amax = jnp.max(jnp.abs(w), axis=-2, keepdims=True)
+    scale = (amax / 127.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-12)), -127, 127).astype(
+        jnp.int8
+    )
+    return {"q": q, "scale": scale}
+
+
+def dequantize_tensor(t: dict[str, jnp.ndarray], dtype=jnp.bfloat16) -> jnp.ndarray:
+    return (t["q"].astype(dtype) * t["scale"].astype(dtype)).astype(dtype)
+
+
+def quantize_params(params: Params) -> Params:
+    """Quantize the matmul weights of a Llama/MoE param tree in place
+    of the bf16 leaves; non-matmul leaves pass through unchanged."""
+
+    def walk(tree):
+        if isinstance(tree, dict):
+            return {
+                k: (
+                    quantize_tensor(v)
+                    if k in _QUANT_LEAVES and hasattr(v, "shape")
+                    else walk(v)
+                )
+                for k, v in tree.items()
+            }
+        return tree
+
+    return walk(params)
+
+
+def dequantize_params(qparams: Params, dtype=jnp.bfloat16) -> Params:
+    """The jit-traceable inverse: same tree with bf16 matmul weights.
+
+    Used as ``forward(dequantize_params(qp), ...)`` — XLA fuses each
+    leaf's ``int8 load → scale-multiply`` into its consuming einsum, so
+    the dequantized tensor never round-trips to HBM. The model code
+    needs no quant-awareness at all.
+    """
+
+    def walk(tree):
+        if isinstance(tree, dict):
+            if set(tree) == {"q", "scale"}:
+                return dequantize_tensor(tree, dtype)
+            return {k: walk(v) for k, v in tree.items()}
+        return tree
+
+    return walk(qparams)
+
+
+def quantization_error(params: Params, qparams: Params) -> dict[str, float]:
+    """Max relative error per quantized leaf (diagnostics)."""
+    out = {}
+
+    def walk(p, q, path):
+        if isinstance(q, dict) and set(q) == {"q", "scale"}:
+            deq = dequantize_tensor(q, jnp.float32)
+            denom = jnp.maximum(jnp.max(jnp.abs(p)), 1e-9)
+            out[path] = float(jnp.max(jnp.abs(p.astype(jnp.float32) - deq)) / denom)
+        elif isinstance(q, dict):
+            for k in q:
+                walk(p[k], q[k], f"{path}/{k}" if path else k)
+
+    walk(params, qparams, "")
+    return out

@@ -1,0 +1,95 @@
+"""Weight-only int8 quantization: round-trip fidelity, generation
+quality vs the bf16 path, and the bytes actually halving."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from odh_kubeflow_tpu.models import GenerateConfig, LlamaConfig, generate
+from odh_kubeflow_tpu.models import llama
+from odh_kubeflow_tpu.models.quant import (
+    dequantize_params,
+    quantization_error,
+    quantize_params,
+    quantize_tensor,
+)
+
+
+def test_quantize_tensor_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+    t = quantize_tensor(w)
+    assert t["q"].dtype == jnp.int8
+    assert t["scale"].shape == (1, 32)  # per-output-channel
+    deq = t["q"].astype(jnp.float32) * t["scale"]
+    # symmetric int8: error ≤ scale/2 per element
+    err = np.abs(np.asarray(w) - np.asarray(deq))
+    bound = np.asarray(t["scale"])[0] / 2 + 1e-6
+    assert (err <= bound[None, :]).all()
+
+
+def test_quantize_params_halves_matmul_bytes_and_is_traceable():
+    cfg = LlamaConfig.tiny(dtype=jnp.bfloat16)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.bfloat16)
+    qparams = quantize_params(params)
+
+    def nbytes(tree):
+        return sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(tree)
+            if hasattr(leaf, "dtype")
+        )
+
+    matmul_before = nbytes(
+        {k: v for k, v in params["layers"].items() if k.startswith("w")}
+    )
+    matmul_after = nbytes(
+        {k: v for k, v in qparams["layers"].items() if k.startswith("w")}
+    )
+    # int8 payload + f32 scales ≈ half the bf16 bytes
+    assert matmul_after < 0.62 * matmul_before
+
+    errs = quantization_error(params, qparams)
+    assert errs and all(e < 0.02 for e in errs.values()), errs
+
+    # dequant is jit-traceable and forward agrees closely with bf16
+    tokens = jnp.arange(16, dtype=jnp.int32)[None, :] % cfg.vocab_size
+    logits_fp = llama.forward(params, tokens, cfg)
+    logits_q = jax.jit(
+        lambda qp, t: llama.forward(dequantize_params(qp), t, cfg)
+    )(qparams, tokens)
+    # rank-1 agreement on next-token argmax for most positions
+    agree = np.mean(
+        np.asarray(jnp.argmax(logits_fp, -1) == jnp.argmax(logits_q, -1))
+    )
+    assert agree > 0.75, agree
+
+
+def test_quantized_generation_runs_and_matches_shapes():
+    cfg = LlamaConfig.tiny(dtype=jnp.bfloat16)
+    params = llama.init_params(jax.random.PRNGKey(1), cfg, dtype=jnp.bfloat16)
+    qparams = quantize_params(params)
+    gen_cfg = GenerateConfig(max_new_tokens=8, temperature=0.0)
+    prompt = jnp.ones((2, 4), jnp.int32)
+
+    out_fp = generate(params, prompt, cfg, gen_cfg)
+    out_q = generate(dequantize_params(qparams), prompt, cfg, gen_cfg)
+    assert out_q["tokens"].shape == out_fp["tokens"].shape
+    assert (np.asarray(out_q["lengths"]) > 0).all()
+
+
+def test_generate_accepts_quantized_params_directly():
+    """forward_with_cache dequantizes per layer inside the scan — the
+    int8 tree feeds generate() as-is, and the result is identical to
+    dequantizing the whole tree upfront (same math, a fraction of the
+    peak memory — the path that fits 8B serving on one v5e)."""
+    cfg = LlamaConfig.tiny(dtype=jnp.bfloat16)
+    params = llama.init_params(jax.random.PRNGKey(2), cfg, dtype=jnp.bfloat16)
+    qparams = quantize_params(params)
+    gen_cfg = GenerateConfig(max_new_tokens=8, temperature=0.0)
+    prompt = jnp.ones((2, 4), jnp.int32)
+
+    out_direct = generate(qparams, prompt, cfg, gen_cfg)
+    out_upfront = generate(dequantize_params(qparams), prompt, cfg, gen_cfg)
+    np.testing.assert_array_equal(
+        np.asarray(out_direct["tokens"]), np.asarray(out_upfront["tokens"])
+    )
