@@ -99,6 +99,14 @@ def main() -> None:
     n = len(devices)
     peak = peak_flops_per_chip(devices[0]) * n
     fast = os.environ.get("BENCH_FAST", "").lower() in ("1", "true")
+    # soft wall-clock budget: the headline number must always make it
+    # out even if cold compiles eat the driver's timeout — extras are
+    # skipped once the budget is spent
+    t_start = time.time()
+    budget_s = float(os.environ.get("BENCH_BUDGET", "420"))
+
+    def over_budget() -> bool:
+        return time.time() - t_start > budget_s
 
     batch_size = int(os.environ.get("BENCH_BATCH", "8"))
     seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
@@ -128,7 +136,7 @@ def main() -> None:
         "loss": round(stats["loss"], 4),
     }
 
-    if not fast:
+    if not fast and not over_budget():
         # the hard regime: 16k context, attention-dominant. Needs all
         # three long-context levers at once: the pallas flash kernel
         # (dense logits at 16k OOM), chunked cross-entropy (full
@@ -144,25 +152,32 @@ def main() -> None:
             lora_cfg=LoraConfig(rank=16),
             mesh=mesh,
         )
-        long_stats = long_trainer.benchmark(max(1, n), long_seq, steps=3, warmup=1)
-        long_detail = {
-            "seq": long_seq,
-            "batch": max(1, n),
-            "attention_impl": impl,
-            "step_time_s": round(long_stats["step_time_s"], 4),
-            "tokens_per_s": round(long_stats["tokens_per_s"], 1),
-        }
-        if peak > 0:
-            long_detail["mfu"] = round(long_stats["flops_per_s"] / peak, 4)
-        detail["long_context"] = long_detail
         try:
-            detail["attention_op_ms"] = _attention_op_compare(jax, jnp)
-        except Exception as e:  # noqa: BLE001 — comparison is best-effort
-            detail["attention_op_ms"] = {"error": str(e)[:200]}
-        try:
-            detail["generate"] = _generate_smoke(jax, jnp, long_trainer)
-        except Exception as e:  # noqa: BLE001 — smoke is best-effort
-            detail["generate"] = {"error": str(e)[:200]}
+            long_stats = long_trainer.benchmark(
+                max(1, n), long_seq, steps=3, warmup=1
+            )
+            long_detail = {
+                "seq": long_seq,
+                "batch": max(1, n),
+                "attention_impl": impl,
+                "step_time_s": round(long_stats["step_time_s"], 4),
+                "tokens_per_s": round(long_stats["tokens_per_s"], 1),
+            }
+            if peak > 0:
+                long_detail["mfu"] = round(long_stats["flops_per_s"] / peak, 4)
+            detail["long_context"] = long_detail
+        except Exception as e:  # noqa: BLE001 — keep the headline alive
+            detail["long_context"] = {"error": str(e)[:200]}
+        if not over_budget():
+            try:
+                detail["attention_op_ms"] = _attention_op_compare(jax, jnp)
+            except Exception as e:  # noqa: BLE001 — best-effort
+                detail["attention_op_ms"] = {"error": str(e)[:200]}
+        if not over_budget():
+            try:
+                detail["generate"] = _generate_smoke(jax, jnp, long_trainer)
+            except Exception as e:  # noqa: BLE001 — best-effort
+                detail["generate"] = {"error": str(e)[:200]}
 
     if peak > 0:
         value = stats["flops_per_s"] / peak
