@@ -146,13 +146,14 @@ def main() -> None:
 
         long_seq = int(os.environ.get("BENCH_LONG_SEQ", "16384"))
         del trainer  # free the headline trainer's param copy first
-        long_trainer = Trainer(
-            _dc.replace(cfg, remat_policy="none"),
-            TrainConfig(warmup_steps=2, total_steps=100),
-            lora_cfg=LoraConfig(rank=16),
-            mesh=mesh,
-        )
+        long_trainer = None
         try:
+            long_trainer = Trainer(
+                _dc.replace(cfg, remat_policy="none"),
+                TrainConfig(warmup_steps=2, total_steps=100),
+                lora_cfg=LoraConfig(rank=16),
+                mesh=mesh,
+            )
             long_stats = long_trainer.benchmark(
                 max(1, n), long_seq, steps=3, warmup=1
             )
@@ -168,16 +169,25 @@ def main() -> None:
             detail["long_context"] = long_detail
         except Exception as e:  # noqa: BLE001 — keep the headline alive
             detail["long_context"] = {"error": str(e)[:200]}
-        if not over_budget():
+        skipped = []
+        if over_budget():
+            skipped.append("attention_op_ms")
+        else:
             try:
                 detail["attention_op_ms"] = _attention_op_compare(jax, jnp)
             except Exception as e:  # noqa: BLE001 — best-effort
                 detail["attention_op_ms"] = {"error": str(e)[:200]}
-        if not over_budget():
+        if over_budget() or long_trainer is None:
+            skipped.append("generate")
+        else:
             try:
                 detail["generate"] = _generate_smoke(jax, jnp, long_trainer)
             except Exception as e:  # noqa: BLE001 — best-effort
                 detail["generate"] = {"error": str(e)[:200]}
+        if skipped:
+            detail["skipped_for_budget"] = skipped
+    elif not fast:
+        detail["skipped_for_budget"] = ["long_context", "attention_op_ms", "generate"]
 
     if peak > 0:
         value = stats["flops_per_s"] / peak

@@ -188,6 +188,52 @@ def test_admission_review_over_tls():
             httpd.shutdown()
 
 
+def test_silent_client_does_not_block_tls_serving():
+    """A connection that never sends a ClientHello must not park the
+    accept loop: the handshake happens per-connection in the handler
+    thread (with a timeout), so concurrent real requests keep flowing.
+    Regression test for the failurePolicy=Fail outage mode where one
+    port-scanner connection would block every Pod create."""
+    import socket
+    import ssl
+    import tempfile
+    import urllib.request
+
+    from odh_kubeflow_tpu.webhooks.certs import generate_webhook_certs
+    from odh_kubeflow_tpu.webhooks.server import make_ssl_context
+
+    api = APIServer()
+    register_crds(api)
+    server = AdmissionServer().handle(
+        "/apply-poddefault", PodDefaultWebhook(api).mutate
+    )
+    with tempfile.TemporaryDirectory() as d:
+        bundle = generate_webhook_certs(dns_names=["localhost"])
+        cert_file, key_file, ca_file = bundle.write(d)
+        httpd = server.app.serve(
+            "127.0.0.1", 0, ssl_context=make_ssl_context(cert_file, key_file)
+        )
+        port = httpd.server_address[1]
+        try:
+            # park a mute TCP connection on the TLS port
+            mute = socket.create_connection(("127.0.0.1", port), timeout=10)
+            try:
+                ctx = ssl.create_default_context(cafile=ca_file)
+                # the urlopen timeout is the real detector: a handshake
+                # done in the accept loop parks this request behind the
+                # mute connection until it raises URLError
+                with urllib.request.urlopen(
+                    f"https://localhost:{port}/healthz",
+                    context=ctx,
+                    timeout=10,
+                ) as r:
+                    assert r.read() == b"ok"
+            finally:
+                mute.close()
+        finally:
+            httpd.shutdown()
+
+
 def test_cert_bootstrap_idempotent_and_patches_cabundle():
     """ensure_cert_secret + patch_ca_bundle: first run generates, second
     run reuses; the MutatingWebhookConfiguration ends up carrying the
