@@ -88,6 +88,7 @@ class Culler:
         self._tpu_url_fn = tpu_url_fn
         self.now = now_fn
         self.m_cull = cull_counter
+        self.m_last_cull = None  # gauge, wired by the notebook controller
 
     def _default_base_url(self, notebook: Obj) -> str:
         name = obj_util.name_of(notebook)
@@ -203,6 +204,8 @@ class Culler:
             obj_util.set_annotation(notebook, STOP_ANNOTATION, _fmt_time(now))
             if self.m_cull is not None:
                 self.m_cull.inc()
+            if self.m_last_cull is not None:
+                self.m_last_cull.set(now)
             self.api.emit_event(
                 notebook,
                 "Culling",

@@ -145,7 +145,17 @@ class NotebookController:
             "notebook_create_failed_total", "Failed creations"
         )
         self.m_cull = reg.counter("notebook_culling_total", "Culled notebooks")
+        self.m_last_cull = reg.gauge(
+            "last_notebook_culling_timestamp_seconds",
+            "Timestamp of the last notebook culling in seconds",
+        )
         reg.register_collector(self._collect_running)
+        # wire the metrics into the culler (reference metrics.go:13-20:
+        # the culling counter/timestamp are the controller's metrics,
+        # incremented when the cull decision fires)
+        if culler is not None and getattr(culler, "m_cull", None) is None:
+            culler.m_cull = self.m_cull
+            culler.m_last_cull = self.m_last_cull
 
     def _collect_running(self):
         n = 0

@@ -191,3 +191,47 @@ def test_unreachable_server_initializes_then_culls(jupyter_server):
     mgr.drain()
     nb = api.get("Notebook", "nb1", "team-a")
     assert STOP_ANNOTATION in nb["metadata"]["annotations"]
+
+
+def test_culling_metrics_fire(jupyter_server):
+    """notebook_culling_total + last_notebook_culling_timestamp_seconds
+    (reference pkg/metrics/metrics.go:13-20) increment when the cull
+    decision fires through the controller-wired culler."""
+    from odh_kubeflow_tpu.utils.prometheus import Registry
+
+    clock = {"t": 5_000_000.0}
+    api = APIServer()
+    register_crds(api)
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-0")
+    registry = Registry()
+    culler = Culler(
+        api,
+        CullerConfig(cull_idle_seconds=600, idleness_check_seconds=60),
+        base_url_fn=lambda nb: "http://127.0.0.1:1",
+        now_fn=lambda: clock["t"],
+    )
+    mgr = Manager(api, time_fn=lambda: clock["t"])
+    from odh_kubeflow_tpu.controllers.notebook import (
+        NotebookController,
+        NotebookControllerConfig,
+    )
+
+    NotebookController(
+        api,
+        NotebookControllerConfig(enable_culling=True),
+        registry=registry,
+        culler=culler,
+    ).register(mgr)
+    api.create(notebook())
+    mgr.drain()
+    cluster.step()
+    clock["t"] += 61
+    mgr.drain()
+    clock["t"] += 700
+    mgr.drain()
+    nb = api.get("Notebook", "nb1", "team-a")
+    assert STOP_ANNOTATION in nb["metadata"]["annotations"]
+    text = registry.exposition()
+    assert "notebook_culling_total 1" in text
+    assert "last_notebook_culling_timestamp_seconds 5000761" in text
