@@ -38,6 +38,7 @@ from odh_kubeflow_tpu.parallel.mesh import (
     AXIS_DATA,
     AXIS_EXPERT,
     AXIS_FSDP,
+    AXIS_PIPE,
     AXIS_TENSOR,
     constrain,
 )
@@ -349,29 +350,7 @@ def _select_attention(cfg: LlamaConfig) -> Callable:
     )
 
 
-def forward(
-    params: Params,
-    tokens: jnp.ndarray,  # [B, S] int32
-    cfg: LlamaConfig,
-    lora: Optional[Params] = None,
-    positions: Optional[jnp.ndarray] = None,
-    segment_ids: Optional[jnp.ndarray] = None,
-    return_hidden: bool = False,
-) -> jnp.ndarray:
-    """Returns logits [B, S, V] in float32 — or, with
-    ``return_hidden=True``, the final-norm hidden states [B, S, D] so
-    the caller can run the LM head chunk-wise (long-context training:
-    a full [S, V] logits tensor at S=16k and V=128k is 8GB+ and is the
-    thing that OOMs, not attention — see
-    ``train.trainer.chunked_cross_entropy``)."""
-    B, S = tokens.shape
-    if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-    sin, cos = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
-
-    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
-    attention_fn = _select_attention(cfg)
-
+def _make_layer_fn(cfg: LlamaConfig, attention_fn: Callable) -> Callable:
     layer_fn = partial(_decoder_layer, cfg, attention_fn)
     if cfg.remat:
         if cfg.remat_policy == "dots":
@@ -381,15 +360,60 @@ def forward(
             )
         else:  # "none": full recompute, minimum residency
             layer_fn = jax.checkpoint(layer_fn)
+    return layer_fn
 
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32
+    cfg: LlamaConfig,
+    lora: Optional[Params] = None,
+    positions: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    return_hidden: bool = False,
+    pipeline_microbatches: int = 8,
+) -> jnp.ndarray:
+    """Returns logits [B, S, V] in float32 — or, with
+    ``return_hidden=True``, the final-norm hidden states [B, S, D] so
+    the caller can run the LM head chunk-wise (long-context training:
+    a full [S, V] logits tensor at S=16k and V=128k is 8GB+ and is the
+    thing that OOMs, not attention — see
+    ``train.trainer.chunked_cross_entropy``).
+
+    When the active mesh shards the ``pipe`` axis, the layer stack runs
+    through the GPipe combinator (``parallel/pipeline.py``) with
+    ``pipeline_microbatches`` microbatches; embeddings, final norm, and
+    the LM head stay outside the pipeline (replicated compute)."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    sin, cos = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    attention_fn = _select_attention(cfg)
+    layer_fn = _make_layer_fn(cfg, attention_fn)
     lora_layers = lora["layers"] if lora is not None else None
 
-    def body(x, scanned):
-        layer, lora_layer = scanned
-        x, _ = layer_fn(x, layer, lora_layer, sin, cos, segment_ids)
-        return x, None
+    am = jax.sharding.get_abstract_mesh()
+    pipe = 0 if am.empty else am.shape.get(AXIS_PIPE, 1)
+    if pipe > 1:
+        x = _apply_layers_pipelined(
+            cfg,
+            layer_fn,
+            params["layers"],
+            lora_layers,
+            x,
+            positions,
+            segment_ids,
+            pipeline_microbatches,
+        )
+    else:
+        def body(x, scanned):
+            layer, lora_layer = scanned
+            x, _ = layer_fn(x, layer, lora_layer, sin, cos, segment_ids)
+            return x, None
 
-    x, _ = jax.lax.scan(body, x, (params["layers"], lora_layers))
+        x, _ = jax.lax.scan(body, x, (params["layers"], lora_layers))
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     if return_hidden:
@@ -399,6 +423,77 @@ def forward(
         "bsd,dv->bsv", x, head.astype(cfg.dtype), preferred_element_type=jnp.float32
     )
     return logits
+
+
+def _apply_layers_pipelined(
+    cfg: LlamaConfig,
+    layer_fn: Callable,
+    layers: Params,
+    lora_layers: Optional[Params],
+    x: jnp.ndarray,  # [B, S, D]
+    positions: jnp.ndarray,  # [B, S]
+    segment_ids: Optional[jnp.ndarray],
+    num_microbatches: int,
+) -> jnp.ndarray:
+    """Decoder stack over the pipe axis. Rope angles and segment ids
+    are per-microbatch constants: they ride the pipeline's ``aux``
+    channel so every stage sees the slice belonging to the microbatch
+    it is currently processing."""
+    from odh_kubeflow_tpu.parallel.pipeline import pipeline_apply
+
+    B, S, D = x.shape
+    M = num_microbatches
+    mb = B // M if B % M == 0 else 0
+    if mb == 0:
+        raise ValueError(
+            f"batch {B} not divisible by pipeline_microbatches={M}"
+        )
+    sin, cos = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    def split(a):
+        return None if a is None else a.reshape(M, mb, *a.shape[1:])
+
+    aux = {"sin": split(jnp.broadcast_to(sin, (B, *sin.shape[1:]))),
+           "cos": split(jnp.broadcast_to(cos, (B, *cos.shape[1:])))}
+    if segment_ids is not None:
+        aux["segment_ids"] = split(segment_ids)
+
+    stage_params = {"layers": layers}
+    if lora_layers is not None:
+        stage_params["lora"] = lora_layers
+
+    def stage_fn(stage, x_flat, aux_t):
+        xx = x_flat.reshape(x_flat.shape[0], S, D)
+        seg = aux_t.get("segment_ids")
+
+        def body(xx, scanned_idx):
+            layer = jax.tree_util.tree_map(
+                lambda l: l[scanned_idx], stage["layers"]
+            )
+            lora_layer = (
+                jax.tree_util.tree_map(
+                    lambda l: l[scanned_idx], stage["lora"]
+                )
+                if "lora" in stage
+                else None
+            )
+            xx, _ = layer_fn(
+                xx, layer, lora_layer, aux_t["sin"], aux_t["cos"], seg
+            )
+            return xx, None
+
+        n_local = jax.tree_util.tree_leaves(stage["layers"])[0].shape[0]
+        xx, _ = jax.lax.scan(body, xx, jnp.arange(n_local))
+        return xx.reshape(x_flat.shape[0], S * D)
+
+    y = pipeline_apply(
+        stage_fn,
+        stage_params,
+        x.reshape(B, S * D),
+        num_microbatches=M,
+        aux=aux,
+    )
+    return y.reshape(B, S, D)
 
 
 def lm_head_weight(params: Params, cfg: LlamaConfig) -> jnp.ndarray:

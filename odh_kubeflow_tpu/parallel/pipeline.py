@@ -5,28 +5,34 @@ The reference platform has no parallelism layer (SURVEY.md §2.4); this
 module completes the rebuild's dp/fsdp/ep/cp/tp/pp axis set. Design is
 the standard JAX/TPU pipelining pattern ("How to Scale Your Model"):
 
-- the layer stack is pre-split into S equal stages whose parameters
-  carry a leading stage dim sharded over ``pipe`` — ``shard_map``
-  hands each device exactly its stage's weights, nothing moves;
-- the batch is split into M microbatches; inside one ``lax.scan`` over
-  M+S-1 ticks, every device runs its stage on the microbatch it holds
-  and passes the activation to the next stage with a single
-  ``ppermute`` hop (point-to-point, ICI/DCN-friendly);
+- layer-stacked parameters ([L, ...] leaves) are sharded over ``pipe``
+  on their leading dim — device p holds layers [p·L/S, (p+1)·L/S), its
+  stage, with no data movement;
+- ``shard_map`` runs **manual over the pipe axis only**
+  (``axis_names={'pipe'}``): the schedule below is hand-written, while
+  fsdp/tensor/expert shardings inside each stage stay under GSPMD
+  exactly as in non-pipelined execution — the two compose;
+- the batch is split into M microbatches; one ``lax.scan`` over M+S-1
+  ticks runs each device's stage on the microbatch it holds and passes
+  the activation to the next stage with a single ``ppermute`` hop
+  (point-to-point, DCN-tolerant — pipeline stages are the natural
+  cross-slice axis);
 - schedule bubble = (S-1)/(M+S-1), the GPipe trade; gradients flow
-  through the scan + ppermute (whose transpose is the reverse
-  ppermute), so ``jax.grad`` of a pipelined forward just works — no
-  hand-written backward schedule.
+  through scan + ppermute (whose transpose is the reverse ppermute),
+  so ``jax.grad`` of a pipelined forward needs no hand-written
+  backward schedule.
 
-Constraints (by design, to stay XLA-friendly): the stage function must
-be shape-preserving ([mb, ...] in = out, true of transformer blocks),
-every stage runs the same ``stage_fn`` over its own weights, and
-M % microbatches must divide the batch.
+Constraints (by design, to stay XLA-friendly): the stage function is
+shape-preserving on the microbatch ([mb, ...] in = out, true of
+transformer blocks), every stage runs the same ``stage_fn`` over its
+own layer slice, and the layer count and batch must divide by the
+stage count and microbatch count respectively.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -38,36 +44,34 @@ from odh_kubeflow_tpu.parallel.mesh import AXIS_PIPE
 Params = Any
 
 
-def stack_stages(layer_params: Params, num_stages: int) -> Params:
-    """[L, ...] layer-stacked params → [S, L/S, ...] stage-stacked."""
-
-    def split(leaf):
-        L = leaf.shape[0]
-        if L % num_stages:
-            raise ValueError(f"{L} layers do not split into {num_stages} stages")
-        return leaf.reshape(num_stages, L // num_stages, *leaf.shape[1:])
-
-    return jax.tree_util.tree_map(split, layer_params)
-
-
 def pipeline_apply(
-    stage_fn: Callable[[Params, jnp.ndarray], jnp.ndarray],
-    stage_params: Params,  # leaves [S, ...], S = mesh extent of `pipe`
-    x: jnp.ndarray,  # [B, ...] (replicated over `pipe`)
+    stage_fn: Callable,
+    layer_params: Params,  # leaves [L, ...], dim0 sharded over `axis`
+    x: jnp.ndarray,  # [B, ...] (replicated over `axis`)
     *,
     num_microbatches: int,
+    aux: Optional[Params] = None,  # leaves [M, ...]: per-microbatch consts
     axis: str = AXIS_PIPE,
 ) -> jnp.ndarray:
-    """Run ``x`` through S pipeline stages; returns [B, ...].
+    """Run ``x`` through the pipelined layer stack; returns [B, ...].
 
-    ``stage_fn(params_for_one_stage, x_mb) -> y_mb`` must preserve the
-    microbatch shape. Call under ``jax.set_mesh`` of a mesh containing
-    ``axis``; differentiable.
+    ``stage_fn(stage_layers, x_mb)`` — or ``stage_fn(stage_layers,
+    x_mb, aux_mb)`` when ``aux`` is given — receives this device's
+    layer slice (leading dim L/S) and must preserve the microbatch
+    shape. ``aux`` carries per-microbatch constants (segment ids, loss
+    masks) that follow their microbatch through the pipeline. Call
+    under ``jax.set_mesh`` of a mesh containing ``axis``;
+    differentiable.
     """
     mesh = jax.sharding.get_abstract_mesh()
     if axis not in mesh.axis_names:
         raise ValueError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
     S = mesh.shape[axis]
+    for leaf in jax.tree_util.tree_leaves(layer_params):
+        if leaf.shape[0] % S:
+            raise ValueError(
+                f"layer dim {leaf.shape[0]} does not divide into {S} stages"
+            )
     B = x.shape[0]
     M = num_microbatches
     if B % M:
@@ -75,20 +79,19 @@ def pipeline_apply(
     mb = B // M
     xm = x.reshape(M, mb, *x.shape[1:])
 
-    param_specs = jax.tree_util.tree_map(
-        lambda _leaf: P(axis), stage_params
-    )
+    param_specs = jax.tree_util.tree_map(lambda _l: P(axis), layer_params)
+    aux_specs = jax.tree_util.tree_map(lambda _l: P(), aux)
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(param_specs, P()),
+        axis_names=frozenset({axis}),  # manual over pipe ONLY: fsdp/
+        # tensor/expert shardings inside the stage stay under GSPMD
+        in_specs=(param_specs, P(), aux_specs),
         out_specs=P(),
         check_vma=False,
     )
-    def run(stage_params_local, xm):
-        # shard_map hands this device leaves of shape [1, ...]: its stage
-        my_params = jax.tree_util.tree_map(lambda a: a[0], stage_params_local)
+    def run(stage_layers, xm, aux):
         idx = jax.lax.axis_index(axis)
         perm = [(i, (i + 1) % S) for i in range(S)]
 
@@ -103,7 +106,18 @@ def pipeline_apply(
             )
             take_input = (idx == 0) & (t < M)
             state = jnp.where(take_input, x_t, state)
-            out = stage_fn(my_params, state)
+            if aux is None:
+                out = stage_fn(stage_layers, state)
+            else:
+                # stage idx processes microbatch t - idx at tick t
+                mb_idx = jnp.clip(t - idx, 0, M - 1)
+                aux_t = jax.tree_util.tree_map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, mb_idx, 0, keepdims=False
+                    ),
+                    aux,
+                )
+                out = stage_fn(stage_layers, state, aux_t)
             # the last stage owns microbatch t-(S-1)'s final activation
             write_t = t - (S - 1)
             write = (idx == S - 1) & (write_t >= 0)
@@ -118,12 +132,10 @@ def pipeline_apply(
             state = jax.lax.ppermute(out, axis, perm)
             return (state, y), None
 
-        (_, y), _ = jax.lax.scan(
-            tick, (state0, y0), jnp.arange(M + S - 1)
-        )
+        (_, y), _ = jax.lax.scan(tick, (state0, y0), jnp.arange(M + S - 1))
         # y is populated only on the last stage; psum replicates it
         # (every other stage contributes zeros)
         return jax.lax.psum(jnp.where(idx == S - 1, y, jnp.zeros_like(y)), axis)
 
-    y = run(stage_params, xm)
+    y = run(layer_params, xm, aux)
     return y.reshape(B, *x.shape[1:])

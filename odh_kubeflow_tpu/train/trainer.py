@@ -39,6 +39,9 @@ class TrainConfig:
     b1: float = 0.9
     b2: float = 0.95
     z_loss: float = 0.0
+    # microbatches per GPipe schedule when the mesh shards `pipe`
+    # (bubble = (S-1)/(M+S-1); must divide the batch)
+    pipeline_microbatches: int = 8
 
 
 def cross_entropy_loss(
@@ -107,6 +110,24 @@ def chunked_cross_entropy(
     return jnp.sum(nll_sum) / jnp.maximum(jnp.sum(mask_sum), 1.0)
 
 
+def _pipe_shard_layer_specs(spec_tree):
+    """Prepend the pipe axis onto every per-layer stacked leaf spec
+    (everything under a 'layers' subtree: leading dim is L)."""
+    from odh_kubeflow_tpu.parallel.mesh import AXIS_PIPE
+
+    def walk(tree, in_layers):
+        if isinstance(tree, dict):
+            return {
+                k: walk(v, in_layers or k == "layers") for k, v in tree.items()
+            }
+        if not in_layers:
+            return tree
+        rest = list(tree)[1:] if len(tree) else []
+        return P(AXIS_PIPE, *rest)
+
+    return walk(spec_tree, False)
+
+
 def _make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0,
@@ -155,6 +176,14 @@ class Trainer:
         key = jax.random.key(seed)
         k_params, k_lora = jax.random.split(key)
 
+        pipe = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get(
+            "pipe", 1
+        )
+        self.pipelined = pipe > 1
+        if self.is_moe and self.pipelined:
+            raise NotImplementedError(
+                "pipeline parallelism is wired for the dense family only"
+            )
         if self.is_moe:
             p_specs = moe_lib.param_specs(model_cfg)
             init_partial = partial(
@@ -165,6 +194,11 @@ class Trainer:
             init_partial = partial(
                 llama.init_params, cfg=model_cfg, dtype=model_cfg.dtype
             )
+        if self.pipelined:
+            # stage ownership: every stacked per-layer leaf shards its
+            # leading L dim over the pipe axis (device p holds its
+            # stage's layers; parallel/pipeline.py runs the schedule)
+            p_specs = _pipe_shard_layer_specs(p_specs)
         with jax.set_mesh(self.mesh):
             init_fn = jax.jit(
                 init_partial,
@@ -173,6 +207,8 @@ class Trainer:
             self.params = init_fn(k_params)
             if lora_cfg is not None:
                 l_specs = lora_lib.lora_specs(model_cfg, lora_cfg)
+                if self.pipelined:
+                    l_specs = _pipe_shard_layer_specs(l_specs)
                 lora_init = jax.jit(
                     partial(
                         lora_lib.init_lora_params, cfg=model_cfg, lora=lora_cfg
@@ -233,6 +269,7 @@ class Trainer:
                 lora=lora_params,
                 segment_ids=batch.get("segment_ids"),
                 return_hidden=True,
+                pipeline_microbatches=self.train_cfg.pipeline_microbatches,
             )
             return chunked_cross_entropy(
                 hidden,
@@ -247,6 +284,7 @@ class Trainer:
             self.model_cfg,
             lora=lora_params,
             segment_ids=batch.get("segment_ids"),
+            pipeline_microbatches=self.train_cfg.pipeline_microbatches,
         )
         loss = cross_entropy_loss(
             logits,
@@ -310,11 +348,12 @@ class Trainer:
             return trainable, opt_state, {"loss": loss, "grad_norm": gnorm}
 
         train_sh = self._sh(self._train_specs)
-        frozen_specs = (
-            llama.param_specs(self.model_cfg)
-            if self.lora_cfg is not None
-            else self._train_specs
-        )
+        if self.lora_cfg is not None:
+            frozen_specs = llama.param_specs(self.model_cfg)
+            if self.pipelined:
+                frozen_specs = _pipe_shard_layer_specs(frozen_specs)
+        else:
+            frozen_specs = self._train_specs
         opt_sh = self._sh(self._opt_specs)
         return jax.jit(
             step_fn,

@@ -1,5 +1,6 @@
 """Pipeline parallelism: pipelined forward/backward must match the
-sequential layer stack exactly, on the virtual mesh."""
+sequential layer stack exactly, on the virtual mesh — including
+composed with GSPMD data sharding (partial-manual shard_map)."""
 
 import jax
 import jax.numpy as jnp
@@ -12,7 +13,7 @@ from odh_kubeflow_tpu.parallel.mesh import (
     MeshConfig,
     build_mesh,
 )
-from odh_kubeflow_tpu.parallel.pipeline import pipeline_apply, stack_stages
+from odh_kubeflow_tpu.parallel.pipeline import pipeline_apply
 
 
 @pytest.fixture
@@ -31,8 +32,7 @@ def _mlp_stack(key, L, D):
 
 
 def _stage_fn(stage_params, x):
-    """One stage = scan over its layers (transformer-block shaped:
-    residual MLP, [mb, D] preserved)."""
+    """One stage = scan over its layer slice (leading dim L/S)."""
 
     def layer(x, lp):
         h = jax.nn.gelu(x @ lp["w1"])
@@ -51,6 +51,15 @@ def _sequential(params, x):
     return out
 
 
+def _put(params, mesh):
+    return jax.device_put(
+        params,
+        jax.tree_util.tree_map(
+            lambda _l: NamedSharding(mesh, P(AXIS_PIPE)), params
+        ),
+    )
+
+
 @pytest.mark.parametrize("pipe,microbatches", [(2, 4), (4, 2), (4, 8)])
 def test_pipeline_matches_sequential(devices8, pipe, microbatches):
     L, D, B = 8, 16, 8
@@ -59,19 +68,12 @@ def test_pipeline_matches_sequential(devices8, pipe, microbatches):
     want = _sequential(params, x)
 
     mesh = build_mesh(MeshConfig(pipe=pipe, data=8 // pipe), devices8)
-    staged = stack_stages(params, pipe)
     with jax.set_mesh(mesh):
-        staged = jax.device_put(
-            staged,
-            jax.tree_util.tree_map(
-                lambda _l: NamedSharding(mesh, P(AXIS_PIPE)), staged
-            ),
-        )
         got = jax.jit(
             lambda p, x: pipeline_apply(
                 _stage_fn, p, x, num_microbatches=microbatches
             )
-        )(staged, x)
+        )(_put(params, mesh), x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
@@ -87,37 +89,77 @@ def test_pipeline_gradients_match_sequential(devices8):
     want_loss, want_grads = jax.value_and_grad(seq_loss)(params)
 
     mesh = build_mesh(MeshConfig(pipe=2, data=4), devices8)
-    staged = stack_stages(params, 2)
     with jax.set_mesh(mesh):
-        staged = jax.device_put(
-            staged,
-            jax.tree_util.tree_map(
-                lambda _l: NamedSharding(mesh, P(AXIS_PIPE)), staged
-            ),
-        )
 
         def pipe_loss(p):
             y = pipeline_apply(_stage_fn, p, x, num_microbatches=2)
             return jnp.mean((y - targets) ** 2)
 
-        got_loss, got_grads = jax.jit(jax.value_and_grad(pipe_loss))(staged)
+        got_loss, got_grads = jax.jit(jax.value_and_grad(pipe_loss))(
+            _put(params, mesh)
+        )
 
     np.testing.assert_allclose(float(got_loss), float(want_loss), rtol=1e-5)
-    got_flat = jax.tree_util.tree_map(
-        lambda g: g.reshape(-1, *g.shape[2:]), got_grads
-    )
     for name in ("w1", "w2"):
         np.testing.assert_allclose(
-            np.asarray(got_flat[name]),
+            np.asarray(got_grads[name]),
             np.asarray(want_grads[name]),
             atol=1e-5,
         )
 
 
-def test_stack_stages_validates_divisibility():
-    params = _mlp_stack(jax.random.PRNGKey(0), 6, 4)
-    with pytest.raises(ValueError):
-        stack_stages(params, 4)
+def test_pipeline_aux_follows_its_microbatch(devices8):
+    """Per-microbatch aux constants must arrive at each stage alongside
+    the microbatch they belong to, at every stage depth."""
+    L, D, B, M = 4, 8, 8, 4
+    params = _mlp_stack(jax.random.PRNGKey(5), L, D)
+    x = jax.random.normal(jax.random.PRNGKey(6), (B, D))
+    # aux value i tags microbatch i; the stage adds it to the state, so
+    # the output encodes (num_stages × aux_i) per microbatch
+    aux = {"tag": jnp.arange(M, dtype=jnp.float32)}
+
+    def stage_fn(stage_params, s, aux_t):
+        return _stage_fn(stage_params, s) + aux_t["tag"]
+
+    def seq_with_tags(p, x):
+        out = []
+        for i in range(M):
+            mb = x.reshape(M, B // M, D)[i]
+            # 2 stages each add the tag once
+            y = mb
+            for stage in range(2):
+                half = jax.tree_util.tree_map(
+                    lambda l: l.reshape(2, L // 2, *l.shape[1:])[stage], p
+                )
+                y = _stage_fn(half, y) + float(i)
+            out.append(y)
+        return jnp.stack(out).reshape(B, D)
+
+    want = seq_with_tags(params, x)
+    mesh = build_mesh(MeshConfig(pipe=2, data=4), devices8)
+    with jax.set_mesh(mesh):
+        got = jax.jit(
+            lambda p, x, a: pipeline_apply(
+                stage_fn, p, x, num_microbatches=M, aux=a
+            )
+        )(_put(params, mesh), x, aux)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_pipeline_validates_divisibility(devices8):
+    mesh = build_mesh(MeshConfig(pipe=4, data=2), devices8)
+    params = _mlp_stack(jax.random.PRNGKey(0), 6, 4)  # 6 layers, 4 stages
+    x = jnp.ones((4, 4))
+    with jax.set_mesh(mesh):
+        with pytest.raises(ValueError):
+            pipeline_apply(_stage_fn, params, x, num_microbatches=2)
+        with pytest.raises(ValueError):
+            pipeline_apply(
+                _stage_fn,
+                _mlp_stack(jax.random.PRNGKey(0), 8, 4),
+                jnp.ones((5, 4)),  # batch 5, microbatches 2
+                num_microbatches=2,
+            )
 
 
 def test_llama_layer_stack_pipelines(devices8):
@@ -156,17 +198,10 @@ def test_llama_layer_stack_pipelines(devices8):
         return out.reshape(x_mb.shape[0], S * D)
 
     mesh = build_mesh(MeshConfig(pipe=2, data=4), devices8)
-    staged = stack_stages(layers, 2)
     with jax.set_mesh(mesh):
-        staged = jax.device_put(
-            staged,
-            jax.tree_util.tree_map(
-                lambda _l: NamedSharding(mesh, P(AXIS_PIPE)), staged
-            ),
-        )
         got = jax.jit(
             lambda p, xf: pipeline_apply(stage_fn, p, xf, num_microbatches=2)
-        )(staged, x.reshape(B, S * D))
+        )(_put(layers, mesh), x.reshape(B, S * D))
     np.testing.assert_allclose(
         np.asarray(got.reshape(B, S, D)), np.asarray(want), atol=1e-4
     )
