@@ -79,8 +79,10 @@ def test_agent_sees_busy_holder_and_culler_treats_it_as_active(agent):
 
     holder = _spawn_holder(agent["device"], busy=True)
     try:
-        # two sample intervals so the delta window sees the burn
-        deadline = time.time() + 10
+        # generous deadline: under a loaded CI host, interpreter startup
+        # + /proc scan lag can delay holder detection by many sample
+        # intervals (observed >10s during full-suite runs)
+        deadline = time.time() + 30
         state = None
         while time.time() < deadline:
             time.sleep(0.4)
