@@ -1,0 +1,192 @@
+"""Minimal completion server: the "try your fine-tune" HTTP surface.
+
+The platform story ends with a user who just LoRA-tuned a model in
+their notebook wanting to poke it over HTTP. This is that surface —
+stdlib-only (the notebook images ship no web framework), wrapping
+``models/generate.py``:
+
+    POST /v1/completions   {"prompt": [[ids...], ...] | [ids...],
+                            "max_tokens": N, "temperature": t,
+                            "top_k": k, "top_p": p}
+      → {"completions": [[ids...], ...], "usage": {...}}
+    GET  /healthz
+
+Design constraints honored:
+- requests are batched per call; each distinct (batch, prompt-pad,
+  max_tokens) shape compiles once and is cached by jit — the server
+  pads prompts to the configured bucket sizes so arbitrary requests
+  reuse a handful of compiled programs (XLA static-shape discipline);
+- params may be the bf16 tree, a LoRA-merged tree, or the int8 tree
+  from ``models/quant.py`` (dequantized per layer inside the cache
+  scan — the 8B-on-one-v5e path);
+- tokenization is out of scope: the platform is model-agnostic and the
+  notebook owns the tokenizer; ids in, ids out.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from odh_kubeflow_tpu.models.generate import GenerateConfig, generate
+from odh_kubeflow_tpu.models.llama import LlamaConfig
+
+Params = dict[str, Any]
+
+DEFAULT_PROMPT_BUCKETS = (64, 256, 1024)
+DEFAULT_BATCH_BUCKETS = (1, 4)
+
+
+def _bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class CompletionService:
+    """Pads to shape buckets and drives jitted generation."""
+
+    def __init__(
+        self,
+        params: Params,
+        cfg: LlamaConfig,
+        *,
+        lora: Optional[Params] = None,
+        prompt_buckets: Sequence[int] = DEFAULT_PROMPT_BUCKETS,
+        batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS,
+        pad_id: int = 0,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.lora = lora
+        self.prompt_buckets = tuple(prompt_buckets)
+        self.batch_buckets = tuple(batch_buckets)
+        self.pad_id = pad_id
+        self._lock = threading.Lock()  # one TPU program at a time
+        self._compiled: dict = {}
+
+    def _runner(self, gen_cfg: GenerateConfig):
+        key = (gen_cfg.max_new_tokens, gen_cfg.temperature, gen_cfg.top_k,
+               gen_cfg.top_p, gen_cfg.eos_id)
+        if key not in self._compiled:
+            self._compiled[key] = jax.jit(
+                lambda p, lora, prompt, lengths, rng: generate(
+                    p,
+                    prompt,
+                    self.cfg,
+                    gen_cfg,
+                    prompt_lengths=lengths,
+                    lora=lora,
+                    key=rng,
+                )
+            )
+        return self._compiled[key]
+
+    def complete(
+        self,
+        prompts: list[list[int]],
+        *,
+        max_tokens: int = 64,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 0.0,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> dict:
+        if not prompts or any(not p for p in prompts):
+            raise ValueError("prompts must be non-empty token-id lists")
+        B = _bucket(len(prompts), self.batch_buckets)
+        S = _bucket(max(len(p) for p in prompts), self.prompt_buckets)
+        if max(len(p) for p in prompts) > S:
+            raise ValueError(f"prompt longer than max bucket {S}")
+
+        tokens = jnp.full((B, S), self.pad_id, jnp.int32)
+        lengths = jnp.zeros((B,), jnp.int32)
+        for i, p in enumerate(prompts):
+            tokens = tokens.at[i, : len(p)].set(jnp.asarray(p, jnp.int32))
+            lengths = lengths.at[i].set(len(p))
+
+        gen_cfg = GenerateConfig(
+            max_new_tokens=max_tokens,
+            temperature=temperature,
+            top_k=top_k or None,
+            top_p=top_p or None,
+            eos_id=eos_id,
+            pad_id=self.pad_id,
+        )
+        with self._lock:
+            out = self._runner(gen_cfg)(
+                self.params, self.lora, tokens, lengths, jax.random.key(seed)
+            )
+            toks = jax.device_get(out["tokens"])
+            lens = jax.device_get(out["lengths"])
+        completions = [
+            toks[i, : int(lens[i])].tolist() for i in range(len(prompts))
+        ]
+        return {
+            "completions": completions,
+            "usage": {
+                "prompt_tokens": sum(len(p) for p in prompts),
+                "completion_tokens": int(sum(lens[: len(prompts)])),
+                "padded_shape": [B, S],
+            },
+        }
+
+
+def serve(
+    service: CompletionService, host: str = "0.0.0.0", port: int = 8000
+) -> ThreadingHTTPServer:
+    """Start the HTTP surface on a daemon thread; returns the server."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code: int, body: dict):
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path.rstrip("/").endswith("/healthz"):
+                self._reply(200, {"status": "ok"})
+            else:
+                self._reply(404, {"error": "not found"})
+
+        def do_POST(self):
+            if not self.path.rstrip("/").endswith("/v1/completions"):
+                self._reply(404, {"error": "not found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                req = json.loads(self.rfile.read(length).decode() or "{}")
+                prompts = req.get("prompt") or []
+                if prompts and isinstance(prompts[0], int):
+                    prompts = [prompts]
+                result = service.complete(
+                    prompts,
+                    max_tokens=int(req.get("max_tokens", 64)),
+                    temperature=float(req.get("temperature", 0.0)),
+                    top_k=int(req.get("top_k", 0)),
+                    top_p=float(req.get("top_p", 0.0)),
+                    eos_id=req.get("eos_id"),
+                    seed=int(req.get("seed", 0)),
+                )
+                self._reply(200, result)
+            except ValueError as e:
+                self._reply(400, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — surface, keep serving
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd

@@ -307,6 +307,23 @@ async function showForm() {
     checked: !(config.shm && config.shm.value === false),
   });
 
+  const tolerationSelect = h(
+    "select",
+    { class: "kf-select", id: "nb-toleration" },
+    h("option", { value: "" }, "None"),
+    ((config.tolerationGroup && config.tolerationGroup.options) || []).map(
+      (o) => h("option", { value: o.groupKey }, o.displayName)
+    )
+  );
+  const affinitySelect = h(
+    "select",
+    { class: "kf-select", id: "nb-affinity" },
+    h("option", { value: "" }, "None"),
+    ((config.affinityConfig && config.affinityConfig.options) || []).map((o) =>
+      h("option", { value: o.configKey }, o.displayName)
+    )
+  );
+
   const pdBoxes = poddefaults.map((pd) =>
     h(
       "div",
@@ -373,6 +390,32 @@ async function showForm() {
       h(
         "div",
         { class: "kf-card" },
+        h("h2", {}, "Advanced scheduling"),
+        h(
+          "div",
+          { class: "kf-row" },
+          h(
+            "div",
+            { class: "kf-field" },
+            h("label", { for: "nb-toleration" }, "Toleration group"),
+            tolerationSelect,
+            h(
+              "div",
+              { class: "kf-hint" },
+              "Admin-defined taints to tolerate (e.g. spot/preemptible TPU nodes)."
+            )
+          ),
+          h(
+            "div",
+            { class: "kf-field" },
+            h("label", { for: "nb-affinity" }, "Affinity config"),
+            affinitySelect
+          )
+        )
+      ),
+      h(
+        "div",
+        { class: "kf-card" },
         h("h2", {}, "Configurations"),
         pdBoxes.length
           ? pdBoxes
@@ -414,6 +457,8 @@ async function showForm() {
                   ? ""
                   : form.tpuTopology.value,
               },
+              tolerationGroup: tolerationSelect.value,
+              affinityConfig: affinitySelect.value,
             };
             try {
               await api(`api/namespaces/${ns}/notebooks`, {

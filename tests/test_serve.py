@@ -1,0 +1,101 @@
+"""Completion server: bucketed batching, HTTP surface, quantized-tree
+serving — the fine-tune→try-it HTTP half."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from odh_kubeflow_tpu.models import GenerateConfig, LlamaConfig, generate
+from odh_kubeflow_tpu.models import llama
+from odh_kubeflow_tpu.models.serve import CompletionService, serve
+
+
+@pytest.fixture(scope="module")
+def service():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return CompletionService(
+        params, cfg, prompt_buckets=(8, 16), batch_buckets=(1, 2)
+    )
+
+
+def test_complete_matches_direct_generate(service):
+    prompt = [1, 2, 3, 4]
+    out = service.complete([prompt], max_tokens=6)
+    direct = generate(
+        service.params,
+        jnp.asarray([prompt + [0] * 4], jnp.int32),  # padded to bucket 8
+        service.cfg,
+        GenerateConfig(max_new_tokens=6, temperature=0.0),
+        prompt_lengths=jnp.asarray([4], jnp.int32),
+    )
+    want = np.asarray(direct["tokens"])[0, : int(direct["lengths"][0])].tolist()
+    assert out["completions"][0] == want
+    assert out["usage"]["padded_shape"] == [1, 8]
+
+
+def test_bucketing_and_batched_prompts(service):
+    # 2 ragged prompts → batch bucket 2, prompt bucket 16
+    out = service.complete([[1, 2, 3], list(range(1, 13))], max_tokens=4)
+    assert len(out["completions"]) == 2
+    assert all(len(c) == 4 for c in out["completions"])
+    assert out["usage"]["padded_shape"] == [2, 16]
+    # same buckets → cached compile (one entry per gen-config key)
+    assert len(service._compiled) >= 1
+
+    with pytest.raises(ValueError):
+        service.complete([list(range(99))])  # beyond max bucket
+    with pytest.raises(ValueError):
+        service.complete([[]])
+
+
+def test_http_surface(service):
+    httpd = serve(service, host="127.0.0.1", port=0)
+    port = httpd.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            assert json.loads(r.read())["status"] == "ok"
+
+        req = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({"prompt": [1, 2, 3], "max_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            body = json.loads(r.read())
+        assert len(body["completions"]) == 1
+        assert len(body["completions"][0]) == 4
+
+        # bad request → 400 with an error message, server keeps serving
+        req = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({"prompt": [[]]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 400
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            assert r.status == 200
+    finally:
+        httpd.shutdown()
+
+
+def test_serves_quantized_tree():
+    """The int8 tree (models/quant.py) plugs straight in — the
+    8B-on-one-v5e serving configuration, tiny-sized here."""
+    from odh_kubeflow_tpu.models.quant import quantize_params
+
+    cfg = LlamaConfig.tiny(dtype=jnp.bfloat16)
+    params = llama.init_params(jax.random.PRNGKey(1), cfg, dtype=jnp.bfloat16)
+    svc = CompletionService(
+        quantize_params(params), cfg, prompt_buckets=(8,), batch_buckets=(1,)
+    )
+    out = svc.complete([[5, 6, 7]], max_tokens=4)
+    assert len(out["completions"][0]) == 4
