@@ -99,3 +99,61 @@ def test_serves_quantized_tree():
     )
     out = svc.complete([[5, 6, 7]], max_tokens=4)
     assert len(out["completions"][0]) == 4
+
+
+def test_full_story_finetune_checkpoint_restore_merge_serve(tmp_path):
+    """The platform's whole runtime story in one pass: LoRA fine-tune →
+    orbax checkpoint → restore into a fresh trainer → merge adapters →
+    quantize → serve completions over HTTP. Every seam the notebook
+    user crosses."""
+    from odh_kubeflow_tpu.models import LoraConfig
+    from odh_kubeflow_tpu.models.lora import merge_lora
+    from odh_kubeflow_tpu.models.quant import quantize_params
+    from odh_kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from odh_kubeflow_tpu.train import TrainConfig, Trainer
+    from odh_kubeflow_tpu.train.checkpoint import CheckpointManager
+
+    devices = jax.devices()[:8]
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    trainer = Trainer(
+        cfg,
+        TrainConfig(warmup_steps=1, total_steps=6, learning_rate=1e-2),
+        lora_cfg=LoraConfig(rank=2),
+        mesh=build_mesh(MeshConfig(fsdp=8), devices),
+    )
+    batch = trainer.make_fake_batch(8, 16)
+    for _ in range(3):
+        trainer.train_step(batch)
+    with CheckpointManager(str(tmp_path)) as mgr:
+        trainer.save_checkpoint(mgr, force=True)
+        mgr.wait_until_finished()
+
+        # "the notebook restarts": fresh trainer restores the adapters
+        trainer2 = Trainer(
+            cfg,
+            TrainConfig(warmup_steps=1, total_steps=6),
+            lora_cfg=LoraConfig(rank=2),
+            mesh=build_mesh(MeshConfig(fsdp=8), devices),
+        )
+        assert trainer2.restore_checkpoint(mgr) == 3
+
+    merged = merge_lora(trainer2.params, trainer2.lora_params)
+    svc = CompletionService(
+        quantize_params(jax.device_get(merged)),
+        cfg,
+        prompt_buckets=(8,),
+        batch_buckets=(1,),
+    )
+    httpd = serve(svc, host="127.0.0.1", port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{httpd.server_address[1]}/v1/completions",
+            data=json.dumps({"prompt": [1, 2, 3], "max_tokens": 5}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            body = json.loads(r.read())
+        assert len(body["completions"][0]) == 5
+        assert all(isinstance(t, int) for t in body["completions"][0])
+    finally:
+        httpd.shutdown()
