@@ -33,6 +33,7 @@ from jax.sharding import PartitionSpec as P
 from odh_kubeflow_tpu.ops.attention import dense_attention
 from odh_kubeflow_tpu.ops.norms import rms_norm
 from odh_kubeflow_tpu.ops.rope import apply_rope, rope_angles
+from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
 from odh_kubeflow_tpu.parallel.mesh import (
     AXIS_CONTEXT,
     AXIS_DATA,
@@ -67,9 +68,12 @@ class LlamaConfig:
     remat: bool = True
     # "dots": save weight-matmul outputs (fast backward, ~25k floats
     # per token per layer of residency — fine to ~4k context);
+    # "attn": save only the attention outputs (D floats per token per
+    # layer) — the backward never recomputes the quadratic flash
+    # forward, at a fraction of "dots" residency; the long-context
+    # sweet spot;
     # "none": save only layer boundaries and recompute everything
-    # (the long-context setting: at 16k context "dots" residency alone
-    # is ~13GB on the 1B model).
+    # (minimum residency, maximum recompute).
     remat_policy: str = "dots"
 
     @staticmethod
@@ -288,6 +292,8 @@ def _decoder_layer(
         cache_layer = {"k": ck, "v": cv}
     else:
         attn = attention_fn(q, kk, vv, segment_ids=segment_ids)
+    # named so the "attn" remat policy can pin exactly this tensor
+    attn = _checkpoint_name(attn, "attn_out")
     attn = attn.reshape(B, S, cfg.q_dim)
     x = x + _maybe_lora("wo", attn, layer["wo"], lora_layer)
 
@@ -357,6 +363,13 @@ def _make_layer_fn(cfg: LlamaConfig, attention_fn: Callable) -> Callable:
             layer_fn = jax.checkpoint(
                 layer_fn,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        elif cfg.remat_policy == "attn":
+            layer_fn = jax.checkpoint(
+                layer_fn,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "attn_out"
+                ),
             )
         else:  # "none": full recompute, minimum residency
             layer_fn = jax.checkpoint(layer_fn)
