@@ -139,7 +139,18 @@ def generate(
     if key is None:
         key = jax.random.key(0)
 
-    cache = init_cache(cfg, B, max_len, gen_cfg.cache_dtype)
+    # model-family dispatch: MoeConfig wraps a dense backbone whose
+    # shapes drive the cache; its own cached forward routes the MLP
+    if hasattr(cfg, "base"):
+        from odh_kubeflow_tpu.models import moe as _moe
+
+        cache_cfg = cfg.base
+        fwd = _moe.forward_with_cache
+    else:
+        cache_cfg = cfg
+        fwd = forward_with_cache
+
+    cache = init_cache(cache_cfg, B, max_len, gen_cfg.cache_dtype)
     slots = jnp.arange(max_len, dtype=jnp.int32)[None, :]  # [1, S_max]
     kv_mask = slots < prompt_lengths[:, None]  # prompt region valid
 
@@ -147,7 +158,7 @@ def generate(
     positions = jnp.broadcast_to(
         jnp.arange(S_prompt, dtype=jnp.int32), (B, S_prompt)
     )
-    logits, cache = forward_with_cache(
+    logits, cache = fwd(
         params,
         prompt_tokens,
         cfg,
@@ -177,7 +188,7 @@ def generate(
         write_index = jnp.int32(S_prompt) + i
         kv_mask = kv_mask | (slots == write_index)
         positions = (prompt_lengths + i)[:, None]  # logical rope position
-        logits, cache = forward_with_cache(
+        logits, cache = fwd(
             params,
             token[:, None],
             cfg,

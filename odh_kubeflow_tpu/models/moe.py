@@ -246,6 +246,78 @@ def _moe_decoder_layer(cfg: MoeConfig, attention_fn, x, layer, sin, cos, segment
     return x + moe_out, aux
 
 
+def forward_with_cache(
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32
+    cfg: MoeConfig,
+    cache: Params,  # {"k","v"}: [L, B, S_max, Hkv, hd]
+    cache_index,  # scalar int32 write offset
+    *,
+    positions: jnp.ndarray,  # [B, S]
+    kv_mask: Optional[jnp.ndarray] = None,
+    lora: Optional[Params] = None,
+) -> tuple[jnp.ndarray, Params]:
+    """KV-cached MoE forward (the ``models/generate.py`` decode path).
+
+    Attention is identical to the dense family's cache path (dense
+    attention over the cache with a traced write offset); the MLP is
+    the router+experts. Routing a 1-token decode step degenerates to
+    capacity-1 per expert, which top-k's distinct choices always fit.
+    int8-quantized trees (``models/quant.py``) dequantize per layer
+    like the dense path. ``lora`` is unused (MoE trains
+    full-parameter) and accepted for signature parity.
+    """
+    del lora
+    b = cfg.base
+    sin, cos = rope_angles(positions, b.head_dim, b.rope_theta)
+    x = jnp.take(params["embed"], tokens, axis=0).astype(b.dtype)
+    B, S, D = x.shape
+
+    def body(x, scanned):
+        layer, cache_layer = scanned
+        layer = llama._maybe_dequant(layer, b.dtype)
+        h = rms_norm(x, layer["attn_norm"], b.rms_norm_eps)
+        q = (h @ layer["wq"].astype(x.dtype)).reshape(
+            B, S, b.num_heads, b.head_dim
+        )
+        k = (h @ layer["wk"].astype(x.dtype)).reshape(
+            B, S, b.num_kv_heads, b.head_dim
+        )
+        v = (h @ layer["wv"].astype(x.dtype)).reshape(
+            B, S, b.num_kv_heads, b.head_dim
+        )
+        q = llama.apply_rope(q, sin, cos)
+        k = llama.apply_rope(k, sin, cos)
+        ck = jax.lax.dynamic_update_slice(
+            cache_layer["k"], k.astype(cache_layer["k"].dtype),
+            (0, cache_index, 0, 0),
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cache_layer["v"], v.astype(cache_layer["v"].dtype),
+            (0, cache_index, 0, 0),
+        )
+        from odh_kubeflow_tpu.ops.attention import dense_attention
+
+        attn = dense_attention(
+            q, ck, cv, causal=True, q_offset=cache_index, kv_mask=kv_mask
+        ).reshape(B, S, b.q_dim)
+        x = x + attn @ layer["wo"].astype(x.dtype)
+        h = rms_norm(x, layer["mlp_norm"], b.rms_norm_eps)
+        moe_out, _aux = moe_mlp(h, layer, cfg)
+        return x + moe_out, {"k": ck, "v": cv}
+
+    x, new_cache = jax.lax.scan(body, x, (params["layers"], cache))
+    x = rms_norm(x, params["final_norm"], b.rms_norm_eps)
+    head = llama.lm_head_weight(params, b)
+    if isinstance(head, dict):  # quantized lm_head
+        head = llama._maybe_dequant({"lm_head": head}, b.dtype)["lm_head"]
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, head.astype(b.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, new_cache
+
+
 def forward(
     params: Params,
     tokens: jnp.ndarray,  # [B, S] int32
