@@ -399,3 +399,17 @@ def test_jwa_toleration_and_affinity_groups(jwa_client):
     )
     assert status == 400
     assert "tolerationGroup" in body["log"]
+
+
+def test_spawner_accelerators_exist_in_topology_table():
+    """Every accelerator/topology the spawner form offers must be one
+    the controller's TPU table can schedule — config drift here would
+    turn UI selections into InvalidTPURequest events."""
+    from odh_kubeflow_tpu.utils.tpu import TPU_TOPOLOGIES
+    from odh_kubeflow_tpu.web.jwa import DEFAULT_CONFIG
+
+    for acc in DEFAULT_CONFIG["spawnerFormDefaults"]["tpus"]["accelerators"]:
+        known = TPU_TOPOLOGIES.get(acc["type"])
+        assert known is not None, acc["type"]
+        for topo in acc["topologies"]:
+            assert topo in known["topologies"], (acc["type"], topo)
