@@ -75,12 +75,20 @@ class Controller:
         reconcile: Callable[[Request], Optional[Result]],
         for_kind: str,
         time_fn: Callable[[], float] = time.monotonic,
+        workers: int = 1,
     ):
         self.name = name
         self.api = api
         self.reconcile = reconcile
         self.for_kind = for_kind
         self.time_fn = time_fn
+        # MaxConcurrentReconciles: workers share the queue but a key is
+        # never reconciled by two workers at once (controller-runtime
+        # semantics). >1 keeps one slow reconcile — e.g. a culler probe
+        # against a dead notebook burning its 5s timeout — from
+        # stalling every other notebook.
+        self.workers = max(int(workers), 1)
+        self._inflight: set[Request] = set()
         self._watch_specs: list[_WatchSpec] = []
         self._watches: list[Watch] = []
         self._queue: list[Request] = []
@@ -143,10 +151,14 @@ class Controller:
                     if d[1] not in self._queued:
                         self._queue.append(d[1])
                         self._queued.add(d[1])
-                if self._queue:
-                    req = self._queue.pop(0)
-                    self._queued.discard(req)
-                    return req
+                # hand out the first key not currently being reconciled
+                # by another worker (per-key exclusion)
+                for i, req in enumerate(self._queue):
+                    if req not in self._inflight:
+                        self._queue.pop(i)
+                        self._queued.discard(req)
+                        self._inflight.add(req)
+                        return req
                 if self._stop.is_set():
                     return None
                 waits = [0.05]
@@ -163,11 +175,18 @@ class Controller:
             result = self.reconcile(req) or Result()
         except Exception:
             log.exception("%s: reconcile %s failed", self.name, req)
+            self._done(req)
             self.enqueue(req, after=self._limiter.when(req))
             return
+        self._done(req)
         self._limiter.forget(req)
         if result.requeue_after:
             self.enqueue(req, after=result.requeue_after)
+
+    def _done(self, req: Request) -> None:
+        with self._cv:
+            self._inflight.discard(req)
+            self._cv.notify_all()
 
     # -- event pumping ------------------------------------------------------
 
@@ -215,9 +234,10 @@ class Controller:
             t = threading.Thread(target=pump, args=(i,), daemon=True)
             t.start()
             self._threads.append(t)
-        worker = threading.Thread(target=self._worker, daemon=True)
-        worker.start()
-        self._threads.append(worker)
+        for _ in range(self.workers):
+            worker = threading.Thread(target=self._worker, daemon=True)
+            worker.start()
+            self._threads.append(worker)
 
     def _worker(self) -> None:
         while not self._stop.is_set():
@@ -269,8 +289,20 @@ class Manager:
         name: str,
         for_kind: str,
         reconcile: Callable[[Request], Optional[Result]],
+        workers: Optional[int] = None,
     ) -> Controller:
-        ctrl = Controller(name, self.api, reconcile, for_kind, time_fn=self.time_fn)
+        import os
+
+        if workers is None:
+            workers = int(os.environ.get("MAX_CONCURRENT_RECONCILES", "1"))
+        ctrl = Controller(
+            name,
+            self.api,
+            reconcile,
+            for_kind,
+            time_fn=self.time_fn,
+            workers=workers,
+        )
         self.controllers.append(ctrl)
         return ctrl
 
