@@ -190,3 +190,66 @@ def serve(
     httpd = ThreadingHTTPServer((host, port), Handler)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     return httpd
+
+
+def main(argv: Optional[list] = None) -> None:
+    """``python -m odh_kubeflow_tpu.models.serve`` — serve a model.
+
+    Loads base params (random-init demo mode without --checkpoint; a
+    LoRA adapter checkpoint from ``train/checkpoint.py`` gets merged
+    when one is given), optionally quantizes to int8, and serves
+    completions.
+    """
+    import argparse
+    import time
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--config", default="llama3_1b", choices=["tiny", "llama3_1b", "llama3_8b"]
+    )
+    parser.add_argument("--checkpoint", default="", help="LoRA ckpt dir (orbax)")
+    parser.add_argument("--lora-rank", type=int, default=16)
+    parser.add_argument("--int8", action="store_true", help="quantize weights")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    args = parser.parse_args(argv)
+
+    cfg = getattr(LlamaConfig, args.config)(dtype=jnp.bfloat16)
+    params = jax.jit(
+        lambda k: __import__(
+            "odh_kubeflow_tpu.models.llama", fromlist=["init_params"]
+        ).init_params(k, cfg, dtype=jnp.bfloat16)
+    )(jax.random.key(0))
+
+    if args.checkpoint:
+        from odh_kubeflow_tpu.models.lora import LoraConfig, merge_lora
+        from odh_kubeflow_tpu.train import TrainConfig, Trainer
+        from odh_kubeflow_tpu.train.checkpoint import CheckpointManager
+
+        trainer = Trainer(
+            cfg, TrainConfig(), lora_cfg=LoraConfig(rank=args.lora_rank)
+        )
+        with CheckpointManager(args.checkpoint) as mgr:
+            step = trainer.restore_checkpoint(mgr)
+        params = merge_lora(trainer.params, trainer.lora_params)
+        print(f"restored LoRA adapters at step {step}; merged", flush=True)
+
+    if args.int8:
+        from odh_kubeflow_tpu.models.quant import quantize_params
+
+        params = jax.jit(quantize_params)(params)
+        print("quantized to int8", flush=True)
+
+    service = CompletionService(params, cfg)
+    httpd = serve(service, host=args.host, port=args.port)
+    print(
+        f"completion server on http://{args.host}:{httpd.server_address[1]}"
+        f" (config={args.config}, int8={args.int8})",
+        flush=True,
+    )
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

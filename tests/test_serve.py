@@ -157,3 +157,48 @@ def test_full_story_finetune_checkpoint_restore_merge_serve(tmp_path):
         assert all(isinstance(t, int) for t in body["completions"][0])
     finally:
         httpd.shutdown()
+
+
+def test_cli_entrypoint_demo_mode():
+    """`python -m odh_kubeflow_tpu.models.serve --config tiny` comes up
+    and answers completions (demo mode: random init, no checkpoint)."""
+    import re
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "odh_kubeflow_tpu.models.serve",
+            "--config",
+            "tiny",
+            "--host",
+            "127.0.0.1",
+            "--port",
+            "0",
+            "--int8",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        port = None
+        for _ in range(60):
+            line = proc.stdout.readline()
+            m = re.search(r"http://127\.0\.0\.1:(\d+)", line)
+            if m:
+                port = m.group(1)
+                break
+        assert port, "server never announced its port"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({"prompt": [1, 2, 3], "max_tokens": 3}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert len(json.loads(r.read())["completions"][0]) == 3
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
