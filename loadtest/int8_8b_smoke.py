@@ -25,38 +25,11 @@ def main() -> None:
 
     from odh_kubeflow_tpu.models import GenerateConfig, LlamaConfig, generate
     from odh_kubeflow_tpu.models import llama
-    from odh_kubeflow_tpu.models.quant import _QUANT_LEAVES, quantize_tensor
+    from odh_kubeflow_tpu.models.quant import streaming_quantized_init
 
     cfg = LlamaConfig.llama3_8b(dtype=jnp.bfloat16)
-    shapes = jax.eval_shape(
-        lambda k: llama.init_params(k, cfg, dtype=jnp.bfloat16), jax.random.key(0)
-    )
-
-    def build(tree, path=()):
-        out = {}
-        for k, v in tree.items():
-            if isinstance(v, dict):
-                out[k] = build(v, path + (k,))
-                continue
-            key = jax.random.fold_in(
-                jax.random.key(7), hash((path, k)) % (2**31)
-            )
-            if k in _QUANT_LEAVES:
-                out[k] = jax.jit(
-                    lambda key, sh=v.shape: quantize_tensor(
-                        jax.random.normal(key, sh, jnp.bfloat16) * 0.02
-                    )
-                )(key)
-            else:
-                out[k] = jax.jit(
-                    lambda key, sh=v.shape, dt=v.dtype: (
-                        jax.random.normal(key, sh, jnp.float32) * 0.02
-                    ).astype(dt)
-                )(key)
-        return out
-
     t0 = time.time()
-    qparams = build(shapes)
+    qparams = streaming_quantized_init(cfg, jax.random.key(7))
     jax.block_until_ready(qparams)
     init_s = time.time() - t0
     resident_gib = sum(

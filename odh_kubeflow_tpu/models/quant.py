@@ -90,6 +90,46 @@ def dequantize_params(qparams: Params, dtype=jnp.bfloat16) -> Params:
     return walk(qparams)
 
 
+def streaming_quantized_init(cfg, key: jax.Array, scale: float = 0.02) -> Params:
+    """Build an int8 param tree leaf-by-leaf on device.
+
+    Initialising a big model in bf16 and then quantizing holds both
+    trees at peak (~23GiB for 8B — OOM on a 16GiB v5e). This streams:
+    each leaf is initialised, quantized, and its bf16 source dropped
+    before the next, so the peak is the int8 tree plus one transient
+    leaf. Weights are random (demo/serving-smoke use; real weights
+    arrive via checkpoints).
+    """
+    from odh_kubeflow_tpu.models import llama
+
+    shapes = jax.eval_shape(
+        lambda k: llama.init_params(k, cfg, dtype=jnp.bfloat16), key
+    )
+
+    def build(tree, path=()):
+        out = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out[k] = build(v, path + (k,))
+                continue
+            leaf_key = jax.random.fold_in(key, hash((path, k)) % (2**31))
+            if k in _QUANT_LEAVES:
+                out[k] = jax.jit(
+                    lambda kk, sh=v.shape: quantize_tensor(
+                        jax.random.normal(kk, sh, jnp.bfloat16) * scale
+                    )
+                )(leaf_key)
+            else:
+                out[k] = jax.jit(
+                    lambda kk, sh=v.shape, dt=v.dtype: (
+                        jax.random.normal(kk, sh, jnp.float32) * scale
+                    ).astype(dt)
+                )(leaf_key)
+        return out
+
+    return build(shapes)
+
+
 def quantization_error(params: Params, qparams: Params) -> dict[str, float]:
     """Max relative error per quantized leaf (diagnostics)."""
     out = {}

@@ -203,23 +203,28 @@ def main(argv: Optional[list] = None) -> None:
     import argparse
     import time
 
+    from odh_kubeflow_tpu.models.llama import init_params
+
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config", default="llama3_1b", choices=["tiny", "llama3_1b", "llama3_8b"]
     )
     parser.add_argument("--checkpoint", default="", help="LoRA ckpt dir (orbax)")
     parser.add_argument("--lora-rank", type=int, default=16)
+    parser.add_argument(
+        "--seed",
+        type=int,
+        default=0,
+        help="base-param init seed; MUST match the training run's "
+        "Trainer seed — adapter checkpoints exclude the frozen base, "
+        "so a mismatch silently merges onto the wrong weights",
+    )
     parser.add_argument("--int8", action="store_true", help="quantize weights")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8000)
     args = parser.parse_args(argv)
 
     cfg = getattr(LlamaConfig, args.config)(dtype=jnp.bfloat16)
-    params = jax.jit(
-        lambda k: __import__(
-            "odh_kubeflow_tpu.models.llama", fromlist=["init_params"]
-        ).init_params(k, cfg, dtype=jnp.bfloat16)
-    )(jax.random.key(0))
 
     if args.checkpoint:
         from odh_kubeflow_tpu.models.lora import LoraConfig, merge_lora
@@ -227,18 +232,32 @@ def main(argv: Optional[list] = None) -> None:
         from odh_kubeflow_tpu.train.checkpoint import CheckpointManager
 
         trainer = Trainer(
-            cfg, TrainConfig(), lora_cfg=LoraConfig(rank=args.lora_rank)
+            cfg,
+            TrainConfig(),
+            lora_cfg=LoraConfig(rank=args.lora_rank),
+            seed=args.seed,
         )
         with CheckpointManager(args.checkpoint) as mgr:
             step = trainer.restore_checkpoint(mgr)
         params = merge_lora(trainer.params, trainer.lora_params)
         print(f"restored LoRA adapters at step {step}; merged", flush=True)
+        if args.int8:
+            from odh_kubeflow_tpu.models.quant import quantize_params
 
-    if args.int8:
-        from odh_kubeflow_tpu.models.quant import quantize_params
+            # donate: bf16 leaves free as their int8 twins materialise
+            params = jax.jit(quantize_params, donate_argnums=0)(params)
+            print("quantized to int8", flush=True)
+    elif args.int8:
+        # demo mode + int8: stream init+quantize per leaf so the bf16
+        # tree never fully materialises (8B bf16 alone is 15GiB)
+        from odh_kubeflow_tpu.models.quant import streaming_quantized_init
 
-        params = jax.jit(quantize_params)(params)
-        print("quantized to int8", flush=True)
+        params = streaming_quantized_init(cfg, jax.random.key(args.seed))
+        print("streamed int8 init", flush=True)
+    else:
+        params = jax.jit(
+            lambda k: init_params(k, cfg, dtype=jnp.bfloat16)
+        )(jax.random.key(args.seed))
 
     service = CompletionService(params, cfg)
     httpd = serve(service, host=args.host, port=args.port)

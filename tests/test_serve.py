@@ -184,9 +184,20 @@ def test_cli_entrypoint_demo_mode():
         text=True,
     )
     try:
+        import select
+        import time
+
         port = None
-        for _ in range(60):
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            # bounded wall-time read: a silent-but-alive subprocess must
+            # fail the test, not hang it
+            ready, _, _ = select.select([proc.stdout], [], [], 1.0)
+            if not ready:
+                continue
             line = proc.stdout.readline()
+            if not line:
+                break
             m = re.search(r"http://127\.0\.0\.1:(\d+)", line)
             if m:
                 port = m.group(1)
