@@ -245,3 +245,28 @@ def test_profile_plugin_drives_gcp_client_end_to_end():
     api.delete("Profile", "team-a", None)
     mgr.drain()
     assert state["policy"]["bindings"] == []
+
+
+def test_plugins_from_env_wiring(monkeypatch):
+    """The split-process profile controller builds real IAM clients
+    only when the deployment configures them; no-op otherwise."""
+    from odh_kubeflow_tpu.controllers.profile import plugins_from_env
+    from odh_kubeflow_tpu.machinery.cloudiam import AwsIamClient, GcpIamClient
+
+    # unconfigured: both plugins present, clients are no-ops
+    for var in ("GCP_IAM_ENABLE", "AWS_OIDC_PROVIDER_ARN"):
+        monkeypatch.delenv(var, raising=False)
+    plugins = plugins_from_env()
+    assert set(plugins) == {"WorkloadIdentity", "AwsIamForServiceAccount"}
+    assert not isinstance(plugins["WorkloadIdentity"].iam_client, GcpIamClient)
+
+    monkeypatch.setenv("GCP_IAM_ENABLE", "true")
+    monkeypatch.setenv("AWS_OIDC_PROVIDER_ARN", OIDC_ARN)
+    monkeypatch.setenv("AWS_OIDC_ISSUER_HOST", ISSUER)
+    monkeypatch.setenv("AWS_REGION", "us-west-2")
+    plugins = plugins_from_env()
+    assert isinstance(plugins["WorkloadIdentity"].iam_client, GcpIamClient)
+    aws = plugins["AwsIamForServiceAccount"].iam_client
+    assert isinstance(aws, AwsIamClient)
+    assert aws.oidc_provider_arn == OIDC_ARN
+    assert aws.region == "us-west-2"
