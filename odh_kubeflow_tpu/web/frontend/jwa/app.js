@@ -252,6 +252,12 @@ async function showForm() {
   } catch {
     /* optional */
   }
+  let pvcs = [];
+  try {
+    pvcs = (await api(`api/namespaces/${ns}/pvcs`)).pvcs || [];
+  } catch {
+    /* optional */
+  }
 
   const form = {};
 
@@ -324,6 +330,23 @@ async function showForm() {
     )
   );
 
+  // existing PVCs attachable as data volumes at /data/<name>
+  const pvcVols = pvcs
+    .map((p) => p.metadata ? p.metadata.name : p.name)
+    .filter((name) => name)
+    .map((name) =>
+      h(
+        "div",
+        { class: "kf-checkbox" },
+        h("input", {
+          type: "checkbox",
+          dataset: { pvc: name },
+          id: `vol-${name}`,
+        }),
+        h("label", { for: `vol-${name}` }, `${name} → /data/${name}`)
+      )
+    );
+
   const pdBoxes = poddefaults.map((pd) =>
     h(
       "div",
@@ -374,6 +397,18 @@ async function showForm() {
           )
         ),
         tpuSection(form)
+      ),
+      h(
+        "div",
+        { class: "kf-card" },
+        h("h2", {}, "Data volumes"),
+        pvcVols.length
+          ? pvcVols
+          : h(
+              "div",
+              { class: "kf-muted" },
+              "No existing volumes in this namespace; create them in the Volumes app."
+            )
       ),
       h(
         "div",
@@ -459,6 +494,15 @@ async function showForm() {
               },
               tolerationGroup: tolerationSelect.value,
               affinityConfig: affinitySelect.value,
+              dataVolumes: pvcVols
+                .map((el) => el.querySelector("input"))
+                .filter((i) => i.checked)
+                .map((i) => ({
+                  mount: `/data/${i.dataset.pvc}`,
+                  existingSource: {
+                    persistentVolumeClaim: { claimName: i.dataset.pvc },
+                  },
+                })),
             };
             try {
               await api(`api/namespaces/${ns}/notebooks`, {

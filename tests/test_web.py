@@ -413,3 +413,49 @@ def test_spawner_accelerators_exist_in_topology_table():
         assert known is not None, acc["type"]
         for topo in acc["topologies"]:
             assert topo in known["topologies"], (acc["type"], topo)
+
+
+def test_jwa_attach_existing_pvc_as_data_volume(jwa_client):
+    """The spawner UI's data-volume checkboxes post existingSource
+    entries; the notebook mounts them at the requested path."""
+    client, api, cluster, mgr = jwa_client
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": {"name": "datasets", "namespace": "team-a"},
+            "spec": {
+                "accessModes": ["ReadWriteOnce"],
+                "resources": {"requests": {"storage": "5Gi"}},
+            },
+        }
+    )
+    status, _ = client.post(
+        "/api/namespaces/team-a/notebooks",
+        body={
+            "name": "vol-nb",
+            "image": "odh-kubeflow-tpu/jupyter-jax-tpu:v0.1.0",
+            "cpu": "1",
+            "memory": "1Gi",
+            "dataVolumes": [
+                {
+                    "mount": "/data/datasets",
+                    "existingSource": {
+                        "persistentVolumeClaim": {"claimName": "datasets"}
+                    },
+                }
+            ],
+        },
+    )
+    assert status == 201
+    nb = api.get("Notebook", "vol-nb", "team-a")
+    pod_spec = nb["spec"]["template"]["spec"]
+    claims = [
+        v.get("persistentVolumeClaim", {}).get("claimName")
+        for v in pod_spec["volumes"]
+    ]
+    assert "datasets" in claims
+    mounts = {
+        m["mountPath"] for m in pod_spec["containers"][0]["volumeMounts"]
+    }
+    assert "/data/datasets" in mounts
