@@ -74,7 +74,8 @@ function sidebar() {
       link("notebooks", "Notebooks"),
       link("volumes", "Volumes"),
       link("tensorboards", "TensorBoards"),
-      link("contributors", "Manage Contributors")
+      link("contributors", "Manage Contributors"),
+      state.isClusterAdmin ? link("admin", "All Namespaces") : null
     ),
     h("div", { class: "kd-user" }, state.user || "anonymous")
   );
@@ -298,6 +299,31 @@ async function contributorsView() {
   return view;
 }
 
+async function adminView() {
+  const view = h("div", { class: "kf-page kd-view" });
+  try {
+    const data = await api("api/workgroup/get-all-namespaces");
+    view.append(
+      h(
+        "div",
+        { class: "kf-card" },
+        h("h2", {}, "All namespaces (cluster admin)"),
+        resourceTable({
+          empty: "No profiles exist.",
+          columns: [
+            { title: "Namespace", render: (r) => r[0] },
+            { title: "Owner", render: (r) => r[1] },
+          ],
+          rows: data.namespaces || [],
+        })
+      )
+    );
+  } catch (e) {
+    view.append(h("div", { class: "kf-card kf-muted" }, e.message));
+  }
+  return view;
+}
+
 function appView(appKey) {
   const app = APPS[appKey];
   return h("iframe", {
@@ -325,6 +351,8 @@ async function render() {
       toolbar(),
       h("div", { class: "kd-content" }, await contributorsView())
     );
+  } else if (state.view === "admin") {
+    main.append(toolbar(), h("div", { class: "kd-content" }, await adminView()));
   } else {
     main.append(toolbar(), h("div", { class: "kd-content" }, await homeView()));
   }
