@@ -207,7 +207,15 @@ def main(argv: Optional[list] = None) -> None:
 
     parser = argparse.ArgumentParser()
     parser.add_argument(
-        "--config", default="llama3_1b", choices=["tiny", "llama3_1b", "llama3_8b"]
+        "--config",
+        default="llama3_1b",
+        choices=[
+            "tiny",
+            "llama3_1b",
+            "llama3_8b",
+            "mixtral_tiny",
+            "mixtral_8x1b",
+        ],
     )
     parser.add_argument("--checkpoint", default="", help="LoRA ckpt dir (orbax)")
     parser.add_argument("--lora-rank", type=int, default=16)
@@ -223,6 +231,31 @@ def main(argv: Optional[list] = None) -> None:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8000)
     args = parser.parse_args(argv)
+
+    if args.config.startswith("mixtral"):
+        from odh_kubeflow_tpu.models.moe import MoeConfig
+        from odh_kubeflow_tpu.models import moe as moe_lib
+
+        cfg = getattr(MoeConfig, args.config)()
+        if args.checkpoint:
+            parser.error("MoE checkpoint serving lands with MoE-Trainer ckpts")
+        params = jax.jit(
+            lambda k: moe_lib.init_params(k, cfg, dtype=jnp.bfloat16)
+        )(jax.random.key(args.seed))
+        if args.int8:
+            from odh_kubeflow_tpu.models.quant import quantize_params
+
+            params = jax.jit(quantize_params, donate_argnums=0)(params)
+        service = CompletionService(params, cfg)
+        httpd = serve(service, host=args.host, port=args.port)
+        print(
+            f"completion server on http://{args.host}:"
+            f"{httpd.server_address[1]} (config={args.config}, "
+            f"int8={args.int8})",
+            flush=True,
+        )
+        while True:
+            time.sleep(3600)
 
     cfg = getattr(LlamaConfig, args.config)(dtype=jnp.bfloat16)
 

@@ -213,3 +213,19 @@ def test_cli_entrypoint_demo_mode():
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_serves_moe_family():
+    """CompletionService drives the MoE decode path (generate's config
+    dispatch) — ids in, ids out, same surface as dense."""
+    from odh_kubeflow_tpu.models import MoeConfig
+    from odh_kubeflow_tpu.models import moe as moe_lib
+
+    cfg = MoeConfig.mixtral_tiny()
+    params = moe_lib.init_params(jax.random.PRNGKey(5), cfg)
+    svc = CompletionService(
+        params, cfg, prompt_buckets=(8,), batch_buckets=(1,)
+    )
+    out = svc.complete([[2, 7, 1]], max_tokens=4)
+    assert len(out["completions"][0]) == 4
+    assert all(0 <= t < cfg.vocab_size for t in out["completions"][0])
