@@ -349,3 +349,93 @@ def test_platform_router_serves_apps_and_common_per_mount():
     status, _, body = _get(platform.web, "/")
     assert status.startswith("200")
     assert b"Kubeflow on TPU" in body
+
+
+def test_ui_volume_and_tensorboard_flow_over_http():
+    """The VWA + TWA UIs' exact request sequences against the platform:
+    create a volume, see it listed with status, create a tensorboard on
+    it, watch it reach ready, delete both."""
+    import json
+    import time
+    import urllib.request
+
+    from odh_kubeflow_tpu.platform import Platform
+
+    platform = Platform(sim=True)
+    platform.cluster.add_node("cpu-0")
+    platform.api.create(
+        {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "Profile",
+            "metadata": {"name": "demo-team"},
+            "spec": {"owner": {"kind": "User", "name": "demo@example.com"}},
+        }
+    )
+    _, web_port = platform.start(api_port=0, web_port=0)
+    base = f"http://127.0.0.1:{web_port}"
+    token = "t0ken"
+
+    def call(path, method="GET", body=None):
+        headers = {
+            "kubeflow-userid": "demo@example.com",
+            "Content-Type": "application/json",
+        }
+        if method not in ("GET", "HEAD"):
+            headers["Cookie"] = f"XSRF-TOKEN={token}"
+            headers["x-xsrf-token"] = token
+        req = urllib.request.Request(
+            base + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+            headers=headers,
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read().decode())
+
+    try:
+        # VWA create form → table row
+        call(
+            "/volumes/api/namespaces/demo-team/pvcs",
+            method="POST",
+            body={
+                "pvc": {
+                    "metadata": {"name": "logs-vol"},
+                    "spec": {
+                        "accessModes": ["ReadWriteOnce"],
+                        "resources": {"requests": {"storage": "5Gi"}},
+                    },
+                }
+            },
+        )
+        rows = call("/volumes/api/namespaces/demo-team/pvcs")["pvcs"]
+        row = next(r for r in rows if r["name"] == "logs-vol")
+        assert row["capacity"] == "5Gi"
+
+        # TWA create form over that volume → ready row
+        call(
+            "/tensorboards/api/namespaces/demo-team/tensorboards",
+            method="POST",
+            body={"name": "tb1", "logspath": "pvc://logs-vol/traces"},
+        )
+        deadline = time.time() + 15
+        tb = None
+        while time.time() < deadline:
+            tbs = call("/tensorboards/api/namespaces/demo-team/tensorboards")[
+                "tensorboards"
+            ]
+            tb = next(r for r in tbs if r["name"] == "tb1")
+            if tb["status"]["phase"] == "ready":
+                break
+            time.sleep(0.3)
+        assert tb and tb["status"]["phase"] == "ready", tb
+        assert tb["logspath"] == "pvc://logs-vol/traces"
+
+        # the UI delete buttons
+        call(
+            "/tensorboards/api/namespaces/demo-team/tensorboards/tb1",
+            method="DELETE",
+        )
+        call("/volumes/api/namespaces/demo-team/pvcs/logs-vol", method="DELETE")
+        assert call("/volumes/api/namespaces/demo-team/pvcs")["pvcs"] == []
+    finally:
+        platform.stop()
