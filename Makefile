@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint loadtest images bench dryrun
+.PHONY: all test test-unit test-manifests lint loadtest images bench dryrun platform serve spawn-latency
 
 all: lint test
 
@@ -35,6 +35,14 @@ images:
 
 bench:
 	$(PYTHON) bench.py
+
+# all-in-one platform with the sim kubelet (see docs/GUIDE.md)
+platform:
+	$(PYTHON) -m odh_kubeflow_tpu.platform --sim
+
+# completion server in demo mode on the attached accelerator
+serve:
+	$(PYTHON) -m odh_kubeflow_tpu.models.serve --config llama3_1b --int8
 
 # multi-chip sharding compile check on a virtual 8-device CPU mesh
 dryrun:
