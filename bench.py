@@ -4,21 +4,32 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
 
 The reference platform publishes no perf numbers (BASELINE.md); the
-north star from BASELINE.json is >=50% MFU on a Llama LoRA fine-tune
-from a notebook, so ``vs_baseline`` is measured MFU / 0.50.
+north star from BASELINE.json is >=50% MFU on a Llama-3-**8B** LoRA
+fine-tune from a notebook, so ``vs_baseline`` is measured MFU / 0.50.
 
-Three regimes are measured (VERDICT r1 asked for the hard one to be a
-captured number, not a commit message):
-- headline: Llama-3.2-1B LoRA train step, seq 1024 — the easy regime;
-- long-context: same model at seq 16384, where attention dominates and
-  the pallas flash kernel (ops/pallas_attention.py, causal block skip)
-  is the difference between running and OOM;
-- dense-vs-flash attention op at seq 4096 — the kernel's win as a
-  direct step-time ratio.
+Headline: **Llama-3-8B QLoRA** (int8 frozen base + LoRA r16, seq 4096)
+on the attached chip — the north-star model itself, which bf16 cannot
+even load on one v5e. The value is **strict MFU**: useful FLOPs only,
+where frozen matmuls credit 2× forward (their dW is never computed)
+and attention credits 3× (its backward is required to reach the
+adapters) — see Trainer.benchmark. The laxer 6ND/3× figure most
+published "LoRA MFU" numbers use is reported alongside as
+``mfu_train_equiv_3x``. Falls back to the 1B headline (metric name
+``llama1b_lora_train_mfu``) if the 8B path fails, or when
+BENCH_HEADLINE=1b.
+
+Also measured, budget-permitting (VERDICT r1 asked for the hard
+regimes to be captured numbers, not commit messages):
+- Llama-3.2-1B LoRA at seq 1024 — round-1/2 continuity numbers;
+- long context: 1B at seq 16384, where attention dominates and the
+  pallas flash kernel (ops/pallas_attention.py, causal block skip) is
+  the difference between running and OOM;
+- dense-vs-flash attention op at seq 4096;
+- KV-cache decode smoke.
 
 MFU accounting counts causally-required attention FLOPs only
 (models/llama.py flops_per_token), so block-skipping cannot inflate it.
-Set BENCH_FAST=1 to skip the long-context/op comparisons (CI smoke).
+Set BENCH_FAST=1 to skip everything but the headline (CI smoke).
 """
 
 from __future__ import annotations
@@ -103,7 +114,7 @@ def main() -> None:
     # out even if cold compiles eat the driver's timeout — extras are
     # skipped once the budget is spent
     t_start = time.time()
-    budget_s = float(os.environ.get("BENCH_BUDGET", "420"))
+    budget_s = float(os.environ.get("BENCH_BUDGET", "540"))
 
     def over_budget() -> bool:
         return time.time() - t_start > budget_s
@@ -117,6 +128,49 @@ def main() -> None:
     cfg = LlamaConfig.llama3_1b(dtype=jnp.bfloat16)
     impl = resolved_attention_impl(cfg)
     mesh = build_mesh(MeshConfig(fsdp=n), devices)
+    detail = {
+        "devices": n,
+        "device_kind": getattr(devices[0], "device_kind", "cpu"),
+        "attention_impl": impl,
+    }
+
+    # -- headline: 8B QLoRA (north-star model), single chip or mesh ----
+    headline = None  # (metric, value, vs_baseline)
+    is_tpu = peak > 0
+    want_8b = is_tpu and os.environ.get("BENCH_HEADLINE", "8b") != "1b"
+    if want_8b:
+        try:
+            cfg8 = LlamaConfig.llama3_8b(dtype=jnp.bfloat16, remat_policy="none")
+            t8 = Trainer(
+                cfg8,
+                TrainConfig(warmup_steps=2, total_steps=100),
+                lora_cfg=LoraConfig(rank=16),
+                mesh=mesh,
+                quantize_base=True,
+            )
+            s8 = t8.benchmark(
+                max(2, n) if n > 1 else 2, 4096, steps=3, warmup=1
+            )
+            mfu8 = s8["flops_per_s"] / peak
+            detail["headline_8b_qlora"] = {
+                "batch": max(2, n) if n > 1 else 2,
+                "seq": 4096,
+                "lora_rank": 16,
+                "int8_base": True,
+                "step_time_s": round(s8["step_time_s"], 4),
+                "tokens_per_s": round(s8["tokens_per_s"], 1),
+                "mfu_strict": round(mfu8, 4),
+                "mfu_train_equiv_3x": round(
+                    s8["train_equiv_flops_per_s"] / peak, 4
+                ),
+                "loss": round(s8["loss"], 4),
+            }
+            headline = ("llama8b_qlora_train_mfu", mfu8, mfu8 / 0.50)
+            del t8
+        except Exception as e:  # noqa: BLE001 — fall back to the 1B headline
+            detail["headline_8b_qlora"] = {"error": str(e)[:200]}
+
+    # -- 1B LoRA (round-1/2 continuity regime) -------------------------
     trainer = Trainer(
         cfg,
         TrainConfig(warmup_steps=2, total_steps=100),
@@ -125,16 +179,20 @@ def main() -> None:
     )
     stats = trainer.benchmark(batch_size, seq_len, steps=steps, warmup=2)
 
-    detail = {
-        "devices": n,
-        "device_kind": getattr(devices[0], "device_kind", "cpu"),
-        "attention_impl": impl,
-        "batch": batch_size,
-        "seq": seq_len,
-        "step_time_s": round(stats["step_time_s"], 4),
-        "tokens_per_s": round(stats["tokens_per_s"], 1),
-        "loss": round(stats["loss"], 4),
-    }
+    detail.update(
+        {
+            "batch": batch_size,
+            "seq": seq_len,
+            "step_time_s": round(stats["step_time_s"], 4),
+            "tokens_per_s": round(stats["tokens_per_s"], 1),
+            "loss": round(stats["loss"], 4),
+        }
+    )
+    if peak > 0:
+        detail["llama1b_mfu_strict"] = round(stats["flops_per_s"] / peak, 4)
+        detail["llama1b_mfu_train_equiv_3x"] = round(
+            stats["train_equiv_flops_per_s"] / peak, 4
+        )
 
     if not fast and not over_budget():
         # the hard regime: 16k context, attention-dominant. Needs all
@@ -165,7 +223,12 @@ def main() -> None:
                 "tokens_per_s": round(long_stats["tokens_per_s"], 1),
             }
             if peak > 0:
-                long_detail["mfu"] = round(long_stats["flops_per_s"] / peak, 4)
+                long_detail["mfu_strict"] = round(
+                    long_stats["flops_per_s"] / peak, 4
+                )
+                long_detail["mfu_train_equiv_3x"] = round(
+                    long_stats["train_equiv_flops_per_s"] / peak, 4
+                )
             detail["long_context"] = long_detail
         except Exception as e:  # noqa: BLE001 — keep the headline alive
             detail["long_context"] = {"error": str(e)[:200]}
@@ -189,7 +252,11 @@ def main() -> None:
     elif not fast:
         detail["skipped_for_budget"] = ["long_context", "attention_op_ms", "generate"]
 
-    if peak > 0:
+    if headline is not None:
+        metric, value, vs_baseline = headline
+        unit = "mfu"
+    elif peak > 0:
+        # 1B fallback: strict MFU, same convention as the headline
         value = stats["flops_per_s"] / peak
         metric, unit = "llama1b_lora_train_mfu", "mfu"
         vs_baseline = value / 0.50  # north-star: 50% MFU

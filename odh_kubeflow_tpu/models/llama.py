@@ -153,6 +153,16 @@ class LlamaConfig:
         embed = 0  # lookup, not a matmul
         return L * (proj + attn) + head + embed
 
+    def attn_flops_per_token(self, seq_len: int) -> float:
+        """The quadratic (qk^T + av) share of ``flops_per_token`` —
+        split out so training-FLOPs accounting can treat weight matmuls
+        (whose dW is skipped when the base is frozen) differently from
+        attention (whose backward is required work regardless)."""
+        return (
+            self.num_layers
+            * 2 * 2 * self.num_heads * self.head_dim * (seq_len / 2)
+        )
+
 
 # ---------------------------------------------------------------------------
 # init
@@ -266,6 +276,13 @@ def _decoder_layer(
     """
     B, S, D = x.shape
     x = constrain(x, _activation_spec())
+
+    # int8-quantized frozen weights (models/quant.py) dequantize HERE,
+    # inside the (possibly rematerialised) layer body: only the current
+    # layer's bf16 copy ever materialises, and the backward pass
+    # recomputes the dequant from int8 instead of holding 2× weights.
+    # This is what lets an 8B QLoRA fine-tune fit a single 16GiB v5e.
+    layer = _maybe_dequant(layer, cfg.dtype)
 
     h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
     q = _maybe_lora("wq", h, layer["wq"], lora_layer)
@@ -431,7 +448,7 @@ def forward(
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     if return_hidden:
         return x
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    head = lm_head_weight(params, cfg)
     logits = jnp.einsum(
         "bsd,dv->bsv", x, head.astype(cfg.dtype), preferred_element_type=jnp.float32
     )
@@ -510,8 +527,14 @@ def _apply_layers_pipelined(
 
 
 def lm_head_weight(params: Params, cfg: LlamaConfig) -> jnp.ndarray:
-    """[D, V] head matrix (shared with the embedding when tied)."""
-    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    """[D, V] head matrix (shared with the embedding when tied),
+    dequantized if the tree carries an int8 lm_head."""
+    if cfg.tie_embeddings:
+        return params["embed"].T
+    head = params["lm_head"]
+    if isinstance(head, dict):  # int8 {"q","scale"} leaf
+        head = _maybe_dequant({"lm_head": head}, cfg.dtype)["lm_head"]
+    return head
 
 
 def forward_with_cache(
@@ -540,11 +563,10 @@ def forward_with_cache(
 
     def body(x, scanned):
         layer, lora_layer, cache_layer = scanned
-        # int8-quantized weights (models/quant.py) dequantize HERE,
-        # inside the scan body: only the current layer's bf16 copy ever
+        # int8-quantized weights (models/quant.py) dequantize inside
+        # _decoder_layer: only the current layer's bf16 copy ever
         # materialises, so an 8B model serves from ~8GB of int8 on one
         # v5e instead of 16GB of bf16 that wouldn't fit.
-        layer = _maybe_dequant(layer, cfg.dtype)
         x, new_cache = _decoder_layer(
             cfg,
             None,  # attention_fn unused: cache path is always dense
@@ -565,9 +587,7 @@ def forward_with_cache(
     )
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    if isinstance(head, dict):  # quantized lm_head
-        head = _maybe_dequant({"lm_head": head}, cfg.dtype)["lm_head"]
+    head = lm_head_weight(params, cfg)
     logits = jnp.einsum(
         "bsd,dv->bsv", x, head.astype(cfg.dtype), preferred_element_type=jnp.float32
     )

@@ -20,10 +20,12 @@ the bandwidth story); the LM head is quantized like any other matmul.
 
 from __future__ import annotations
 
-from typing import Any
+import zlib
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 Params = dict[str, Any]
 
@@ -90,7 +92,50 @@ def dequantize_params(qparams: Params, dtype=jnp.bfloat16) -> Params:
     return walk(qparams)
 
 
-def streaming_quantized_init(cfg, key: jax.Array, scale: float = 0.02) -> Params:
+def quantized_param_specs(specs: Params) -> Params:
+    """Map a PartitionSpec tree to the shape ``quantize_params`` gives
+    its param tree: each quantized leaf's spec ``P`` becomes
+    ``{"q": P, "scale": P'}`` where P' replicates the contracted
+    (next-to-last) axis — the scale is ``[..., 1, D_out]`` so only the
+    output-channel axis can stay sharded."""
+
+    def scale_spec(spec: P) -> P:
+        parts = list(spec)
+        if len(parts) >= 2:
+            parts[-2] = None
+        return P(*parts)
+
+    def walk(tree):
+        if isinstance(tree, dict):
+            return {
+                k: (
+                    {"q": v, "scale": scale_spec(v)}
+                    if k in _QUANT_LEAVES and isinstance(v, P)
+                    else walk(v)
+                )
+                for k, v in tree.items()
+            }
+        return tree
+
+    return walk(specs)
+
+
+def _leaf_key(key: jax.Array, path: tuple, name: str) -> jax.Array:
+    # crc32, not hash(): python's hash is salted per-process, which
+    # would give each host of a multi-host slice different "random"
+    # weights for the same seed.
+    tag = zlib.crc32("/".join(path + (name,)).encode())
+    return jax.random.fold_in(key, tag % (2**31))
+
+
+def streaming_quantized_init(
+    cfg,
+    key: jax.Array,
+    scale: float = 0.02,
+    *,
+    mesh: Optional[Mesh] = None,
+    specs: Optional[Params] = None,
+) -> Params:
     """Build an int8 param tree leaf-by-leaf on device.
 
     Initialising a big model in bf16 and then quantizing holds both
@@ -99,6 +144,10 @@ def streaming_quantized_init(cfg, key: jax.Array, scale: float = 0.02) -> Params
     before the next, so the peak is the int8 tree plus one transient
     leaf. Weights are random (demo/serving-smoke use; real weights
     arrive via checkpoints).
+
+    With ``mesh`` + ``specs`` (a *quantized* spec tree from
+    ``quantized_param_specs``), every leaf lands pre-sharded via
+    per-leaf ``out_shardings`` — the QLoRA Trainer's frozen-base init.
     """
     from odh_kubeflow_tpu.models import llama
 
@@ -106,28 +155,40 @@ def streaming_quantized_init(cfg, key: jax.Array, scale: float = 0.02) -> Params
         lambda k: llama.init_params(k, cfg, dtype=jnp.bfloat16), key
     )
 
-    def build(tree, path=()):
+    def sharding(spec_leaf):
+        if mesh is None or spec_leaf is None:
+            return None
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s),
+            spec_leaf,
+            is_leaf=lambda s: isinstance(s, P),
+        )
+
+    def build(tree, spec_tree, path=()):
         out = {}
         for k, v in tree.items():
+            spec = None if spec_tree is None else spec_tree.get(k)
             if isinstance(v, dict):
-                out[k] = build(v, path + (k,))
+                out[k] = build(v, spec, path + (k,))
                 continue
-            leaf_key = jax.random.fold_in(key, hash((path, k)) % (2**31))
+            leaf_key = _leaf_key(key, path, k)
             if k in _QUANT_LEAVES:
                 out[k] = jax.jit(
                     lambda kk, sh=v.shape: quantize_tensor(
                         jax.random.normal(kk, sh, jnp.bfloat16) * scale
-                    )
+                    ),
+                    out_shardings=sharding(spec),
                 )(leaf_key)
             else:
                 out[k] = jax.jit(
                     lambda kk, sh=v.shape, dt=v.dtype: (
                         jax.random.normal(kk, sh, jnp.float32) * scale
-                    ).astype(dt)
+                    ).astype(dt),
+                    out_shardings=sharding(spec),
                 )(leaf_key)
         return out
 
-    return build(shapes)
+    return build(shapes, specs)
 
 
 def quantization_error(params: Params, qparams: Params) -> dict[str, float]:

@@ -158,6 +158,7 @@ class Trainer:
         lora_cfg: Optional[lora_lib.LoraConfig] = None,
         mesh: Optional[Mesh] = None,
         seed: int = 0,
+        quantize_base: bool = False,
     ):
         from odh_kubeflow_tpu.models import moe as moe_lib
 
@@ -168,8 +169,19 @@ class Trainer:
                 "LoRA adapters are wired for the dense family only; "
                 "MoE trains full-parameter"
             )
+        if quantize_base and lora_cfg is None:
+            raise ValueError(
+                "quantize_base freezes the base weights as int8 — it "
+                "requires LoRA adapters to have anything to train"
+            )
+        if quantize_base and self.is_moe:
+            raise NotImplementedError(
+                "quantize_base is wired for the dense family (QLoRA); "
+                "MoE quantization exists on the decode path only"
+            )
         self.train_cfg = train_cfg
         self.lora_cfg = lora_cfg
+        self.quantize_base = quantize_base
         self.mesh = mesh if mesh is not None else build_mesh()
         self.optimizer = _make_optimizer(train_cfg)
 
@@ -194,17 +206,29 @@ class Trainer:
             init_partial = partial(
                 llama.init_params, cfg=model_cfg, dtype=model_cfg.dtype
             )
+        if quantize_base:
+            from odh_kubeflow_tpu.models import quant as quant_lib
+
+            p_specs = quant_lib.quantized_param_specs(p_specs)
         if self.pipelined:
             # stage ownership: every stacked per-layer leaf shards its
             # leading L dim over the pipe axis (device p holds its
             # stage's layers; parallel/pipeline.py runs the schedule)
             p_specs = _pipe_shard_layer_specs(p_specs)
+        self._frozen_specs = p_specs
         with jax.set_mesh(self.mesh):
-            init_fn = jax.jit(
-                init_partial,
-                out_shardings=self._sh(p_specs),
-            )
-            self.params = init_fn(k_params)
+            if quantize_base:
+                # leaf-streamed int8 init: never holds the bf16 tree
+                # (8B bf16 alone would OOM the 16GiB v5e this targets)
+                self.params = quant_lib.streaming_quantized_init(
+                    model_cfg, k_params, mesh=self.mesh, specs=p_specs
+                )
+            else:
+                init_fn = jax.jit(
+                    init_partial,
+                    out_shardings=self._sh(p_specs),
+                )
+                self.params = init_fn(k_params)
             if lora_cfg is not None:
                 l_specs = lora_lib.lora_specs(model_cfg, lora_cfg)
                 if self.pipelined:
@@ -348,12 +372,9 @@ class Trainer:
             return trainable, opt_state, {"loss": loss, "grad_norm": gnorm}
 
         train_sh = self._sh(self._train_specs)
-        if self.lora_cfg is not None:
-            frozen_specs = llama.param_specs(self.model_cfg)
-            if self.pipelined:
-                frozen_specs = _pipe_shard_layer_specs(frozen_specs)
-        else:
-            frozen_specs = self._train_specs
+        # frozen tree shards as initialised (quantized or not); on the
+        # full-fine-tune path frozen IS the trainable tree.
+        frozen_specs = self._frozen_specs
         opt_sh = self._sh(self._opt_specs)
         return jax.jit(
             step_fn,
@@ -451,13 +472,26 @@ class Trainer:
         loss = float(metrics["loss"])
         dt = (time.perf_counter() - t0) / steps
         tokens = batch_size * seq_len
-        # fwd + bwd ≈ 3× forward matmul flops (LoRA bwd still back-props
-        # through the frozen matmuls, so the classic 3× estimate holds).
-        flops = 3 * self.model_cfg.flops_per_token(seq_len) * tokens
+        # Useful-FLOPs accounting (strict MFU, the PaLM-paper sense):
+        # - full fine-tune: fwd + bwd ≈ 3× forward (dx + dW per matmul);
+        # - LoRA / frozen base: dW of every frozen matmul is *not*
+        #   computed, so weight matmuls cost 2× (fwd + dx) — but the
+        #   attention backward (dQ/dK/dV) is required to reach the
+        #   adapters upstream, so the quadratic term still counts 3×.
+        # Rematerialisation recompute is never credited; the 3×-based
+        # figure is additionally reported as train_equiv_flops_per_s
+        # (the 6ND convention most cited "LoRA MFU" numbers use).
+        fpt = self.model_cfg.flops_per_token(seq_len)
+        if self.lora_cfg is not None:
+            attn_fpt = self.model_cfg.attn_flops_per_token(seq_len)
+            flops = (2 * fpt + attn_fpt) * tokens
+        else:
+            flops = 3 * fpt * tokens
         return {
             "step_time_s": dt,
             "tokens_per_s": tokens / dt,
             "model_flops_per_step": flops,
             "flops_per_s": flops / dt,
+            "train_equiv_flops_per_s": 3 * fpt * tokens / dt,
             "loss": loss,
         }
