@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint loadtest images bench dryrun platform serve spawn-latency
+.PHONY: all test test-unit test-manifests lint loadtest images bench dryrun platform serve spawn-latency native
 
 all: lint test
 
@@ -29,6 +29,12 @@ loadtest:
 
 spawn-latency:
 	$(PYTHON) -m loadtest.spawn_latency --record
+
+# C++ host-side components (input-pipeline packer); lazy-built on first
+# import too — this target just front-loads the compile
+native:
+	$(PYTHON) -c "from odh_kubeflow_tpu import native; so = native.build(force=True); \
+	  import sys; print(so) if so else sys.exit('no C++ compiler found')"
 
 images:
 	$(MAKE) -C images build

@@ -1,0 +1,57 @@
+"""Native-vs-Python packer wall-clock comparison.
+
+The packer is the host-side hot loop feeding the chip
+(train/data.pack_documents); the C++ pass writes each output element
+once while the Python path does per-piece numpy slicing. Run:
+``python -m loadtest.packer_bench``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    from odh_kubeflow_tpu import native
+    from odh_kubeflow_tpu.train.data import pack_documents
+
+    if not native.available():
+        print(json.dumps({"error": "no C++ compiler; native packer unavailable"}))
+        return
+
+    rng = np.random.default_rng(0)
+    docs = [
+        list(rng.integers(1, 32000, size=rng.integers(20, 2000)))
+        for _ in range(20_000)
+    ]
+    total_tokens = sum(len(d) for d in docs)
+
+    t0 = time.perf_counter()
+    n_py = sum(1 for _ in pack_documents(docs, 8, 2048, engine="python"))
+    t_py = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    n_nat = sum(1 for _ in pack_documents(docs, 8, 2048, engine="native"))
+    t_nat = time.perf_counter() - t0
+    assert n_py == n_nat
+
+    print(
+        json.dumps(
+            {
+                "docs": len(docs),
+                "total_tokens": total_tokens,
+                "batches": n_py,
+                "python_s": round(t_py, 3),
+                "native_s": round(t_nat, 3),
+                "speedup": round(t_py / t_nat, 1),
+                "native_tokens_per_s": round(total_tokens / t_nat),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,140 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The TPU compute path is JAX/XLA; these are the host-side hot loops
+around it. Each component ships as a single .cpp with a plain C ABI
+(this image has no pybind11) plus a ctypes wrapper here. The shared
+object is built on first use with the system g++ and cached next to the
+source; everything degrades gracefully to the pure-Python
+implementation when no compiler is available (``available()`` →
+False), so the package has no hard native dependency.
+
+Build explicitly with ``make native`` (top-level Makefile) or let the
+first import compile lazily.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "packer.cpp")
+_SO = os.path.join(_DIR, "libodhkf_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def build(force: bool = False) -> Optional[str]:
+    """Compile the native library; returns the .so path or None when no
+    compiler exists. Compiles into a temp file then atomically renames,
+    so concurrent builders race benignly."""
+    if not force and os.path.exists(_SO):
+        if os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return _SO
+    cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+    if cxx is None:
+        return None
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+    os.close(fd)
+    try:
+        subprocess.run(
+            [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, _SO)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return _SO
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            so = build()
+            if so is None:
+                _load_failed = True
+                return None
+            lib = ctypes.CDLL(so)
+            lib.pack_documents_c.restype = ctypes.c_long
+            lib.pack_documents_c.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_long,
+                ctypes.c_long,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_long,
+            ]
+            _lib = lib
+        except (OSError, subprocess.CalledProcessError):
+            _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def pack_rows(
+    flat: np.ndarray,  # int32 [total] concatenated tokens
+    doc_lens: np.ndarray,  # int64 [n_docs]
+    seq_len: int,
+    pad_id: int = 0,
+) -> dict:
+    """Pack the whole document stream into [n_rows, seq_len] arrays in
+    one native pass. Raises RuntimeError when the native library is
+    unavailable — callers (train/data.py) decide the fallback."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native packer unavailable (no C++ compiler)")
+    flat = np.ascontiguousarray(flat, np.int32)
+    doc_lens = np.ascontiguousarray(doc_lens, np.int64)
+    total = int(doc_lens.sum())
+    if total != flat.size:
+        raise ValueError(f"doc_lens sum {total} != flat size {flat.size}")
+    max_rows = max((total + seq_len - 1) // seq_len, 1)
+    tokens = np.full((max_rows, seq_len), pad_id, np.int32)
+    targets = np.full((max_rows, seq_len), pad_id, np.int32)
+    seg_ids = np.zeros((max_rows, seq_len), np.int32)
+    loss_mask = np.zeros((max_rows, seq_len), np.float32)
+    n = lib.pack_documents_c(
+        _i32p(flat),
+        doc_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(doc_lens),
+        seq_len,
+        _i32p(tokens),
+        _i32p(targets),
+        _i32p(seg_ids),
+        loss_mask.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        max_rows,
+    )
+    if n < 0:
+        raise RuntimeError("native packer overflowed its row bound (bug)")
+    return {
+        "tokens": tokens[:n],
+        "targets": targets[:n],
+        "segment_ids": seg_ids[:n],
+        "loss_mask": loss_mask[:n],
+    }

@@ -38,6 +38,7 @@ def pack_documents(
     *,
     pad_id: int = 0,
     drop_remainder: bool = True,
+    engine: str = "auto",
 ) -> Iterator[Batch]:
     """Greedy sequence packing into [B, S] training batches.
 
@@ -46,7 +47,47 @@ def pack_documents(
     its own segment; targets are next-token *within a piece*, so the
     last token of every piece (and all padding) is masked out of the
     loss — the cost of keeping rows independent under sharding.
+
+    ``engine``: "auto" uses the native C++ packer
+    (``odh_kubeflow_tpu.native``) when the documents are already
+    materialised (list/tuple) and a compiler built the library —
+    bit-identical output, one write per element instead of per-piece
+    numpy slicing; "python"/"native" force a path. Generators always
+    stream through the Python path (packing is a strict concatenation,
+    so rows cross chunk boundaries and can't be windowed natively).
+
+    Not itself a generator: engine/argument errors raise at the call
+    site, then the returned iterator streams lazily.
     """
+    if engine not in ("auto", "python", "native"):
+        raise ValueError(f"unknown engine {engine!r}")
+    if engine != "python" and isinstance(documents, (list, tuple)):
+        from odh_kubeflow_tpu import native
+
+        if native.available():
+            return _pack_documents_native(
+                documents, batch_size, seq_len, pad_id, drop_remainder
+            )
+        if engine == "native":
+            raise RuntimeError(
+                "engine='native' requested but no C++ compiler is available"
+            )
+    elif engine == "native":
+        raise RuntimeError(
+            "engine='native' needs a materialised list/tuple of documents"
+        )
+    return _pack_documents_python(
+        documents, batch_size, seq_len, pad_id, drop_remainder
+    )
+
+
+def _pack_documents_python(
+    documents: Iterable[Sequence[int]],
+    batch_size: int,
+    seq_len: int,
+    pad_id: int,
+    drop_remainder: bool,
+) -> Iterator[Batch]:
     rows: list[list[tuple[int, list[int]]]] = []  # [(segment, tokens)]
     current: list[tuple[int, list[int]]] = []
     used = 0
@@ -80,6 +121,44 @@ def pack_documents(
         while len(rows) < batch_size:
             rows.append([])
         yield _emit(rows, seq_len, pad_id)
+
+
+def _pack_documents_native(
+    documents: Sequence[Sequence[int]],
+    batch_size: int,
+    seq_len: int,
+    pad_id: int,
+    drop_remainder: bool,
+) -> Iterator[Batch]:
+    """One native pass over the concatenated stream, then yield [B, S]
+    windows. Output is bit-identical to the Python generator path
+    (contract-tested in tests/test_native.py)."""
+    from odh_kubeflow_tpu import native
+
+    doc_lens = np.fromiter(
+        (len(d) for d in documents), np.int64, count=len(documents)
+    )
+    flat = np.empty(int(doc_lens.sum()), np.int32)
+    pos = 0
+    for d, n in zip(documents, doc_lens):
+        flat[pos : pos + n] = d
+        pos += n
+    packed = native.pack_rows(flat, doc_lens, seq_len, pad_id=pad_id)
+    n_rows = packed["tokens"].shape[0]
+    full = (n_rows // batch_size) * batch_size
+    for start in range(0, full, batch_size):
+        yield {k: v[start : start + batch_size] for k, v in packed.items()}
+    rem = n_rows - full
+    if rem and not drop_remainder:
+        out = {
+            "tokens": np.full((batch_size, seq_len), pad_id, np.int32),
+            "targets": np.full((batch_size, seq_len), pad_id, np.int32),
+            "segment_ids": np.zeros((batch_size, seq_len), np.int32),
+            "loss_mask": np.zeros((batch_size, seq_len), np.float32),
+        }
+        for k, v in packed.items():
+            out[k][:rem] = v[full:]
+        yield out
 
 
 def _emit(rows, seq_len: int, pad_id: int) -> Batch:
