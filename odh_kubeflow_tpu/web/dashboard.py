@@ -169,6 +169,49 @@ class DashboardApp:
                 )
             return success({"namespaces": out})
 
+        @app.route("/api/activities/<namespace>")
+        def activities(request, namespace):
+            """Namespace activity feed (reference: centraldashboard
+            api.ts events route feeding main-page's activities view):
+            recent k8s Events, newest first, access-gated like every
+            other per-namespace view."""
+            user = user_of(request)
+            if not (
+                namespace in self.kfam.namespaces_for_user(user)
+                or self.kfam.is_cluster_admin(user)
+            ):
+                return failure(f"{user} has no access to {namespace}", 403)
+
+            def stamp(e):
+                return (
+                    e.get("lastTimestamp")
+                    or e.get("firstTimestamp")
+                    or obj_util.get_path(
+                        e, "metadata", "creationTimestamp", default=""
+                    )
+                )
+
+            events = sorted(
+                self.api.list("Event", namespace=namespace),
+                key=stamp,
+                reverse=True,
+            )[:100]
+            rows = [
+                {
+                    "time": stamp(e),
+                    "type": e.get("type", "Normal"),
+                    "reason": e.get("reason", ""),
+                    "message": e.get("message", ""),
+                    "involved": "{}/{}".format(
+                        e.get("involvedObject", {}).get("kind", ""),
+                        e.get("involvedObject", {}).get("name", ""),
+                    ),
+                    "count": e.get("count", 1),
+                }
+                for e in events
+            ]
+            return success({"activities": rows})
+
         @app.route("/api/metrics")
         def metrics_panel(request):
             """Cluster metrics panels (metrics_service.ts analog): TPU

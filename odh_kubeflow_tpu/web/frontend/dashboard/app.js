@@ -74,6 +74,7 @@ function sidebar() {
       link("notebooks", "Notebooks"),
       link("volumes", "Volumes"),
       link("tensorboards", "TensorBoards"),
+      link("activities", "Activities"),
       link("contributors", "Manage Contributors"),
       state.isClusterAdmin ? link("admin", "All Namespaces") : null
     ),
@@ -206,6 +207,52 @@ function registrationView() {
       )
     )
   );
+}
+
+async function activitiesView() {
+  /* Reference: main-page.js activities view — recent namespace events,
+   * newest first, Warning rows highlighted. */
+  const view = h("div", { class: "kf-page kd-view" });
+  const ns = state.namespace;
+  if (!ns) {
+    view.append(h("div", { class: "kf-card kf-muted" }, "Pick a namespace first."));
+    return view;
+  }
+  let rows = [];
+  try {
+    rows = (await api(`api/activities/${ns}`)).activities || [];
+  } catch (e) {
+    view.append(h("div", { class: "kf-card kf-muted" }, e.message));
+    return view;
+  }
+  view.append(
+    h(
+      "div",
+      { class: "kf-card" },
+      h("h2", {}, `Recent activity in ${ns}`),
+      resourceTable({
+        empty: "No events recorded in this namespace.",
+        columns: [
+          { title: "When", field: "time" },
+          {
+            title: "Type",
+            render: (r) =>
+              h(
+                "span",
+                { class: r.type === "Warning" ? "kf-status-warning" : "kf-muted" },
+                r.type
+              ),
+          },
+          { title: "Object", field: "involved" },
+          { title: "Reason", field: "reason" },
+          { title: "Message", field: "message" },
+          { title: "Count", field: "count" },
+        ],
+        rows,
+      })
+    )
+  );
+  return view;
 }
 
 async function contributorsView() {
@@ -346,6 +393,11 @@ async function render() {
     main.append(toolbar(), h("div", { class: "kd-content" }, registrationView()));
   } else if (APPS[state.view]) {
     main.append(toolbar(), h("div", { class: "kd-content" }, appView(state.view)));
+  } else if (state.view === "activities") {
+    main.append(
+      toolbar(),
+      h("div", { class: "kd-content" }, await activitiesView())
+    );
   } else if (state.view === "contributors") {
     main.append(
       toolbar(),

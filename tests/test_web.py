@@ -364,6 +364,40 @@ def test_kfam_and_dashboard(env):
         "/api/workgroup/contributors/team-a", user="root@example.com"
     )
     assert body["contributors"] == []
+
+    # activity feed: namespace events, newest first, access-gated
+    api.create(
+        {
+            "kind": "Event",
+            "apiVersion": "v1",
+            "metadata": {"name": "nb-ev-1", "namespace": "team-a"},
+            "type": "Warning",
+            "reason": "FailedScheduling",
+            "message": "0/3 nodes have google.com/tpu",
+            "involvedObject": {"kind": "Notebook", "name": "nb1"},
+            "lastTimestamp": "2026-07-30T10:00:00Z",
+        },
+    )
+    api.create(
+        {
+            "kind": "Event",
+            "apiVersion": "v1",
+            "metadata": {"name": "nb-ev-2", "namespace": "team-a"},
+            "type": "Normal",
+            "reason": "Created",
+            "message": "created sts",
+            "involvedObject": {"kind": "StatefulSet", "name": "nb1"},
+            "lastTimestamp": "2026-07-30T11:00:00Z",
+        },
+    )
+    status, body = dc.get("/api/activities/team-a", user="root@example.com")
+    assert status == 200
+    acts = body["activities"]
+    assert [a["reason"] for a in acts[:2]] == ["Created", "FailedScheduling"]
+    assert acts[1]["involved"] == "Notebook/nb1"
+    status, _ = dc.get("/api/activities/team-a", user="stranger@example.com")
+    assert status == 403
+
     kfam_server.shutdown()
     dash_server.shutdown()
 
