@@ -138,11 +138,14 @@ def _pack_documents_native(
     doc_lens = np.fromiter(
         (len(d) for d in documents), np.int64, count=len(documents)
     )
-    flat = np.empty(int(doc_lens.sum()), np.int32)
-    pos = 0
-    for d, n in zip(documents, doc_lens):
-        flat[pos : pos + n] = d
-        pos += n
+    # ndarray documents (the memmapped-tokenizer-output case) concatenate
+    # as fast memcpy casts; python-list documents pay one per-element
+    # conversion here — the same cost the python path pays writing each
+    # piece, so native still wins on everything after the flatten.
+    flat = np.concatenate(
+        [np.asarray(d, np.int32) for d in documents]
+        or [np.empty(0, np.int32)]
+    )
     packed = native.pack_rows(flat, doc_lens, seq_len, pad_id=pad_id)
     n_rows = packed["tokens"].shape[0]
     full = (n_rows // batch_size) * batch_size
