@@ -21,6 +21,10 @@ from odh_kubeflow_tpu.parallel.mesh import AXIS_FSDP, AXIS_TENSOR
 
 Params = dict[str, Any]
 
+# the only valid targets for the MoE family (its expert banks replace
+# the dense MLP weights; adapters attach to attention projections)
+ATTENTION_TARGETS = ("wq", "wk", "wv", "wo")
+
 _TARGET_DIMS = {
     # name -> (fan_in attr, fan_out attr) resolved against LlamaConfig
     "wq": ("hidden_size", "q_dim"),
@@ -37,7 +41,7 @@ _TARGET_DIMS = {
 class LoraConfig:
     rank: int = 16
     alpha: float = 32.0
-    targets: Sequence[str] = ("wq", "wk", "wv", "wo")
+    targets: Sequence[str] = ATTENTION_TARGETS
 
     @property
     def scale(self) -> float:

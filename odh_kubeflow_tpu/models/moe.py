@@ -113,6 +113,12 @@ class MoeConfig:
             (self.num_experts_per_tok - 1) * mlp + router
         )
 
+    def attn_flops_per_token(self, seq_len: int) -> float:
+        """Quadratic attention share — identical to the backbone's
+        (experts replace only the MLP); used by the strict LoRA MFU
+        accounting in ``Trainer.benchmark``."""
+        return self.base.attn_flops_per_token(seq_len)
+
 
 # ---------------------------------------------------------------------------
 # params
@@ -227,19 +233,32 @@ def moe_mlp(
 # decoder layer + forward (mirrors llama.forward's API)
 
 
-def _moe_decoder_layer(cfg: MoeConfig, attention_fn, x, layer, sin, cos, segment_ids):
+def _moe_decoder_layer(
+    cfg: MoeConfig, attention_fn, x, layer, lora_layer, sin, cos, segment_ids
+):
+    """LoRA adapters attach to the attention projections only (the
+    standard MoE-LoRA recipe — expert banks stay frozen); int8 leaves
+    (``models/quant.py``) dequantize here inside the remat boundary,
+    mirroring the dense family's QLoRA memory story."""
     b = cfg.base
     B, S, D = x.shape
     x = constrain(x, llama._activation_spec())
+    layer = llama._maybe_dequant(layer, b.dtype)
 
     h = rms_norm(x, layer["attn_norm"], b.rms_norm_eps)
-    q = (h @ layer["wq"].astype(x.dtype)).reshape(B, S, b.num_heads, b.head_dim)
-    k = (h @ layer["wk"].astype(x.dtype)).reshape(B, S, b.num_kv_heads, b.head_dim)
-    v = (h @ layer["wv"].astype(x.dtype)).reshape(B, S, b.num_kv_heads, b.head_dim)
+    q = llama._maybe_lora("wq", h, layer["wq"], lora_layer).reshape(
+        B, S, b.num_heads, b.head_dim
+    )
+    k = llama._maybe_lora("wk", h, layer["wk"], lora_layer).reshape(
+        B, S, b.num_kv_heads, b.head_dim
+    )
+    v = llama._maybe_lora("wv", h, layer["wv"], lora_layer).reshape(
+        B, S, b.num_kv_heads, b.head_dim
+    )
     q = llama.apply_rope(q, sin, cos)
     k = llama.apply_rope(k, sin, cos)
     attn = attention_fn(q, k, v, segment_ids=segment_ids).reshape(B, S, b.q_dim)
-    x = x + attn @ layer["wo"].astype(x.dtype)
+    x = x + llama._maybe_lora("wo", attn, layer["wo"], lora_layer)
 
     h = rms_norm(x, layer["mlp_norm"], b.rms_norm_eps)
     moe_out, aux = moe_mlp(h, layer, cfg)
@@ -264,26 +283,27 @@ def forward_with_cache(
     the router+experts. Routing a 1-token decode step degenerates to
     capacity-1 per expert, which top-k's distinct choices always fit.
     int8-quantized trees (``models/quant.py``) dequantize per layer
-    like the dense path. ``lora`` is unused (MoE trains
-    full-parameter) and accepted for signature parity.
+    like the dense path. ``lora`` carries attention-projection
+    adapters (the MoE-LoRA targets), so a LoRA-tuned MoE decodes
+    without merging.
     """
-    del lora
     b = cfg.base
     sin, cos = rope_angles(positions, b.head_dim, b.rope_theta)
     x = jnp.take(params["embed"], tokens, axis=0).astype(b.dtype)
     B, S, D = x.shape
+    lora_layers = lora["layers"] if lora is not None else None
 
     def body(x, scanned):
-        layer, cache_layer = scanned
+        layer, lora_layer, cache_layer = scanned
         layer = llama._maybe_dequant(layer, b.dtype)
         h = rms_norm(x, layer["attn_norm"], b.rms_norm_eps)
-        q = (h @ layer["wq"].astype(x.dtype)).reshape(
+        q = llama._maybe_lora("wq", h, layer["wq"], lora_layer).reshape(
             B, S, b.num_heads, b.head_dim
         )
-        k = (h @ layer["wk"].astype(x.dtype)).reshape(
+        k = llama._maybe_lora("wk", h, layer["wk"], lora_layer).reshape(
             B, S, b.num_kv_heads, b.head_dim
         )
-        v = (h @ layer["wv"].astype(x.dtype)).reshape(
+        v = llama._maybe_lora("wv", h, layer["wv"], lora_layer).reshape(
             B, S, b.num_kv_heads, b.head_dim
         )
         q = llama.apply_rope(q, sin, cos)
@@ -301,16 +321,16 @@ def forward_with_cache(
         attn = dense_attention(
             q, ck, cv, causal=True, q_offset=cache_index, kv_mask=kv_mask
         ).reshape(B, S, b.q_dim)
-        x = x + attn @ layer["wo"].astype(x.dtype)
+        x = x + llama._maybe_lora("wo", attn, layer["wo"], lora_layer)
         h = rms_norm(x, layer["mlp_norm"], b.rms_norm_eps)
         moe_out, _aux = moe_mlp(h, layer, cfg)
         return x + moe_out, {"k": ck, "v": cv}
 
-    x, new_cache = jax.lax.scan(body, x, (params["layers"], cache))
+    x, new_cache = jax.lax.scan(
+        body, x, (params["layers"], lora_layers, cache)
+    )
     x = rms_norm(x, params["final_norm"], b.rms_norm_eps)
-    head = llama.lm_head_weight(params, b)
-    if isinstance(head, dict):  # quantized lm_head
-        head = llama._maybe_dequant({"lm_head": head}, b.dtype)["lm_head"]
+    head = llama.lm_head_weight(params, b)  # dequantizes int8 lm_head
     logits = jnp.einsum(
         "bsd,dv->bsv", x, head.astype(b.dtype),
         preferred_element_type=jnp.float32,
@@ -322,6 +342,7 @@ def forward(
     params: Params,
     tokens: jnp.ndarray,  # [B, S] int32
     cfg: MoeConfig,
+    lora: Optional[Params] = None,
     positions: Optional[jnp.ndarray] = None,
     segment_ids: Optional[jnp.ndarray] = None,
     return_hidden: bool = False,
@@ -339,14 +360,16 @@ def forward(
     layer_fn = partial(_moe_decoder_layer, cfg, attention_fn)
     if b.remat:
         layer_fn = jax.checkpoint(layer_fn)
+    lora_layers = lora["layers"] if lora is not None else None
 
     def body(carry, scanned):
         x, aux = carry
-        x, layer_aux = layer_fn(x, scanned, sin, cos, segment_ids)
+        layer, lora_layer = scanned
+        x, layer_aux = layer_fn(x, layer, lora_layer, sin, cos, segment_ids)
         return (x, aux + layer_aux), None
 
     (x, aux_total), _ = jax.lax.scan(
-        body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+        body, (x, jnp.zeros((), jnp.float32)), (params["layers"], lora_layers)
     )
 
     x = rms_norm(x, params["final_norm"], b.rms_norm_eps)

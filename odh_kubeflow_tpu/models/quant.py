@@ -148,11 +148,16 @@ def streaming_quantized_init(
     With ``mesh`` + ``specs`` (a *quantized* spec tree from
     ``quantized_param_specs``), every leaf lands pre-sharded via
     per-leaf ``out_shardings`` — the QLoRA Trainer's frozen-base init.
+    ``cfg`` may be a LlamaConfig or a MoeConfig (expert banks quantize
+    like any other matmul bank).
     """
-    from odh_kubeflow_tpu.models import llama
+    from odh_kubeflow_tpu.models import llama, moe
 
+    init = (
+        moe.init_params if isinstance(cfg, moe.MoeConfig) else llama.init_params
+    )
     shapes = jax.eval_shape(
-        lambda k: llama.init_params(k, cfg, dtype=jnp.bfloat16), key
+        lambda k: init(k, cfg, dtype=jnp.bfloat16), key
     )
 
     def sharding(spec_leaf):

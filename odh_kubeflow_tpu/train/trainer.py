@@ -165,19 +165,17 @@ class Trainer:
         self.model_cfg = model_cfg
         self.is_moe = isinstance(model_cfg, moe_lib.MoeConfig)
         if self.is_moe and lora_cfg is not None:
-            raise NotImplementedError(
-                "LoRA adapters are wired for the dense family only; "
-                "MoE trains full-parameter"
-            )
+            bad = set(lora_cfg.targets) - set(lora_lib.ATTENTION_TARGETS)
+            if bad:
+                raise ValueError(
+                    f"MoE LoRA adapts attention projections only "
+                    f"(expert banks replace the dense MLP); invalid "
+                    f"targets: {sorted(bad)}"
+                )
         if quantize_base and lora_cfg is None:
             raise ValueError(
                 "quantize_base freezes the base weights as int8 — it "
                 "requires LoRA adapters to have anything to train"
-            )
-        if quantize_base and self.is_moe:
-            raise NotImplementedError(
-                "quantize_base is wired for the dense family (QLoRA); "
-                "MoE quantization exists on the decode path only"
             )
         self.train_cfg = train_cfg
         self.lora_cfg = lora_cfg
@@ -230,12 +228,17 @@ class Trainer:
                 )
                 self.params = init_fn(k_params)
             if lora_cfg is not None:
-                l_specs = lora_lib.lora_specs(model_cfg, lora_cfg)
+                # adapters mirror the *backbone* dims (for MoE that is
+                # cfg.base — targets are the attention projections)
+                lora_dims_cfg = model_cfg.base if self.is_moe else model_cfg
+                l_specs = lora_lib.lora_specs(lora_dims_cfg, lora_cfg)
                 if self.pipelined:
                     l_specs = _pipe_shard_layer_specs(l_specs)
                 lora_init = jax.jit(
                     partial(
-                        lora_lib.init_lora_params, cfg=model_cfg, lora=lora_cfg
+                        lora_lib.init_lora_params,
+                        cfg=lora_dims_cfg,
+                        lora=lora_cfg,
                     ),
                     out_shardings=self._sh(l_specs),
                 )
@@ -277,12 +280,12 @@ class Trainer:
     # -- train step ---------------------------------------------------------
 
     def _loss_fn(self, trainable, frozen, batch):
-        if self.is_moe:
-            return self._moe_loss_fn(trainable, batch)
         if self.lora_cfg is not None:
             params, lora_params = frozen, trainable
         else:
             params, lora_params = trainable, None
+        if self.is_moe:
+            return self._moe_loss_fn(params, lora_params, batch)
         seq_len = batch["tokens"].shape[1]
         if seq_len > 2048 and seq_len % 1024 == 0:
             # long context: never materialise [B, S, V] logits
@@ -318,9 +321,11 @@ class Trainer:
         )
         return loss
 
-    def _moe_loss_fn(self, params, batch):
+    def _moe_loss_fn(self, params, lora_params, batch):
         """MoE: router aux (load-balancing) loss rides on the LM loss;
-        the long-context chunked path applies the same way."""
+        the long-context chunked path applies the same way. With LoRA,
+        the (possibly int8) base params stay frozen and only the
+        attention adapters train, exactly like the dense family."""
         from odh_kubeflow_tpu.models import moe as moe_lib
 
         cfg = self.model_cfg
@@ -330,6 +335,7 @@ class Trainer:
                 params,
                 batch["tokens"],
                 cfg,
+                lora=lora_params,
                 segment_ids=batch.get("segment_ids"),
                 return_hidden=True,
             )
@@ -347,6 +353,7 @@ class Trainer:
             params,
             batch["tokens"],
             cfg,
+            lora=lora_params,
             segment_ids=batch.get("segment_ids"),
         )
         return (

@@ -145,16 +145,17 @@ def test_moe_trainer_end_to_end(devices8):
     # expert banks actually shard over the expert axis
     assert "expert" in str(trainer.params["layers"]["moe_gate"].sharding.spec)
 
-    # LoRA on MoE is explicitly not wired
-    import pytest as _pytest
+    # LoRA on MoE adapts attention projections (tests/test_moe.py has
+    # the full train/decode coverage); MLP targets are rejected there.
+    lora_trainer = Trainer(
+        MoeConfig.mixtral_tiny(),
+        TrainConfig(),
+        lora_cfg=LoraConfig(rank=2),
+        mesh=build_mesh(MeshConfig(fsdp=8), devices8),
+    )
+    from odh_kubeflow_tpu.models.lora import ATTENTION_TARGETS
 
-    with _pytest.raises(NotImplementedError):
-        Trainer(
-            MoeConfig.mixtral_tiny(),
-            TrainConfig(),
-            lora_cfg=LoraConfig(rank=2),
-            mesh=build_mesh(MeshConfig(fsdp=8), devices8),
-        )
+    assert set(lora_trainer.lora_params["layers"]) == set(ATTENTION_TARGETS)
 
 
 def test_pipelined_trainer_matches_unpipelined(devices8):
