@@ -238,10 +238,27 @@ def main(argv: Optional[list] = None) -> None:
 
         cfg = getattr(MoeConfig, args.config)()
         if args.checkpoint:
-            parser.error("MoE checkpoint serving lands with MoE-Trainer ckpts")
-        params = jax.jit(
-            lambda k: moe_lib.init_params(k, cfg, dtype=jnp.bfloat16)
-        )(jax.random.key(args.seed))
+            # MoE LoRA checkpoint: adapters on the attention projections
+            # (models/moe.py), restored into a same-seed trainer and
+            # merged — the same contract as the dense path below
+            from odh_kubeflow_tpu.models.lora import LoraConfig, merge_lora
+            from odh_kubeflow_tpu.train import TrainConfig, Trainer
+            from odh_kubeflow_tpu.train.checkpoint import CheckpointManager
+
+            trainer = Trainer(
+                cfg,
+                TrainConfig(),
+                lora_cfg=LoraConfig(rank=args.lora_rank),
+                seed=args.seed,
+            )
+            with CheckpointManager(args.checkpoint) as mgr:
+                step = trainer.restore_checkpoint(mgr)
+            params = merge_lora(trainer.params, trainer.lora_params)
+            print(f"restored MoE LoRA adapters at step {step}; merged", flush=True)
+        else:
+            params = jax.jit(
+                lambda k: moe_lib.init_params(k, cfg, dtype=jnp.bfloat16)
+            )(jax.random.key(args.seed))
         if args.int8:
             from odh_kubeflow_tpu.models.quant import quantize_params
 

@@ -159,6 +159,48 @@ def test_full_story_finetune_checkpoint_restore_merge_serve(tmp_path):
         httpd.shutdown()
 
 
+def test_full_story_moe_lora(tmp_path):
+    """The MoE family's version of the full story: attention-adapter
+    LoRA fine-tune → checkpoint → restore → merge → serve. Exercises
+    the seam the serve CLI's mixtral --checkpoint branch crosses."""
+    from odh_kubeflow_tpu.models import LoraConfig
+    from odh_kubeflow_tpu.models.lora import merge_lora
+    from odh_kubeflow_tpu.models.moe import MoeConfig
+    from odh_kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from odh_kubeflow_tpu.train import TrainConfig, Trainer
+    from odh_kubeflow_tpu.train.checkpoint import CheckpointManager
+
+    devices = jax.devices()[:8]
+    cfg = MoeConfig.mixtral_tiny()
+    mesh = build_mesh(MeshConfig(fsdp=2, expert=2, data=2), devices)
+    trainer = Trainer(
+        cfg,
+        TrainConfig(warmup_steps=1, total_steps=6, learning_rate=1e-2),
+        lora_cfg=LoraConfig(rank=2),
+        mesh=mesh,
+    )
+    batch = trainer.make_fake_batch(8, 16)
+    for _ in range(2):
+        trainer.train_step(batch)
+    with CheckpointManager(str(tmp_path)) as mgr:
+        trainer.save_checkpoint(mgr, force=True)
+        mgr.wait_until_finished()
+        trainer2 = Trainer(
+            cfg,
+            TrainConfig(warmup_steps=1, total_steps=6),
+            lora_cfg=LoraConfig(rank=2),
+            mesh=build_mesh(MeshConfig(fsdp=8), devices),  # new topology
+        )
+        assert trainer2.restore_checkpoint(mgr) == 2
+
+    merged = merge_lora(trainer2.params, trainer2.lora_params)
+    svc = CompletionService(
+        jax.device_get(merged), cfg, prompt_buckets=(8,), batch_buckets=(1,)
+    )
+    out = svc.complete([[1, 2, 3]], max_tokens=4)["completions"]
+    assert len(out[0]) == 4 and all(isinstance(t, int) for t in out[0])
+
+
 def test_cli_entrypoint_demo_mode():
     """`python -m odh_kubeflow_tpu.models.serve --config tiny` comes up
     and answers completions (demo mode: random init, no checkpoint)."""
