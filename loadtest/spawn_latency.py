@@ -12,7 +12,12 @@ BASELINE.md's headline latency metric. Two measured segments:
 2. **ready→first-step** — on the attached real TPU chip, do what the
    user's first cell does: import the runtime, build the Llama-1B LoRA
    trainer, and run one train step to a fetched loss. Cold-compile
-   time is the dominant term and is measured for real.
+   time is the dominant term and is measured for real — twice, in
+   subprocesses sharing a ``JAX_COMPILATION_CACHE_DIR``: the cold run
+   populates the persistent cache, the warm run measures what a
+   re-spawned notebook pays (the TPU images and the ``tpu-runtime``
+   PodDefault pin the cache onto the workspace PVC, which survives
+   stop/cull/restart).
 
 Prints one JSON line; ``--record`` rewrites the table row in
 BASELINE.md.
@@ -140,13 +145,22 @@ def record(result: dict) -> None:
 
     path = pathlib.Path(__file__).resolve().parent.parent / "BASELINE.md"
     text = path.read_text()
+    warm = result.get("first_step_warm")
+    warm_part = (
+        f"; **warm re-spawn {result['total_warm_s']:.1f}s** (persistent "
+        f"compile cache on the workspace PVC: build "
+        f"{warm['trainer_build_s']}s + step {warm['first_step_compile_s']}s)"
+        if warm
+        else ""
+    )
     line = (
         f"| Spawn → first JAX step latency | "
-        f"**{result['total_s']:.1f}s** measured (spawn→ready "
+        f"**{result['total_s']:.1f}s** cold (spawn→ready "
         f"{result['spawn_to_ready_s']}s platform path on sim kubelet, + "
         f"trainer build {result['first_step']['trainer_build_s']}s + "
         f"first-step compile {result['first_step']['first_step_compile_s']}s "
-        f"on real {result['first_step']['device']}; excludes image pull) "
+        f"on real {result['first_step']['device']}; excludes image pull)"
+        f"{warm_part} "
         f"| v5e-1 (single chip) and v5p-8 | loadtest/spawn_latency.py |"
     )
     pattern = r"\| Spawn → first JAX step latency \|[^\n]*"
@@ -157,20 +171,65 @@ def record(result: dict) -> None:
     path.write_text(text)
 
 
+def _first_step_subprocess(cache_dir: str) -> dict:
+    """Run measure_first_jax_step in a fresh interpreter with the
+    persistent compilation cache pointed at ``cache_dir`` — the only
+    way to measure a cold/warm pair (an in-process rerun would hit
+    jax's in-memory jit cache and measure nothing)."""
+    import os
+    import subprocess
+
+    env = dict(
+        os.environ,
+        JAX_COMPILATION_CACHE_DIR=cache_dir,
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="1",
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "loadtest.spawn_latency", "--first-step-only"],
+        env=env,
+        capture_output=True,
+        text=True,
+        check=True,
+        timeout=580,
+    )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--record", action="store_true", help="update BASELINE.md")
+    parser.add_argument(
+        "--first-step-only",
+        action="store_true",
+        help="internal: just the ready→first-step half, honoring "
+        "JAX_COMPILATION_CACHE_DIR from the environment",
+    )
     args = parser.parse_args()
 
+    if args.first_step_only:
+        print(json.dumps(measure_first_jax_step()))
+        return
+
+    import tempfile
+
     spawn = measure_spawn_to_ready()
-    first = measure_first_jax_step()
+    with tempfile.TemporaryDirectory(prefix="jaxcache-") as cache_dir:
+        first = _first_step_subprocess(cache_dir)  # cold: populates cache
+        warm = _first_step_subprocess(cache_dir)  # warm: the re-spawn path
     result = {
         **spawn,
         "first_step": first,
+        "first_step_warm": warm,
         "total_s": round(
             spawn["spawn_to_ready_s"]
             + first["trainer_build_s"]
             + first["first_step_compile_s"],
+            3,
+        ),
+        "total_warm_s": round(
+            spawn["spawn_to_ready_s"]
+            + warm["trainer_build_s"]
+            + warm["first_step_compile_s"],
             3,
         ),
     }

@@ -253,6 +253,18 @@ def tpu_runtime_poddefault(namespace: str) -> Obj:
                 },
                 # jax.distributed picks these up for multi-host init
                 {"name": "JAX_COORDINATOR_PORT", "value": "8476"},
+                # persistent compilation cache on the workspace PVC:
+                # survives stop/cull/restart cycles, so a re-spawned
+                # notebook's first train step skips the ~30s XLA
+                # compile (north-star spawn latency, warm path)
+                {
+                    "name": "JAX_COMPILATION_CACHE_DIR",
+                    "value": "/home/jovyan/.cache/jax",
+                },
+                {
+                    "name": "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                    "value": "1",
+                },
             ],
             "volumes": [
                 {"name": "dshm", "emptyDir": {"medium": "Memory"}},

@@ -57,6 +57,8 @@ def test_jax_tpu_env_contract():
     text = (IMAGES / "jupyter-jax-tpu" / "Dockerfile").read_text()
     assert "jax[tpu]" in text
     assert "JAX_PLATFORMS=tpu,cpu" in text
+    # compile cache on the PVC: warm re-spawn latency contract
+    assert "JAX_COMPILATION_CACHE_DIR=/home/jovyan/.cache/jax" in text
     # slice identity must be injected by the platform, not baked in
     assert "ENV TPU_WORKER_ID" not in text
 

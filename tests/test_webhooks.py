@@ -144,6 +144,8 @@ def test_tpu_runtime_poddefault_injects_libtpu_env(api):
     assert env["JAX_PLATFORMS"] == "tpu,cpu"
     assert env["JAX_COORDINATOR_PORT"] == "8476"
     assert "latency_hiding_scheduler" in env["XLA_FLAGS"]
+    # persistent compile cache rides the workspace PVC (warm re-spawns)
+    assert env["JAX_COMPILATION_CACHE_DIR"] == "/home/jovyan/.cache/jax"
     assert {"name": "dshm", "mountPath": "/dev/shm"} in c0["volumeMounts"]
 
 
