@@ -62,15 +62,22 @@ def run_elastic(
     total_steps: int,
     on_step: Optional[Callable[[int, dict], None]] = None,
     guard: Optional[PreemptionGuard] = None,
+    eval_batches: Optional[Callable[[], Iterable[Any]]] = None,
+    eval_interval: int = 0,
 ) -> dict:
     """Train until ``total_steps`` or preemption.
 
-    Returns ``{"step", "preempted", "resumed_from"}``. On preemption a
-    final checkpoint is forced before returning; callers exit with
-    ``PREEMPTED_EXIT_CODE`` so supervisors distinguish reclaim from
-    crash. ``manager`` is a ``train.checkpoint.CheckpointManager``;
-    its ``save_interval_steps`` policy drives periodic saves, the
-    preemption save bypasses it.
+    Returns ``{"step", "preempted", "resumed_from", "eval_loss"}``. On
+    preemption a final checkpoint is forced before returning; callers
+    exit with ``PREEMPTED_EXIT_CODE`` so supervisors distinguish
+    reclaim from crash. ``manager`` is a
+    ``train.checkpoint.CheckpointManager``; its ``save_interval_steps``
+    policy drives periodic saves, the preemption save bypasses it.
+
+    ``eval_batches`` (a zero-arg callable returning a fresh iterable,
+    so the held-out set replays each round) with ``eval_interval`` > 0
+    runs a no-grad eval sweep every N steps; the mean loss lands in
+    the per-step metrics dict as ``eval_loss``.
     """
     own_guard = guard is None
     guard = (guard or PreemptionGuard()).install()
@@ -89,6 +96,17 @@ def run_elastic(
                 break
             metrics = trainer.train_step(batch)
             trainer.save_checkpoint(manager)
+            if (
+                eval_batches is not None
+                and eval_interval > 0
+                and trainer.step % eval_interval == 0
+            ):
+                losses = [
+                    float(trainer.eval_step(b)["loss"])
+                    for b in eval_batches()
+                ]
+                if losses:
+                    metrics["eval_loss"] = sum(losses) / len(losses)
             if on_step is not None:
                 on_step(trainer.step, metrics)
         if guard.preempted:

@@ -393,6 +393,23 @@ class Trainer:
             donate_argnums=(0, 2),
         )
 
+    def eval_step(self, batch: dict) -> dict:
+        """Loss on a held-out batch: same sharded loss function, no
+        gradient, no optimizer-state touch. Compiled once, cached."""
+        if not hasattr(self, "_compiled_eval"):
+            train_sh = self._sh(self._train_specs)
+            frozen_sh = self._sh(self._frozen_specs)
+            self._compiled_eval = jax.jit(
+                lambda trainable, frozen, batch: self._loss_fn(
+                    trainable, frozen, batch
+                ),
+                in_shardings=(train_sh, frozen_sh, None),
+            )
+        trainable = self.lora_params if self.lora_cfg is not None else self.params
+        with jax.set_mesh(self.mesh):
+            loss = self._compiled_eval(trainable, self.params, batch)
+        return {"loss": loss}
+
     def train_step(self, batch: dict) -> dict:
         trainable = self.lora_params if self.lora_cfg is not None else self.params
         frozen = self.params

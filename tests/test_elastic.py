@@ -99,3 +99,41 @@ def test_guard_restores_previous_handlers():
     assert signal.getsignal(signal.SIGTERM) != before
     guard.uninstall()
     assert signal.getsignal(signal.SIGTERM) == before
+
+
+def test_elastic_eval_interval(tmp_path):
+    """Eval sweeps run every eval_interval steps over a replayable
+    held-out set, landing eval_loss in the step metrics."""
+    import jax
+
+    from odh_kubeflow_tpu.models import LlamaConfig, LoraConfig
+    from odh_kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from odh_kubeflow_tpu.train import TrainConfig, Trainer
+    from odh_kubeflow_tpu.train.checkpoint import CheckpointManager
+    from odh_kubeflow_tpu.train.elastic import run_elastic
+
+    trainer = Trainer(
+        LlamaConfig.tiny(dtype=jnp.float32),
+        TrainConfig(warmup_steps=1, total_steps=10),
+        lora_cfg=LoraConfig(rank=2),
+        mesh=build_mesh(MeshConfig(), jax.devices()[:1]),
+    )
+    train_batch = trainer.make_fake_batch(2, 16, seed=0)
+    held_out = trainer.make_fake_batch(2, 16, seed=99)
+    seen = {}
+
+    with CheckpointManager(str(tmp_path), save_interval_steps=100) as mgr:
+        out = run_elastic(
+            trainer,
+            mgr,
+            [train_batch] * 4,
+            total_steps=4,
+            eval_batches=lambda: [held_out],
+            eval_interval=2,
+            on_step=lambda step, m: seen.update({step: dict(m)}),
+        )
+    assert out["step"] == 4
+    assert "eval_loss" in seen[2] and "eval_loss" in seen[4]
+    assert "eval_loss" not in seen[1] and "eval_loss" not in seen[3]
+    # training on a different batch should not leave eval loss frozen
+    assert seen[2]["eval_loss"] != seen[4]["eval_loss"]

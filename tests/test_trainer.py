@@ -232,3 +232,33 @@ def test_maximal_axis_composition_pp_cp_tp(devices8):
     lf = float(flat.train_step(flat.make_fake_batch(8, 32))["loss"])
     assert np.isfinite(lp) and np.isfinite(lf)
     assert abs(lp - lf) / lf < 5e-3  # ring vs dense fp accumulation
+
+
+def test_eval_step_no_state_mutation():
+    """eval_step reports the same loss train_step would see (pre-
+    update) and leaves params/opt_state/step untouched."""
+    import numpy as np
+
+    trainer = Trainer(
+        LlamaConfig.tiny(dtype=jnp.float32),
+        TrainConfig(warmup_steps=1, total_steps=10),
+        lora_cfg=LoraConfig(rank=2),
+        mesh=build_mesh(MeshConfig(), jax.devices()[:1]),
+    )
+    batch = trainer.make_fake_batch(2, 16)
+    before = jax.tree_util.tree_map(
+        lambda x: np.asarray(x).copy(), trainer.lora_params
+    )
+    eval_loss = float(trainer.eval_step(batch)["loss"])
+    # adapters untouched, step not advanced
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+        before,
+        trainer.lora_params,
+    )
+    assert trainer.step == 0
+    # the first train step computes its loss BEFORE applying updates —
+    # it must equal the eval loss on the same batch
+    train_loss = float(trainer.train_step(batch)["loss"])
+    np.testing.assert_allclose(eval_loss, train_loss, rtol=1e-5)
+    assert trainer.step == 1
