@@ -76,6 +76,62 @@ def _qj_live_fn(causal: bool, q_offset: int, block_q: int, block_k: int,
     return live
 
 
+def _block_predicates(qb, ki, *, causal, q_offset, sk, block_q, block_k):
+    """(run, full) for the block at Q-block index ``qb`` / K-block
+    index ``ki``. ``run``: any (row, col) pair is live under the causal
+    skip. ``full``: EVERY pair is live — interior causal blocks with no
+    padded K columns, the hot case at long context (S=16k, block 1024:
+    120 of 136 live blocks are full). Full blocks skip the iota/
+    compare/select mask arithmetic, which is what the VPU otherwise
+    burns time on between the MXU dots."""
+    run = True
+    full = (ki + 1) * block_k <= sk
+    if causal:
+        run = ki * block_k <= qb * block_q + (block_q - 1) + q_offset
+        full = jnp.logical_and(
+            full, qb * block_q + q_offset >= ki * block_k + (block_k - 1)
+        )
+    return run, full
+
+
+def _block_mask(qb, ki, qseg_ref, kseg_ref, *, causal, q_offset, sk,
+                block_q, block_k):
+    """[block_q, block_k] live-pair mask for an edge block."""
+    q_pos = qb * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    mask = k_pos < sk  # padded K columns never contribute
+    if causal:
+        mask = jnp.logical_and(mask, q_pos + q_offset >= k_pos)
+    if qseg_ref is not None:
+        mask = jnp.logical_and(mask, qseg_ref[0] == kseg_ref[0])
+    return mask
+
+
+def _when_blocks(run, full, has_segments, body):
+    """Dispatch a kernel body over the full/edge split. Segmented
+    kernels always take the masked path (segment walls can cut any
+    block); otherwise interior blocks run the mask-free fast path."""
+    if has_segments:
+
+        @pl.when(run)
+        def _masked():
+            body(masked=True)
+
+    else:
+
+        @pl.when(jnp.logical_and(run, full))
+        def _full():
+            body(masked=False)
+
+        @pl.when(jnp.logical_and(run, jnp.logical_not(full)))
+        def _edge():
+            body(masked=True)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -110,25 +166,19 @@ def _fwd_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # Positions of this block's rows/cols in the (padded) sequence.
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
+    geom = dict(
+        causal=causal, q_offset=q_offset, sk=sk,
+        block_q=block_q, block_k=block_k,
     )
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1
-    )
+    run, full = _block_predicates(qi, ki, **geom)
 
-    # A causal block is dead when its first column is beyond the last row.
-    run = True
-    if causal:
-        run = ki * block_k <= qi * block_q + (block_q - 1) + q_offset
-
-    @pl.when(run)
-    def _body():
+    def body(masked: bool):
         # Dots take the native (bf16) operands — the MXU runs bf16
         # inputs at full rate — and accumulate in f32 via
         # preferred_element_type. Softmax statistics stay f32.
-        q = q_ref[0, 0]
+        # Scaling rides on the [bq, hd] q block (block_k/hd ≈ 16×
+        # cheaper than scaling the [bq, bk] score matrix).
+        q = q_ref[0, 0] * scale
         k = k_ref[0, 0]
         v = v_ref[0, 0]
 
@@ -138,22 +188,21 @@ def _fwd_kernel(
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        s = s * scale
 
-        mask = k_pos < sk  # padded K columns never contribute
-        if causal:
-            mask = jnp.logical_and(mask, q_pos + q_offset >= k_pos)
-        if qseg_ref is not None:
-            mask = jnp.logical_and(mask, qseg_ref[0] == kseg_ref[0])
-        s = jnp.where(mask, s, _NEG_INF)
+        mask = None
+        if masked:
+            mask = _block_mask(qi, ki, qseg_ref, kseg_ref, **geom)
+            s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        # Re-mask after the exp: on a row with no live column yet,
-        # m_new == _NEG_INF and exp(s - m_new) == 1 for masked entries,
-        # which would poison l/acc with phantom mass.
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        p = jnp.exp(s - m_new)
+        if masked:
+            # Re-mask after the exp: on a row with no live column yet,
+            # m_new == _NEG_INF and exp(s - m_new) == 1 for masked
+            # entries, which would poison l/acc with phantom mass.
+            p = jnp.where(mask, p, 0.0)
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             p.astype(v.dtype),
@@ -162,6 +211,8 @@ def _fwd_kernel(
             preferred_element_type=jnp.float32,
         )
         m_scr[...] = m_new
+
+    _when_blocks(run, full, qseg_ref is not None, body)
 
     @pl.when(ki == num_k - 1)
     def _finalize():
@@ -306,52 +357,47 @@ def _dq_kernel(
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
+    geom = dict(
+        causal=causal, q_offset=q_offset, sk=sk,
+        block_q=block_q, block_k=block_k,
     )
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1
-    )
+    run, full = _block_predicates(qi, ki, **geom)
 
-    run = True
-    if causal:
-        run = ki * block_k <= qi * block_q + (block_q - 1) + q_offset
-
-    @pl.when(run)
-    def _body():
-        q = q_ref[0, 0]
+    def body(masked: bool):
+        # s comes from the pre-scaled q; the outer `* scale` on ds is
+        # linear, so it moves to the finalize (one [bq, hd] multiply
+        # instead of a [bq, bk] one per K block).
+        q = q_ref[0, 0] * scale
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
 
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * scale
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
-        mask = k_pos < sk
-        if causal:
-            mask = jnp.logical_and(mask, q_pos + q_offset >= k_pos)
-        if qseg_ref is not None:
-            mask = jnp.logical_and(mask, qseg_ref[0] == kseg_ref[0])
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        p = jnp.exp(s - lse)
+        if masked:
+            p = jnp.where(
+                _block_mask(qi, ki, qseg_ref, kseg_ref, **geom), p, 0.0
+            )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * scale
+        ds = p * (dp - delta)
         dq_scr[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
+    _when_blocks(run, full, qseg_ref is not None, body)
+
     @pl.when(ki == num_k - 1)
     def _finalize():
-        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+        dq_ref[0, 0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
@@ -386,20 +432,15 @@ def _dkv_kernel(
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    q_pos = qj * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
+    geom = dict(
+        causal=causal, q_offset=q_offset, sk=sk,
+        block_q=block_q, block_k=block_k,
     )
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1
-    )
+    # run/full are symmetric in (Q block, K block): same predicates as
+    # the forward, evaluated at this program's qj.
+    run, full = _block_predicates(qj, ki, **geom)
 
-    run = True
-    if causal:
-        # Q block dead when its last row is above this K block's first col.
-        run = qj * block_q + (block_q - 1) + q_offset >= ki * block_k
-
-    @pl.when(run)
-    def _body():
+    def body(masked: bool):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
@@ -407,19 +448,18 @@ def _dkv_kernel(
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
 
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * scale
+        # s from pre-scaled q; dK's `* scale` is linear and moves to
+        # the finalize. The dk dot below contracts against the ORIGINAL
+        # q — its scale factor is exactly the deferred one.
+        s = jax.lax.dot_general(
+            q * scale, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
-        mask = k_pos < sk
-        if causal:
-            mask = jnp.logical_and(mask, q_pos + q_offset >= k_pos)
-        if qseg_ref is not None:
-            mask = jnp.logical_and(mask, qseg_ref[0] == kseg_ref[0])
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        p = jnp.exp(s - lse)  # [bq, bk]
+        if masked:
+            p = jnp.where(
+                _block_mask(qj, ki, qseg_ref, kseg_ref, **geom), p, 0.0
+            )
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -428,15 +468,17 @@ def _dkv_kernel(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * scale
+        ds = p * (dp - delta)
         dk_scr[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
+    _when_blocks(run, full, qseg_ref is not None, body)
+
     @pl.when(t == total_q - 1)
     def _finalize():
-        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dk_ref[0, 0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 

@@ -67,17 +67,19 @@ def run_elastic(
 ) -> dict:
     """Train until ``total_steps`` or preemption.
 
-    Returns ``{"step", "preempted", "resumed_from", "eval_loss"}``. On
-    preemption a final checkpoint is forced before returning; callers
-    exit with ``PREEMPTED_EXIT_CODE`` so supervisors distinguish
-    reclaim from crash. ``manager`` is a
-    ``train.checkpoint.CheckpointManager``; its ``save_interval_steps``
-    policy drives periodic saves, the preemption save bypasses it.
+    Returns ``{"step", "preempted", "resumed_from"}``. On preemption a
+    final checkpoint is forced before returning; callers exit with
+    ``PREEMPTED_EXIT_CODE`` so supervisors distinguish reclaim from
+    crash. ``manager`` is a ``train.checkpoint.CheckpointManager``;
+    its ``save_interval_steps`` policy drives periodic saves, the
+    preemption save bypasses it.
 
     ``eval_batches`` (a zero-arg callable returning a fresh iterable,
     so the held-out set replays each round) with ``eval_interval`` > 0
     runs a no-grad eval sweep every N steps; the mean loss lands in
-    the per-step metrics dict as ``eval_loss``.
+    the per-step metrics dict passed to ``on_step`` as ``eval_loss``.
+    Sweeps are skipped once preemption is signalled — the grace period
+    belongs to the final checkpoint.
     """
     own_guard = guard is None
     guard = (guard or PreemptionGuard()).install()
@@ -100,6 +102,7 @@ def run_elastic(
                 eval_batches is not None
                 and eval_interval > 0
                 and trainer.step % eval_interval == 0
+                and not guard.preempted
             ):
                 losses = [
                     float(trainer.eval_step(b)["loss"])

@@ -115,3 +115,49 @@ def test_model_forward_with_flash_impl():
     assert jnp.allclose(ref, got, atol=3e-4, rtol=3e-4), (
         float(jnp.abs(ref - got).max())
     )
+
+
+def test_multiblock_causal_exercises_full_block_fast_path():
+    """S=512 with explicit 128-blocks: the causal grid has interior
+    blocks that take the mask-free full-block fast path in all three
+    kernels (fwd/dq/dkv) plus diagonal edge blocks — both paths must
+    agree with dense, forward and grads. (The default-block tests run
+    every causal case as a single diagonal block, which would let a
+    broken `full` predicate pass green.)"""
+    B, S, Hq, Hkv, hd = 1, 512, 4, 2, 64
+    q, k, v = _qkv(jax.random.key(11), B, S, S, Hq, Hkv, hd)
+    tangent = jax.random.normal(jax.random.key(12), (B, S, Hq, hd))
+
+    def flash128(q, k, v, causal=True):
+        return flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+
+    ref = dense_attention(q, k, v, causal=True)
+    got = flash128(q, k, v)
+    assert jnp.allclose(got, ref, atol=2e-5, rtol=2e-5), (
+        float(jnp.abs(got - ref).max())
+    )
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v, causal=True) * tangent)
+
+    ref_grads = jax.grad(lambda *a: loss(dense_attention, *a), argnums=(0, 1, 2))(
+        q, k, v
+    )
+    got_grads = jax.grad(lambda *a: loss(flash128, *a), argnums=(0, 1, 2))(
+        q, k, v
+    )
+    for name, r, g in zip("qkv", ref_grads, got_grads):
+        err = float(jnp.abs(r - g).max())
+        assert jnp.allclose(r, g, atol=5e-4, rtol=1e-3), (name, err)
+
+
+def test_multiblock_non_causal_full_blocks():
+    """Non-causal multi-block: every block is full (no mask at all);
+    padding via ragged seq keeps one edge block alive too."""
+    B, S, Hq, Hkv, hd = 1, 320, 4, 4, 64  # pads to 384 at block 128
+    q, k, v = _qkv(jax.random.key(13), B, S, S, Hq, Hkv, hd)
+    ref = dense_attention(q, k, v, causal=False)
+    got = flash_attention(q, k, v, causal=False, block_q=128, block_k=128)
+    assert jnp.allclose(got, ref, atol=2e-5, rtol=2e-5), (
+        float(jnp.abs(got - ref).max())
+    )
