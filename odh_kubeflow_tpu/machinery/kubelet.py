@@ -121,34 +121,47 @@ class FakeCluster:
             total += obj_util.parse_quantity(limits.get(TPU_RESOURCE, 0))
         return total
 
-    def _node_fits(self, node: Obj, pod: Obj) -> bool:
+    def _node_fits(
+        self,
+        node: Obj,
+        pod: Obj,
+        want_tpu: float,
+        used_by_node: Optional[dict[str, float]],
+    ) -> bool:
         selector = obj_util.get_path(pod, "spec", "nodeSelector", default={}) or {}
         node_labels = obj_util.labels_of(node)
         for k, v in selector.items():
             if node_labels.get(k) != v:
                 return False
-        want_tpu = self._pod_tpu_request(pod)
         if want_tpu:
             alloc = obj_util.parse_quantity(
                 obj_util.get_path(
                     node, "status", "allocatable", TPU_RESOURCE, default=0
                 )
             )
-            used = 0.0
-            for other in self.api.list("Pod"):
-                if (
-                    obj_util.get_path(other, "spec", "nodeName")
-                    == obj_util.name_of(node)
-                    and obj_util.get_path(other, "status", "phase") != "Succeeded"
-                ):
-                    used += self._pod_tpu_request(other)
+            used = (used_by_node or {}).get(obj_util.name_of(node), 0.0)
             if used + want_tpu > alloc:
                 return False
         return True
 
     def _schedule(self, pod: Obj) -> Optional[str]:
+        # one pod list per scheduling pass, not one per candidate node —
+        # the pod×node product was the loadtest's O(N²) control-plane
+        # hotspot (every list deep-copies through the store)
+        want_tpu = self._pod_tpu_request(pod)
+        used_by_node: Optional[dict[str, float]] = None
+        if want_tpu:
+            used_by_node = {}
+            for other in self.api.list("Pod"):
+                if obj_util.get_path(other, "status", "phase") == "Succeeded":
+                    continue
+                name = obj_util.get_path(other, "spec", "nodeName")
+                if name:
+                    used_by_node[name] = used_by_node.get(
+                        name, 0.0
+                    ) + self._pod_tpu_request(other)
         for node in self.api.list("Node"):
-            if self._node_fits(node, pod):
+            if self._node_fits(node, pod, want_tpu, used_by_node):
                 return obj_util.name_of(node)
         return None
 
