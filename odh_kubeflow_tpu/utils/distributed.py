@@ -1,0 +1,61 @@
+"""jax.distributed bring-up from the platform's injected env contract.
+
+The notebook controller provisions multi-host TPU slices as a
+StatefulSet + headless service and injects per-worker identity env
+(``controllers/notebook.py:480-499``): ``TPU_WORKER_HOSTNAMES`` (comma
+list, stable DNS names), ``TPU_WORKER_ID`` (pod index), and
+``JAX_COORDINATOR_ADDRESS`` (worker 0's DNS name + port). The in-image
+``tpu-init`` script (images/*/tpu-init) consumes that contract before
+the lab starts; this module is the *library* entry for user code and
+tests — same contract, importable.
+
+The reference platform has no analog: its multi-node training story is
+user-space NCCL inside images (SURVEY.md §5 "distributed communication
+backend"); here multi-host bring-up is a platform contract, and the
+collectives ride XLA (ICI within a slice, Gloo/DCN across hosts).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def env_contract() -> dict:
+    """The parsed contract, without side effects."""
+    hostnames = [
+        h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h
+    ]
+    worker_id = int(os.environ.get("TPU_WORKER_ID", "0") or "0")
+    port = os.environ.get("JAX_COORDINATOR_PORT", "8476")
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS", "")
+    if not coordinator and hostnames:
+        coordinator = f"{hostnames[0]}:{port}"
+    elif coordinator and ":" not in coordinator:
+        coordinator = f"{coordinator}:{port}"
+    return {
+        "hostnames": hostnames,
+        "num_processes": len(hostnames),
+        "process_id": worker_id,
+        "coordinator_address": coordinator,
+    }
+
+
+def initialize_from_env() -> bool:
+    """Run ``jax.distributed.initialize`` when the platform injected a
+    multi-host contract; no-op (False) on single-host spawns, where
+    libtpu wires ICI by itself once the pod holds the whole slice.
+
+    Idempotent per process only in the no-op case — call once, before
+    any backend use, like ``tpu-init`` does.
+    """
+    c = env_contract()
+    if c["num_processes"] <= 1:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=c["coordinator_address"],
+        num_processes=c["num_processes"],
+        process_id=c["process_id"],
+    )
+    return True

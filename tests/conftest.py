@@ -34,3 +34,9 @@ def devices8():
     devs = jax.devices()
     assert len(devs) == 8, devs
     return devs
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running tests"
+    )
