@@ -326,11 +326,14 @@ class NotebookController:
         slices are a TPU-platform fact of life."""
         name = obj_util.name_of(notebook)
         ns = obj_util.namespace_of(notebook)
-        pods = [
-            p
-            for p in self.api.list("Pod", namespace=ns)
-            if obj_util.labels_of(p).get("statefulset") == name
-        ]
+        # filter in the store (before the per-object copy), not here:
+        # at N notebooks this reconcile runs N times per drain, and an
+        # unfiltered list would copy all N slices' pods every time
+        pods = self.api.list(
+            "Pod",
+            namespace=ns,
+            label_selector={"matchLabels": {"statefulset": name}},
+        )
         failed = [
             p
             for p in pods
