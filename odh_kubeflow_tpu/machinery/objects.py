@@ -18,22 +18,42 @@ Obj = dict[str, Any]
 
 _SCALARS = (str, int, float, bool, type(None))
 
+_native_copy = None
+_native_tried = False
 
-def deepcopy(obj: Obj) -> Obj:
-    """Deep copy specialised for JSON-shaped trees (dict/list/scalars
-    are the only shapes API objects use). ~8× faster than
-    ``copy.deepcopy``, which spends its time on memo/id bookkeeping
-    these trees never need — and the store copies on every get/list,
-    so this is the control plane's hottest function under load.
-    Exotic leaves fall back to ``copy.deepcopy``."""
+
+def _py_deepcopy(obj: Obj) -> Obj:
     t = type(obj)
     if t is dict:
-        return {k: deepcopy(v) for k, v in obj.items()}
+        return {k: _py_deepcopy(v) for k, v in obj.items()}
     if t is list:
-        return [deepcopy(v) for v in obj]
+        return [_py_deepcopy(v) for v in obj]
     if t in _SCALARS:
         return obj
     return copy.deepcopy(obj)
+
+
+def deepcopy(obj: Obj) -> Obj:
+    """Deep copy specialised for JSON-shaped trees (dict/list/scalars
+    are the only shapes API objects use). The store copies on every
+    get/list, making this the control plane's hottest function under
+    load; the native C extension (odh_kubeflow_tpu/native/jsontree.cpp)
+    walks the tree with direct C-API calls, with this Python recursion
+    (itself ~8× over ``copy.deepcopy``'s memo bookkeeping) as the
+    no-compiler fallback. Exotic leaves use ``copy.deepcopy`` on both
+    paths."""
+    global _native_copy, _native_tried
+    if not _native_tried:
+        _native_tried = True
+        try:
+            from odh_kubeflow_tpu import native
+
+            _native_copy = native.jsontree_deepcopy()
+        except Exception:  # noqa: BLE001 — any native failure → Python
+            _native_copy = None
+    if _native_copy is not None:
+        return _native_copy(obj)
+    return _py_deepcopy(obj)
 
 
 def meta(obj: Obj) -> Obj:

@@ -27,19 +27,20 @@ import numpy as np
 _DIR = os.path.dirname(__file__)
 _SRC = os.path.join(_DIR, "packer.cpp")
 _SO = os.path.join(_DIR, "libodhkf_native.so")
+_JT_SRC = os.path.join(_DIR, "jsontree.cpp")
+_JT_SO = os.path.join(_DIR, "_odhkf_jsontree.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
+_jt_fn = None
+_jt_tried = False
 
 
-def build(force: bool = False) -> Optional[str]:
-    """Compile the native library; returns the .so path or None when no
-    compiler exists. Compiles into a temp file then atomically renames,
-    so concurrent builders race benignly."""
-    if not force and os.path.exists(_SO):
-        if os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-            return _SO
+def _compile(src: str, out: str, extra: list[str], force: bool) -> Optional[str]:
+    if not force and os.path.exists(out):
+        if os.path.getmtime(out) >= os.path.getmtime(src):
+            return out
     cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
     if cxx is None:
         return None
@@ -47,15 +48,35 @@ def build(force: bool = False) -> Optional[str]:
     os.close(fd)
     try:
         subprocess.run(
-            [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+            [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", *extra, src, "-o", tmp],
             check=True,
             capture_output=True,
         )
-        os.replace(tmp, _SO)
+        os.replace(tmp, out)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
-    return _SO
+    return out
+
+
+def build(force: bool = False) -> Optional[str]:
+    """Compile the native components; returns the packer .so path (or
+    None when no compiler exists). Compiles into temp files then
+    atomically renames, so concurrent builders race benignly. Also
+    builds the jsontree CPython extension (machinery's hot deepcopy);
+    its failure is non-fatal — everything degrades to Python."""
+    import sysconfig
+
+    try:
+        _compile(
+            _JT_SRC,
+            _JT_SO,
+            ["-I" + sysconfig.get_paths()["include"]],
+            force,
+        )
+    except (OSError, subprocess.CalledProcessError):
+        pass
+    return _compile(_SRC, _SO, [], force)
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -91,6 +112,41 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _load() is not None
+
+
+def jsontree_deepcopy():
+    """The C deepcopy for JSON-shaped trees (machinery/objects.py's
+    hot path), or None when it can't build/load. Lazy-built and cached
+    like the packer; parity with the Python fallback is contract-tested
+    in tests/test_native.py."""
+    global _jt_fn, _jt_tried
+    if _jt_tried:
+        return _jt_fn
+    with _lock:
+        if _jt_tried:
+            return _jt_fn
+        try:
+            import sysconfig
+
+            so = _compile(
+                _JT_SRC,
+                _JT_SO,
+                ["-I" + sysconfig.get_paths()["include"]],
+                False,
+            )
+            if so is not None:
+                from importlib.machinery import ExtensionFileLoader
+                from importlib.util import module_from_spec, spec_from_loader
+
+                loader = ExtensionFileLoader("_odhkf_jsontree", so)
+                spec = spec_from_loader("_odhkf_jsontree", loader)
+                mod = module_from_spec(spec)
+                loader.exec_module(mod)
+                _jt_fn = mod.deepcopy
+        except (OSError, subprocess.CalledProcessError, ImportError):
+            _jt_fn = None
+        _jt_tried = True
+    return _jt_fn
 
 
 def _i32p(a: np.ndarray):

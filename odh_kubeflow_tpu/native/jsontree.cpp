@@ -1,0 +1,91 @@
+// JSON-tree deepcopy — the control plane's hottest function, in C.
+//
+// The embedded apiserver (machinery/store.py) copies every object on
+// get/list to give callers apiserver-like isolation; profiling the
+// 100/300-notebook loadtests put the (already tree-specialised) Python
+// copy at the top of the profile. API objects are JSON-shaped trees —
+// dict/list/str/int/float/bool/None — so this extension walks them
+// with direct C-API calls and no memo/bookkeeping. Exotic leaves
+// (never produced by the store, but callers may stash them) fall back
+// to copy.deepcopy for exact parity with the Python implementation in
+// machinery/objects.py.
+//
+// Built lazily by odh_kubeflow_tpu.native.build() as a real extension
+// module (CPython C API; this image has no pybind11).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+static PyObject* g_copy_deepcopy = NULL;
+
+static PyObject* tree_copy(PyObject* obj) {
+  if (PyDict_CheckExact(obj)) {
+    PyObject* out = PyDict_New();
+    if (!out) return NULL;
+    PyObject *key, *value;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(obj, &pos, &key, &value)) {
+      PyObject* cv = tree_copy(value);
+      if (!cv) {
+        Py_DECREF(out);
+        return NULL;
+      }
+      if (PyDict_SetItem(out, key, cv) < 0) {
+        Py_DECREF(cv);
+        Py_DECREF(out);
+        return NULL;
+      }
+      Py_DECREF(cv);
+    }
+    return out;
+  }
+  if (PyList_CheckExact(obj)) {
+    Py_ssize_t n = PyList_GET_SIZE(obj);
+    PyObject* out = PyList_New(n);
+    if (!out) return NULL;
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* cv = tree_copy(PyList_GET_ITEM(obj, i));
+      if (!cv) {
+        Py_DECREF(out);
+        return NULL;
+      }
+      PyList_SET_ITEM(out, i, cv);  // steals cv
+    }
+    return out;
+  }
+  if (PyUnicode_CheckExact(obj) || PyLong_CheckExact(obj) ||
+      PyFloat_CheckExact(obj) || PyBool_Check(obj) || obj == Py_None) {
+    Py_INCREF(obj);
+    return obj;
+  }
+  return PyObject_CallFunctionObjArgs(g_copy_deepcopy, obj, NULL);
+}
+
+static PyObject* jsontree_deepcopy(PyObject* Py_UNUSED(self), PyObject* obj) {
+  return tree_copy(obj);
+}
+
+static PyMethodDef Methods[] = {
+    {"deepcopy", (PyCFunction)jsontree_deepcopy, METH_O,
+     "Deep copy a JSON-shaped tree (dict/list/scalars); exotic leaves "
+     "fall back to copy.deepcopy."},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT,
+                                       "_odhkf_jsontree",
+                                       NULL,
+                                       -1,
+                                       Methods,
+                                       NULL,
+                                       NULL,
+                                       NULL,
+                                       NULL};
+
+PyMODINIT_FUNC PyInit__odhkf_jsontree(void) {
+  PyObject* copy_mod = PyImport_ImportModule("copy");
+  if (!copy_mod) return NULL;
+  g_copy_deepcopy = PyObject_GetAttrString(copy_mod, "deepcopy");
+  Py_DECREF(copy_mod);
+  if (!g_copy_deepcopy) return NULL;
+  return PyModule_Create(&moduledef);
+}

@@ -78,3 +78,50 @@ def test_native_and_python_agree_at_scale():
     for a, b in zip(py, nat):
         for k in a:
             np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_jsontree_deepcopy_matches_python():
+    """The C extension and the Python fallback must agree exactly:
+    independent trees (mutating the copy leaves the source alone),
+    scalar identity, exotic-leaf fallback."""
+    from odh_kubeflow_tpu import native
+    from odh_kubeflow_tpu.machinery.objects import _py_deepcopy
+
+    fn = native.jsontree_deepcopy()
+    if fn is None:
+        pytest.skip("no C++ compiler")
+
+    src = {
+        "metadata": {"name": "nb", "labels": {"a": "1"}, "n": 3},
+        "spec": {"containers": [{"env": [{"name": "X", "value": "y"}]}]},
+        "flag": True,
+        "none": None,
+        "f": 1.5,
+        "exotic": {1, 2},  # set → copy.deepcopy fallback on both paths
+    }
+    for impl in (fn, _py_deepcopy):
+        out = impl(src)
+        assert out == src and out is not src
+        out["spec"]["containers"][0]["env"].append({"name": "Z"})
+        out["metadata"]["labels"]["b"] = "2"
+        assert "b" not in src["metadata"]["labels"]
+        assert len(src["spec"]["containers"][0]["env"]) == 1
+        assert out["exotic"] == {1, 2} and out["exotic"] is not src["exotic"]
+
+
+def test_store_uses_fast_copy_isolation():
+    """Store get/list isolation semantics survive the native copy:
+    mutating a returned object never leaks into the store."""
+    from odh_kubeflow_tpu.machinery.store import APIServer
+
+    api = APIServer()
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Namespace",
+            "metadata": {"name": "iso", "labels": {"x": "1"}},
+        }
+    )
+    got = api.get("Namespace", "iso")
+    got["metadata"]["labels"]["x"] = "mutated"
+    assert api.get("Namespace", "iso")["metadata"]["labels"]["x"] == "1"
