@@ -456,7 +456,7 @@ def forward(
 
 
 def _apply_layers_pipelined(
-    cfg: LlamaConfig,
+    cfg,  # LlamaConfig or any config with head_dim/rope_theta
     layer_fn: Callable,
     layers: Params,
     lora_layers: Optional[Params],
@@ -464,11 +464,19 @@ def _apply_layers_pipelined(
     positions: jnp.ndarray,  # [B, S]
     segment_ids: Optional[jnp.ndarray],
     num_microbatches: int,
-) -> jnp.ndarray:
-    """Decoder stack over the pipe axis. Rope angles and segment ids
-    are per-microbatch constants: they ride the pipeline's ``aux``
-    channel so every stage sees the slice belonging to the microbatch
-    it is currently processing."""
+    accumulate_aux: bool = False,
+):
+    """Decoder stack over the pipe axis — shared by the dense and MoE
+    families. Rope angles and segment ids are per-microbatch constants
+    riding the pipeline's ``aux`` channel, so every stage sees the
+    slice belonging to the microbatch it is currently processing.
+
+    ``layer_fn(x, layer, lora_layer, sin, cos, seg)`` returns
+    ``(x, extra)``; with ``accumulate_aux`` the extra (the MoE router
+    aux loss) is summed over layers and (stage, microbatch) pairs and
+    this returns ``(y, aux_sum / M)`` at full-batch scale — otherwise
+    the extra (the dense family's unused cache slot) is discarded and
+    only ``y`` returns."""
     from odh_kubeflow_tpu.parallel.pipeline import pipeline_apply
 
     B, S, D = x.shape
@@ -496,7 +504,8 @@ def _apply_layers_pipelined(
         xx = x_flat.reshape(x_flat.shape[0], S, D)
         seg = aux_t.get("segment_ids")
 
-        def body(xx, scanned_idx):
+        def body(carry, scanned_idx):
+            xx, acc = carry
             layer = jax.tree_util.tree_map(
                 lambda l: l[scanned_idx], stage["layers"]
             )
@@ -507,23 +516,32 @@ def _apply_layers_pipelined(
                 if "lora" in stage
                 else None
             )
-            xx, _ = layer_fn(
+            xx, extra = layer_fn(
                 xx, layer, lora_layer, aux_t["sin"], aux_t["cos"], seg
             )
-            return xx, None
+            if accumulate_aux:
+                acc = acc + extra
+            return (xx, acc), None
 
         n_local = jax.tree_util.tree_leaves(stage["layers"])[0].shape[0]
-        xx, _ = jax.lax.scan(body, xx, jnp.arange(n_local))
-        return xx.reshape(x_flat.shape[0], S * D)
+        (xx, acc), _ = jax.lax.scan(
+            body, (xx, jnp.zeros((), jnp.float32)), jnp.arange(n_local)
+        )
+        xx = xx.reshape(x_flat.shape[0], S * D)
+        return (xx, acc) if accumulate_aux else xx
 
-    y = pipeline_apply(
+    out = pipeline_apply(
         stage_fn,
         stage_params,
         x.reshape(B, S * D),
         num_microbatches=M,
         aux=aux,
+        with_aux_out=accumulate_aux,
     )
-    return y.reshape(B, S, D)
+    if accumulate_aux:
+        y, aux_sum = out
+        return y.reshape(B, S, D), aux_sum / M
+    return out.reshape(B, S, D)
 
 
 def lm_head_weight(params: Params, cfg: LlamaConfig) -> jnp.ndarray:

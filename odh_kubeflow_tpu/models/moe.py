@@ -42,6 +42,7 @@ from odh_kubeflow_tpu.ops.rope import rope_angles
 from odh_kubeflow_tpu.parallel.mesh import (
     AXIS_EXPERT,
     AXIS_FSDP,
+    AXIS_PIPE,
     AXIS_TENSOR,
     constrain,
 )
@@ -346,9 +347,17 @@ def forward(
     positions: Optional[jnp.ndarray] = None,
     segment_ids: Optional[jnp.ndarray] = None,
     return_hidden: bool = False,
+    pipeline_microbatches: int = 8,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (logits [B,S,V] f32 — or hidden [B,S,D] with
-    ``return_hidden`` — , total_aux_loss)."""
+    ``return_hidden`` — , total_aux_loss).
+
+    When the active mesh shards the ``pipe`` axis, the layer stack runs
+    through the GPipe combinator like the dense family, with the router
+    aux loss riding the pipeline's scalar output channel. Router
+    statistics are then per-microbatch (aux averaged over microbatches)
+    — the standard MoE×PP semantics; numerically close to, but not
+    bit-equal with, full-batch routing statistics."""
     b = cfg.base
     B, S = tokens.shape
     if positions is None:
@@ -362,15 +371,34 @@ def forward(
         layer_fn = jax.checkpoint(layer_fn)
     lora_layers = lora["layers"] if lora is not None else None
 
-    def body(carry, scanned):
-        x, aux = carry
-        layer, lora_layer = scanned
-        x, layer_aux = layer_fn(x, layer, lora_layer, sin, cos, segment_ids)
-        return (x, aux + layer_aux), None
+    am = jax.sharding.get_abstract_mesh()
+    pipe = 0 if am.empty else am.shape.get(AXIS_PIPE, 1)
+    if pipe > 1:
+        x, aux_total = _apply_layers_pipelined(
+            cfg,
+            layer_fn,
+            params["layers"],
+            lora_layers,
+            x,
+            positions,
+            segment_ids,
+            pipeline_microbatches,
+        )
+    else:
 
-    (x, aux_total), _ = jax.lax.scan(
-        body, (x, jnp.zeros((), jnp.float32)), (params["layers"], lora_layers)
-    )
+        def body(carry, scanned):
+            x, aux = carry
+            layer, lora_layer = scanned
+            x, layer_aux = layer_fn(
+                x, layer, lora_layer, sin, cos, segment_ids
+            )
+            return (x, aux + layer_aux), None
+
+        (x, aux_total), _ = jax.lax.scan(
+            body,
+            (x, jnp.zeros((), jnp.float32)),
+            (params["layers"], lora_layers),
+        )
 
     x = rms_norm(x, params["final_norm"], b.rms_norm_eps)
     if return_hidden:
@@ -380,3 +408,29 @@ def forward(
         "bsd,dv->bsv", x, head.astype(b.dtype), preferred_element_type=jnp.float32
     )
     return logits, aux_total
+
+
+def _apply_layers_pipelined(
+    cfg: MoeConfig,
+    layer_fn,
+    layers: Params,
+    lora_layers: Optional[Params],
+    x: jnp.ndarray,  # [B, S, D]
+    positions: jnp.ndarray,  # [B, S]
+    segment_ids: Optional[jnp.ndarray],
+    num_microbatches: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """MoE decoder stack over the pipe axis: the shared combinator
+    wrapper (``llama._apply_layers_pipelined``) with the router aux
+    loss accumulated through the pipeline's scalar output channel."""
+    return llama._apply_layers_pipelined(
+        cfg.base,
+        layer_fn,
+        layers,
+        lora_layers,
+        x,
+        positions,
+        segment_ids,
+        num_microbatches,
+        accumulate_aux=True,
+    )

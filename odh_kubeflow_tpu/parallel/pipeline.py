@@ -52,8 +52,10 @@ def pipeline_apply(
     num_microbatches: int,
     aux: Optional[Params] = None,  # leaves [M, ...]: per-microbatch consts
     axis: str = AXIS_PIPE,
-) -> jnp.ndarray:
-    """Run ``x`` through the pipelined layer stack; returns [B, ...].
+    with_aux_out: bool = False,
+) -> "jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]":
+    """Run ``x`` through the pipelined layer stack; returns [B, ...]
+    (or ``(y, aux_sum)`` with ``with_aux_out=True``).
 
     ``stage_fn(stage_layers, x_mb)`` — or ``stage_fn(stage_layers,
     x_mb, aux_mb)`` when ``aux`` is given — receives this device's
@@ -62,6 +64,13 @@ def pipeline_apply(
     masks) that follow their microbatch through the pipeline. Call
     under ``jax.set_mesh`` of a mesh containing ``axis``;
     differentiable.
+
+    ``with_aux_out=True``: ``stage_fn`` additionally returns a scalar
+    per call (e.g. the MoE router load-balancing loss); returns
+    ``(y, aux_sum)`` where ``aux_sum`` totals the scalar over every
+    (stage, microbatch) pair — bubble ticks, whose activations are
+    garbage, are excluded from the sum. Divide by ``num_microbatches``
+    for a per-batch quantity comparable to the unpipelined forward.
     """
     mesh = jax.sharding.get_abstract_mesh()
     if axis not in mesh.axis_names:
@@ -79,8 +88,27 @@ def pipeline_apply(
     mb = B // M
     xm = x.reshape(M, mb, *x.shape[1:])
 
+    # XLA's CPU backend aborts ("Invalid binary instruction opcode
+    # copy") on bf16 ppermute/psum under a partial-manual shard_map —
+    # minimal repro: scan+ppermute+psum of a bf16 carry. Work around it
+    # on CPU (tests / dryrun) by carrying activations between stages in
+    # f32: stages still compute in the model dtype, and since each
+    # stage's outputs are already bf16-rounded values, the up/down
+    # casts are bit-exact. Real TPU backends keep native bf16 transit.
+    transit_f32 = (
+        x.dtype == jnp.bfloat16 and jax.default_backend() == "cpu"
+    )
+    stage_dtype = x.dtype
+    if transit_f32:
+        # cast OUTSIDE the shard_map: a bf16 input would psum its bf16
+        # cotangent at the manual boundary in the backward — the same
+        # crashing pattern
+        xm = xm.astype(jnp.float32)
+
     param_specs = jax.tree_util.tree_map(lambda _l: P(axis), layer_params)
     aux_specs = jax.tree_util.tree_map(lambda _l: P(), aux)
+
+    out_specs = (P(), P()) if with_aux_out else P()
 
     @partial(
         shard_map,
@@ -88,7 +116,7 @@ def pipeline_apply(
         axis_names=frozenset({axis}),  # manual over pipe ONLY: fsdp/
         # tensor/expert shardings inside the stage stay under GSPMD
         in_specs=(param_specs, P(), aux_specs),
-        out_specs=P(),
+        out_specs=out_specs,
         check_vma=False,
     )
     def run(stage_layers, xm, aux):
@@ -97,17 +125,19 @@ def pipeline_apply(
 
         y0 = jnp.zeros_like(xm)
         state0 = jnp.zeros_like(xm[0])
+        aux_acc0 = jnp.zeros((), jnp.float32)
 
         def tick(carry, t):
-            state, y = carry
+            state, y, aux_acc = carry
             # stage 0 ingests microbatch t while t < M
             x_t = jax.lax.dynamic_index_in_dim(
                 xm, jnp.clip(t, 0, M - 1), 0, keepdims=False
             )
             take_input = (idx == 0) & (t < M)
             state = jnp.where(take_input, x_t, state)
+            state_in = state.astype(stage_dtype) if transit_f32 else state
             if aux is None:
-                out = stage_fn(stage_layers, state)
+                out = stage_fn(stage_layers, state_in)
             else:
                 # stage idx processes microbatch t - idx at tick t
                 mb_idx = jnp.clip(t - idx, 0, M - 1)
@@ -117,7 +147,17 @@ def pipeline_apply(
                     ),
                     aux,
                 )
-                out = stage_fn(stage_layers, state, aux_t)
+                out = stage_fn(stage_layers, state_in, aux_t)
+            if with_aux_out:
+                out, aux_s = out
+                # bubble ticks run on garbage activations: only ticks
+                # where this stage holds a real microbatch contribute
+                valid = (t >= idx) & (t - idx < M)
+                aux_acc = aux_acc + jnp.where(
+                    valid, aux_s.astype(jnp.float32), 0.0
+                )
+            if transit_f32:
+                out = out.astype(jnp.float32)
             # the last stage owns microbatch t-(S-1)'s final activation
             write_t = t - (S - 1)
             write = (idx == S - 1) & (write_t >= 0)
@@ -130,12 +170,20 @@ def pipeline_apply(
             )
             # hand the activation to the next stage (single p2p hop)
             state = jax.lax.ppermute(out, axis, perm)
-            return (state, y), None
+            return (state, y, aux_acc), None
 
-        (_, y), _ = jax.lax.scan(tick, (state0, y0), jnp.arange(M + S - 1))
+        (_, y, aux_acc), _ = jax.lax.scan(
+            tick, (state0, y0, aux_acc0), jnp.arange(M + S - 1)
+        )
         # y is populated only on the last stage; psum replicates it
         # (every other stage contributes zeros)
-        return jax.lax.psum(jnp.where(idx == S - 1, y, jnp.zeros_like(y)), axis)
+        y = jax.lax.psum(jnp.where(idx == S - 1, y, jnp.zeros_like(y)), axis)
+        if with_aux_out:
+            return y, jax.lax.psum(aux_acc, axis)
+        return y
 
-    y = run(layer_params, xm, aux)
-    return y.reshape(B, *x.shape[1:])
+    out = run(layer_params, xm, aux)
+    if with_aux_out:
+        y, aux_sum = out
+        return y.reshape(B, *x.shape[1:]).astype(x.dtype), aux_sum
+    return out.reshape(B, *x.shape[1:]).astype(x.dtype)

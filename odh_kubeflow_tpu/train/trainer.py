@@ -190,10 +190,6 @@ class Trainer:
             "pipe", 1
         )
         self.pipelined = pipe > 1
-        if self.is_moe and self.pipelined:
-            raise NotImplementedError(
-                "pipeline parallelism is wired for the dense family only"
-            )
         if self.is_moe:
             p_specs = moe_lib.param_specs(model_cfg)
             init_partial = partial(
@@ -338,6 +334,7 @@ class Trainer:
                 lora=lora_params,
                 segment_ids=batch.get("segment_ids"),
                 return_hidden=True,
+                pipeline_microbatches=self.train_cfg.pipeline_microbatches,
             )
             return (
                 chunked_cross_entropy(
@@ -355,6 +352,7 @@ class Trainer:
             cfg,
             lora=lora_params,
             segment_ids=batch.get("segment_ids"),
+            pipeline_microbatches=self.train_cfg.pipeline_microbatches,
         )
         return (
             cross_entropy_loss(

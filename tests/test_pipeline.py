@@ -205,3 +205,32 @@ def test_llama_layer_stack_pipelines(devices8):
     np.testing.assert_allclose(
         np.asarray(got.reshape(B, S, D)), np.asarray(want), atol=1e-4
     )
+
+
+def test_pipelined_bf16_transit(devices8):
+    """bf16 activations through the pipeline: XLA's CPU backend aborts
+    on bf16 ppermute/psum under partial-manual shard_map, so transit
+    runs in f32 on CPU (bit-exact: stage outputs are already
+    bf16-rounded). Regression test for the crash, and the pipelined
+    loss must still match the flat bf16 trainer."""
+    import numpy as np
+
+    from odh_kubeflow_tpu.models import LlamaConfig, LoraConfig
+    from odh_kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from odh_kubeflow_tpu.train import TrainConfig, Trainer
+
+    losses = {}
+    for name, mesh_cfg, micro in (
+        ("flat", MeshConfig(data=8), 8),
+        ("piped", MeshConfig(pipe=2, data=4), 2),
+    ):
+        trainer = Trainer(
+            LlamaConfig.tiny(dtype=jnp.bfloat16),
+            TrainConfig(warmup_steps=1, total_steps=4, pipeline_microbatches=micro),
+            lora_cfg=LoraConfig(rank=2),
+            mesh=build_mesh(mesh_cfg, devices8),
+        )
+        batch = trainer.make_fake_batch(8, 16, seed=5)
+        losses[name] = float(trainer.train_step(batch)["loss"])
+    assert np.isfinite(losses["piped"])
+    assert abs(losses["piped"] - losses["flat"]) < 0.05, losses
