@@ -113,6 +113,19 @@ def sample_logits(
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
+def family_forward(cfg):
+    """(cache-shape config, cached-forward fn) for a dense or MoE
+    config — the single model-family dispatch point shared by
+    ``generate`` and ``models/spec_decode.py``. A MoeConfig wraps a
+    dense backbone whose shapes drive the cache; its own cached
+    forward routes the MLP through the experts."""
+    if hasattr(cfg, "base"):
+        from odh_kubeflow_tpu.models import moe as _moe
+
+        return cfg.base, _moe.forward_with_cache
+    return cfg, forward_with_cache
+
+
 def generate(
     params: Params,
     prompt_tokens: jnp.ndarray,  # [B, S_prompt] int32, right-padded
@@ -139,16 +152,7 @@ def generate(
     if key is None:
         key = jax.random.key(0)
 
-    # model-family dispatch: MoeConfig wraps a dense backbone whose
-    # shapes drive the cache; its own cached forward routes the MLP
-    if hasattr(cfg, "base"):
-        from odh_kubeflow_tpu.models import moe as _moe
-
-        cache_cfg = cfg.base
-        fwd = _moe.forward_with_cache
-    else:
-        cache_cfg = cfg
-        fwd = forward_with_cache
+    cache_cfg, fwd = family_forward(cfg)
 
     cache = init_cache(cache_cfg, B, max_len, gen_cfg.cache_dtype)
     slots = jnp.arange(max_len, dtype=jnp.int32)[None, :]  # [1, S_max]

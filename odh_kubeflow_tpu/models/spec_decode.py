@@ -1,0 +1,214 @@
+"""Speculative decoding: draft-model proposal + single-pass target
+verification.
+
+Autoregressive decode is HBM-bandwidth-bound — every token streams the
+full weight set. A small draft model proposes ``k`` tokens cheaply; the
+target then scores all of them in ONE cached forward (k+1 tokens wide,
+so its weights stream once per round instead of once per token) and
+accepts the longest prefix matching its own greedy choices, plus one
+corrected/bonus token. Greedy speculative decoding is **exact**: the
+emitted stream is bit-identical to the target model decoding alone
+(tested), the draft only changes *when* the target's weights get
+streamed.
+
+TPU-first constraints honored:
+- two traced shapes per model (prompt prefill + the fixed (k+1)-wide
+  verify window); the round loop is a ``lax.while_loop`` with static
+  shapes throughout;
+- rejected tokens leave stale cache entries *behind the masked
+  horizon* — ``kv_mask`` + the traced ``q_offset`` already guarantee
+  they are never attended, so no cache rewind is materialised;
+- the output buffer is over-allocated by ``k+1`` and written with one
+  ``dynamic_update_slice`` per round (accept-masked), so no scatter.
+
+Single-stream (B=1) by design: per-row acceptance lengths would need
+per-row cache offsets, and batched serving is already compute-bound —
+speculation is the *latency* lever (``models/serve.py`` remains the
+throughput path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from odh_kubeflow_tpu.models.generate import family_forward, init_cache
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecDecodeConfig:
+    max_new_tokens: int = 64
+    num_draft_tokens: int = 4  # k
+    eos_id: Optional[int] = None
+    pad_id: int = 0
+    cache_dtype: Any = jnp.bfloat16
+
+
+def speculative_generate(
+    target_params: Params,
+    target_cfg,
+    draft_params: Params,
+    draft_cfg,
+    prompt_tokens: jnp.ndarray,  # [1, S_prompt] int32
+    spec_cfg: SpecDecodeConfig = SpecDecodeConfig(),
+    *,
+    target_lora: Optional[Params] = None,
+    draft_lora: Optional[Params] = None,
+) -> dict[str, jnp.ndarray]:
+    """Greedy speculative decode; returns ``{"tokens": [1, N],
+    "lengths": [1], "accepted_drafts", "rounds"}``.
+
+    ``accepted_drafts / (rounds * k)`` is the draft acceptance rate;
+    each round emits between 1 and k+1 tokens, so the target runs
+    ``rounds`` wide forwards instead of ``N`` narrow ones.
+    """
+    B, S_prompt = prompt_tokens.shape
+    if B != 1:
+        raise ValueError(
+            "speculative decoding is the single-stream latency path "
+            f"(per-row acceptance needs per-row cache offsets); got B={B}"
+        )
+    t_base, t_fwd = family_forward(target_cfg)
+    d_base, d_fwd = family_forward(draft_cfg)
+    if t_base.vocab_size != d_base.vocab_size:
+        raise ValueError(
+            f"draft/target vocab mismatch: {d_base.vocab_size} vs "
+            f"{t_base.vocab_size}"
+        )
+
+    N = spec_cfg.max_new_tokens
+    k = spec_cfg.num_draft_tokens
+    max_len = S_prompt + N + k + 1  # verify window may overhang by k
+    slots = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+
+    t_cache = init_cache(t_base, 1, max_len, spec_cfg.cache_dtype)
+    d_cache = init_cache(d_base, 1, max_len, spec_cfg.cache_dtype)
+
+    # --- prefill both models on the prompt --------------------------------
+    positions = jnp.arange(S_prompt, dtype=jnp.int32)[None, :]
+    prompt_mask = slots < S_prompt
+    t_logits, t_cache = t_fwd(
+        target_params, prompt_tokens, target_cfg, t_cache, jnp.int32(0),
+        positions=positions, kv_mask=prompt_mask, lora=target_lora,
+    )
+    _, d_cache = d_fwd(
+        draft_params, prompt_tokens, draft_cfg, d_cache, jnp.int32(0),
+        positions=positions, kv_mask=prompt_mask, lora=draft_lora,
+    )
+    # first token: the target's own greedy choice after the prompt
+    t0 = jnp.argmax(t_logits[:, -1, :], axis=-1).astype(jnp.int32)  # [1]
+
+    out0 = jnp.full((N + k + 1,), spec_cfg.pad_id, jnp.int32)
+    out0 = out0.at[0].set(t0[0])
+
+    def draft_steps(d_cache, t_cur, pos):
+        """Greedy single-token draft steps from ``t_cur`` at slot
+        ``pos``; returns (cache, drafts [k]). Runs k+1 steps so the
+        draft also CONSUMES its last proposal d_k — on full acceptance
+        the next round starts at slot pos+k+1, and skipping d_k would
+        leave a permanent hole in the draft cache (the bug class this
+        comment guards: the k+1'th proposal itself is discarded)."""
+
+        def one(carry, i):
+            d_cache, tok = carry
+            write = pos + i
+            mask = slots < write + 1
+            logits, d_cache = d_fwd(
+                draft_params, tok[None, :], draft_cfg, d_cache, write,
+                positions=write[None, None], kv_mask=mask, lora=draft_lora,
+            )
+            nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+            return (d_cache, nxt), nxt[0]
+
+        (d_cache, _), proposals = jax.lax.scan(
+            one, (d_cache, t_cur), jnp.arange(k + 1, dtype=jnp.int32)
+        )
+        return d_cache, proposals[:k]
+
+    def round_body(state):
+        out, n_gen, t_cur, t_cache, d_cache, done, acc, rounds = state
+        pos = jnp.int32(S_prompt) + n_gen - 1  # slot of t_cur
+
+        d_cache, drafts = draft_steps(d_cache, t_cur, pos)
+
+        # one wide target forward over [t_cur, d_1..d_k] at slots
+        # pos..pos+k; logits[j] is the target's prediction AFTER
+        # consuming window[j]
+        window = jnp.concatenate([t_cur, drafts])[None, :]  # [1, k+1]
+        w_pos = pos + jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+        w_mask = slots < pos + k + 1
+        t_logits, t_cache = t_fwd(
+            target_params, window, target_cfg, t_cache, pos,
+            positions=w_pos, kv_mask=w_mask, lora=target_lora,
+        )
+        t_choice = jnp.argmax(t_logits[0], axis=-1).astype(jnp.int32)  # [k+1]
+
+        # longest prefix where the draft matched the target's greedy
+        match = drafts == t_choice[:k]
+        accept = jnp.argmin(
+            jnp.concatenate([match, jnp.zeros((1,), bool)])
+        ).astype(jnp.int32)  # in [0, k]
+        # emitted this round: d_1..d_accept then the target's own token
+        cand = jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)])
+        idx = jnp.arange(k + 1, dtype=jnp.int32)
+        emitted = jnp.where(
+            idx < accept,
+            cand,
+            jnp.where(
+                idx == accept, t_choice[accept], jnp.int32(spec_cfg.pad_id)
+            ),
+        )
+        out = jax.lax.dynamic_update_slice(out, emitted, (n_gen,))
+
+        n_emit = accept + 1
+        t_cur = t_choice[accept][None]
+        n_gen = n_gen + n_emit
+        acc = acc + accept
+        rounds = rounds + 1
+        if spec_cfg.eos_id is not None:
+            done = done | jnp.any(
+                (emitted == spec_cfg.eos_id) & (idx <= accept)
+            )
+        return (out, n_gen, t_cur, t_cache, d_cache, done, acc, rounds)
+
+    def cond(state):
+        _, n_gen, _, _, _, done, _, _ = state
+        return (n_gen < N) & ~done
+
+    state = (
+        out0,
+        jnp.int32(1),
+        t0,
+        t_cache,
+        d_cache,
+        jnp.zeros((), bool),
+        jnp.int32(0),
+        jnp.int32(0),
+    )
+    out, n_gen, _, _, _, _, acc, rounds = jax.lax.while_loop(
+        cond, round_body, state
+    )
+
+    tokens = out[:N][None, :]
+    idx = jnp.arange(N, dtype=jnp.int32)[None, :]
+    tokens = jnp.where(idx < n_gen, tokens, jnp.int32(spec_cfg.pad_id))
+    if spec_cfg.eos_id is not None:
+        is_eos = tokens[0] == spec_cfg.eos_id
+        first_eos = jnp.argmax(is_eos)
+        has_eos = jnp.any(is_eos)
+        cut = jnp.where(has_eos, first_eos + 1, jnp.minimum(n_gen, N))
+        tokens = jnp.where(idx < cut, tokens, jnp.int32(spec_cfg.pad_id))
+        length = cut
+    else:
+        length = jnp.minimum(n_gen, N)
+    return {
+        "tokens": tokens,
+        "lengths": length[None].astype(jnp.int32),
+        "accepted_drafts": acc,
+        "rounds": rounds,
+    }

@@ -1,0 +1,110 @@
+"""Speculative decoding: greedy exactness vs the target decoding
+alone, acceptance accounting, eos truncation, and guard rails."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from odh_kubeflow_tpu.models import GenerateConfig, LlamaConfig, generate
+from odh_kubeflow_tpu.models import llama
+from odh_kubeflow_tpu.models.spec_decode import (
+    SpecDecodeConfig,
+    speculative_generate,
+)
+
+
+@pytest.fixture(scope="module")
+def models():
+    target_cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    draft_cfg = LlamaConfig.tiny(dtype=jnp.float32, num_layers=1)
+    target = llama.init_params(jax.random.PRNGKey(0), target_cfg)
+    draft = llama.init_params(jax.random.PRNGKey(1), draft_cfg)
+    return target, target_cfg, draft, draft_cfg
+
+
+def test_greedy_exactness_vs_target_alone(models):
+    """The defining property: the emitted stream is identical to the
+    target model greedy-decoding by itself — the draft only changes
+    how often the target's weights stream."""
+    target, target_cfg, draft, draft_cfg = models
+    prompt = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)
+    N = 24
+    want = generate(
+        target, prompt, target_cfg, GenerateConfig(max_new_tokens=N, temperature=0.0)
+    )
+
+    for k in (1, 3, 4):
+        got = speculative_generate(
+            target, target_cfg, draft, draft_cfg, prompt,
+            SpecDecodeConfig(max_new_tokens=N, num_draft_tokens=k),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got["tokens"]), np.asarray(want["tokens"]),
+            err_msg=f"k={k}",
+        )
+        assert int(got["lengths"][0]) == N
+        # every round makes progress: rounds <= N, and with k drafts
+        # per round at least ceil((N-1)/(k+1)) rounds are needed
+        assert int(got["rounds"]) <= N
+
+
+def test_perfect_draft_accepts_everything(models):
+    """Draft == target → every proposal accepted: rounds collapses to
+    ~N/(k+1) and the acceptance rate is 100%."""
+    target, target_cfg, _, _ = models
+    prompt = jnp.asarray([[7, 2, 9]], jnp.int32)
+    N, k = 25, 4
+    got = speculative_generate(
+        target, target_cfg, target, target_cfg, prompt,
+        SpecDecodeConfig(max_new_tokens=N, num_draft_tokens=k),
+    )
+    rounds = int(got["rounds"])
+    accepted = int(got["accepted_drafts"])
+    assert accepted == rounds * k  # all drafts accepted
+    assert rounds == -(-(N - 1) // (k + 1))  # ceil((N-1)/(k+1))
+    want = generate(
+        target, prompt, target_cfg, GenerateConfig(max_new_tokens=N, temperature=0.0)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got["tokens"]), np.asarray(want["tokens"])
+    )
+
+
+def test_eos_truncates(models):
+    target, target_cfg, draft, draft_cfg = models
+    prompt = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)
+    N = 24
+    plain = generate(
+        target, prompt, target_cfg, GenerateConfig(max_new_tokens=N, temperature=0.0)
+    )
+    # pick the 5th emitted token as "eos" so truncation must fire
+    eos = int(np.asarray(plain["tokens"])[0, 4])
+    got = speculative_generate(
+        target, target_cfg, draft, draft_cfg, prompt,
+        SpecDecodeConfig(max_new_tokens=N, num_draft_tokens=3, eos_id=eos),
+    )
+    toks = np.asarray(got["tokens"])[0]
+    length = int(got["lengths"][0])
+    assert toks[length - 1] == eos
+    assert (toks[length:] == 0).all()
+    np.testing.assert_array_equal(
+        toks[:length], np.asarray(plain["tokens"])[0, :length]
+    )
+
+
+def test_guard_rails(models):
+    target, target_cfg, draft, draft_cfg = models
+    with pytest.raises(ValueError, match="B=2"):
+        speculative_generate(
+            target, target_cfg, draft, draft_cfg,
+            jnp.ones((2, 4), jnp.int32),
+        )
+    small_vocab = LlamaConfig.tiny(vocab_size=128)
+    with pytest.raises(ValueError, match="vocab"):
+        speculative_generate(
+            target, target_cfg,
+            llama.init_params(jax.random.PRNGKey(2), small_vocab),
+            small_vocab,
+            jnp.ones((1, 4), jnp.int32),
+        )
