@@ -58,6 +58,9 @@ class CompletionService:
         cfg: LlamaConfig,
         *,
         lora: Optional[Params] = None,
+        draft_params: Optional[Params] = None,
+        draft_cfg=None,
+        spec_k: int = 4,
         prompt_buckets: Sequence[int] = DEFAULT_PROMPT_BUCKETS,
         batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS,
         pad_id: int = 0,
@@ -65,6 +68,12 @@ class CompletionService:
         self.params = params
         self.cfg = cfg
         self.lora = lora
+        # optional draft model: greedy single-prompt requests then run
+        # speculative decoding (models/spec_decode.py) — exact same
+        # output, fewer target weight streams
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.spec_k = spec_k
         self.prompt_buckets = tuple(prompt_buckets)
         self.batch_buckets = tuple(batch_buckets)
         self.pad_id = pad_id
@@ -84,6 +93,34 @@ class CompletionService:
                     prompt_lengths=lengths,
                     lora=lora,
                     key=rng,
+                )
+            )
+        return self._compiled[key]
+
+    def _spec_runner(self, max_tokens: int, eos_id: Optional[int]):
+        from odh_kubeflow_tpu.models.spec_decode import (
+            SpecDecodeConfig,
+            speculative_generate,
+        )
+
+        key = ("spec", max_tokens, eos_id, self.spec_k)
+        if key not in self._compiled:
+            spec_cfg = SpecDecodeConfig(
+                max_new_tokens=max_tokens,
+                num_draft_tokens=self.spec_k,
+                eos_id=eos_id,
+                pad_id=self.pad_id,
+            )
+            self._compiled[key] = jax.jit(
+                lambda tp, dp, lora, prompt, lengths: speculative_generate(
+                    tp,
+                    self.cfg,
+                    dp,
+                    self.draft_cfg,
+                    prompt,
+                    spec_cfg,
+                    prompt_lengths=lengths,
+                    target_lora=lora,
                 )
             )
         return self._compiled[key]
@@ -112,6 +149,13 @@ class CompletionService:
             tokens = tokens.at[i, : len(p)].set(jnp.asarray(p, jnp.int32))
             lengths = lengths.at[i].set(len(p))
 
+        # greedy single-prompt requests take the speculative path when
+        # a draft model is attached: identical output, lower latency
+        speculate = (
+            self.draft_params is not None
+            and len(prompts) == 1
+            and temperature == 0.0
+        )
         gen_cfg = GenerateConfig(
             max_new_tokens=max_tokens,
             temperature=temperature,
@@ -121,9 +165,19 @@ class CompletionService:
             pad_id=self.pad_id,
         )
         with self._lock:
-            out = self._runner(gen_cfg)(
-                self.params, self.lora, tokens, lengths, jax.random.key(seed)
-            )
+            if speculate:
+                out = self._spec_runner(max_tokens, eos_id)(
+                    self.params,
+                    self.draft_params,
+                    self.lora,
+                    tokens[:1],
+                    lengths[:1],
+                )
+            else:
+                out = self._runner(gen_cfg)(
+                    self.params, self.lora, tokens, lengths,
+                    jax.random.key(seed),
+                )
             toks = jax.device_get(out["tokens"])
             lens = jax.device_get(out["lengths"])
         completions = [
@@ -228,6 +282,14 @@ def main(argv: Optional[list] = None) -> None:
         "so a mismatch silently merges onto the wrong weights",
     )
     parser.add_argument("--int8", action="store_true", help="quantize weights")
+    parser.add_argument(
+        "--draft-config",
+        default="",
+        choices=["", "tiny", "llama3_1b"],
+        help="attach a draft model: greedy single-stream requests use "
+        "speculative decoding (identical output, lower latency)",
+    )
+    parser.add_argument("--spec-k", type=int, default=4)
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8000)
     args = parser.parse_args(argv)
@@ -309,11 +371,32 @@ def main(argv: Optional[list] = None) -> None:
             lambda k: init_params(k, cfg, dtype=jnp.bfloat16)
         )(jax.random.key(args.seed))
 
-    service = CompletionService(params, cfg)
+    draft_params, draft_cfg = None, None
+    if args.draft_config:
+        draft_cfg = getattr(LlamaConfig, args.draft_config)(dtype=jnp.bfloat16)
+        if args.int8:
+            from odh_kubeflow_tpu.models.quant import streaming_quantized_init
+
+            draft_params = streaming_quantized_init(
+                draft_cfg, jax.random.key(args.seed)
+            )
+        else:
+            draft_params = jax.jit(
+                lambda k: init_params(k, draft_cfg, dtype=jnp.bfloat16)
+            )(jax.random.key(args.seed))
+
+    service = CompletionService(
+        params,
+        cfg,
+        draft_params=draft_params,
+        draft_cfg=draft_cfg,
+        spec_k=args.spec_k,
+    )
     httpd = serve(service, host=args.host, port=args.port)
     print(
         f"completion server on http://{args.host}:{httpd.server_address[1]}"
-        f" (config={args.config}, int8={args.int8})",
+        f" (config={args.config}, int8={args.int8}, "
+        f"draft={args.draft_config or 'none'})",
         flush=True,
     )
     while True:

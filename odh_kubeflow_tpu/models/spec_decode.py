@@ -54,9 +54,10 @@ def speculative_generate(
     target_cfg,
     draft_params: Params,
     draft_cfg,
-    prompt_tokens: jnp.ndarray,  # [1, S_prompt] int32
+    prompt_tokens: jnp.ndarray,  # [1, S_prompt] int32, right-padded
     spec_cfg: SpecDecodeConfig = SpecDecodeConfig(),
     *,
+    prompt_lengths: Optional[jnp.ndarray] = None,  # [1] int32
     target_lora: Optional[Params] = None,
     draft_lora: Optional[Params] = None,
 ) -> dict[str, jnp.ndarray]:
@@ -66,6 +67,11 @@ def speculative_generate(
     ``accepted_drafts / (rounds * k)`` is the draft acceptance rate;
     each round emits between 1 and k+1 tokens, so the target runs
     ``rounds`` wide forwards instead of ``N`` narrow ones.
+
+    ``prompt_lengths`` supports right-padded (bucketed) prompts: decode
+    writes continue at physical slot ``prompt_len`` — inside the pad
+    region, whose masked slots are overwritten before ever being
+    attended — so logical and physical positions coincide throughout.
     """
     B, S_prompt = prompt_tokens.shape
     if B != 1:
@@ -85,13 +91,17 @@ def speculative_generate(
     k = spec_cfg.num_draft_tokens
     max_len = S_prompt + N + k + 1  # verify window may overhang by k
     slots = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    if prompt_lengths is None:
+        plen = jnp.int32(S_prompt)
+    else:
+        plen = prompt_lengths.astype(jnp.int32)[0]
 
     t_cache = init_cache(t_base, 1, max_len, spec_cfg.cache_dtype)
     d_cache = init_cache(d_base, 1, max_len, spec_cfg.cache_dtype)
 
     # --- prefill both models on the prompt --------------------------------
     positions = jnp.arange(S_prompt, dtype=jnp.int32)[None, :]
-    prompt_mask = slots < S_prompt
+    prompt_mask = slots < plen
     t_logits, t_cache = t_fwd(
         target_params, prompt_tokens, target_cfg, t_cache, jnp.int32(0),
         positions=positions, kv_mask=prompt_mask, lora=target_lora,
@@ -100,8 +110,10 @@ def speculative_generate(
         draft_params, prompt_tokens, draft_cfg, d_cache, jnp.int32(0),
         positions=positions, kv_mask=prompt_mask, lora=draft_lora,
     )
-    # first token: the target's own greedy choice after the prompt
-    t0 = jnp.argmax(t_logits[:, -1, :], axis=-1).astype(jnp.int32)  # [1]
+    # first token: the target's greedy choice after the last REAL
+    # prompt position
+    last = jnp.take_along_axis(t_logits, (plen - 1)[None, None, None], axis=1)
+    t0 = jnp.argmax(last[:, 0, :], axis=-1).astype(jnp.int32)  # [1]
 
     out0 = jnp.full((N + k + 1,), spec_cfg.pad_id, jnp.int32)
     out0 = out0.at[0].set(t0[0])
@@ -132,7 +144,7 @@ def speculative_generate(
 
     def round_body(state):
         out, n_gen, t_cur, t_cache, d_cache, done, acc, rounds = state
-        pos = jnp.int32(S_prompt) + n_gen - 1  # slot of t_cur
+        pos = plen + n_gen - 1  # slot of t_cur (continues at prompt_len)
 
         d_cache, drafts = draft_steps(d_cache, t_cur, pos)
 

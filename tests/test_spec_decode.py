@@ -108,3 +108,52 @@ def test_guard_rails(models):
             small_vocab,
             jnp.ones((1, 4), jnp.int32),
         )
+
+
+def test_bucketed_padded_prompt_matches_exact(models):
+    """prompt_lengths support: a right-padded (bucketed) prompt decodes
+    identically to the unpadded one — the service's spawn path."""
+    target, target_cfg, draft, draft_cfg = models
+    real = [3, 1, 4, 1, 5]
+    exact = speculative_generate(
+        target, target_cfg, draft, draft_cfg,
+        jnp.asarray([real], jnp.int32),
+        SpecDecodeConfig(max_new_tokens=12, num_draft_tokens=3),
+    )
+    padded = jnp.zeros((1, 8), jnp.int32).at[0, :5].set(jnp.asarray(real))
+    bucketed = speculative_generate(
+        target, target_cfg, draft, draft_cfg, padded,
+        SpecDecodeConfig(max_new_tokens=12, num_draft_tokens=3),
+        prompt_lengths=jnp.asarray([5], jnp.int32),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(exact["tokens"]), np.asarray(bucketed["tokens"])
+    )
+
+
+def test_completion_service_speculative_path(models):
+    """A draft-equipped CompletionService serves greedy single-prompt
+    requests through speculation with output identical to the plain
+    service; batched and sampled requests fall back to generate()."""
+    from odh_kubeflow_tpu.models.serve import CompletionService
+
+    target, target_cfg, draft, draft_cfg = models
+    plain = CompletionService(
+        target, target_cfg, prompt_buckets=(8,), batch_buckets=(1, 2)
+    )
+    spec = CompletionService(
+        target,
+        target_cfg,
+        draft_params=draft,
+        draft_cfg=draft_cfg,
+        spec_k=3,
+        prompt_buckets=(8,),
+        batch_buckets=(1, 2),
+    )
+    prompt = [3, 1, 4, 1, 5]
+    want = plain.complete([prompt], max_tokens=10)["completions"]
+    got = spec.complete([prompt], max_tokens=10)["completions"]
+    assert got == want
+    # batched request: falls back to the batched generate() path
+    two = spec.complete([prompt, [2, 7]], max_tokens=6)["completions"]
+    assert len(two) == 2 and all(len(c) == 6 for c in two)
