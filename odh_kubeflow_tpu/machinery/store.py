@@ -535,4 +535,33 @@ class APIServer:
         created = self.create(event)
         with self._lock:
             self._event_index[dedupe_key] = created["metadata"]["name"]
+        self._prune_events(ns)
         return created
+
+    # events per namespace kept after pruning (kube-apiserver expires
+    # events by TTL; a bounded ring is the embedded equivalent — a
+    # long-running platform must not grow its event set unboundedly)
+    EVENT_RETENTION = 1000
+
+    def _prune_events(self, namespace: str) -> None:
+        limit = self.EVENT_RETENTION
+        with self._lock:
+            info = self.type_info("Event")
+            store = self._store["Event"]
+            names = [
+                # resourceVersion is the store's monotonic clock —
+                # wall-clock timestamps tie within a millisecond
+                (int(obj["metadata"]["resourceVersion"]), name)
+                for (ns, name), obj in store.items()
+                if ns == namespace
+            ]
+            if len(names) <= limit:
+                return
+            names.sort()  # oldest first
+            drop = names[: len(names) - limit]
+            for _, name in drop:
+                store.pop(self._key(info, namespace, name), None)
+            dead = {name for _, name in drop}
+            self._event_index = {
+                k: v for k, v in self._event_index.items() if v not in dead
+            }

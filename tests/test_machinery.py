@@ -416,3 +416,35 @@ def test_store_concurrent_crud_consistency():
             assert rv > last_rv[name], (name, rv, last_rv[name])
         last_rv[name] = rv
     watch.stop()
+
+
+def test_event_retention_bounded():
+    """Events are pruned per namespace beyond EVENT_RETENTION (the
+    embedded analog of kube-apiserver's event TTL): a long-running
+    platform's event set stays bounded, newest events survive, and the
+    dedupe index drops pruned entries so re-emission works."""
+    from odh_kubeflow_tpu.machinery.store import APIServer
+
+    api = APIServer()
+    api.EVENT_RETENTION = 50
+    involved = [
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": f"p{i}", "namespace": "load", "uid": f"u{i}"},
+        }
+        for i in range(120)
+    ]
+    for i, obj in enumerate(involved):
+        api.emit_event(obj, "Tick", f"event {i}")
+    events = api.list("Event", namespace="load")
+    assert len(events) == 50
+    # the newest survive
+    msgs = {e["message"] for e in events}
+    assert "event 119" in msgs and "event 0" not in msgs
+    # a pruned event's dedupe entry is gone: re-emitting creates anew
+    again = api.emit_event(involved[0], "Tick", "event 0")
+    assert again["message"] == "event 0"
+    assert any(
+        e["message"] == "event 0" for e in api.list("Event", namespace="load")
+    )
