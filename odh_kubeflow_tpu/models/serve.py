@@ -25,6 +25,7 @@ Design constraints honored:
 
 from __future__ import annotations
 
+import collections
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -78,12 +79,20 @@ class CompletionService:
         self.batch_buckets = tuple(batch_buckets)
         self.pad_id = pad_id
         self._lock = threading.Lock()  # one TPU program at a time
-        self._compiled: dict = {}
+        # LRU-bounded: every distinct (max_tokens, sampling...) combo
+        # compiles a program — unbounded growth would let arbitrary
+        # request params exhaust memory on a long-running server
+        self._compiled: "collections.OrderedDict" = collections.OrderedDict()
+        self.max_compiled = 32
 
     def _runner(self, gen_cfg: GenerateConfig):
         key = (gen_cfg.max_new_tokens, gen_cfg.temperature, gen_cfg.top_k,
                gen_cfg.top_p, gen_cfg.eos_id)
-        if key not in self._compiled:
+        if key in self._compiled:
+            self._compiled.move_to_end(key)
+        else:
+            while len(self._compiled) >= self.max_compiled:
+                self._compiled.popitem(last=False)
             self._compiled[key] = jax.jit(
                 lambda p, lora, prompt, lengths, rng: generate(
                     p,
@@ -104,6 +113,11 @@ class CompletionService:
         )
 
         key = ("spec", max_tokens, eos_id, self.spec_k)
+        if key in self._compiled:
+            self._compiled.move_to_end(key)
+            return self._compiled[key]
+        while len(self._compiled) >= self.max_compiled:
+            self._compiled.popitem(last=False)
         if key not in self._compiled:
             spec_cfg = SpecDecodeConfig(
                 max_new_tokens=max_tokens,

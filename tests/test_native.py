@@ -125,3 +125,31 @@ def test_store_uses_fast_copy_isolation():
     got = api.get("Namespace", "iso")
     got["metadata"]["labels"]["x"] = "mutated"
     assert api.get("Namespace", "iso")["metadata"]["labels"]["x"] == "1"
+
+
+def test_native_pack_fuzz_edge_cases():
+    """Property fuzz: random doc-length distributions incl. exact
+    row-fills, seq_len-multiple docs, and singleton tokens — native
+    and Python packers must agree bit-for-bit on every draw."""
+    rng = np.random.default_rng(7)
+    for trial in range(10):
+        kind = trial % 4
+        if kind == 0:  # many tiny docs
+            docs = [list(rng.integers(1, 99, size=rng.integers(1, 4)))
+                    for _ in range(rng.integers(1, 40))]
+        elif kind == 1:  # docs exactly seq_len / multiples
+            docs = [list(rng.integers(1, 99, size=s)) for s in (32, 64, 96, 32)]
+        elif kind == 2:  # one giant doc
+            docs = [list(rng.integers(1, 99, size=500))]
+        else:  # mixed, numpy-backed
+            docs = [rng.integers(1, 99, size=rng.integers(1, 120), dtype=np.int32)
+                    for _ in range(20)]
+        for drop in (True, False):
+            py = list(pack_documents(list(docs), 2, 32, engine="python",
+                                     drop_remainder=drop))
+            nat = list(pack_documents(list(docs), 2, 32, engine="native",
+                                      drop_remainder=drop))
+            assert len(py) == len(nat), (trial, drop)
+            for a, b in zip(py, nat):
+                for k in a:
+                    np.testing.assert_array_equal(a[k], b[k], err_msg=f"{trial}/{k}")

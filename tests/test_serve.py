@@ -271,3 +271,22 @@ def test_serves_moe_family():
     out = svc.complete([[2, 7, 1]], max_tokens=4)
     assert len(out["completions"][0]) == 4
     assert all(0 <= t < cfg.vocab_size for t in out["completions"][0])
+
+
+def test_compile_cache_bounded(service):
+    """Distinct request params each compile a program; the cache is
+    LRU-bounded so arbitrary max_tokens values cannot exhaust memory
+    on a long-running server."""
+    svc = CompletionService(
+        service.params, service.cfg, prompt_buckets=(8,), batch_buckets=(1,)
+    )
+    svc.max_compiled = 3
+    for n in (2, 3, 4, 5, 6):
+        svc.complete([[1, 2, 3]], max_tokens=n)
+    assert len(svc._compiled) == 3
+    # most-recent entries survive
+    assert any(k[0] == 6 for k in svc._compiled)
+    assert not any(k[0] == 2 for k in svc._compiled)
+    # evicted shapes still serve (recompile on demand)
+    out = svc.complete([[1, 2, 3]], max_tokens=2)
+    assert len(out["completions"][0]) == 2
