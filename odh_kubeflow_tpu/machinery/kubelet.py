@@ -30,6 +30,9 @@ class FakeCluster:
     def __init__(self, api: APIServer):
         self.api = api
         self._ip_counter = itertools.count(2)
+        # per-step() scheduler ledger: used-TPU-by-node, built once per
+        # pass and updated as pods bind (None outside a step)
+        self._sched_used: Optional[dict[str, float]] = None
 
     # -- nodes --------------------------------------------------------------
 
@@ -144,25 +147,32 @@ class FakeCluster:
                 return False
         return True
 
+    def _build_used_by_node(self) -> dict[str, float]:
+        used: dict[str, float] = {}
+        for other in self.api.list("Pod"):
+            if obj_util.get_path(other, "status", "phase") == "Succeeded":
+                continue
+            name = obj_util.get_path(other, "spec", "nodeName")
+            if name:
+                used[name] = used.get(name, 0.0) + self._pod_tpu_request(other)
+        return used
+
     def _schedule(self, pod: Obj) -> Optional[str]:
-        # one pod list per scheduling pass, not one per candidate node —
-        # the pod×node product was the loadtest's O(N²) control-plane
-        # hotspot (every list deep-copies through the store)
+        # One pod list per step() (the real kube-scheduler keeps a
+        # cache the same way), updated incrementally as this pass binds
+        # pods — re-listing per pod was the loadtest's O(N²) hotspot
+        # (every list deep-copies through the store). Outside a step
+        # (direct calls in tests) the ledger is built on demand.
         want_tpu = self._pod_tpu_request(pod)
-        used_by_node: Optional[dict[str, float]] = None
-        if want_tpu:
-            used_by_node = {}
-            for other in self.api.list("Pod"):
-                if obj_util.get_path(other, "status", "phase") == "Succeeded":
-                    continue
-                name = obj_util.get_path(other, "spec", "nodeName")
-                if name:
-                    used_by_node[name] = used_by_node.get(
-                        name, 0.0
-                    ) + self._pod_tpu_request(other)
+        used_by_node = self._sched_used
+        if want_tpu and used_by_node is None:
+            used_by_node = self._build_used_by_node()
         for node in self.api.list("Node"):
             if self._node_fits(node, pod, want_tpu, used_by_node):
-                return obj_util.name_of(node)
+                name = obj_util.name_of(node)
+                if want_tpu and used_by_node is not None:
+                    used_by_node[name] = used_by_node.get(name, 0.0) + want_tpu
+                return name
         return None
 
     # -- pod lifecycle ------------------------------------------------------
@@ -345,7 +355,11 @@ class FakeCluster:
 
     def step(self) -> None:
         """One full sync pass over all StatefulSets and Deployments."""
-        for sts in self.api.list("StatefulSet"):
-            self._sync_statefulset(sts)
-        for deploy in self.api.list("Deployment"):
-            self._sync_deployment(deploy)
+        self._sched_used = self._build_used_by_node()
+        try:
+            for sts in self.api.list("StatefulSet"):
+                self._sync_statefulset(sts)
+            for deploy in self.api.list("Deployment"):
+                self._sync_deployment(deploy)
+        finally:
+            self._sched_used = None
