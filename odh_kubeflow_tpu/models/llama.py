@@ -68,10 +68,11 @@ class LlamaConfig:
     remat: bool = True
     # "dots": save weight-matmul outputs (fast backward, ~25k floats
     # per token per layer of residency — fine to ~4k context);
-    # "attn": save only the attention outputs (D floats per token per
-    # layer) — the backward never recomputes the quadratic flash
-    # forward, at a fraction of "dots" residency; the long-context
-    # sweet spot;
+    # "attn": pin the attention output — on the flash path its padded
+    # kernel output + logsumexp (~D+Hq floats per token per layer),
+    # on dense/ring the "attn_out" tensor (~D floats) — so the
+    # backward never re-executes the quadratic attention forward, at
+    # a fraction of "dots" residency; the long-context sweet spot;
     # "none": save only layer boundaries and recompute everything
     # (minimum residency, maximum recompute).
     remat_policy: str = "dots"
@@ -382,11 +383,22 @@ def _make_layer_fn(cfg: LlamaConfig, attention_fn: Callable) -> Callable:
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             )
         elif cfg.remat_policy == "attn":
+            # "flash_out"/"flash_lse" are the flash kernel's custom-vjp
+            # residuals (ops/pallas_attention.py _flash_fwd): with them
+            # saved, remat's recompute is projections-only — the O(S²)
+            # forward kernel runs exactly once per layer, and the
+            # un-padded "attn_out" view is re-derived from "flash_out"
+            # by a free moveaxis/slice (saving both would double the
+            # residency). Dense/ring impls have no flash residuals, so
+            # there "attn_out" itself is pinned.
+            names = (
+                ("flash_out", "flash_lse")
+                if resolved_attention_impl(cfg) == "flash"
+                else ("attn_out",)
+            )
             layer_fn = jax.checkpoint(
                 layer_fn,
-                policy=jax.checkpoint_policies.save_only_these_names(
-                    "attn_out"
-                ),
+                policy=jax.checkpoint_policies.save_only_these_names(*names),
             )
         else:  # "none": full recompute, minimum residency
             layer_fn = jax.checkpoint(layer_fn)
@@ -420,6 +432,10 @@ def forward(
     sin, cos = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
 
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    # Resolve the attention impl exactly once: _select_attention and
+    # _make_layer_fn's remat-policy choice must agree on it (both
+    # consult ambient backend/mesh state under "auto").
+    cfg = dataclasses.replace(cfg, attention_impl=resolved_attention_impl(cfg))
     attention_fn = _select_attention(cfg)
     layer_fn = _make_layer_fn(cfg, attention_fn)
     lora_layers = lora["layers"] if lora is not None else None

@@ -27,6 +27,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -697,13 +698,13 @@ def _bwd(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12)
 )
 def _flash(q, k, v, segment_ids, causal, q_offset, sq, sk,
-           block_q, block_k, interpret):
+           block_q, block_k, bwd_block_q, bwd_block_k, interpret):
     out, _ = _flash_fwd(
         q, k, v, segment_ids, causal, q_offset, sq, sk,
-        block_q, block_k, interpret,
+        block_q, block_k, bwd_block_q, bwd_block_k, interpret,
     )
     return out
 
@@ -734,7 +735,7 @@ def _prep(q, k, v, segment_ids, sq, sk, block_q, block_k):
 
 
 def _flash_fwd(q, k, v, segment_ids, causal, q_offset, sq, sk,
-               block_q, block_k, interpret):
+               block_q, block_k, bwd_block_q, bwd_block_k, interpret):
     hd = q.shape[-1]
     scale = hd**-0.5
     qt, kt, vt, qseg, kseg = _prep(
@@ -745,26 +746,49 @@ def _flash_fwd(q, k, v, segment_ids, causal, q_offset, sq, sk,
         scale=scale, causal=causal, q_offset=q_offset, sk=sk,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
+    # Named residuals: under ``jax.checkpoint`` a policy that saves
+    # "flash_out"/"flash_lse" (models/llama.py remat_policy="attn")
+    # keeps exactly these two tensors, so the backward pass never
+    # re-executes the forward flash kernel — the recompute is reduced
+    # to the (cheap) projections while attention runs fwd-once +
+    # bwd-once. O(S·Hq·hd) extra residency per layer, vs the O(S²)
+    # score matrix flash exists to avoid.
+    out_p = _checkpoint_name(out_p, "flash_out")
+    lse = _checkpoint_name(lse, "flash_lse")
     out = jnp.moveaxis(out_p[:, :, :sq], 2, 1)
     return out, (q, k, v, segment_ids, out_p, lse)
 
 
-def _flash_bwd(causal, q_offset, sq, sk, block_q, block_k, interpret,
-               res, g):
+def _flash_bwd(causal, q_offset, sq, sk, block_q, block_k,
+               bwd_block_q, bwd_block_k, interpret, res, g):
     q, k, v, segment_ids, out_p, lse = res
     hd = q.shape[-1]
     scale = hd**-0.5
+    # The dq/dkv kernels have different arithmetic (3 dots each, larger
+    # VMEM working set) than the forward, so their optimal tiling
+    # differs — they get their own block sizes. Residuals out_p/lse are
+    # padded to the FORWARD block multiple; re-pad to the backward one
+    # when they disagree (padded q rows are zero ⇒ s = 0 and do = 0
+    # there, so any finite lse fill keeps the padded contributions 0).
+    bq, bk = bwd_block_q or block_q, bwd_block_k or block_k
     qt, kt, vt, qseg, kseg = _prep(
-        q, k, v, segment_ids, sq, sk, block_q, block_k
+        q, k, v, segment_ids, sq, sk, bq, bk
     )
     sq_p = qt.shape[2]
+    if out_p.shape[2] != sq_p:
+        out_p = out_p[:, :, :sq]
+        lse = lse[:, :, :sq]
+        if sq_p != sq:
+            pad = ((0, 0), (0, 0), (0, sq_p - sq), (0, 0))
+            out_p = jnp.pad(out_p, pad)
+            lse = jnp.pad(lse, pad)
     do = jnp.moveaxis(g, 1, 2)
     if sq_p != sq:
         do = jnp.pad(do, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
     dq, dk, dv = _bwd(
         qt, kt, vt, qseg, kseg, out_p, lse, do,
         scale=scale, causal=causal, q_offset=q_offset, sk=sk,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=bq, block_k=bk, interpret=interpret,
     )
     dq = jnp.moveaxis(dq[:, :, :sq], 2, 1)
     dk = jnp.moveaxis(dk[:, :, :sk], 2, 1)
@@ -785,6 +809,8 @@ def flash_attention(
     segment_ids: Optional[jnp.ndarray] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Flash attention; same contract as ``dense_attention``.
@@ -792,6 +818,10 @@ def flash_attention(
     ``q_offset`` must be a static python int on this path (the pallas
     grid's causal-skip predicate is specialised on it); the decode path
     with a traced offset should use ``dense_attention``.
+
+    ``bwd_block_q``/``bwd_block_k`` tile the dq/dkv kernels
+    independently of the forward (their 3-dot bodies have a different
+    VMEM/VPU balance); None inherits the forward blocks.
     """
     if not isinstance(q_offset, int):
         raise TypeError(
@@ -805,7 +835,11 @@ def flash_attention(
         interpret = _interpret_default()
     block_q = min(block_q, _ceil_to(sq, 128))
     block_k = min(block_k, _ceil_to(sk, 128))
+    if bwd_block_q is not None:
+        bwd_block_q = min(bwd_block_q, _ceil_to(sq, 128))
+    if bwd_block_k is not None:
+        bwd_block_k = min(bwd_block_k, _ceil_to(sk, 128))
     return _flash(
         q, k, v, segment_ids, causal, q_offset, sq, sk,
-        block_q, block_k, interpret,
+        block_q, block_k, bwd_block_q, bwd_block_k, interpret,
     )

@@ -151,6 +151,73 @@ def test_multiblock_causal_exercises_full_block_fast_path():
         assert jnp.allclose(r, g, atol=5e-4, rtol=1e-3), (name, err)
 
 
+def test_bwd_blocks_differ_from_fwd():
+    """Backward kernels tiled independently of the forward — including
+    a ragged seq where fwd/bwd pad to different multiples, exercising
+    the residual re-pad in _flash_bwd."""
+    B, S, Hq, Hkv, hd = 1, 300, 4, 2, 64  # fwd pads to 384, bwd to 512
+    q, k, v = _qkv(jax.random.key(20), B, S, S, Hq, Hkv, hd)
+    tangent = jax.random.normal(jax.random.key(21), (B, S, Hq, hd))
+
+    def flash_mixed(q, k, v, causal=True):
+        return flash_attention(
+            q, k, v, causal=causal, block_q=128, block_k=128,
+            bwd_block_q=256, bwd_block_k=256,
+        )
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v, causal=True) * tangent)
+
+    ref = jax.grad(lambda *a: loss(dense_attention, *a), argnums=(0, 1, 2))(
+        q, k, v
+    )
+    got = jax.grad(lambda *a: loss(flash_mixed, *a), argnums=(0, 1, 2))(
+        q, k, v
+    )
+    for name, r, g in zip("qkv", ref, got):
+        err = float(jnp.abs(r - g).max())
+        assert jnp.allclose(r, g, atol=5e-4, rtol=1e-3), (name, err)
+
+
+def test_attn_remat_policy_skips_flash_forward_recompute():
+    """remat_policy="attn" pins the flash kernel's named residuals
+    ("flash_out"/"flash_lse"): the backward must not re-execute the
+    forward kernel. Counted structurally — a remat'd layer lowers 4
+    pallas_calls (fwd, recomputed fwd, dq, dkv) under the "none"
+    policy but exactly 3 under "attn"; grads must match no-remat."""
+    import dataclasses
+
+    from odh_kubeflow_tpu.models import LlamaConfig, forward, init_params
+
+    cfg0 = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="flash")
+    params = init_params(jax.random.key(0), cfg=cfg0, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.key(1), (1, 128), 0, cfg0.vocab_size)
+
+    def loss_fn(cfg):
+        return lambda p: jnp.sum(forward(p, tokens, cfg) ** 2) / tokens.size
+
+    cfg_attn = dataclasses.replace(cfg0, remat=True, remat_policy="attn")
+    cfg_none = dataclasses.replace(cfg0, remat=True, remat_policy="none")
+
+    n_attn = str(jax.make_jaxpr(jax.grad(loss_fn(cfg_attn)))(params)).count(
+        "pallas_call"
+    )
+    n_none = str(jax.make_jaxpr(jax.grad(loss_fn(cfg_none)))(params)).count(
+        "pallas_call"
+    )
+    assert n_none == 4, n_none
+    assert n_attn == 3, n_attn
+
+    g_ref = jax.grad(loss_fn(cfg0))(params)
+    g_attn = jax.grad(loss_fn(cfg_attn))(params)
+    flat_r, _ = jax.tree_util.tree_flatten(g_ref)
+    flat_a, _ = jax.tree_util.tree_flatten(g_attn)
+    for r, a in zip(flat_r, flat_a):
+        assert jnp.allclose(r, a, atol=1e-5, rtol=1e-5), (
+            float(jnp.abs(r - a).max())
+        )
+
+
 def test_multiblock_non_causal_full_blocks():
     """Non-causal multi-block: every block is full (no mask at all);
     padding via ragged seq keeps one edge block alive too."""
