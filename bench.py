@@ -41,7 +41,14 @@ import time
 
 
 def _attention_op_compare(jax, jnp, seq: int = 4096):
-    """Dense vs flash attention step time at the 1B model's head shape."""
+    """Dense vs flash attention step time at the 1B model's head shape.
+
+    The op runs inside a ``lax.scan`` (8 iterations per dispatch) so the
+    relay backend's per-call dispatch latency — tens of ms, comparable
+    to the op itself — amortizes out; a bare timing loop here measures
+    the tunnel, not the kernel."""
+    from jax import lax
+
     from odh_kubeflow_tpu.ops.attention import dense_attention
     from odh_kubeflow_tpu.ops.pallas_attention import flash_attention
 
@@ -50,19 +57,27 @@ def _attention_op_compare(jax, jnp, seq: int = 4096):
     q = jax.random.normal(key, (B, seq, Hq, hd), jnp.bfloat16)
     k = jax.random.normal(key, (B, seq, Hkv, hd), jnp.bfloat16)
     v = jax.random.normal(key, (B, seq, Hkv, hd), jnp.bfloat16)
+    N = 8
     out = {}
     for name, fn in (
         ("dense", lambda q, k, v: dense_attention(q, k, v, causal=True)),
         ("flash", lambda q, k, v: flash_attention(q, k, v, causal=True)),
     ):
-        jf = jax.jit(fn)
+        def scanned(q, k, v, fn=fn):
+            def body(c, _):
+                o = fn(c, k, v)
+                return o * 1e-3 + c * 0.999, None
+            return lax.scan(body, q, None, length=N)[0]
+
+        jf = jax.jit(scanned)
         float(jf(q, k, v).sum())  # compile + warm (host transfer = sync)
-        t0 = time.time()
-        r = None
-        for _ in range(5):
-            r = jf(q, k, v)
-        float(r.sum())
-        out[name] = round((time.time() - t0) / 5 * 1e3, 2)
+        best = None
+        for _ in range(2):
+            t0 = time.time()
+            float(jf(q, k, v).sum())
+            dt = (time.time() - t0) / N
+            best = dt if best is None else min(best, dt)
+        out[name] = round(best * 1e3, 2)
     return out
 
 
@@ -140,7 +155,7 @@ def main() -> None:
     want_8b = is_tpu and os.environ.get("BENCH_HEADLINE", "8b") != "1b"
     if want_8b:
         try:
-            cfg8 = LlamaConfig.llama3_8b(dtype=jnp.bfloat16, remat_policy="none")
+            cfg8 = LlamaConfig.llama3_8b(dtype=jnp.bfloat16, remat_policy="attn")
             t8 = Trainer(
                 cfg8,
                 TrainConfig(warmup_steps=2, total_steps=100),
@@ -198,40 +213,82 @@ def main() -> None:
         # the hard regime: 16k context, attention-dominant. Needs all
         # three long-context levers at once: the pallas flash kernel
         # (dense logits at 16k OOM), chunked cross-entropy (full
-        # [S,V] logits are 8.4GB), and full remat (the "dots" policy's
-        # saved matmul outputs are ~13GB at this length).
+        # [S,V] logits are 8.4GB), and aggressive remat. Primary row:
+        # the north-star 8B model itself, QLoRA at 16k on one chip
+        # (full remat — the flash-residual "attn" policy's ~4GB of
+        # saved residuals doesn't fit next to the int8 base at this
+        # length). Secondary row: the 1B continuity config from
+        # rounds 1-2, now under the "attn" policy (backward never
+        # re-runs the flash forward).
         import dataclasses as _dc
 
         long_seq = int(os.environ.get("BENCH_LONG_SEQ", "16384"))
         del trainer  # free the headline trainer's param copy first
-        long_trainer = None
-        try:
-            long_trainer = Trainer(
-                _dc.replace(cfg, remat_policy="none"),
-                TrainConfig(warmup_steps=2, total_steps=100),
-                lora_cfg=LoraConfig(rank=16),
-                mesh=mesh,
-            )
-            long_stats = long_trainer.benchmark(
-                max(1, n), long_seq, steps=3, warmup=1
-            )
-            long_detail = {
+
+        def _long_row(trainer_, batch_):
+            st = trainer_.benchmark(batch_, long_seq, steps=3, warmup=1)
+            row = {
                 "seq": long_seq,
-                "batch": max(1, n),
+                "batch": batch_,
                 "attention_impl": impl,
-                "step_time_s": round(long_stats["step_time_s"], 4),
-                "tokens_per_s": round(long_stats["tokens_per_s"], 1),
+                "step_time_s": round(st["step_time_s"], 4),
+                "tokens_per_s": round(st["tokens_per_s"], 1),
             }
             if peak > 0:
-                long_detail["mfu_strict"] = round(
-                    long_stats["flops_per_s"] / peak, 4
+                row["mfu_strict"] = round(st["flops_per_s"] / peak, 4)
+                row["mfu_train_equiv_3x"] = round(
+                    st["train_equiv_flops_per_s"] / peak, 4
                 )
-                long_detail["mfu_train_equiv_3x"] = round(
-                    long_stats["train_equiv_flops_per_s"] / peak, 4
+            return row
+
+        if want_8b:
+            t8l = None
+            try:
+                t8l = Trainer(
+                    LlamaConfig.llama3_8b(
+                        dtype=jnp.bfloat16, remat_policy="none"
+                    ),
+                    TrainConfig(warmup_steps=2, total_steps=100),
+                    lora_cfg=LoraConfig(rank=16),
+                    mesh=mesh,
+                    quantize_base=True,
                 )
-            detail["long_context"] = long_detail
-        except Exception as e:  # noqa: BLE001 — keep the headline alive
-            detail["long_context"] = {"error": str(e)[:200]}
+                detail["long_context"] = {
+                    "model": "llama3-8b-qlora-int8", **_long_row(t8l, max(1, n))
+                }
+            except Exception as e:  # noqa: BLE001 — keep the headline alive
+                detail["long_context"] = {"error": str(e)[:200]}
+            finally:
+                # free the ~8GB int8 base even when benchmark() raised,
+                # or every remaining row inherits the OOM
+                del t8l
+
+        long_trainer = None
+        if not over_budget():
+            try:
+                long_trainer = Trainer(
+                    _dc.replace(cfg, remat_policy="attn"),
+                    TrainConfig(warmup_steps=2, total_steps=100),
+                    lora_cfg=LoraConfig(rank=16),
+                    mesh=mesh,
+                )
+                row1b = {
+                    "model": "llama3.2-1b-lora",
+                    **_long_row(long_trainer, max(1, n)),
+                }
+                detail["long_context_1b"] = row1b
+                if "long_context" not in detail or (
+                    "error" in detail["long_context"]
+                ):
+                    # keep the 8B failure visible before falling back
+                    if "error" in detail.get("long_context", {}):
+                        detail["long_context_8b_error"] = detail[
+                            "long_context"
+                        ]["error"]
+                    detail["long_context"] = row1b
+            except Exception as e:  # noqa: BLE001 — keep the headline alive
+                detail.setdefault("long_context", {"error": str(e)[:200]})
+                detail["long_context_1b"] = {"error": str(e)[:200]}
         skipped = []
         if over_budget():
             skipped.append("attention_op_ms")

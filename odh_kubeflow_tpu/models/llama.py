@@ -378,10 +378,19 @@ def _make_layer_fn(cfg: LlamaConfig, attention_fn: Callable) -> Callable:
     layer_fn = partial(_decoder_layer, cfg, attention_fn)
     if cfg.remat:
         if cfg.remat_policy == "dots":
-            layer_fn = jax.checkpoint(
-                layer_fn,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            )
+            # dots_with_no_batch_dims does NOT cover pallas_call, so on
+            # the flash path the kernel's named residuals ride along —
+            # otherwise the O(S²) forward would re-run in the backward
+            # even under the "save matmuls" policy.
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            if resolved_attention_impl(cfg) == "flash":
+                policy = jax.checkpoint_policies.save_from_both_policies(
+                    policy,
+                    jax.checkpoint_policies.save_only_these_names(
+                        "flash_out", "flash_lse"
+                    ),
+                )
+            layer_fn = jax.checkpoint(layer_fn, policy=policy)
         elif cfg.remat_policy == "attn":
             # "flash_out"/"flash_lse" are the flash kernel's custom-vjp
             # residuals (ops/pallas_attention.py _flash_fwd): with them

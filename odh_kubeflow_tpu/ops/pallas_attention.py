@@ -36,6 +36,13 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
+# The softmax runs in base-2 end to end: log2(e) folds into the q
+# prescale (one [bq, hd] multiply), so the VPU evaluates raw exp2 on
+# the [bq, bk] score blocks instead of exp = exp2(x·log2e) — one fewer
+# full-block multiply per exponential, and the kernel is VPU-bound at
+# small head_dim. The saved logsumexp residual is likewise base-2
+# (lse2 = log2e·lse); it never leaves this file.
+_LOG2E = 1.4426950408889634
 
 
 def _ceil_to(x: int, m: int) -> int:
@@ -177,9 +184,9 @@ def _fwd_kernel(
         # Dots take the native (bf16) operands — the MXU runs bf16
         # inputs at full rate — and accumulate in f32 via
         # preferred_element_type. Softmax statistics stay f32.
-        # Scaling rides on the [bq, hd] q block (block_k/hd ≈ 16×
-        # cheaper than scaling the [bq, bk] score matrix).
-        q = q_ref[0, 0] * scale
+        # Scaling (incl. the base-2 fold) rides on the [bq, hd] q block
+        # (block_k/hd ≈ 16× cheaper than scaling the [bq, bk] scores).
+        q = q_ref[0, 0] * (scale * _LOG2E)
         k = k_ref[0, 0]
         v = v_ref[0, 0]
 
@@ -197,8 +204,8 @@ def _fwd_kernel(
 
         m_prev = m_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
+        p = jnp.exp2(s - m_new)
         if masked:
             # Re-mask after the exp: on a row with no live column yet,
             # m_new == _NEG_INF and exp(s - m_new) == 1 for masked
@@ -220,7 +227,7 @@ def _fwd_kernel(
         l = l_scr[...]
         l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows
         o_ref[0, 0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_scr[...] + jnp.log(l_safe)
+        lse_ref[0, 0] = m_scr[...] + jnp.log2(l_safe)
 
 
 def _fwd(
@@ -365,21 +372,21 @@ def _dq_kernel(
     run, full = _block_predicates(qi, ki, **geom)
 
     def body(masked: bool):
-        # s comes from the pre-scaled q; the outer `* scale` on ds is
-        # linear, so it moves to the finalize (one [bq, hd] multiply
-        # instead of a [bq, bk] one per K block).
-        q = q_ref[0, 0] * scale
+        # s comes from the pre-scaled q (base-2 fold included); the
+        # outer `* scale` on ds is linear, so it moves to the finalize
+        # (one [bq, hd] multiply instead of a [bq, bk] one per block).
+        q = q_ref[0, 0] * (scale * _LOG2E)
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0]
+        lse = lse_ref[0, 0]  # base-2 (see _LOG2E)
         delta = delta_ref[0, 0]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        p = jnp.exp(s - lse)
+        p = jnp.exp2(s - lse)
         if masked:
             p = jnp.where(
                 _block_mask(qi, ki, qseg_ref, kseg_ref, **geom), p, 0.0
@@ -449,14 +456,15 @@ def _dkv_kernel(
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
 
-        # s from pre-scaled q; dK's `* scale` is linear and moves to
-        # the finalize. The dk dot below contracts against the ORIGINAL
-        # q — its scale factor is exactly the deferred one.
+        # s from pre-scaled q (base-2 fold included); dK's `* scale` is
+        # linear and moves to the finalize. The dk dot below contracts
+        # against the ORIGINAL q — its scale factor is exactly the
+        # deferred one.
         s = jax.lax.dot_general(
-            q * scale, k, (((1,), (1,)), ((), ())),
+            q * (scale * _LOG2E), k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        p = jnp.exp(s - lse)  # [bq, bk]
+        p = jnp.exp2(s - lse)  # [bq, bk]; lse is base-2
         if masked:
             p = jnp.where(
                 _block_mask(qj, ki, qseg_ref, kseg_ref, **geom), p, 0.0
