@@ -211,7 +211,13 @@ def param_specs(cfg: LlamaConfig) -> Params:
     the scan, one slice per step.
     """
     specs: Params = {
-        "embed": P(AXIS_TENSOR, AXIS_FSDP),
+        # vocab-sharded (V over tensor+fsdp, D replicated): V ≫ D so the
+        # memory split is the same as a D-shard, but the token gather
+        # and its scatter-add transpose both accept batch-sharded
+        # activations — a D-over-fsdp table forces a batch→d reshard of
+        # the embedding cotangent that GSPMD can only do by full
+        # rematerialization (r2 multichip dryrun warnings).
+        "embed": P((AXIS_TENSOR, AXIS_FSDP), None),
         "layers": {
             "attn_norm": P(None, None),
             "wq": P(None, AXIS_FSDP, AXIS_TENSOR),
@@ -295,19 +301,9 @@ def _decoder_layer(
     q = apply_rope(q, sin, cos)
     kk = apply_rope(kk, sin, cos)
     if cache_layer is not None:
-        # append this step's K/V at cache_index, attend over the whole
-        # cache with absolute positions (q_offset masks the unwritten
-        # tail — positions > cache_index+S are never attended)
-        ck = jax.lax.dynamic_update_slice(
-            cache_layer["k"], kk.astype(cache_layer["k"].dtype), (0, cache_index, 0, 0)
+        attn, cache_layer = cache_write_and_attend(
+            q, kk, vv, cache_layer, cache_index, kv_mask
         )
-        cv = jax.lax.dynamic_update_slice(
-            cache_layer["v"], vv.astype(cache_layer["v"].dtype), (0, cache_index, 0, 0)
-        )
-        attn = dense_attention(
-            q, ck, cv, causal=True, q_offset=cache_index, kv_mask=kv_mask
-        )
-        cache_layer = {"k": ck, "v": cv}
     else:
         attn = attention_fn(q, kk, vv, segment_ids=segment_ids)
     # named so the "attn" remat policy can pin exactly this tensor
@@ -320,6 +316,51 @@ def _decoder_layer(
     up = _maybe_lora("w_up", h, layer["w_up"], lora_layer)
     x = x + _maybe_lora("w_down", jax.nn.silu(gate) * up, layer["w_down"], lora_layer)
     return x, cache_layer
+
+
+def cache_write_and_attend(
+    q,  # [B, S, Hq, hd]
+    kk,  # [B, S, Hkv, hd] this step's keys
+    vv,
+    cache_layer,  # {"k","v"}: [B, S_max, Hkv, hd]
+    cache_index,  # scalar int32, or [B] int32 (per-row offsets)
+    kv_mask,  # [B, S_max] bool or None
+):
+    """Append this step's K/V at ``cache_index`` and attend over the
+    whole cache with absolute positions (``kv_mask``/``q_offset`` mask
+    the unwritten tail). Shared by the dense and MoE cached layers.
+
+    A scalar ``cache_index`` is the classic generate() layout: every
+    row writes at the same physical offset (ragged prompts pad to a
+    shared index). A **[B] vector** is the continuous-batching engine's
+    layout (``models/engine.py``): each batch slot sits at its own
+    depth, so writes scatter per-row — S must be 1 on that path.
+    """
+    if getattr(cache_index, "ndim", 0) == 1:
+        B = q.shape[0]
+        assert q.shape[1] == 1, "vector cache_index requires S == 1"
+        rows = jnp.arange(B)
+        ck = cache_layer["k"].at[rows, cache_index].set(
+            kk[:, 0].astype(cache_layer["k"].dtype)
+        )
+        cv = cache_layer["v"].at[rows, cache_index].set(
+            vv[:, 0].astype(cache_layer["v"].dtype)
+        )
+    else:
+        ck = jax.lax.dynamic_update_slice(
+            cache_layer["k"],
+            kk.astype(cache_layer["k"].dtype),
+            (0, cache_index, 0, 0),
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cache_layer["v"],
+            vv.astype(cache_layer["v"].dtype),
+            (0, cache_index, 0, 0),
+        )
+    attn = dense_attention(
+        q, ck, cv, causal=True, q_offset=cache_index, kv_mask=kv_mask
+    )
+    return attn, {"k": ck, "v": cv}
 
 
 def resolved_attention_impl(cfg: LlamaConfig) -> str:

@@ -40,6 +40,7 @@ from odh_kubeflow_tpu.models.llama import LlamaConfig
 from odh_kubeflow_tpu.ops.norms import rms_norm
 from odh_kubeflow_tpu.ops.rope import rope_angles
 from odh_kubeflow_tpu.parallel.mesh import (
+    AXIS_DATA,
     AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_PIPE,
@@ -215,18 +216,31 @@ def moe_mlp(
         "bsd,de->bse", x, layer["router"].astype(dtype),
         preferred_element_type=jnp.float32,
     )
+    router_logits = constrain(
+        router_logits, P((AXIS_DATA, AXIS_FSDP, AXIS_EXPERT), None, None)
+    )
     dispatch, combine, aux = route_tokens(router_logits, cfg)
 
     # token→expert all-to-all: contraction against expert-sharded
-    # operands; GSPMD inserts the collective
+    # operands; GSPMD inserts the collective. Inside the expert block
+    # the batch dim keeps its data×fsdp parallelism (e over expert, b
+    # over data+fsdp) — all devices stay busy in the expert MLPs — and
+    # BOTH ends are pinned (xin and out_e/out): an unconstrained
+    # boundary lets the partitioner invent d-split operand shardings
+    # for the dispatch/combine transposes, which it can only realise
+    # by full rematerialization ("[SPMD] Involuntary full
+    # rematerialization" in the r2 multichip dryrun).
+    expert_spec = P(AXIS_EXPERT, (AXIS_DATA, AXIS_FSDP), None, None)
     xin = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(dtype), x)
-    xin = constrain(xin, P(AXIS_EXPERT, (AXIS_FSDP,), None, None))
+    xin = constrain(xin, expert_spec)
     gate = jnp.einsum("ebcd,edf->ebcf", xin, layer["moe_gate"].astype(dtype))
     up = jnp.einsum("ebcd,edf->ebcf", xin, layer["moe_up"].astype(dtype))
     h = jax.nn.silu(gate) * up
     out_e = jnp.einsum("ebcf,efd->ebcd", h, layer["moe_down"].astype(dtype))
+    out_e = constrain(out_e, expert_spec)
     # expert→token all-to-all back
     out = jnp.einsum("bsec,ebcd->bsd", combine.astype(dtype), out_e)
+    out = constrain(out, llama._activation_spec())
     return out, aux
 
 
@@ -309,23 +323,14 @@ def forward_with_cache(
         )
         q = llama.apply_rope(q, sin, cos)
         k = llama.apply_rope(k, sin, cos)
-        ck = jax.lax.dynamic_update_slice(
-            cache_layer["k"], k.astype(cache_layer["k"].dtype),
-            (0, cache_index, 0, 0),
+        attn, new_cache_layer = llama.cache_write_and_attend(
+            q, k, v, cache_layer, cache_index, kv_mask
         )
-        cv = jax.lax.dynamic_update_slice(
-            cache_layer["v"], v.astype(cache_layer["v"].dtype),
-            (0, cache_index, 0, 0),
-        )
-        from odh_kubeflow_tpu.ops.attention import dense_attention
-
-        attn = dense_attention(
-            q, ck, cv, causal=True, q_offset=cache_index, kv_mask=kv_mask
-        ).reshape(B, S, b.q_dim)
+        attn = attn.reshape(B, S, b.q_dim)
         x = x + llama._maybe_lora("wo", attn, layer["wo"], lora_layer)
         h = rms_norm(x, layer["mlp_norm"], b.rms_norm_eps)
         moe_out, _aux = moe_mlp(h, layer, cfg)
-        return x + moe_out, {"k": ck, "v": cv}
+        return x + moe_out, new_cache_layer
 
     x, new_cache = jax.lax.scan(
         body, x, (params["layers"], lora_layers, cache)

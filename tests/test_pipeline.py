@@ -234,3 +234,67 @@ def test_pipelined_bf16_transit(devices8):
         losses[name] = float(trainer.train_step(batch)["loss"])
     assert np.isfinite(losses["piped"])
     assert abs(losses["piped"] - losses["flat"]) < 0.05, losses
+
+
+def test_sharded_steps_compile_without_involuntary_remat(devices8, capfd):
+    """VERDICT r2 item 2: the pipelined train step (and the MoE
+    expert-parallel step, whose r2 dryrun carried the same warnings)
+    must compile with ZERO "[SPMD] Involuntary full rematerialization"
+    partitioner warnings — each one is a replicate-then-slice of a full
+    tensor every step on real multi-chip hardware. The partitioner
+    logs to fd 2 from C++, so capfd (not capsys) observes it."""
+    import optax
+    from jax.sharding import NamedSharding
+
+    from odh_kubeflow_tpu.models import LlamaConfig, LoraConfig
+    from odh_kubeflow_tpu.models import moe as moe_lib
+    from odh_kubeflow_tpu.train import TrainConfig, Trainer
+
+    # pipelined dense trainer step
+    trainer = Trainer(
+        LlamaConfig.tiny(dtype=jnp.bfloat16),
+        TrainConfig(warmup_steps=1, total_steps=4, pipeline_microbatches=2),
+        lora_cfg=LoraConfig(rank=2),
+        mesh=build_mesh(MeshConfig(pipe=2, data=4), devices8),
+    )
+    trainer.train_step(trainer.make_fake_batch(8, 16, seed=7))
+
+    # MoE expert-parallel step with the optimizer fused (grads pinned
+    # to param shardings — the combination that surfaced the warnings)
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, expert=2), devices8)
+    cfg = moe_lib.MoeConfig.mixtral_tiny()
+    specs = moe_lib.param_specs(cfg)
+    with jax.set_mesh(mesh):
+        params = jax.jit(
+            lambda k: moe_lib.init_params(k, cfg),
+            out_shardings=jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s),
+                specs,
+                is_leaf=lambda s: isinstance(s, P),
+            ),
+        )(jax.random.key(1))
+        opt = optax.adam(1e-2)
+        opt_state = opt.init(params)
+        tokens = jnp.ones((8, 16), jnp.int32)
+
+        def loss_fn(p):
+            logits, aux = moe_lib.forward(p, tokens, cfg)
+            targets = jnp.roll(tokens, -1, axis=1)
+            nll = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            ).mean()
+            return nll + aux
+
+        @jax.jit
+        def step(p, s):
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            updates, s = opt.update(grads, s)
+            return optax.apply_updates(p, updates), s, loss
+
+        _, _, loss = step(params, opt_state)
+        assert float(loss) == float(loss)
+
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err, (
+        err[err.find("Involuntary") - 500:err.find("Involuntary") + 500]
+    )
