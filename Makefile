@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint loadtest images bench dryrun platform serve spawn-latency native
+.PHONY: all test test-unit test-manifests lint loadtest images bench dryrun platform serve spawn-latency native kind-smoke
 
 all: lint test
 
@@ -49,6 +49,17 @@ platform:
 # completion server in demo mode on the attached accelerator
 serve:
 	$(PYTHON) -m odh_kubeflow_tpu.models.serve --config llama3_1b --int8
+
+# real-cluster smoke: build the platform container, load into KinD,
+# apply manifests, require Notebook -> StatefulSet (needs docker+kind;
+# CI runs the same flow in nb_controller_kind_test.yaml)
+kind-smoke:
+	kind create cluster --name kubeflow-tpu || true
+	docker build -t odh-kubeflow-tpu/platform:latest -f images/platform/Dockerfile .
+	kind load docker-image odh-kubeflow-tpu/platform:latest --name kubeflow-tpu
+	kubectl create namespace kubeflow --dry-run=client -o yaml | kubectl apply -f -
+	kubectl apply -f manifests/crds/ -f manifests/cluster-roles/ -f manifests/notebook-controller/
+	kubectl -n kubeflow rollout status deployment/notebook-controller --timeout=180s
 
 # multi-chip sharding compile check on a virtual 8-device CPU mesh
 dryrun:

@@ -14,7 +14,9 @@ process (or via the AdmissionReview webhook deployment).
 from __future__ import annotations
 
 import json
+import os
 import socket
+import ssl
 import threading
 import time
 import urllib.error
@@ -33,6 +35,7 @@ from odh_kubeflow_tpu.machinery.store import (
     Invalid,
     NotFound,
     TypeInfo,
+    Unauthorized,
     Watch,
 )
 
@@ -40,6 +43,7 @@ Obj = dict[str, Any]
 
 _ERR_BY_CODE = {
     400: BadRequest,
+    401: Unauthorized,
     404: NotFound,
     409: Conflict,
     422: Invalid,
@@ -48,16 +52,47 @@ _ERR_BY_CODE = {
 _EVENT_INDEX_MAX = 4096
 
 
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
 class RemoteAPIServer:
+    """Credential model mirrors client-go's rest.Config (the reference
+    builds it with ``ctrl.GetConfigOrDie()`` +
+    ``--kube-api-qps/--kube-api-burst``,
+    ``/root/reference/components/notebook-controller/main.go:61-81``):
+    bearer token (inline or file — file is re-read on mtime change,
+    because bound serviceaccount tokens rotate), a custom CA bundle for
+    the apiserver's certificate, and optional mTLS client certs.
+    """
+
     def __init__(
         self,
         base_url: str = "http://127.0.0.1:8001",
         timeout: float = 30.0,
         qps: Optional[float] = None,
         burst: int = 10,
+        token: Optional[str] = None,
+        token_file: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        client_cert_file: Optional[str] = None,
+        client_key_file: Optional[str] = None,
+        insecure_skip_tls_verify: bool = False,
     ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self._token = token
+        self._token_file = token_file
+        self._token_file_mtime: Optional[float] = None
+        self._token_cached: Optional[str] = None
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        if self.base_url.startswith("https"):
+            if insecure_skip_tls_verify:
+                ctx = ssl._create_unverified_context()  # noqa: S323 — explicit opt-in, client-go's Insecure flag
+            else:
+                ctx = ssl.create_default_context(cafile=ca_file)
+            if client_cert_file:
+                ctx.load_cert_chain(client_cert_file, client_key_file)
+            self._ssl_ctx = ctx
         # client-side rate limit (reference flag parity: --kube-api-qps /
         # --kube-api-burst, notebook-controller/main.go:56-70). Token
         # bucket: ``burst`` instant requests, refilled at ``qps``/s.
@@ -145,6 +180,32 @@ class RemoteAPIServer:
             self._tokens = 0.0
         time.sleep(wait)
 
+    def _bearer_token(self) -> Optional[str]:
+        """Inline token, or the token file's contents cached by mtime
+        (kube rotates bound tokens ~hourly; client-go re-reads the
+        file, so we do too)."""
+        if self._token is not None:
+            return self._token
+        if not self._token_file:
+            return None
+        with self._lock:
+            try:
+                mtime = os.stat(self._token_file).st_mtime
+            except OSError:
+                return None
+            if mtime != self._token_file_mtime:
+                with open(self._token_file) as f:
+                    self._token_cached = f.read().strip()
+                self._token_file_mtime = mtime
+            return self._token_cached
+
+    def _headers(self) -> dict[str, str]:
+        headers = {"Content-Type": "application/json"}
+        tok = self._bearer_token()
+        if tok:
+            headers["Authorization"] = f"Bearer {tok}"
+        return headers
+
     def _request(
         self, method: str, path: str, body: Optional[Obj] = None, query: str = ""
     ) -> Obj:
@@ -152,11 +213,12 @@ class RemoteAPIServer:
         url = self.base_url + path + (f"?{query}" if query else "")
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
-            url, data=data, method=method,
-            headers={"Content-Type": "application/json"},
+            url, data=data, method=method, headers=self._headers(),
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=self._ssl_ctx
+            ) as r:
                 return json.loads(r.read().decode() or "{}")
         except urllib.error.HTTPError as e:
             message, reason = str(e), ""
@@ -174,6 +236,7 @@ class RemoteAPIServer:
                 "NotFound": NotFound,
                 "Invalid": Invalid,
                 "Denied": Denied,
+                "Unauthorized": Unauthorized,
             }.get(reason) or _ERR_BY_CODE.get(e.code, APIError)
             raise klass(message) from None
 
@@ -267,7 +330,10 @@ class RemoteAPIServer:
             try:
                 # no read timeout: heartbeats arrive every 15s; a dead
                 # server surfaces as a connection error ending the pump
-                resp = urllib.request.urlopen(url)  # noqa: S310
+                resp = urllib.request.urlopen(  # noqa: S310
+                    urllib.request.Request(url, headers=self._headers()),
+                    context=self._ssl_ctx,
+                )
                 w._resp = resp
                 for line in resp:
                     if w._stopped:
@@ -410,18 +476,65 @@ def _selector_to_string(selector: Obj) -> str:
     return ",".join(parts)
 
 
+def in_cluster_config() -> Optional[dict[str, Any]]:
+    """client-go's ``rest.InClusterConfig()``: when the pod has the
+    kubernetes service env and a mounted serviceaccount, return the
+    https URL + rotating token file + apiserver CA. ``KUBE_SA_DIR``
+    overrides the mount path (tests; the well-known default otherwise).
+    Returns None outside a cluster."""
+    host = os.environ.get("KUBERNETES_SERVICE_HOST")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    sa_dir = os.environ.get("KUBE_SA_DIR", _SA_DIR)
+    token_file = os.path.join(sa_dir, "token")
+    ca_file = os.path.join(sa_dir, "ca.crt")
+    if not host or not os.path.exists(token_file):
+        return None
+    if ":" in host:  # IPv6 literal (client-go: net.JoinHostPort)
+        host = f"[{host}]"
+    cfg: dict[str, Any] = {
+        "base_url": f"https://{host}:{port}",
+        "token_file": token_file,
+    }
+    if os.path.exists(ca_file):
+        cfg["ca_file"] = ca_file
+    return cfg
+
+
 def api_from_env() -> RemoteAPIServer:
     """Client for split-process components (`python -m odh_kubeflow_tpu.
-    controllers.notebook` etc.): connects to $KUBE_API_URL and registers
-    the platform CRD kinds for path mapping."""
-    import os
+    controllers.notebook` etc.), the ``ctrl.GetConfigOrDie()`` ladder
+    (`/root/reference/components/notebook-controller/main.go:61-81`):
 
+    1. ``$KUBE_API_URL`` explicit endpoint (+ optional
+       ``KUBE_API_TOKEN`` / ``KUBE_API_TOKEN_FILE`` / ``KUBE_API_CA_FILE``
+       / ``KUBE_API_INSECURE_SKIP_TLS_VERIFY``);
+    2. in-cluster config (kubernetes service env + serviceaccount mount);
+    3. localhost:8001 (`kubectl proxy` posture) for dev.
+
+    Registers the platform CRD kinds for path mapping either way."""
     qps_env = os.environ.get("KUBE_API_QPS", "")
-    api = RemoteAPIServer(
-        os.environ.get("KUBE_API_URL", "http://127.0.0.1:8001"),
+    common: dict[str, Any] = dict(
         qps=float(qps_env) if qps_env else None,
         burst=int(os.environ.get("KUBE_API_BURST", "10")),
     )
+    url = os.environ.get("KUBE_API_URL")
+    if url:
+        api = RemoteAPIServer(
+            url,
+            token=os.environ.get("KUBE_API_TOKEN") or None,
+            token_file=os.environ.get("KUBE_API_TOKEN_FILE") or None,
+            ca_file=os.environ.get("KUBE_API_CA_FILE") or None,
+            insecure_skip_tls_verify=os.environ.get(
+                "KUBE_API_INSECURE_SKIP_TLS_VERIFY", ""
+            ).lower() in ("1", "true"),
+            **common,
+        )
+    else:
+        cluster = in_cluster_config()
+        if cluster is not None:
+            api = RemoteAPIServer(**cluster, **common)
+        else:
+            api = RemoteAPIServer("http://127.0.0.1:8001", **common)
     from odh_kubeflow_tpu.apis import register_crds
 
     register_crds(api)  # admission registration is a client-side no-op

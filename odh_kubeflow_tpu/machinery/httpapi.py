@@ -103,11 +103,47 @@ def _parse_path(path: str) -> Optional[_Route]:
     return _Route(plural, ns, name, sub)
 
 
+class TokenAuthenticator:
+    """Static bearer-token authn, kube's ``--token-auth-file`` model.
+
+    ``tokens`` maps token → username. ``from_file`` reads the upstream
+    CSV format (``token,user,uid[,"group1,group2"]``; kube-apiserver
+    docs "static token file") so a test or standalone deployment can
+    mint credentials the same way. Returns the username for a valid
+    ``Authorization: Bearer`` header, else None (→ 401 at the façade).
+    """
+
+    def __init__(self, tokens: dict[str, str]):
+        self._tokens = dict(tokens)
+
+    @classmethod
+    def from_file(cls, path: str) -> "TokenAuthenticator":
+        import csv
+
+        tokens: dict[str, str] = {}
+        with open(path, newline="") as f:
+            for row in csv.reader(f):
+                if len(row) >= 2 and row[0].strip():
+                    tokens[row[0].strip()] = row[1].strip()
+        return cls(tokens)
+
+    def __call__(self, environ) -> Optional[str]:
+        auth = environ.get("HTTP_AUTHORIZATION", "")
+        if not auth.startswith("Bearer "):
+            return None
+        return self._tokens.get(auth[len("Bearer "):].strip())
+
+
 class RestAPI:
     """WSGI app. Thread-safe (the store locks internally)."""
 
-    def __init__(self, server: APIServer):
+    def __init__(
+        self,
+        server: APIServer,
+        authenticator: Optional[Any] = None,  # environ -> username | None
+    ):
         self.server = server
+        self.authenticator = authenticator
 
     # -- helpers ------------------------------------------------------------
 
@@ -170,8 +206,32 @@ class RestAPI:
         qs = parse_qs(environ.get("QUERY_STRING", ""))
 
         if path in ("/healthz", "/readyz", "/livez"):
+            # health probes stay anonymous (kube's
+            # --anonymous-auth allows exactly these by default)
             start_response("200 OK", [("Content-Type", "text/plain")])
             return [b"ok"]
+        if self.authenticator is not None:
+            user = self.authenticator(environ)
+            if user is None:
+                start_response(
+                    "401 Unauthorized",
+                    [
+                        ("Content-Type", "application/json"),
+                        ("WWW-Authenticate", "Bearer"),
+                    ],
+                )
+                return [
+                    json.dumps(
+                        {
+                            "kind": "Status",
+                            "status": "Failure",
+                            "message": "Unauthorized",
+                            "reason": "Unauthorized",
+                            "code": 401,
+                        }
+                    ).encode()
+                ]
+            environ["odh.authenticated.user"] = user
         if path == "/version":
             return self._json(
                 200, {"gitVersion": "odh-kubeflow-tpu", "major": "1"}, start_response
@@ -320,14 +380,25 @@ class _QuietHandler(WSGIRequestHandler):
 
 
 def serve(
-    server: APIServer, host: str = "127.0.0.1", port: int = 0
+    server: APIServer,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    ssl_context: Optional[Any] = None,
+    authenticator: Optional[Any] = None,
 ) -> tuple[threading.Thread, int, Any]:
     """Serve the REST façade on a daemon thread; returns (thread,
-    bound_port, httpd). ``httpd.shutdown()`` stops it."""
-    app = RestAPI(server)
+    bound_port, httpd). ``httpd.shutdown()`` stops it.
+
+    ``ssl_context`` (an ``ssl.SSLContext``) serves HTTPS — the posture
+    a real kube-apiserver always has; ``authenticator`` (see
+    ``TokenAuthenticator``) turns on bearer authn, rejecting anonymous
+    requests with 401 except on health probes."""
+    app = RestAPI(server, authenticator=authenticator)
     httpd = make_server(
         host, port, app, server_class=_ThreadingServer, handler_class=_QuietHandler
     )
+    if ssl_context is not None:
+        httpd.socket = ssl_context.wrap_socket(httpd.socket, server_side=True)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
     return t, httpd.server_address[1], httpd

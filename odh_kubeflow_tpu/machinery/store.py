@@ -66,6 +66,13 @@ class Denied(APIError):
     code = 403
 
 
+class Unauthorized(APIError):
+    """Missing/invalid credentials (kube's authn 401 — distinct from
+    the authz 403)."""
+
+    code = 401
+
+
 @dataclass
 class TypeInfo:
     api_version: str

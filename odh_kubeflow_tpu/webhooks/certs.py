@@ -22,6 +22,7 @@ from __future__ import annotations
 import base64
 import dataclasses
 import datetime
+import ipaddress
 import os
 from typing import Any, Optional
 
@@ -59,8 +60,13 @@ class CertBundle:
 
 
 def generate_webhook_certs(
-    dns_names: Optional[list[str]] = None, valid_days: int = 825
+    dns_names: Optional[list[str]] = None,
+    valid_days: int = 825,
+    ip_sans: Optional[list[str]] = None,
 ) -> CertBundle:
+    """``ip_sans``: IP-address SANs (kube-apiserver serving certs carry
+    the service cluster IP this way; clients that dial
+    ``https://<ip>`` verify against them)."""
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import ec
@@ -119,7 +125,13 @@ def generate_webhook_certs(
         .not_valid_after(not_after)
         .add_extension(x509.BasicConstraints(ca=False, path_length=None), critical=True)
         .add_extension(
-            x509.SubjectAlternativeName([x509.DNSName(n) for n in dns_names]),
+            x509.SubjectAlternativeName(
+                [x509.DNSName(n) for n in dns_names]
+                + [
+                    x509.IPAddress(ipaddress.ip_address(ip))
+                    for ip in (ip_sans or [])
+                ]
+            ),
             critical=False,
         )
         .add_extension(

@@ -1,0 +1,186 @@
+"""Real-cluster credentials for the remote client (VERDICT r2 item 3).
+
+The reference's controllers authenticate to kube-apiserver via
+``ctrl.GetConfigOrDie()`` — bearer token, apiserver CA, in-cluster
+discovery (`/root/reference/components/notebook-controller/main.go:61-81`).
+These tests serve the embedded apiserver's REST façade over **HTTPS with
+bearer authn** (certs from ``webhooks.certs``, kube's static-token-file
+format) and prove:
+
+- a full notebook reconcile loop (watches included) through the
+  authenticated TLS client;
+- anonymous and wrong-token requests get 401 (health stays open);
+- the token file is re-read on rotation (bound SA tokens rotate);
+- ``api_from_env`` discovers in-cluster config (service env + mounted
+  serviceaccount dir) and connects with it.
+"""
+
+import os
+import ssl
+import time
+import urllib.request
+
+import pytest
+
+from odh_kubeflow_tpu.apis import register_crds
+from odh_kubeflow_tpu.controllers.notebook import (
+    NotebookController,
+    NotebookControllerConfig,
+)
+from odh_kubeflow_tpu.controllers.runtime import Manager
+from odh_kubeflow_tpu.machinery import httpapi
+from odh_kubeflow_tpu.machinery.client import RemoteAPIServer, api_from_env
+from odh_kubeflow_tpu.machinery.store import APIServer, NotFound, Unauthorized
+from odh_kubeflow_tpu.webhooks.certs import generate_webhook_certs
+
+TOKEN = "sa-token-abc123"
+ROTATED = "sa-token-rotated456"
+
+
+@pytest.fixture(scope="module")
+def tls_materials(tmp_path_factory):
+    d = tmp_path_factory.mktemp("apiserver-tls")
+    bundle = generate_webhook_certs(
+        dns_names=["localhost"], ip_sans=["127.0.0.1"]
+    )
+    cert_path, key_path, ca_path = bundle.write(str(d))
+    token_auth_file = d / "tokens.csv"
+    token_auth_file.write_text(
+        f"{TOKEN},system:serviceaccount:kubeflow:notebook-controller,uid1\n"
+        f'{ROTATED},system:serviceaccount:kubeflow:notebook-controller,uid1,"system:masters"\n'
+    )
+    return {
+        "cert": cert_path,
+        "key": key_path,
+        "ca": ca_path,
+        "token_auth_file": str(token_auth_file),
+        "dir": d,
+    }
+
+
+@pytest.fixture()
+def tls_served(tls_materials):
+    server = APIServer()
+    register_crds(server)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(tls_materials["cert"], tls_materials["key"])
+    authenticator = httpapi.TokenAuthenticator.from_file(
+        tls_materials["token_auth_file"]
+    )
+    _, port, httpd = httpapi.serve(
+        server, ssl_context=ctx, authenticator=authenticator
+    )
+    yield server, port
+    httpd.shutdown()
+
+
+def _client(tls_materials, port, **kw) -> RemoteAPIServer:
+    kw.setdefault("ca_file", tls_materials["ca"])
+    c = RemoteAPIServer(f"https://127.0.0.1:{port}", **kw)
+    register_crds(c)
+    return c
+
+
+def _notebook(name="nb1", ns="team-a"):
+    return {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "template": {
+                "spec": {"containers": [{"name": name, "image": "jupyter:x"}]}
+            }
+        },
+    }
+
+
+def test_anonymous_and_bad_token_rejected(tls_materials, tls_served):
+    _, port = tls_served
+    anon = _client(tls_materials, port)
+    with pytest.raises(Unauthorized):
+        anon.list("Notebook", namespace="team-a")
+    bad = _client(tls_materials, port, token="wrong-token")
+    with pytest.raises(Unauthorized):
+        bad.get("Notebook", "nb1", "team-a")
+
+
+def test_health_probes_stay_anonymous(tls_materials, tls_served):
+    _, port = tls_served
+    ctx = ssl.create_default_context(cafile=tls_materials["ca"])
+    with urllib.request.urlopen(
+        f"https://127.0.0.1:{port}/healthz", context=ctx
+    ) as r:
+        assert r.read() == b"ok"
+
+
+def test_remote_reconcile_over_tls_with_token(tls_materials, tls_served):
+    """The full split-process posture: controller attaches over HTTPS
+    with a bearer token; Notebook → StatefulSet+Service materialise.
+    The Manager's watch streams carry the same credentials."""
+    _, port = tls_served
+    client = _client(tls_materials, port, token=TOKEN)
+    mgr = Manager(client)
+    NotebookController(client, NotebookControllerConfig()).register(mgr)
+    mgr.start()
+    try:
+        client.create(_notebook("secure-nb"))
+        deadline = time.time() + 10
+        sts = None
+        while time.time() < deadline:
+            try:
+                sts = client.get("StatefulSet", "secure-nb", "team-a")
+                break
+            except NotFound:
+                time.sleep(0.1)
+        assert sts is not None, "controller never created the StatefulSet"
+        svc = client.get("Service", "secure-nb", "team-a")
+        assert svc["spec"]["ports"][0]["port"] == 80
+    finally:
+        mgr.stop()
+
+
+def test_token_file_rotation(tls_materials, tls_served, tmp_path):
+    """Bound serviceaccount tokens rotate on disk; the client re-reads
+    the file on mtime change instead of pinning the boot token."""
+    _, port = tls_served
+    token_file = tmp_path / "token"
+    token_file.write_text(TOKEN)
+    client = _client(tls_materials, port, token_file=str(token_file))
+    client.create(_notebook("rotate-nb"))
+
+    token_file.write_text("no-longer-valid")
+    os.utime(token_file, (time.time() + 2, time.time() + 2))
+    with pytest.raises(Unauthorized):
+        client.get("Notebook", "rotate-nb", "team-a")
+
+    token_file.write_text(ROTATED)
+    os.utime(token_file, (time.time() + 4, time.time() + 4))
+    got = client.get("Notebook", "rotate-nb", "team-a")
+    assert got["metadata"]["name"] == "rotate-nb"
+
+
+def test_api_from_env_in_cluster_discovery(
+    tls_materials, tls_served, tmp_path, monkeypatch
+):
+    """`api_from_env` finds the kubernetes service env + mounted
+    serviceaccount (KUBE_SA_DIR override) and returns a working
+    authenticated TLS client — the in-cluster path the manifests
+    deploy."""
+    _, port = tls_served
+    sa = tmp_path / "serviceaccount"
+    sa.mkdir()
+    (sa / "token").write_text(TOKEN)
+    (sa / "ca.crt").write_bytes(open(tls_materials["ca"], "rb").read())
+    (sa / "namespace").write_text("kubeflow")
+    monkeypatch.delenv("KUBE_API_URL", raising=False)
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "127.0.0.1")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", str(port))
+    monkeypatch.setenv("KUBE_SA_DIR", str(sa))
+    monkeypatch.setenv("KUBE_API_QPS", "50")
+
+    api = api_from_env()
+    assert api.base_url == f"https://127.0.0.1:{port}"
+    api.create(_notebook("incluster-nb"))
+    assert api.get("Notebook", "incluster-nb", "team-a")["metadata"]["name"] == (
+        "incluster-nb"
+    )
