@@ -69,6 +69,36 @@ def test_start_script_serves_culler_probe_prefix():
     assert "--port=8888" in text
 
 
+def test_pytorch_xla_image_contract():
+    """The second framework family (reference:
+    example-notebook-servers/jupyter-pytorch/cuda.Dockerfile:1-14, CUDA
+    wheels → torch_xla[tpu] wheels): PJRT runtime env, the tpu wheel,
+    and a build-time smoke gate so the Dockerfile can't silently ship
+    a broken runtime."""
+    text = (IMAGES / "jupyter-pytorch-xla" / "Dockerfile").read_text()
+    assert "torch_xla[tpu]" in text
+    assert "PJRT_DEVICE=TPU" in text
+    assert "torch-xla-smoke" in text
+    # the smoke gate runs at image build (RUN ... torch-xla-smoke)
+    assert "PJRT_DEVICE=CPU python3 /usr/local/bin/torch-xla-smoke" in text
+
+
+def test_pytorch_xla_smoke_script_runs():
+    """Execute the in-image smoke: exit 0 with a verified XLA matmul
+    where torch_xla exists; exit 3 (documented not-installed path) in
+    this offline env, never a crash. CI's images_build.yaml runs the
+    same script inside the built image where only 0 passes."""
+    script = IMAGES / "jupyter-pytorch-xla" / "torch-xla-smoke"
+    out = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, timeout=120
+    )
+    assert out.returncode in (0, 3), out.stderr
+    if out.returncode == 0:
+        assert b"xla matmul ok" in out.stdout
+    else:
+        assert b"torch_xla not installed" in out.stderr
+
+
 def test_tpu_init_noop_without_hostnames(tmp_path):
     """Single-host path exits 0 without touching jax.distributed."""
     script = IMAGES / "jupyter-jax-tpu" / "tpu-init"
