@@ -53,10 +53,18 @@ def dense_attention(
 
     mask = None
     if causal:
-        q_pos = jnp.arange(Sq)[:, None] + q_offset
-        k_pos = jnp.arange(Sk)[None, :]
-        mask = q_pos >= k_pos  # [Sq, Sk]
-        mask = mask[None, None, None, :, :]
+        if getattr(q_offset, "ndim", 0) == 1:
+            # per-row offsets ([B] vector — the continuous-batching
+            # engine's slots each sit at their own position)
+            q_pos = q_offset[:, None, None] + jnp.arange(Sq)[None, :, None]
+            mask = (q_pos >= jnp.arange(Sk)[None, None, :])[
+                :, None, None, :, :
+            ]  # [B, 1, 1, Sq, Sk]
+        else:
+            q_pos = jnp.arange(Sq)[:, None] + q_offset
+            k_pos = jnp.arange(Sk)[None, :]
+            mask = q_pos >= k_pos  # [Sq, Sk]
+            mask = mask[None, None, None, :, :]
     if segment_ids is not None:
         # [B, Sq, Sk] → [B, 1, 1, Sq, Sk]
         seg = (

@@ -290,3 +290,54 @@ def test_compile_cache_bounded(service):
     # evicted shapes still serve (recompile on demand)
     out = svc.complete([[1, 2, 3]], max_tokens=2)
     assert len(out["completions"][0]) == 2
+
+
+def test_engine_mode_http_concurrent():
+    """engine_slots>0: concurrent HTTP requests join the continuous-
+    batching decode loop; greedy output matches the one-shot path and
+    the response is marked usage.engine."""
+    import threading
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    svc = CompletionService(
+        params, cfg, prompt_buckets=(8, 16), batch_buckets=(1, 2),
+        engine_slots=2, engine_max_len=64,
+    )
+    try:
+        want = CompletionService(
+            params, cfg, prompt_buckets=(8, 16), batch_buckets=(1, 2)
+        ).complete([[1, 2, 3, 4]], max_tokens=6)["completions"][0]
+
+        httpd = serve(svc, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+        results = {}
+
+        def post(name, prompt):
+            req = urllib.request.Request(
+                base + "/v1/completions",
+                data=json.dumps(
+                    {"prompt": prompt, "max_tokens": 6}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=120) as r:
+                results[name] = json.loads(r.read())
+
+        threads = [
+            threading.Thread(target=post, args=(i, [1, 2, 3, 4]))
+            for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert len(results) == 3
+        for out in results.values():
+            assert out["usage"]["engine"] is True
+            assert out["completions"][0] == want
+        httpd.shutdown()
+    finally:
+        if svc.engine is not None:
+            svc.engine.stop()
