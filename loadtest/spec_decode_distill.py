@@ -6,7 +6,13 @@ uncorrelated), so r2 could only report a cost model. This script
 closes the loop the way the verdict prescribed: **distill the 1B draft
 on the 8B target's own greedy outputs**, then measure single-stream
 tok/s with and without speculation — same jits as
-``loadtest/spec_decode_8b.py``, held-out prompts, no projections.
+``loadtest/spec_decode_8b.py``, real acceptance, no projections. Two
+prompts are measured and both reported: an **in-distribution** prompt
+the distillation saw (the headline — the "same training corpus"
+operating assumption of production spec decode) and a **held-out**
+prompt, where acceptance is necessarily ~0 because a random-weight
+target's continuation is a pure prompt-hash (see the comment at the
+measure call).
 
 Two phases, each sized to run inside one driver window; an npz chains
 them:
@@ -16,9 +22,10 @@ them:
 
 The distilled draft never leaves the device: checkpointing 7.5GiB of
 train state through the relay tunnel measurably takes longer than
-retraining it (~90s), so the measure phase trains, quantizes in place
-(donated), frees the optimizer state, and only then streams in the
-8GiB int8 target — peak residency ~9.5GiB of the chip's 16GiB.
+retraining it (~90s), so the measure phase trains, frees the optimizer
+state, quantizes (the bf16 tree and its int8 twin briefly coexist,
+~3.5GiB), and only then streams in the 8GiB int8 target — peak
+residency stays well inside the chip's 16GiB.
 """
 
 from __future__ import annotations
@@ -117,7 +124,14 @@ def _distill_draft(jax, jnp, log):
     for _ in range(TRAIN_STEPS):
         rows = rng.integers(0, data.shape[0], 8)
         tokens = jnp.asarray(data[rows], jnp.int32)
-        batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+        # mask the last position: its roll()-ed "target" is the row's
+        # wrapped-around first token, a systematically wrong objective
+        mask = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
+        batch = {
+            "tokens": tokens,
+            "targets": jnp.roll(tokens, -1, axis=1),
+            "loss_mask": mask,
+        }
         loss = float(trainer.train_step(batch)["loss"])
         if loss0 is None:
             loss0 = loss
@@ -126,9 +140,10 @@ def _distill_draft(jax, jnp, log):
     log["distill_loss_last"] = round(loss, 3)
     log["distill_s"] = round(time.time() - t0, 1)
     params = trainer.params
-    trainer.opt_state = trainer.params = None  # free 7.5GiB before the 8B
+    trainer.opt_state = trainer.params = None  # free the adam state
     del trainer
-    return draft_cfg, jax.jit(quantize_params, donate_argnums=0)(params)
+    # no donation: int8+scale outputs can't alias the bf16 buffers
+    return draft_cfg, jax.jit(quantize_params)(params)
 
 
 def phase_measure(k: int, tokens: int) -> None:
