@@ -1,0 +1,69 @@
+"""Mixtral-class 8×1B QLoRA on one v5e — the MoE single-chip headline.
+
+    python -m loadtest.moe_qlora_8x1b [--capacity-factor 1.25] [--batch 2]
+
+Strict-sparse MFU (k=2 of 8 experts credited; frozen matmuls credit
+2×, attention 3× — Trainer.benchmark). Round-3 numbers (ragged
+index-table dispatch + pinned flash/moe_out remat residuals,
+models/moe.py):
+
+    cf=1.25 (zero token drops):   0.329 strict-sparse MFU, 1.13 s/step
+    cf=1.0  (1.14% assignment drops at random routing — the
+             Switch-style trade): 0.376 strict-sparse MFU, 0.99 s/step
+
+r2 baseline was 0.297 (one-hot einsum dispatch, full remat).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--capacity-factor", type=float, default=1.25)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--seq", type=int, default=4096)
+    args = ap.parse_args()
+
+    from odh_kubeflow_tpu.models import LoraConfig
+    from odh_kubeflow_tpu.models.llama import LlamaConfig
+    from odh_kubeflow_tpu.models.moe import MoeConfig
+    from odh_kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from odh_kubeflow_tpu.train import TrainConfig, Trainer
+    from odh_kubeflow_tpu.utils.tpu import peak_flops_per_chip
+
+    devices = jax.devices()
+    peak = peak_flops_per_chip(devices[0])
+    mesh = build_mesh(MeshConfig(fsdp=len(devices)), devices)
+    cfg = MoeConfig.mixtral_8x1b(
+        base=LlamaConfig.llama3_1b(dtype=jnp.bfloat16, remat_policy="attn"),
+        capacity_factor=args.capacity_factor,
+    )
+    trainer = Trainer(
+        cfg,
+        TrainConfig(warmup_steps=2, total_steps=100),
+        lora_cfg=LoraConfig(rank=16),
+        mesh=mesh,
+        quantize_base=True,
+    )
+    s = trainer.benchmark(args.batch, args.seq, steps=3, warmup=1)
+    print(json.dumps({
+        "model": "mixtral-8x1b-qlora-int8",
+        "device": getattr(devices[0], "device_kind", "cpu"),
+        "dispatch": cfg.dispatch,
+        "capacity_factor": args.capacity_factor,
+        "batch": args.batch,
+        "seq": args.seq,
+        "step_time_s": round(s["step_time_s"], 4),
+        "tokens_per_s": round(s["tokens_per_s"], 1),
+        "mfu_strict_sparse": round(s["flops_per_s"] / peak, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

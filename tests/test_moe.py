@@ -324,3 +324,46 @@ def test_moe_lora_pipelined(devices8):
     m2 = trainer.train_step(batch)
     assert np.isfinite(float(m1["loss"]))
     assert float(m2["loss"]) <= float(m1["loss"]) + 0.5
+
+
+def test_ragged_dispatch_matches_einsum():
+    """The index-table gather/scatter path and the GShard one-hot
+    einsum path implement the SAME routing decisions — outputs and
+    gradients must agree to numerical precision (VERDICT r2 item 6)."""
+    import dataclasses
+
+    from odh_kubeflow_tpu.models.moe import MoeConfig, forward, init_params
+
+    cfg_e = MoeConfig.mixtral_tiny(dispatch="einsum")
+    cfg_e = dataclasses.replace(
+        cfg_e, base=dataclasses.replace(cfg_e.base, dtype=jnp.float32)
+    )
+    cfg_r = dataclasses.replace(cfg_e, dispatch="ragged")
+    params = jax.jit(lambda k: init_params(k, cfg_e, dtype=jnp.float32))(
+        jax.random.key(3)
+    )
+    tokens = jax.random.randint(
+        jax.random.key(4), (2, 40), 0, cfg_e.vocab_size
+    )
+
+    le, ae = forward(params, tokens, cfg_e)
+    lr, ar = forward(params, tokens, cfg_r)
+    assert jnp.allclose(ae, ar, atol=1e-6), (float(ae), float(ar))
+    assert jnp.allclose(le, lr, atol=2e-4, rtol=2e-4), (
+        float(jnp.abs(le - lr).max())
+    )
+
+    def loss(cfg):
+        def f(p):
+            logits, aux = forward(p, tokens, cfg)
+            return jnp.mean(logits**2) + aux
+        return f
+
+    ge = jax.grad(loss(cfg_e))(params)
+    gr = jax.grad(loss(cfg_r))(params)
+    flat_e, _ = jax.tree_util.tree_flatten(ge)
+    flat_r, _ = jax.tree_util.tree_flatten(gr)
+    for e, r in zip(flat_e, flat_r):
+        assert jnp.allclose(e, r, atol=2e-4, rtol=2e-4), (
+            float(jnp.abs(e - r).max())
+        )
