@@ -105,41 +105,296 @@ export function statusIcon(status) {
   );
 }
 
-/* -- resource table (resource-table equivalent) --------------------------- */
+/* -- resource table (resource-table equivalent) ---------------------------
+ *
+ * Sortable + filterable + paginated (reference:
+ * kubeflow-common-lib resource-table with MatSort/MatPaginator).
+ * Apps re-create the table element on every poll tick, so the UI
+ * state (sort column/direction, filter text, page) lives in a
+ * module-level map keyed by `stateKey` (defaults to the column
+ * titles) and survives re-renders; the filter input keeps focus by
+ * restoring the caret when it was focused before the re-render.
+ */
 
-export function resourceTable({ columns, rows, empty = "No resources" }) {
-  const thead = h(
-    "thead",
-    {},
-    h(
-      "tr",
-      {},
-      columns.map((c) => h("th", {}, c.title))
-    )
-  );
-  const tbody = h("tbody");
-  if (!rows.length) {
-    tbody.append(
-      h(
-        "tr",
-        { class: "kf-empty" },
-        h("td", { colspan: String(columns.length) }, empty)
-      )
+const tableStates = new Map();
+
+function cellSortValue(col, row) {
+  if (col.sortValue) return col.sortValue(row);
+  if (col.field != null) return row[col.field];
+  const v = col.render ? col.render(row) : null;
+  return v && v.textContent != null ? v.textContent : v;
+}
+
+export function resourceTable({
+  columns,
+  rows,
+  empty = "No resources",
+  stateKey = null,
+  pageSize = 10,
+  filterable = true,
+}) {
+  const key = stateKey || columns.map((c) => c.title).join("|");
+  const state = tableStates.get(key) || {
+    sortCol: -1,
+    sortDir: 1,
+    filter: "",
+    page: 0,
+    filterFocused: false,
+  };
+  tableStates.set(key, state);
+
+  let container = null;
+  const rerender = () => {
+    const next = build();
+    container.replaceWith(next);
+    container = next;
+  };
+
+  const build = () => {
+    // Schwartzian transform: extract each row's cell keys ONCE — the
+    // comparator/filter must not call col.render (DOM construction)
+    // O(n log n) times per keystroke/poll tick
+    let view = rows.map((row) => ({
+      row,
+      keys: columns.map((c) => cellSortValue(c, row)),
+    }));
+    if (state.filter) {
+      const needle = state.filter.toLowerCase();
+      view = view.filter(({ keys }) =>
+        keys.some(
+          (v) => v != null && String(v).toLowerCase().includes(needle)
+        )
+      );
+    }
+    if (state.sortCol >= 0 && columns[state.sortCol]) {
+      const i = state.sortCol;
+      view = [...view].sort((a, b) => {
+        const va = a.keys[i];
+        const vb = b.keys[i];
+        if (va == null && vb == null) return 0;
+        if (va == null) return 1;
+        if (vb == null) return -1;
+        const cmp =
+          typeof va === "number" && typeof vb === "number"
+            ? va - vb
+            : String(va).localeCompare(String(vb));
+        return cmp * state.sortDir;
+      });
+    }
+    view = view.map(({ row }) => row);
+    const pages = Math.max(1, Math.ceil(view.length / pageSize));
+    state.page = Math.min(state.page, pages - 1);
+    const pageRows = view.slice(
+      state.page * pageSize,
+      (state.page + 1) * pageSize
     );
-  }
-  for (const row of rows) {
-    tbody.append(
+
+    const thead = h(
+      "thead",
+      {},
       h(
         "tr",
         {},
-        columns.map((c) => {
-          const v = c.render ? c.render(row) : row[c.field];
-          return h("td", {}, v == null ? "" : v);
+        columns.map((c, i) => {
+          const sortable = c.sortable !== false && !!c.title;
+          const marker =
+            state.sortCol === i ? (state.sortDir > 0 ? " ▲" : " ▼") : "";
+          return h(
+            "th",
+            sortable
+              ? {
+                  class: "kf-sortable",
+                  dataset: { sort: c.title },
+                  onClick: () => {
+                    if (state.sortCol === i) state.sortDir *= -1;
+                    else {
+                      state.sortCol = i;
+                      state.sortDir = 1;
+                    }
+                    rerender();
+                  },
+                }
+              : {},
+            `${c.title}${marker}`
+          );
         })
       )
     );
+    const tbody = h("tbody");
+    if (!pageRows.length) {
+      tbody.append(
+        h(
+          "tr",
+          { class: "kf-empty" },
+          h(
+            "td",
+            { colspan: String(columns.length) },
+            state.filter ? `No matches for “${state.filter}”` : empty
+          )
+        )
+      );
+    }
+    for (const row of pageRows) {
+      tbody.append(
+        h(
+          "tr",
+          {},
+          columns.map((c) => {
+            const v = c.render ? c.render(row) : row[c.field];
+            return h("td", {}, v == null ? "" : v);
+          })
+        )
+      );
+    }
+
+    const filterInput = filterable
+      ? h("input", {
+          class: "kf-input kf-table-filter",
+          placeholder: "Filter…",
+          value: state.filter,
+          onInput: (e) => {
+            state.filter = e.target.value;
+            state.page = 0;
+            state.filterFocused = true;
+            state.caret = e.target.selectionStart;
+            rerender();
+          },
+          onFocus: () => {
+            // a poll-tick re-render must not steal focus even before
+            // the first keystroke
+            state.filterFocused = true;
+          },
+          onBlur: () => {
+            state.filterFocused = false;
+          },
+        })
+      : null;
+
+    const pager =
+      pages > 1 || state.page > 0
+        ? h(
+            "div",
+            { class: "kf-table-pager" },
+            h(
+              "button",
+              {
+                class: "kf-icon-btn",
+                disabled: state.page === 0,
+                onClick: () => {
+                  state.page -= 1;
+                  rerender();
+                },
+              },
+              "‹"
+            ),
+            h(
+              "span",
+              { class: "kf-muted" },
+              ` ${state.page + 1} / ${pages} (${view.length}) `
+            ),
+            h(
+              "button",
+              {
+                class: "kf-icon-btn",
+                disabled: state.page >= pages - 1,
+                onClick: () => {
+                  state.page += 1;
+                  rerender();
+                },
+              },
+              "›"
+            )
+          )
+        : null;
+
+    const wrap = h(
+      "div",
+      { class: "kf-table-wrap" },
+      filterInput,
+      h("table", { class: "kf-table" }, thead, tbody),
+      pager
+    );
+    if (filterInput && state.filterFocused) {
+      queueMicrotask(() => {
+        filterInput.focus();
+        // restore the caret where the user left it (mid-string edits
+        // must not jump to the end)
+        const pos = state.caret != null ? state.caret : filterInput.value.length;
+        filterInput.setSelectionRange(pos, pos);
+      });
+    }
+    return wrap;
+  };
+
+  container = build();
+  return container;
+}
+
+/* -- form validation (form-control suite equivalent) ----------------------
+ *
+ * Reference: kubeflow-common-lib form controls + the spawner's
+ * per-field Angular validators (e.g. form-name dns-1123 checks).
+ * `formField` wraps a control with a label/hint and an error line;
+ * `validateFields` runs all validators, surfaces messages inline, and
+ * focuses the first offender.
+ */
+
+export const validators = {
+  required: (msg = "Required") => (v) =>
+    v == null || String(v).trim() === "" ? msg : null,
+  dns1123: () => (v) =>
+    /^[a-z0-9]([-a-z0-9]{0,61}[a-z0-9])?$/.test(String(v).trim())
+      ? null
+      : "Lowercase letters, digits and '-'; must start/end alphanumeric (max 63)",
+  quantity: () => (v) =>
+    /^[0-9]+(\.[0-9]+)?(m|Ki|Mi|Gi|Ti|k|M|G|T)?$/.test(String(v).trim())
+      ? null
+      : "Not a Kubernetes quantity (e.g. 500m, 2, 1Gi)",
+  number: ({ min = null, max = null } = {}) => (v) => {
+    const n = Number(String(v).trim());
+    if (!isFinite(n)) return "Must be a number";
+    if (min != null && n < min) return `Must be ≥ ${min}`;
+    if (max != null && n > max) return `Must be ≤ ${max}`;
+    return null;
+  },
+};
+
+export function formField({ label, input, hint = null, validators: vs = [] }) {
+  const errorEl = h("div", { class: "kf-field-error", hidden: true });
+  const field = h(
+    "div",
+    { class: "kf-field" },
+    label ? h("label", { for: input.id }, label) : null,
+    input,
+    hint ? h("div", { class: "kf-hint" }, hint) : null,
+    errorEl
+  );
+  const validate = () => {
+    for (const v of vs) {
+      const err = v(input.value);
+      if (err) {
+        errorEl.textContent = err;
+        errorEl.hidden = false;
+        input.classList.add("kf-invalid");
+        return err;
+      }
+    }
+    errorEl.hidden = true;
+    input.classList.remove("kf-invalid");
+    return null;
+  };
+  input.addEventListener("input", validate);
+  input.addEventListener("blur", validate);
+  return { el: field, input, validate };
+}
+
+export function validateFields(fields) {
+  let firstBad = null;
+  for (const f of fields) {
+    if (f.validate() && !firstBad) firstBad = f;
   }
-  return h("table", { class: "kf-table" }, thead, tbody);
+  if (firstBad) firstBad.input.focus();
+  return firstBad == null;
 }
 
 /* -- confirm dialog ------------------------------------------------------- */

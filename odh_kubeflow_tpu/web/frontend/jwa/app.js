@@ -19,6 +19,9 @@ import {
   poll,
   currentNamespace,
   age,
+  formField,
+  validators,
+  validateFields,
 } from "./common/kubeflow-common.js";
 
 const root = document.getElementById("app");
@@ -86,13 +89,26 @@ function renderIndex(notebooks) {
             },
             { title: "CPU", field: "cpu" },
             { title: "Memory", field: "memory" },
-            { title: "Age", render: (r) => age(r.age) },
+            // sortValue: sort chronologically on the raw timestamp,
+            // not lexicographically on the humanized "5m"/"2h" string
+            { title: "Age", sortValue: (r) => r.age, render: (r) => age(r.age) },
             {
               title: "",
+              sortable: false,
               render: (r) =>
                 h(
                   "span",
                   {},
+                  h(
+                    "button",
+                    {
+                      class: "kf-icon-btn",
+                      dataset: { action: "details", name: r.name },
+                      title: "Details & events",
+                      onClick: () => showDetails(r),
+                    },
+                    "☰ details"
+                  ),
                   h(
                     "button",
                     {
@@ -164,6 +180,108 @@ async function deleteNotebook(row) {
   } catch (e) {
     snackbar(e.message, "error");
   }
+}
+
+/* -- details / events drawer ----------------------------------------------
+ * Reference parity: the notebook details page's OVERVIEW + EVENTS tabs
+ * (jupyter/frontend .../notebook-page), collapsed into a side drawer
+ * fed by GET .../notebooks/<name>/events (the controller re-emits
+ * owned STS/Pod events onto the Notebook CR). */
+
+let stopDrawerPolling = null;
+
+function closeDrawer() {
+  if (stopDrawerPolling) stopDrawerPolling();
+  stopDrawerPolling = null;
+  document.querySelectorAll(".kf-drawer-backdrop").forEach((el) => el.remove());
+}
+
+async function showDetails(row) {
+  closeDrawer();
+  const eventsBody = h("div", { class: "kf-drawer-events" }, "Loading…");
+  const backdrop = h(
+    "div",
+    {
+      class: "kf-drawer-backdrop",
+      onClick: (e) => {
+        if (e.target === backdrop) closeDrawer();
+      },
+    },
+    h(
+      "div",
+      { class: "kf-drawer" },
+      h(
+        "div",
+        { class: "kf-toolbar" },
+        h("h2", {}, row.name),
+        h("span", { class: "kf-spacer" }),
+        h(
+          "button",
+          { class: "kf-icon-btn", onClick: () => closeDrawer() },
+          "✕"
+        )
+      ),
+      h(
+        "div",
+        { class: "kf-drawer-overview" },
+        statusIcon(row.status),
+        h("div", {}, h("b", {}, "Image: "), h("code", {}, row.shortImage)),
+        h(
+          "div",
+          {},
+          h("b", {}, "TPU: "),
+          row.tpus
+            ? `${row.tpus.accelerator} ${row.tpus.topology} (${row.tpus.chips} chips)`
+            : "none"
+        ),
+        h("div", {}, h("b", {}, "CPU: "), row.cpu, " · ", h("b", {}, "Memory: "), row.memory),
+        h("div", {}, h("b", {}, "Age: "), age(row.age))
+      ),
+      h("h3", {}, "Events"),
+      eventsBody
+    )
+  );
+  document.body.append(backdrop);
+
+  const refresh = async () => {
+    const data = await api(
+      `api/namespaces/${ns}/notebooks/${row.name}/events`
+    );
+    const events = data.events || [];
+    clear(eventsBody).append(
+      events.length
+        ? resourceTable({
+            // per-notebook state: A's filter/page must not leak into
+            // B's drawer
+            stateKey: `nb-events:${row.name}`,
+            pageSize: 8,
+            columns: [
+              {
+                title: "Type",
+                field: "type",
+                render: (e) =>
+                  h(
+                    "span",
+                    { class: e.type === "Warning" ? "kf-danger" : "" },
+                    e.type
+                  ),
+              },
+              { title: "Reason", field: "reason" },
+              { title: "From", field: "involved" },
+              { title: "Message", field: "message" },
+              {
+                title: "Age",
+                sortValue: (e) => e.timestamp,
+                render: (e) => age(e.timestamp),
+              },
+            ],
+            rows: events,
+            empty: "No events",
+          })
+        : h("div", { class: "kf-muted" }, "No events recorded yet.")
+    );
+  };
+  stopDrawerPolling = poll(refresh, 5000);
 }
 
 /* -- spawner form ---------------------------------------------------------- */
@@ -291,22 +409,39 @@ async function showForm() {
     );
   });
 
-  const nameInput = h("input", {
-    class: "kf-input",
-    id: "nb-name",
-    placeholder: "my-notebook",
-    autocomplete: "off",
+  // validated controls (reference: the Angular spawner's per-field
+  // validators — dns-1123 name, k8s quantity cpu/mem); errors surface
+  // inline under each control and Launch refuses until they clear
+  const nameField = formField({
+    input: h("input", {
+      class: "kf-input",
+      id: "nb-name",
+      placeholder: "my-notebook",
+      autocomplete: "off",
+    }),
+    validators: [validators.required("Name is required"), validators.dns1123()],
   });
-  const cpuInput = h("input", {
-    class: "kf-input",
-    id: "nb-cpu",
-    value: (config.cpu && config.cpu.value) || "0.5",
+  const cpuField = formField({
+    label: "CPU",
+    input: h("input", {
+      class: "kf-input",
+      id: "nb-cpu",
+      value: (config.cpu && config.cpu.value) || "0.5",
+    }),
+    validators: [validators.required(), validators.quantity()],
   });
-  const memInput = h("input", {
-    class: "kf-input",
-    id: "nb-memory",
-    value: (config.memory && config.memory.value) || "1Gi",
+  const memField = formField({
+    label: "Memory",
+    input: h("input", {
+      class: "kf-input",
+      id: "nb-memory",
+      value: (config.memory && config.memory.value) || "1Gi",
+    }),
+    validators: [validators.required(), validators.quantity()],
   });
+  const nameInput = nameField.input;
+  const cpuInput = cpuField.input;
+  const memInput = memField.input;
   const shmBox = h("input", {
     type: "checkbox",
     id: "nb-shm",
@@ -378,24 +513,14 @@ async function showForm() {
         "div",
         { class: "kf-card" },
         h("h2", {}, "Name"),
-        h("div", { class: "kf-field" }, nameInput)
+        nameField.el
       ),
       h("div", { class: "kf-card" }, h("h2", {}, "Server type & image"), imageSelects),
       h(
         "div",
         { class: "kf-card" },
         h("h2", {}, "Resources"),
-        h(
-          "div",
-          { class: "kf-row" },
-          h("div", { class: "kf-field" }, h("label", { for: "nb-cpu" }, "CPU"), cpuInput),
-          h(
-            "div",
-            { class: "kf-field" },
-            h("label", { for: "nb-memory" }, "Memory"),
-            memInput
-          )
-        ),
+        h("div", { class: "kf-row" }, cpuField.el, memField.el),
         tpuSection(form)
       ),
       h(
@@ -468,11 +593,11 @@ async function showForm() {
           class: "kf-btn",
           id: "launch",
           onClick: async () => {
-            const name = nameInput.value.trim();
-            if (!name) {
-              snackbar("Name is required", "error");
+            if (!validateFields([nameField, cpuField, memField])) {
+              snackbar("Fix the highlighted fields first", "error");
               return;
             }
+            const name = nameInput.value.trim();
             const chosenGroup = IMAGE_GROUPS.find(
               ({ key }) => form[key].radio.checked
             );

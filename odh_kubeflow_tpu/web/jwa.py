@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import copy
 import os
+import re
 from typing import Any, Optional
 
 import yaml
@@ -224,6 +225,39 @@ class JupyterWebApp(CrudBackend):
             self.authorize(request, "get", "notebooks", namespace, "kubeflow.org")
             nb = self.api.get("Notebook", name, namespace)
             return success({"notebook": nb})
+
+        @app.route(
+            "/api/namespaces/<namespace>/notebooks/<name>/events",
+            methods=["GET"],
+        )
+        def notebook_events(request, namespace, name):
+            """The detail drawer's feed: events involving the Notebook
+            CR itself (the controller re-emits owned STS/Pod events
+            onto it) plus any raw events from its child resources,
+            newest first — reference parity with the notebook details
+            page's EVENTS tab."""
+            self.authorize(request, "get", "notebooks", namespace, "kubeflow.org")
+            events = []
+            for event in self.api.list("Event", namespace=namespace):
+                involved = event.get("involvedObject", {})
+                if not _event_belongs_to_notebook(involved, name):
+                    continue
+                events.append(
+                    {
+                        "type": event.get("type", "Normal"),
+                        "reason": event.get("reason", ""),
+                        "message": event.get("message", ""),
+                        "involved": (
+                            f"{involved.get('kind', '')}/"
+                            f"{involved.get('name', '')}"
+                        ),
+                        "timestamp": event.get("lastTimestamp")
+                        or event.get("firstTimestamp", ""),
+                        "count": event.get("count", 1),
+                    }
+                )
+            events.sort(key=lambda e: e["timestamp"], reverse=True)
+            return success({"events": events})
 
         @app.route(
             "/api/namespaces/<namespace>/notebooks/<name>", methods=["PATCH"]
@@ -537,9 +571,28 @@ class JupyterWebApp(CrudBackend):
             iname = involved.get("name", "")
             if involved.get("kind") == "Notebook" and iname == name:
                 return event.get("message", event.get("reason", ""))
-            if iname == name or iname.startswith(f"{name}-"):
+            if _event_belongs_to_notebook(involved, name):
                 fallback = event.get("message", event.get("reason", ""))
         return fallback
+
+
+def _event_belongs_to_notebook(involved: Obj, name: str) -> bool:
+    """Match an event's involvedObject to a notebook's owned-resource
+    family: the CR/STS/Service share its exact name, *Pods* append an
+    ordinal (``name-0``), the workspace *PVC* appends ``-workspace``.
+    The suffix rules are kind-gated because names alone are ambiguous:
+    a bare ``name-`` prefix match would swallow a SIBLING notebook
+    called ``name-2`` (kind Notebook/StatefulSet — rejected) while the
+    pod ``name-2`` of THIS notebook (kind Pod — accepted) keeps its
+    events. The drawer must never show another server's crashes."""
+    kind = involved.get("kind", "")
+    iname = involved.get("name", "")
+    if iname == name:
+        return True
+    suffix = iname[len(name):] if iname.startswith(name) else ""
+    if kind == "Pod" and re.fullmatch(r"-\d+", suffix):
+        return True
+    return kind == "PersistentVolumeClaim" and suffix == "-workspace"
 
 
 def _apply_limit_factor(value: str, cfg: Obj) -> str:
