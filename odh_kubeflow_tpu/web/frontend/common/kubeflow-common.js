@@ -151,19 +151,42 @@ export function resourceTable({
   };
 
   const build = () => {
-    // Schwartzian transform: extract each row's cell keys ONCE — the
-    // comparator/filter must not call col.render (DOM construction)
-    // O(n log n) times per keystroke/poll tick
+    // capture the live caret/focus from the CURRENT filter input (the
+    // app may be rebuilding us from a poll tick; arrow-key moves fire
+    // no input event, so only the live selection is trustworthy)
+    const activeEl = document.activeElement;
+    if (
+      activeEl &&
+      activeEl.classList &&
+      activeEl.classList.contains("kf-table-filter")
+    ) {
+      state.filterFocused = true;
+      state.caret = activeEl.selectionStart;
+    }
+
+    // Schwartzian transform over TITLED columns only (the untitled
+    // action column's button labels must not make "stop"/"delete"
+    // match every row), computed lazily — no keys, and no throwaway
+    // col.render DOM, unless a sort or filter is actually active
+    const keyCols = columns
+      .map((c, i) => ({ c, i }))
+      .filter(({ c }) => !!c.title);
+    const needKeys = !!state.filter || state.sortCol >= 0;
     let view = rows.map((row) => ({
       row,
-      keys: columns.map((c) => cellSortValue(c, row)),
+      keys: needKeys
+        ? Object.fromEntries(
+            keyCols.map(({ c, i }) => [i, cellSortValue(c, row)])
+          )
+        : null,
     }));
     if (state.filter) {
       const needle = state.filter.toLowerCase();
       view = view.filter(({ keys }) =>
-        keys.some(
-          (v) => v != null && String(v).toLowerCase().includes(needle)
-        )
+        keyCols.some(({ i }) => {
+          const v = keys[i];
+          return v != null && String(v).toLowerCase().includes(needle);
+        })
       );
     }
     if (state.sortCol >= 0 && columns[state.sortCol]) {

@@ -60,7 +60,7 @@ function render(tbs) {
                   : r.name,
             },
             { title: "Logs path", render: (r) => h("code", {}, r.logspath) },
-            { title: "Age", render: (r) => age(r.age) },
+            { title: "Age", sortValue: (r) => r.age, render: (r) => age(r.age) },
             {
               title: "",
               render: (r) =>

@@ -65,7 +65,7 @@ function render(pvcs) {
                   ? r.usedBy.map((p) => h("span", { class: "kf-chip" }, p))
                   : "—",
             },
-            { title: "Age", render: (r) => age(r.age) },
+            { title: "Age", sortValue: (r) => r.age, render: (r) => age(r.age) },
             {
               title: "",
               render: (r) =>

@@ -34,6 +34,7 @@ def agent(tmp_path):
         TPU_AGENT_PORT="0",
         TPU_AGENT_INTERVAL="0.3",
         TPU_DEVICE_GLOBS=str(tmp_path / "accel*"),
+        TPU_METRICS_URL="",  # hermetic: tier 2 only in these tests
     )
     proc = subprocess.Popen(
         [sys.executable, str(AGENT)],
@@ -162,3 +163,81 @@ def test_agent_idle_holder_not_active(agent):
     finally:
         holder.send_signal(signal.SIGKILL)
         holder.wait(timeout=5)
+
+
+def test_agent_prefers_device_metrics_and_falls_back(tmp_path):
+    """Tier 1: with a host TPU metrics endpoint exporting a duty-cycle
+    gauge, the agent reports the DEVICE's number (multi-chip mean,
+    source=device-metrics) regardless of holder CPU; when the endpoint
+    dies mid-lifetime, the next sample falls back to the /proc
+    heuristic without a restart."""
+    import http.server
+    import threading
+
+    class Prom(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = (
+                b"# HELP tpu_duty_cycle_percent TPU duty cycle\n"
+                b'tpu_duty_cycle_percent{chip="0"} 83.5\n'
+                b'tpu_duty_cycle_percent{chip="1"} 76.5\n'
+            )
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    prom = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Prom)
+    threading.Thread(target=prom.serve_forever, daemon=True).start()
+
+    dev = tmp_path / "accel0"
+    dev.write_bytes(b"")
+    env = dict(
+        os.environ,
+        TPU_AGENT_PORT="0",
+        TPU_AGENT_INTERVAL="0.2",
+        TPU_DEVICE_GLOBS=str(tmp_path / "accel*"),
+        TPU_METRICS_URL=f"http://127.0.0.1:{prom.server_address[1]}/metrics",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, str(AGENT)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        port = re.search(r":(\d+)$", line.strip()).group(1)
+        url = f"http://127.0.0.1:{port}/api/tpu/activity"
+
+        deadline = time.time() + 10
+        state = {}
+        while time.time() < deadline:
+            state = _get(url)
+            if state["source"] == "device-metrics":
+                break
+            time.sleep(0.2)
+        assert state["source"] == "device-metrics", state
+        assert state["duty_cycle_pct"] == 80.0  # mean of 83.5 / 76.5
+        # an 80% device duty cycle marks activity even with ZERO
+        # /proc holders — the collective-heavy false-idle case the
+        # heuristic alone gets wrong
+        assert state["holders"] == 0
+        assert state["last_active"] is not None
+
+        prom.shutdown()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            state = _get(url)
+            if state["source"] == "proc-heuristic":
+                break
+            time.sleep(0.2)
+        assert state["source"] == "proc-heuristic", state
+        assert state["duty_cycle_pct"] == 0.0
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
