@@ -93,6 +93,26 @@ class _Request:
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     tokens: list[int] = dataclasses.field(default_factory=list)
     error: Optional[Exception] = None
+    # set by submit(stream=True): tokens are ALSO pushed here as they
+    # decode; a None sentinel marks end-of-stream (check .error then)
+    token_q: Optional["queue.Queue"] = None
+    cancelled: bool = False
+
+    def cancel(self) -> None:
+        """Abandon the stream (client went away): the engine frees the
+        slot at the next chunk boundary instead of decoding the rest
+        of max_tokens for nobody."""
+        self.cancelled = True
+
+    def _emit(self, tok: int) -> None:
+        self.tokens.append(tok)
+        if self.token_q is not None:
+            self.token_q.put(tok)
+
+    def _finish(self) -> None:
+        self.done.set()
+        if self.token_q is not None:
+            self.token_q.put(None)
 
     def result(self, timeout: Optional[float] = None) -> list[int]:
         if not self.done.wait(timeout):
@@ -100,6 +120,18 @@ class _Request:
         if self.error is not None:
             raise self.error
         return self.tokens
+
+    def iter_tokens(self, timeout: float = 600.0):
+        """Generator over tokens as they decode (stream=True submits
+        only). Raises the stream's error, if any, at the end."""
+        assert self.token_q is not None, "submit with stream=True"
+        while True:
+            tok = self.token_q.get(timeout=timeout)
+            if tok is None:
+                break
+            yield tok
+        if self.error is not None:
+            raise self.error
 
 
 class DecodeEngine:
@@ -296,9 +328,9 @@ class DecodeEngine:
             ),
         )
         tok = int(first)
-        req.tokens.append(tok)
+        req._emit(tok)
         if req.max_tokens <= 1 or tok == req.eos_id:
-            req.done.set()
+            req._finish()
             return
         self._slot_req[slot] = req
 
@@ -314,7 +346,7 @@ class DecodeEngine:
         for slot, req in enumerate(self._slot_req):
             if req is not None:
                 req.error = exc
-                req.done.set()
+                req._finish()
                 self._slot_req[slot] = None
         while True:
             try:
@@ -323,7 +355,7 @@ class DecodeEngine:
                 break
             if req is not None:
                 req.error = exc
-                req.done.set()
+                req._finish()
 
     def _loop(self) -> None:
         while not self._stopped:
@@ -340,7 +372,7 @@ class DecodeEngine:
                     admitted = True
                 except Exception as e:  # noqa: BLE001 — state integrity unknown
                     req.error = e
-                    req.done.set()
+                    req._finish()
                     self._fail_engine(e)
                     return
             if not any(r is not None for r in self._slot_req):
@@ -360,15 +392,25 @@ class DecodeEngine:
             for slot, req in enumerate(self._slot_req):
                 if req is None:
                     continue
+                if req.cancelled:
+                    # client abandoned the stream: deactivate the slot
+                    # on device (stops its kv growth and emission) and
+                    # free it now instead of decoding for nobody
+                    self._state["active"] = (
+                        self._state["active"].at[slot].set(False)
+                    )
+                    req._finish()
+                    self._slot_req[slot] = None
+                    continue
                 for t, live in zip(toks[slot], mask[slot]):
                     if live:
-                        req.tokens.append(int(t))
+                        req._emit(int(t))
                         self.tokens_emitted += 1
                 if (
                     len(req.tokens) >= req.max_tokens
                     or (req.tokens and req.tokens[-1] == req.eos_id)
                 ):
-                    req.done.set()
+                    req._finish()
                     self._slot_req[slot] = None
 
     # -- public API ---------------------------------------------------------
@@ -382,6 +424,7 @@ class DecodeEngine:
         top_k: int = 0,
         top_p: float = 0.0,
         eos_id: Optional[int] = None,
+        stream: bool = False,
     ) -> _Request:
         if self.failure is not None:
             raise RuntimeError(
@@ -404,6 +447,7 @@ class DecodeEngine:
             top_k=top_k,
             top_p=top_p,
             eos_id=-1 if eos_id is None else int(eos_id),
+            token_q=queue.Queue() if stream else None,
         )
         self._queue.put(req)
         self._wake.set()
@@ -414,3 +458,7 @@ class DecodeEngine:
         self._queue.put(None)
         self._wake.set()
         self._thread.join(timeout=5)
+        # finish whatever was in flight: a concurrent result()/
+        # iter_tokens() consumer must get its sentinel + error now,
+        # not a 600s queue timeout
+        self._fail_engine(RuntimeError("decode engine stopped"))

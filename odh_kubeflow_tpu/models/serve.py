@@ -269,6 +269,18 @@ class CompletionService:
         }
 
 
+def _gen_params(req: dict) -> dict:
+    """The sampling knobs shared verbatim by the one-shot and
+    streaming paths — one parser so their defaults can't drift."""
+    return {
+        "max_tokens": int(req.get("max_tokens", 64)),
+        "temperature": float(req.get("temperature", 0.0)),
+        "top_k": int(req.get("top_k", 0)),
+        "top_p": float(req.get("top_p", 0.0)),
+        "eos_id": req.get("eos_id"),
+    }
+
+
 def serve(
     service: CompletionService, host: str = "0.0.0.0", port: int = 8000
 ) -> ThreadingHTTPServer:
@@ -299,20 +311,83 @@ def serve(
                 prompts = req.get("prompt") or []
                 if prompts and isinstance(prompts[0], int):
                     prompts = [prompts]
+                if req.get("stream"):
+                    return self._stream(prompts, req)
                 result = service.complete(
                     prompts,
-                    max_tokens=int(req.get("max_tokens", 64)),
-                    temperature=float(req.get("temperature", 0.0)),
-                    top_k=int(req.get("top_k", 0)),
-                    top_p=float(req.get("top_p", 0.0)),
-                    eos_id=req.get("eos_id"),
                     seed=int(req.get("seed", 0)),
+                    **_gen_params(req),
                 )
                 self._reply(200, result)
             except ValueError as e:
                 self._reply(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — surface, keep serving
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _stream(self, prompts, req):
+            """``"stream": true`` → Server-Sent Events: one
+            ``data: {"token": id}`` frame per decoded token as the
+            engine's decode loop produces them, a final
+            ``data: {"done": true, "tokens": [...]}`` frame, ids-only
+            like the rest of the surface. Requires the continuous-
+            batching engine (streaming a bucketed one-shot decode
+            would be fake — tokens only exist when the whole batch
+            finishes)."""
+            if len(prompts) != 1:
+                return self._reply(
+                    400, {"error": "stream requires exactly one prompt"}
+                )
+            if int(req.get("seed", 0)) != 0:
+                # the engine samples from its own rng stream shared by
+                # all slots — a per-request seed cannot be honored;
+                # reject rather than silently ignore (the one-shot
+                # path honors seeds, without streaming)
+                return self._reply(
+                    400,
+                    {"error": "stream does not support seed; omit it"},
+                )
+            eng = service.engine
+            if eng is None:
+                return self._reply(
+                    400,
+                    {"error": "streaming requires engine_slots > 0"},
+                )
+            if eng.failure is not None:
+                return self._reply(
+                    500,
+                    {"error": f"decode engine is down: {eng.failure!r}"},
+                )
+            try:
+                handle = eng.submit(
+                    prompts[0], stream=True, **_gen_params(req)
+                )
+            except (ValueError, RuntimeError) as e:
+                return self._reply(400, {"error": str(e)})
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            try:
+                for tok in handle.iter_tokens():
+                    self.wfile.write(
+                        f"data: {json.dumps({'token': tok})}\n\n".encode()
+                    )
+                    self.wfile.flush()
+                final = {"done": True, "tokens": handle.tokens}
+            except OSError:
+                # client went away mid-stream: release the slot so it
+                # stops decoding the rest of max_tokens for nobody
+                handle.cancel()
+                return
+            except Exception as e:  # noqa: BLE001 — end the stream honestly
+                final = {"done": True, "error": f"{type(e).__name__}: {e}"}
+            try:
+                self.wfile.write(
+                    f"data: {json.dumps(final)}\n\n".encode()
+                )
+                self.wfile.flush()
+            except OSError:
+                pass  # client went away on the final frame
 
         def log_message(self, *a):
             pass

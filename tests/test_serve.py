@@ -341,3 +341,80 @@ def test_engine_mode_http_concurrent():
     finally:
         if svc.engine is not None:
             svc.engine.stop()
+
+
+def test_engine_failure_falls_back_to_bucketed_path():
+    """A dead engine (device failure marked in engine.failure) must not
+    black-hole the server: complete() routes around it through the
+    one-shot bucketed path and still answers."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    svc = CompletionService(
+        params, cfg, prompt_buckets=(8, 16), batch_buckets=(1, 2),
+        engine_slots=2, engine_max_len=64,
+    )
+    try:
+        ok = svc.complete([[1, 2, 3]], max_tokens=4)
+        assert ok["usage"].get("engine") is True
+
+        svc.engine.failure = RuntimeError("simulated device loss")
+        out = svc.complete([[1, 2, 3]], max_tokens=4)
+        assert "engine" not in out["usage"]  # bucketed path answered
+        assert len(out["completions"][0]) == 4
+    finally:
+        svc.engine.stop()
+
+
+def test_streaming_completions_sse():
+    """"stream": true → SSE frames arrive one token at a time from the
+    running decode loop, and the concatenation equals the non-streamed
+    greedy result."""
+    import http.client
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    svc = CompletionService(
+        params, cfg, prompt_buckets=(8, 16), batch_buckets=(1, 2),
+        engine_slots=2, engine_max_len=64,
+    )
+    try:
+        want = svc.complete([[1, 2, 3, 4]], max_tokens=6)["completions"][0]
+
+        httpd = serve(svc, host="127.0.0.1", port=0)
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", httpd.server_address[1], timeout=120
+        )
+        conn.request(
+            "POST",
+            "/v1/completions",
+            body=json.dumps(
+                {"prompt": [1, 2, 3, 4], "max_tokens": 6, "stream": True}
+            ),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        frames = []
+        buf = b""
+        while True:
+            chunk = resp.read(1)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                frame, buf = buf.split(b"\n\n", 1)
+                assert frame.startswith(b"data: ")
+                frames.append(json.loads(frame[len(b"data: "):]))
+            if frames and frames[-1].get("done"):
+                break
+        conn.close()
+        httpd.shutdown()
+
+        tokens = [f["token"] for f in frames if "token" in f]
+        assert frames[-1]["done"] is True
+        assert frames[-1]["tokens"] == want
+        assert tokens == want
+        assert len(frames) == len(want) + 1  # one frame per token + done
+    finally:
+        svc.engine.stop()
