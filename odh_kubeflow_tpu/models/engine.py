@@ -209,6 +209,9 @@ class DecodeEngine:
         logits, sub_cache = self._fwd(
             params, prompt, self.cfg, sub_cache, jnp.int32(0),
             positions=positions, kv_mask=kv_mask1, lora=lora,
+            # bucket padding is not content: the MoE router must not
+            # let pad positions consume expert capacity
+            token_mask=kv_mask1[:, :S_b],
         )
         last = jnp.take_along_axis(
             logits, (length - 1)[None, None, None], axis=1
@@ -341,8 +344,12 @@ class DecodeEngine:
         reference deleted memory. Fail every in-flight and queued
         request immediately (their ``result()`` raises instead of
         hanging out a timeout), and make future ``submit()`` raise so
-        callers fall back to the one-shot path."""
-        self.failure = exc
+        callers fall back to the one-shot path. Idempotent: the first
+        failure wins (the clean-stop drain must not overwrite a device
+        error) and re-finishing an already-finished request is a no-op
+        for its consumers."""
+        if self.failure is None:
+            self.failure = exc
         for slot, req in enumerate(self._slot_req):
             if req is not None:
                 req.error = exc
@@ -358,6 +365,16 @@ class DecodeEngine:
                 req._finish()
 
     def _loop(self) -> None:
+        try:
+            self._run_loop()
+        finally:
+            # drain on ANY exit (stop sentinel, device failure, bug):
+            # the loop thread owns _slot_req, so draining here — never
+            # from stop()'s caller thread — cannot race an in-flight
+            # decode chunk still emitting into the same requests
+            self._fail_engine(RuntimeError("decode engine stopped"))
+
+    def _run_loop(self) -> None:
         while not self._stopped:
             admitted = False
             while None in self._slot_req:
@@ -367,6 +384,12 @@ class DecodeEngine:
                     break
                 if req is None:
                     return
+                if req.cancelled:
+                    # client left while the request was still queued:
+                    # don't spend a prefill (possibly a fresh compile)
+                    # on it
+                    req._finish()
+                    continue
                 try:
                     self._admit(req)
                     admitted = True
@@ -454,11 +477,13 @@ class DecodeEngine:
         return req
 
     def stop(self) -> None:
+        """Signal the loop to exit and wait for it. The loop itself
+        drains in-flight requests on exit (see _loop's finally) — the
+        drain must run on the loop thread, after any in-flight decode
+        chunk finished, or it would race the chunk's emissions. A
+        cold-compile chunk can exceed the join timeout; the daemon
+        thread still drains when it completes."""
         self._stopped = True
         self._queue.put(None)
         self._wake.set()
-        self._thread.join(timeout=5)
-        # finish whatever was in flight: a concurrent result()/
-        # iter_tokens() consumer must get its sentinel + error now,
-        # not a 600s queue timeout
-        self._fail_engine(RuntimeError("decode engine stopped"))
+        self._thread.join(timeout=60)

@@ -171,6 +171,9 @@ def generate(
         positions=positions,
         kv_mask=kv_mask,
         lora=lora,
+        # right-padded prompts: pad positions are not real tokens (the
+        # MoE family's router must not let them consume capacity)
+        token_mask=kv_mask[:, :S_prompt],
     )
     # next token comes from each row's last *real* prompt position
     last = jnp.take_along_axis(

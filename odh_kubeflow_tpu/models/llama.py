@@ -631,6 +631,9 @@ def forward_with_cache(
     positions: jnp.ndarray,  # [B, S] absolute positions (rope)
     kv_mask: Optional[jnp.ndarray] = None,  # [B, S_max] valid cache slots
     lora: Optional[Params] = None,
+    token_mask: Optional[jnp.ndarray] = None,  # [B, S]; accepted for
+    # family-generic callers (the MoE twin routes on it; the dense
+    # stack has no router, pads are inert through masked attention)
 ) -> tuple[jnp.ndarray, Params]:
     """KV-cached forward: returns (logits [B, S, V] float32, new cache).
 

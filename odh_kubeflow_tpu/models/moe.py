@@ -176,18 +176,29 @@ def param_specs(cfg: MoeConfig) -> Params:
 def _routing_topk(
     router_logits: jnp.ndarray,  # [B, S, E] float32
     cfg: MoeConfig,
+    token_mask: Optional[jnp.ndarray] = None,  # [B, S] bool; False = pad
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Shared routing preamble for both dispatch representations:
     renormalised top-k probs/ids + the Switch aux loss (balance
     fraction-routed vs mean prob per expert). One copy, so the
-    einsum-vs-ragged equivalence the tests pin cannot drift."""
+    einsum-vs-ragged equivalence the tests pin cannot drift.
+
+    ``token_mask`` excludes padding from the aux statistics (a
+    bucket-padded prefill or packed batch must not skew the balance
+    objective with phantom tokens)."""
     probs = jax.nn.softmax(router_logits, axis=-1)  # [B,S,E]
     top_p, top_idx = jax.lax.top_k(probs, cfg.num_experts_per_tok)
     top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
     E = router_logits.shape[-1]
     first_choice = jax.nn.one_hot(top_idx[..., 0], E, dtype=jnp.float32)
-    f = first_choice.mean(axis=(0, 1))  # fraction of tokens per expert
-    p = probs.mean(axis=(0, 1))
+    if token_mask is None:
+        f = first_choice.mean(axis=(0, 1))  # fraction routed per expert
+        p = probs.mean(axis=(0, 1))
+    else:
+        m = token_mask.astype(jnp.float32)[..., None]
+        denom = jnp.maximum(m.sum(), 1.0)
+        f = (first_choice * m).sum(axis=(0, 1)) / denom
+        p = (probs * m).sum(axis=(0, 1)) / denom
     aux_loss = E * jnp.sum(f * p) * cfg.router_aux_loss_coef
     return top_p, top_idx, aux_loss
 
@@ -195,6 +206,7 @@ def _routing_topk(
 def route_tokens(
     router_logits: jnp.ndarray,  # [B, S, E] float32
     cfg: MoeConfig,
+    token_mask: Optional[jnp.ndarray] = None,  # [B, S] bool; False = pad
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Top-k routing with per-(batch-row) capacity.
 
@@ -202,11 +214,17 @@ def route_tokens(
     aux_loss scalar)``. Group = batch row (the GShard grouping): the
     cumulative-sum position is per row, so capacity stays static under
     any batch sharding.
+
+    ``token_mask`` (False = padding) keeps pad tokens out of the
+    expert buffers entirely: without it a bucket-padded prefill's pad
+    positions CONSUME CAPACITY and can evict real tokens' expert
+    slots — real outputs would then differ between padded and
+    unpadded execution of the same prompt.
     """
     B, S, E = router_logits.shape
     k = cfg.num_experts_per_tok
     C = cfg.capacity(S)
-    top_p, top_idx, aux_loss = _routing_topk(router_logits, cfg)
+    top_p, top_idx, aux_loss = _routing_topk(router_logits, cfg, token_mask)
 
     dispatch = jnp.zeros((B, S, E, C), jnp.bool_)
     combine = jnp.zeros((B, S, E, C), jnp.float32)
@@ -214,6 +232,8 @@ def route_tokens(
     fill = jnp.zeros((B, E), jnp.int32)
     for slot in range(k):
         onehot = jax.nn.one_hot(top_idx[..., slot], E, dtype=jnp.int32)  # [B,S,E]
+        if token_mask is not None:
+            onehot = onehot * token_mask.astype(jnp.int32)[..., None]
         # position of each token within its expert's capacity buffer
         pos = jnp.cumsum(onehot, axis=1) - onehot + fill[:, None, :]  # [B,S,E]
         keep = (pos < C) & (onehot > 0)
@@ -227,6 +247,7 @@ def route_tokens(
 def route_tables(
     router_logits: jnp.ndarray,  # [B, S, E] float32
     cfg: MoeConfig,
+    token_mask: Optional[jnp.ndarray] = None,  # [B, S] bool; False = pad
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Ragged-dispatch form of :func:`route_tokens`: the inverse index
     tables instead of the one-hot [B,S,E,C] tensors.
@@ -244,7 +265,7 @@ def route_tables(
     B, S, E = router_logits.shape
     k = cfg.num_experts_per_tok
     C = cfg.capacity(S)
-    top_p, top_idx, aux_loss = _routing_topk(router_logits, cfg)
+    top_p, top_idx, aux_loss = _routing_topk(router_logits, cfg, token_mask)
 
     b_grid = jnp.arange(B, dtype=jnp.int32)[:, None]
     s_grid = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -256,9 +277,15 @@ def route_tables(
     for slot in range(k):
         e_sel = top_idx[..., slot]  # [B,S]
         onehot = jax.nn.one_hot(e_sel, E, dtype=jnp.int32)
+        if token_mask is not None:
+            # pad tokens neither consume capacity (onehot) nor write
+            # table entries (keep)
+            onehot = onehot * token_mask.astype(jnp.int32)[..., None]
         pos = jnp.cumsum(onehot, axis=1) - onehot + fill[:, None, :]
         p_sel = jnp.take_along_axis(pos, e_sel[..., None], 2)[..., 0]
         keep = p_sel < C
+        if token_mask is not None:
+            keep = keep & token_mask
         c_clip = jnp.clip(p_sel, 0, C - 1)
         idx = idx.at[b_grid, e_sel, c_clip].add(
             jnp.where(keep, s_grid + 1, 0)
@@ -274,13 +301,14 @@ def moe_mlp(
     x: jnp.ndarray,  # [B, S, D]
     layer: Params,  # router [D,E], moe_gate/up [E,D,F], moe_down [E,F,D]
     cfg: MoeConfig,
+    token_mask: Optional[jnp.ndarray] = None,  # [B, S] bool; False = pad
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (out [B,S,D], aux_loss). Dispatch/combine implementation
     selected by ``cfg.dispatch``: "ragged" (default — index-table
     gather/scatter, zero bookkeeping matmul FLOPs) or "einsum" (the
     GShard one-hot form, kept as the reference semantics)."""
     if cfg.dispatch == "ragged":
-        return _moe_mlp_ragged(x, layer, cfg)
+        return _moe_mlp_ragged(x, layer, cfg, token_mask)
     if cfg.dispatch != "einsum":
         raise ValueError(
             f"unknown dispatch {cfg.dispatch!r}; expected 'ragged' or "
@@ -288,7 +316,7 @@ def moe_mlp(
         )
     dtype = x.dtype
     router_logits = _router_logits(x, layer)
-    dispatch, combine, aux = route_tokens(router_logits, cfg)
+    dispatch, combine, aux = route_tokens(router_logits, cfg, token_mask)
 
     # token→expert all-to-all: contraction against expert-sharded
     # operands; GSPMD inserts the collective
@@ -332,6 +360,7 @@ def _moe_mlp_ragged(
     x: jnp.ndarray,  # [B, S, D]
     layer: Params,
     cfg: MoeConfig,
+    token_mask: Optional[jnp.ndarray] = None,  # [B, S] bool; False = pad
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Index-table dispatch: gather tokens into [E,B,C,D], run the
     expert MLPs (identical einsums to the GShard path), scatter-add the
@@ -345,7 +374,7 @@ def _moe_mlp_ragged(
     E = cfg.num_experts
     C = cfg.capacity(S)
 
-    idx, w, aux = route_tables(_router_logits(x, layer), cfg)
+    idx, w, aux = route_tables(_router_logits(x, layer), cfg, token_mask)
 
     flat_idx = idx.reshape(B, E * C)
     valid = (flat_idx >= 0)[..., None].astype(dtype)
@@ -403,7 +432,12 @@ def _moe_decoder_layer(
     x = x + llama._maybe_lora("wo", attn, layer["wo"], lora_layer)
 
     h = rms_norm(x, layer["mlp_norm"], b.rms_norm_eps)
-    moe_out, aux = moe_mlp(h, layer, cfg)
+    # packed batches mark padding with segment id 0 (train/data.py):
+    # those tokens must not consume router capacity or skew the aux
+    moe_out, aux = moe_mlp(
+        h, layer, cfg,
+        token_mask=None if segment_ids is None else segment_ids > 0,
+    )
     # named so the remat policy can pin the combined expert output:
     # the backward needs gate/up for silu' but never the down einsum's
     # value, so saving this skips down + combine in the recompute
@@ -421,6 +455,7 @@ def forward_with_cache(
     positions: jnp.ndarray,  # [B, S]
     kv_mask: Optional[jnp.ndarray] = None,
     lora: Optional[Params] = None,
+    token_mask: Optional[jnp.ndarray] = None,  # [B, S] bool; False = pad
 ) -> tuple[jnp.ndarray, Params]:
     """KV-cached MoE forward (the ``models/generate.py`` decode path).
 
@@ -438,6 +473,17 @@ def forward_with_cache(
     x = jnp.take(params["embed"], tokens, axis=0).astype(b.dtype)
     B, S, D = x.shape
     lora_layers = lora["layers"] if lora is not None else None
+    # Router token-validity: pads must not consume expert capacity
+    # (they would evict real tokens' slots and make padded vs unpadded
+    # execution of the SAME prompt disagree). Callers that know the
+    # window pass ``token_mask`` explicitly; the fallback inference
+    # covers the prefill layout (S>1, cache_index 0 — input positions
+    # map 1:1 onto cache slots, so kv_mask's prompt region IS the
+    # validity mask). Decode steps (S=1) always carry a real token.
+    if token_mask is None:
+        token_mask = (
+            kv_mask[:, :S] if (kv_mask is not None and S > 1) else None
+        )
 
     def body(x, scanned):
         layer, lora_layer, cache_layer = scanned
@@ -460,7 +506,7 @@ def forward_with_cache(
         attn = attn.reshape(B, S, b.q_dim)
         x = x + llama._maybe_lora("wo", attn, layer["wo"], lora_layer)
         h = rms_norm(x, layer["mlp_norm"], b.rms_norm_eps)
-        moe_out, _aux = moe_mlp(h, layer, cfg)
+        moe_out, _aux = moe_mlp(h, layer, cfg, token_mask=token_mask)
         return x + moe_out, new_cache_layer
 
     x, new_cache = jax.lax.scan(

@@ -361,8 +361,10 @@ def serve(
                 handle = eng.submit(
                     prompts[0], stream=True, **_gen_params(req)
                 )
-            except (ValueError, RuntimeError) as e:
+            except ValueError as e:  # caller's request is malformed
                 return self._reply(400, {"error": str(e)})
+            except RuntimeError as e:  # engine died under us → server-side
+                return self._reply(500, {"error": str(e)})
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")

@@ -367,3 +367,43 @@ def test_ragged_dispatch_matches_einsum():
         assert jnp.allclose(e, r, atol=2e-4, rtol=2e-4), (
             float(jnp.abs(e - r).max())
         )
+
+
+def test_padded_routing_matches_unpadded():
+    """token_mask semantics: a bucket-padded batch's REAL tokens route
+    exactly as the unpadded batch would — pads consume no capacity
+    (without the mask they can evict real tokens' expert slots) and
+    write no table entries. Exact check at the routing level, both
+    dispatch representations."""
+    from odh_kubeflow_tpu.models.moe import (
+        MoeConfig,
+        route_tables,
+        route_tokens,
+    )
+
+    cfg = MoeConfig.mixtral_tiny()
+    S_real, S_pad = 5, 16
+    logits_real = jax.random.normal(jax.random.key(7), (2, S_real, 4))
+    # pad with large logits toward expert 0 — the worst case: unmasked
+    # pads would flood expert 0's capacity ahead of nothing, after the
+    # real tokens, but DO steal slots in the cumulative count when a
+    # real token comes after... place pads convincingly by position
+    pad_logits = jnp.zeros((2, S_pad - S_real, 4)).at[..., 0].set(10.0)
+    logits = jnp.concatenate([logits_real, pad_logits], axis=1)
+    mask = jnp.arange(S_pad)[None, :] < S_real
+    mask = jnp.broadcast_to(mask, (2, S_pad))
+
+    d_ref, c_ref, _ = route_tokens(logits_real, cfg)
+    d_pad, c_pad, _ = route_tokens(logits, cfg, token_mask=mask)
+    C_ref = d_ref.shape[-1]
+    # same capacity slots for the real positions; pads fully inert
+    assert jnp.array_equal(d_pad[:, :S_real, :, :C_ref], d_ref)
+    assert jnp.allclose(c_pad[:, :S_real, :, :C_ref], c_ref)
+    assert not bool(d_pad[:, S_real:].any())
+    assert float(jnp.abs(c_pad[:, S_real:]).sum()) == 0.0
+
+    idx, w, _ = route_tables(logits, cfg, token_mask=mask)
+    # every table entry points at a real token (or is empty)
+    assert bool(((idx < S_real)).all())
+    # and the kept assignment count matches the unpadded reference
+    assert int((w > 0).sum()) == int((c_ref > 0).sum())
