@@ -87,7 +87,11 @@ class RemoteAPIServer:
         self._ssl_ctx: Optional[ssl.SSLContext] = None
         if self.base_url.startswith("https"):
             if insecure_skip_tls_verify:
-                ctx = ssl._create_unverified_context()  # noqa: S323 — explicit opt-in, client-go's Insecure flag
+                # explicit opt-in, client-go's Insecure flag — built
+                # from the public API (no ssl._create_unverified_context)
+                ctx = ssl.create_default_context()
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
             else:
                 ctx = ssl.create_default_context(cafile=ca_file)
             if client_cert_file:

@@ -474,6 +474,30 @@ class DecodeEngine:
         )
         self._queue.put(req)
         self._wake.set()
+        # the loop thread may have exited (stop() or a device failure)
+        # between the pre-check above and the put — its final drain
+        # would then never see this request and result() would hang to
+        # its timeout. Re-check and fail the request ourselves; _finish
+        # is idempotent so double-draining with the loop is safe.
+        if self.failure is not None or self._stopped:
+            err = self.failure or RuntimeError("decode engine stopped")
+            try:
+                while True:
+                    q = self._queue.get_nowait()
+                    if q is None:
+                        # stop()'s shutdown sentinel — put it back so the
+                        # loop's early-exit path still sees it
+                        self._queue.put(None)
+                        break
+                    # only requests we drained ourselves are provably
+                    # un-admitted; one the loop already took may be
+                    # completing concurrently and must not get a late
+                    # error write (its drain is the loop's job)
+                    if q.error is None:
+                        q.error = err
+                        q._finish()
+            except queue.Empty:
+                pass
         return req
 
     def stop(self) -> None:

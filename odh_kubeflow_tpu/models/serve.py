@@ -169,8 +169,15 @@ class CompletionService:
         top_k: int = 0,
         top_p: float = 0.0,
         eos_id: Optional[int] = None,
-        seed: int = 0,
+        seed: Optional[int] = None,
     ) -> dict:
+        """``seed`` semantics (API change, round 4): *presence* of a
+        seed — including an explicit 0 — requests per-call reproducible
+        sampling and takes the one-shot path (the engine's shared rng
+        stream cannot honor per-request seeds). Omit it for the
+        continuous-batching path. Previously ``seed: 0`` meant
+        "default/unseeded"; clients that always send it now get
+        deterministic one-shot decodes (and a 400 on streams)."""
         if not prompts or any(not p for p in prompts):
             raise ValueError("prompts must be non-empty token-id lists")
 
@@ -193,7 +200,7 @@ class CompletionService:
         if (
             eng is not None
             and not speculate
-            and seed == 0
+            and seed is None
             and eng.failure is None
             and all(
                 len(p) <= eng.prompt_buckets[-1]
@@ -252,7 +259,7 @@ class CompletionService:
             else:
                 out = self._runner(gen_cfg)(
                     self.params, self.lora, tokens, lengths,
-                    jax.random.key(seed),
+                    jax.random.key(0 if seed is None else seed),
                 )
             toks = jax.device_get(out["tokens"])
             lens = jax.device_get(out["lengths"])
@@ -315,7 +322,11 @@ def serve(
                     return self._stream(prompts, req)
                 result = service.complete(
                     prompts,
-                    seed=int(req.get("seed", 0)),
+                    seed=(
+                        None
+                        if req.get("seed") is None
+                        else int(req["seed"])
+                    ),
                     **_gen_params(req),
                 )
                 self._reply(200, result)
@@ -337,7 +348,7 @@ def serve(
                 return self._reply(
                     400, {"error": "stream requires exactly one prompt"}
                 )
-            if int(req.get("seed", 0)) != 0:
+            if req.get("seed") is not None:
                 # the engine samples from its own rng stream shared by
                 # all slots — a per-request seed cannot be honored;
                 # reject rather than silently ignore (the one-shot
