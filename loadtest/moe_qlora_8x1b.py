@@ -26,8 +26,27 @@ import jax.numpy as jnp
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--capacity-factor", type=float, default=1.25)
+    ap.add_argument(
+        "--dispatch", default="ragged",
+        choices=["ragged", "einsum", "grouped"],
+        help="expert dispatch: 'grouped' is the dropless pallas "
+        "grouped-GEMM (capacity-factor is then irrelevant — nothing "
+        "is ever dropped and nothing is capacity-padded)",
+    )
     ap.add_argument("--batch", type=int, default=2)
     ap.add_argument("--seq", type=int, default=4096)
+    ap.add_argument(
+        "--pin-expert-acts", action="store_true",
+        help="pin gate/up activations as remat residuals (grouped "
+        "dispatch): the backward never re-runs the expert forward "
+        "matmuls, at ~0.5GB/layer residency",
+    )
+    ap.add_argument(
+        "--pin-layers", type=int, default=None,
+        help="with --pin-expert-acts: pin only the last N layers "
+        "(memory budget — all 16 at ~0.5GB each do not fit beside "
+        "the int8 base)",
+    )
     args = ap.parse_args()
 
     from odh_kubeflow_tpu.models import LoraConfig
@@ -41,8 +60,14 @@ def main() -> None:
     peak = peak_flops_per_chip(devices[0])
     mesh = build_mesh(MeshConfig(fsdp=len(devices)), devices)
     cfg = MoeConfig.mixtral_8x1b(
-        base=LlamaConfig.llama3_1b(dtype=jnp.bfloat16, remat_policy="attn"),
+        base=LlamaConfig.llama3_1b(
+            dtype=jnp.bfloat16,
+            remat_policy="attn",
+            remat_pin_layers=args.pin_layers,
+        ),
         capacity_factor=args.capacity_factor,
+        dispatch=args.dispatch,
+        pin_expert_acts=args.pin_expert_acts,
     )
     trainer = Trainer(
         cfg,

@@ -481,23 +481,30 @@ class DecodeEngine:
         # is idempotent so double-draining with the loop is safe.
         if self.failure is not None or self._stopped:
             err = self.failure or RuntimeError("decode engine stopped")
+            saw_sentinel = False
             try:
                 while True:
                     q = self._queue.get_nowait()
                     if q is None:
-                        # stop()'s shutdown sentinel — put it back so the
-                        # loop's early-exit path still sees it
-                        self._queue.put(None)
-                        break
+                        # stop()'s shutdown sentinel — remember it and
+                        # keep draining: our request may sit behind it
+                        # with no live loop left to drain it
+                        saw_sentinel = True
+                        continue
                     # only requests we drained ourselves are provably
                     # un-admitted; one the loop already took may be
                     # completing concurrently and must not get a late
-                    # error write (its drain is the loop's job)
+                    # error write (its drain is the loop's job). Every
+                    # drained request is finished — dropping one here
+                    # would strand its result() to the timeout.
                     if q.error is None:
                         q.error = err
-                        q._finish()
+                    q._finish()
             except queue.Empty:
                 pass
+            if saw_sentinel:
+                # restore it so a still-live loop's early-exit fires
+                self._queue.put(None)
         return req
 
     def stop(self) -> None:

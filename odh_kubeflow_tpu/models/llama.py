@@ -76,6 +76,15 @@ class LlamaConfig:
     # "none": save only layer boundaries and recompute everything
     # (minimum residency, maximum recompute).
     remat_policy: str = "dots"
+    # Memory-budgeted partial pinning: apply ``remat_policy`` to only
+    # the LAST n layers and full recompute ("none") to the rest.
+    # The 8B/16k QLoRA config is the motivating case: all-32 "attn"
+    # pinning needs ~4GB of flash residuals that don't fit beside the
+    # int8 base, but a suffix of layers does — each pinned layer's
+    # backward skips one O(S²) attention recompute. Pinning the
+    # suffix (not prefix) frees residuals earliest in the backward
+    # sweep. None = all layers.
+    remat_pin_layers: Optional[int] = None
 
     @staticmethod
     def llama3_8b(**kw) -> "LlamaConfig":
@@ -504,12 +513,52 @@ def forward(
             pipeline_microbatches,
         )
     else:
-        def body(x, scanned):
-            layer, lora_layer = scanned
-            x, _ = layer_fn(x, layer, lora_layer, sin, cos, segment_ids)
-            return x, None
+        def body_with(fn):
+            def body(x, scanned):
+                layer, lora_layer = scanned
+                x, _ = fn(x, layer, lora_layer, sin, cos, segment_ids)
+                return x, None
 
-        x, _ = jax.lax.scan(body, x, (params["layers"], lora_layers))
+            return body
+
+        pin = cfg.remat_pin_layers
+        if (
+            cfg.remat
+            and cfg.remat_policy != "none"
+            and pin is not None
+            and 0 < pin < cfg.num_layers
+        ):
+            # two scans: a full-recompute prefix and a pinned suffix —
+            # per-layer policies can't vary inside one scan
+            n_first = cfg.num_layers - pin
+            fn_none = _make_layer_fn(
+                dataclasses.replace(cfg, remat_policy="none"), attention_fn
+            )
+            split = lambda t, a, b: (  # noqa: E731
+                None
+                if t is None
+                else jax.tree.map(lambda v: v[a:b], t)
+            )
+            x, _ = jax.lax.scan(
+                body_with(fn_none),
+                x,
+                (
+                    split(params["layers"], 0, n_first),
+                    split(lora_layers, 0, n_first),
+                ),
+            )
+            x, _ = jax.lax.scan(
+                body_with(layer_fn),
+                x,
+                (
+                    split(params["layers"], n_first, cfg.num_layers),
+                    split(lora_layers, n_first, cfg.num_layers),
+                ),
+            )
+        else:
+            x, _ = jax.lax.scan(
+                body_with(layer_fn), x, (params["layers"], lora_layers)
+            )
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     if return_hidden:

@@ -62,8 +62,17 @@ class MoeConfig:
     router_aux_loss_coef: float = 0.02
     # "ragged": index-table gather/scatter dispatch (no O(B·S·E·C·D)
     # bookkeeping matmuls — the small-batch winner); "einsum": the
-    # GShard one-hot reference form
+    # GShard one-hot reference form; "grouped": dropless sorted
+    # grouped-GEMM pallas kernels (ops/pallas_grouped_matmul.py)
     dispatch: str = "ragged"
+    # with remat on, additionally pin the grouped path's gate
+    # activation ("moe_g", [B·S·k, F] bf16 per layer): with frozen
+    # (QLoRA) banks the backward needs g and u only for silu', so
+    # pinning g leaves exactly one recomputed expert matmul (u) —
+    # executed expert units drop 8 → 7 per layer per step at ~M·F
+    # bytes/layer of residency (8×1B @ 4k: ~0.27GB/layer, which fits
+    # beside the int8 base; pinning u as well would not)
+    pin_expert_acts: bool = False
 
     @staticmethod
     def mixtral_tiny(**kw) -> "MoeConfig":
@@ -304,15 +313,32 @@ def moe_mlp(
     token_mask: Optional[jnp.ndarray] = None,  # [B, S] bool; False = pad
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (out [B,S,D], aux_loss). Dispatch/combine implementation
-    selected by ``cfg.dispatch``: "ragged" (default — index-table
-    gather/scatter, zero bookkeeping matmul FLOPs) or "einsum" (the
-    GShard one-hot form, kept as the reference semantics)."""
+    selected by ``cfg.dispatch``: "grouped" (dropless sorted-token
+    pallas grouped-GEMM — the single-chip perf path), "ragged"
+    (default — index-table gather/scatter, zero bookkeeping matmul
+    FLOPs) or "einsum" (the GShard one-hot form, kept as the reference
+    semantics)."""
+    if cfg.dispatch == "grouped":
+        if _grouped_usable(x, cfg):
+            return _moe_mlp_grouped(x, layer, cfg, token_mask)
+        import warnings
+
+        warnings.warn(
+            "dispatch='grouped' fell back to the ragged (capacity) "
+            "path — sharded mesh or tiny batch; capacity_factor "
+            f"{cfg.capacity_factor} dropping applies",
+            stacklevel=2,
+        )
+        # the grouped training path keeps int8 banks quantized; the
+        # ragged einsums need them dequantized
+        layer = llama._maybe_dequant(layer, x.dtype)
+        return _moe_mlp_ragged(x, layer, cfg, token_mask)
     if cfg.dispatch == "ragged":
         return _moe_mlp_ragged(x, layer, cfg, token_mask)
     if cfg.dispatch != "einsum":
         raise ValueError(
-            f"unknown dispatch {cfg.dispatch!r}; expected 'ragged' or "
-            "'einsum'"
+            f"unknown dispatch {cfg.dispatch!r}; expected 'grouped', "
+            "'ragged' or 'einsum'"
         )
     dtype = x.dtype
     router_logits = _router_logits(x, layer)
@@ -375,6 +401,10 @@ def _moe_mlp_ragged(
     C = cfg.capacity(S)
 
     idx, w, aux = route_tables(_router_logits(x, layer), cfg, token_mask)
+    # pinned by the same remat names as the grouped path (tiny): the
+    # backward re-runs gather/experts/scatter but not the routing
+    idx = llama._checkpoint_name(idx, "moe_route_src")
+    w = llama._checkpoint_name(w, "moe_route_w")
 
     flat_idx = idx.reshape(B, E * C)
     valid = (flat_idx >= 0)[..., None].astype(dtype)
@@ -399,6 +429,166 @@ def _moe_mlp_ragged(
     return out, aux
 
 
+def _grouped_usable(x: jnp.ndarray, cfg: MoeConfig) -> bool:
+    """The grouped-GEMM path runs one unpartitioned pallas kernel, so
+    it is the right choice exactly when the expert compute is local:
+    single chip (or a mesh whose model axes are trivial) and enough
+    assignments that the 512-row alignment padding is noise. Decode
+    steps (tiny B·S·k) and expert/tensor/fsdp-sharded meshes fall back
+    to the ragged path, whose einsums GSPMD knows how to shard."""
+    B, S, _ = x.shape
+    if B * S * cfg.num_experts_per_tok < 2048:
+        return False
+    am = jax.sharding.get_abstract_mesh()
+    if not am.empty:
+        for ax in (AXIS_EXPERT, AXIS_TENSOR, AXIS_FSDP, AXIS_DATA):
+            if am.shape.get(ax, 1) > 1:
+                return False
+    return True
+
+
+def route_sorted(
+    router_logits: jnp.ndarray,  # [B, S, E] float32
+    cfg: MoeConfig,
+    token_mask: Optional[jnp.ndarray] = None,  # [B, S] bool; False = pad
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Dropless sorted-by-expert routing for the grouped-GEMM path.
+
+    Returns ``(src [M] int32, w [M] f32, offsets [E+1] int32, aux)``:
+    row ``r`` of the sorted layout reads flat token ``src[r]`` and
+    contributes with combine weight ``w[r]`` (0 on alignment-padding
+    rows); rows ``[offsets[e], offsets[e+1])`` belong to expert ``e``.
+    Every group start is 128-aligned (``pallas_grouped_matmul.ALIGN``)
+    — groups are padded up, never truncated, so *no assignment is ever
+    dropped*: there is no capacity concept at all, which is the whole
+    point vs ``route_tokens``/``route_tables`` (capacity_factor > 1
+    buys zero drops there by computing cf× extra rows; here the only
+    overhead is the ≤127-row pad per expert). M is static:
+    ``round_up(B·S·k + E·128, 512)``. Pad tokens (``token_mask``
+    False) are sorted past every real group with weight 0 — they
+    consume neither expert capacity (there is none) nor aux-loss mass.
+    The tail region beyond the last real group is computed with expert
+    E-1's weights and discarded via w=0 (the kernel's offsets[E] is
+    pinned to M so every row is written — 0·finite, never 0·garbage).
+    """
+    from odh_kubeflow_tpu.ops.pallas_grouped_matmul import (
+        ALIGN,
+        DEFAULT_BM_B,
+    )
+
+    B, S, E = router_logits.shape
+    k = cfg.num_experts_per_tok
+    Na = B * S * k
+    M = -(-(Na + E * ALIGN) // DEFAULT_BM_B) * DEFAULT_BM_B
+    top_p, top_idx, aux_loss = _routing_topk(router_logits, cfg, token_mask)
+
+    mask_flat = (
+        None if token_mask is None else token_mask.reshape(B * S)
+    )
+    tok_ids = jnp.arange(B * S, dtype=jnp.int32)
+
+    # Counting sort, not comparison sort: an XLA sort of B·S·k keys is
+    # ~log²(N) latency-bound passes per layer (and again in the remat
+    # recompute); the one-hot cumsum below is one vectorized pass —
+    # the same trick route_tables uses, with a global (not per-row)
+    # running fill because there is no per-row capacity here.
+    counts = jnp.zeros((E,), jnp.int32)
+    ranks = []  # per slot: position of each token within its expert
+    experts = []
+    for slot in range(k):
+        e_sel = top_idx[..., slot].reshape(B * S)  # [B*S]
+        onehot = jax.nn.one_hot(e_sel, E, dtype=jnp.int32)
+        if mask_flat is not None:
+            onehot = onehot * mask_flat.astype(jnp.int32)[:, None]
+        pos = jnp.cumsum(onehot, axis=0) - onehot + counts[None, :]
+        ranks.append(jnp.take_along_axis(pos, e_sel[:, None], 1)[:, 0])
+        experts.append(e_sel)
+        counts = counts + onehot.sum(axis=0)
+
+    aligned = -(-counts // ALIGN) * ALIGN
+    astarts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(aligned)]
+    ).astype(jnp.int32)
+    offsets = jnp.concatenate(
+        [astarts[:E], jnp.full((1,), M, jnp.int32)]
+    ).astype(jnp.int32)
+
+    src = jnp.zeros((M,), jnp.int32)
+    w = jnp.zeros((M,), jnp.float32)
+    sent_fill = astarts[E]  # pad tokens go past every aligned group
+    for slot in range(k):
+        e_sel, rank = experts[slot], ranks[slot]
+        w_sel = top_p[..., slot].reshape(B * S)
+        if mask_flat is None:
+            dst = astarts[e_sel] + rank
+        else:
+            # masked tokens: rank past the sentinel fill pointer
+            n_masked = jnp.cumsum(~mask_flat) - (~mask_flat)
+            dst = jnp.where(
+                mask_flat,
+                astarts[e_sel] + rank,
+                sent_fill + n_masked,
+            )
+            sent_fill = sent_fill + (~mask_flat).sum()
+            w_sel = jnp.where(mask_flat, w_sel, 0.0)
+        src = src.at[dst].set(tok_ids)
+        w = w.at[dst].set(w_sel)
+    return src, w, offsets, aux_loss
+
+
+def _moe_mlp_grouped(
+    x: jnp.ndarray,  # [B, S, D]
+    layer: Params,
+    cfg: MoeConfig,
+    token_mask: Optional[jnp.ndarray] = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Sorted-token dropless dispatch through the pallas grouped GEMM
+    (``ops/pallas_grouped_matmul.py``): gather tokens into
+    expert-sorted order, run the three expert projections as grouped
+    matmuls that compute every assignment exactly once (no capacity
+    padding — the einsum/ragged paths at cf=1.25 spend 25% of their
+    expert FLOPs on empty capacity slots, which is why their
+    strict-sparse MFU is capped at 0.8·dense), and weighted
+    scatter-add back to token order."""
+    from odh_kubeflow_tpu.ops.pallas_grouped_matmul import gmm
+
+    dtype = x.dtype
+    B, S, D = x.shape
+    src, w, offsets, aux = route_sorted(
+        _router_logits(x, layer), cfg, token_mask
+    )
+    # named so the remat policies can pin them (~200KB/layer): the
+    # backward then re-runs gather→gmm→silu but never the routing
+    # chain (softmax, top-k, cumsum ranking)
+    src = llama._checkpoint_name(src, "moe_route_src")
+    w = llama._checkpoint_name(w, "moe_route_w")
+    offsets = llama._checkpoint_name(offsets, "moe_route_offs")
+    def bank_gmm(lhs, bank):
+        if isinstance(bank, dict):  # int8-native (models/quant.py leaf)
+            # positional args: custom_vjp functions reject kwargs
+            return gmm(lhs, bank["q"], offsets, False, None, bank["scale"])
+        return gmm(lhs, bank.astype(dtype), offsets)
+
+    x_sorted = x.reshape(B * S, D)[src]
+    g = bank_gmm(x_sorted, layer["moe_gate"])
+    u = bank_gmm(x_sorted, layer["moe_up"])
+    g = llama._checkpoint_name(g, "moe_g")
+    u = llama._checkpoint_name(u, "moe_u")
+    h = (jax.nn.silu(g.astype(jnp.float32)) * u.astype(jnp.float32)).astype(
+        dtype
+    )
+    y = bank_gmm(h, layer["moe_down"])
+    contrib = y * w[:, None].astype(dtype)
+    out = (
+        jnp.zeros((B * S, D), dtype)
+        .at[src]
+        .add(contrib)
+        .reshape(B, S, D)
+    )
+    out = constrain(out, llama._activation_spec())
+    return out, aux
+
+
 # ---------------------------------------------------------------------------
 # decoder layer + forward (mirrors llama.forward's API)
 
@@ -413,7 +603,19 @@ def _moe_decoder_layer(
     b = cfg.base
     B, S, D = x.shape
     x = constrain(x, llama._activation_spec())
-    layer = llama._maybe_dequant(layer, b.dtype)
+    if cfg.dispatch == "grouped":
+        # int8 expert banks stay quantized: the grouped kernels read
+        # them natively (half the weight bytes per pass, no dequantized
+        # [E,D,F] bank ever materialised in HBM)
+        banks = {
+            k: layer[k]
+            for k in ("moe_gate", "moe_up", "moe_down")
+            if isinstance(layer[k], dict)
+        }
+        rest = {k: v for k, v in layer.items() if k not in banks}
+        layer = {**llama._maybe_dequant(rest, b.dtype), **banks}
+    else:
+        layer = llama._maybe_dequant(layer, b.dtype)
 
     h = rms_norm(x, layer["attn_norm"], b.rms_norm_eps)
     q = llama._maybe_lora("wq", h, layer["wq"], lora_layer).reshape(
@@ -551,41 +753,53 @@ def forward(
         b, attention_impl=llama.resolved_attention_impl(b)
     )
     attention_fn = llama._select_attention(b)
-    layer_fn = partial(_moe_decoder_layer, cfg, attention_fn)
-    if b.remat:
+    def make_layer_fn(pin_acts: bool, policy: Optional[str] = None):
+        layer_fn = partial(_moe_decoder_layer, cfg, attention_fn)
+        if not b.remat:
+            return layer_fn
+        policy = policy or b.remat_policy
         # same policy vocabulary as the dense family
         # (llama._make_layer_fn), with the MoE extra that "attn" and
         # "dots" also pin the combined expert output: the backward
         # needs gate/up for silu' but never the down einsum's value,
         # so saving "moe_out" drops down + combine + attention from
         # the recompute.
-        names = ["moe_out"] + (
+        names = [
+            "moe_out", "moe_route_src", "moe_route_w", "moe_route_offs",
+        ] + (
+            # "moe_g" alone: with frozen (QLoRA) banks the backward
+            # needs g and u only for silu' — pinning g leaves one
+            # recomputed unit (u) at half the residency of pinning
+            # both, which is what fits beside the int8 base at 4k
+            ["moe_g"] if pin_acts else []
+        ) + (
             ["flash_out", "flash_lse"]
             if b.attention_impl == "flash"
             else ["attn_out"]
         )
         named = jax.checkpoint_policies.save_only_these_names(*names)
-        if b.remat_policy == "none":
-            layer_fn = jax.checkpoint(layer_fn)
-        elif b.remat_policy == "attn":
-            layer_fn = jax.checkpoint(layer_fn, policy=named)
-        elif b.remat_policy == "dots":
+        if policy == "none":
+            return jax.checkpoint(layer_fn)
+        if policy == "attn":
+            return jax.checkpoint(layer_fn, policy=named)
+        if policy == "dots":
             # dense-family semantics (save every matmul output) plus
             # the named kernel residuals. NOTE: at MoE scale the expert
             # einsum outputs are large — mixtral_8x1b's factory
             # defaults its base to "attn" for exactly that reason.
-            layer_fn = jax.checkpoint(
+            return jax.checkpoint(
                 layer_fn,
                 policy=jax.checkpoint_policies.save_from_both_policies(
                     jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
                     named,
                 ),
             )
-        else:
-            raise ValueError(
-                f"unknown remat_policy {b.remat_policy!r}; expected "
-                "'dots', 'attn', or 'none'"
-            )
+        raise ValueError(
+            f"unknown remat_policy {policy!r}; expected "
+            "'dots', 'attn', or 'none'"
+        )
+
+    layer_fn = make_layer_fn(cfg.pin_expert_acts)
     lora_layers = lora["layers"] if lora is not None else None
 
     am = jax.sharding.get_abstract_mesh()
@@ -603,19 +817,67 @@ def forward(
         )
     else:
 
-        def body(carry, scanned):
-            x, aux = carry
-            layer, lora_layer = scanned
-            x, layer_aux = layer_fn(
-                x, layer, lora_layer, sin, cos, segment_ids
-            )
-            return (x, aux + layer_aux), None
+        def body_with(fn):
+            def body(carry, scanned):
+                x, aux = carry
+                layer, lora_layer = scanned
+                x, layer_aux = fn(
+                    x, layer, lora_layer, sin, cos, segment_ids
+                )
+                return (x, aux + layer_aux), None
 
-        (x, aux_total), _ = jax.lax.scan(
-            body,
-            (x, jnp.zeros((), jnp.float32)),
-            (params["layers"], lora_layers),
-        )
+            return body
+
+        carry = (x, jnp.zeros((), jnp.float32))
+        pin = b.remat_pin_layers
+        if (
+            b.remat
+            and b.remat_policy != "none"
+            and pin is not None
+            and 0 < pin < b.num_layers
+        ):
+            # Memory-budgeted suffix pinning (llama semantics): the
+            # LAST ``remat_pin_layers`` layers keep the configured
+            # policy (incl. "moe_g" under pin_expert_acts — freed
+            # earliest in the backward sweep); the prefix drops to the
+            # cheap tier (no "moe_g", or full recompute when
+            # pin_expert_acts is off). Two scans because per-layer
+            # policies can't vary inside one — note the tree slices
+            # COPY the stacked params, so this costs a params-sized
+            # HBM allowance and only pays when the pinned residuals
+            # are the larger term.
+            n_first = b.num_layers - pin
+            sl = lambda t, a, z: (  # noqa: E731
+                None if t is None else jax.tree.map(lambda v: v[a:z], t)
+            )
+            prefix_fn = (
+                make_layer_fn(False)
+                if cfg.pin_expert_acts
+                else make_layer_fn(False, policy="none")
+            )
+            carry, _ = jax.lax.scan(
+                body_with(prefix_fn),
+                carry,
+                (
+                    sl(params["layers"], 0, n_first),
+                    sl(lora_layers, 0, n_first),
+                ),
+            )
+            carry, _ = jax.lax.scan(
+                body_with(layer_fn),
+                carry,
+                (
+                    sl(params["layers"], n_first, b.num_layers),
+                    sl(lora_layers, n_first, b.num_layers),
+                ),
+            )
+        else:
+            carry, _ = jax.lax.scan(
+                body_with(layer_fn),
+                carry,
+                (params["layers"], lora_layers),
+            )
+        x, aux_total = carry
 
     x = rms_norm(x, params["final_norm"], b.rms_norm_eps)
     if return_hidden:

@@ -1,0 +1,549 @@
+"""Pallas TPU grouped matmul (megablocks-style) for dropless MoE.
+
+``gmm(lhs, rhs, group_offsets)`` multiplies row-groups of ``lhs [M, K]``
+against per-group weight matrices ``rhs [E, K, N]``: rows in
+``[group_offsets[e], group_offsets[e+1])`` use expert ``e``. Unlike the
+one-hot (GShard) or capacity-table dispatch in ``models/moe.py``, there
+is **no per-expert capacity**: the caller sorts token assignments by
+expert (padding each group to a 128 multiple) and every assignment is
+computed exactly once — zero drops, zero capacity over-compute. That
+padding discipline is what lets the hot kernel skip all boundary
+masking (see kernel A below).
+
+The reference platform carries no kernels at all (SURVEY.md §2.4); this
+is TPU-native capability on top of it, built for the v5e memory system:
+
+- **Kernel A** (contraction dim K ≤ ~2048, e.g. the gate/up projection
+  D→F): grid ``(n_tiles, m_tiles)`` with the *expert weight block
+  resident in VMEM* across each group's row tiles (consecutive m tiles
+  share a group, so Mosaic re-uses the fetched block) while 128-row lhs
+  tiles stream through. K is not split, so there is no accumulator
+  scratch. Requires every group boundary 128-aligned — then every lhs
+  tile belongs to exactly one group and the kernel has no masks at all.
+- **Kernel B** (K large, output dim N ≤ 4096, e.g. the down projection
+  F→D and the backward dlhs of gate/up): K is split into ``bk`` blocks
+  accumulated in a full-width ``(bm, N)`` f32 scratch. Row tiles are
+  512 wide, so a tile may span several groups; the grid runs over
+  (tile × group) *span pairs* with scalar-prefetched metadata, masking
+  lhs rows outside the pair's group and writing the tile out once, on
+  its last pair. Unwritten grid visits flush whatever the rotating
+  VMEM buffer holds, so pad pairs target a dedicated dummy tile row
+  (the output carries one extra ``bm`` row block the caller slices off).
+- **tgmm** computes the weight gradient ``drhs[e] = lhsᵀ · doutᵀ`` per
+  group with the same span-pair walk (k, n outer; pairs inner) and a
+  per-group f32 accumulator; empty groups get a singleton pair that
+  writes zeros (their block would otherwise be uninitialised HBM). With
+  frozen expert banks (the QLoRA recipe) the whole tgmm is dead code —
+  XLA removes it because ``grad`` never requests those cotangents.
+
+``trans_rhs`` reads ``rhs`` stored as ``[E, N, K]`` (an expert weight
+bank used "backwards", as in dlhs = dout · Wᵀ) without materialising a
+256 MB transposed copy in HBM — the dot contracts the trailing axis of
+both operands and Mosaic handles the in-VMEM layout.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# group boundaries are padded to this (kernel A's row tile); kernel B's
+# row tile must be a multiple of it
+ALIGN = 128
+
+DEFAULT_BM_B = 512
+DEFAULT_BK_B = 1024
+DEFAULT_BN_B = 1024
+DEFAULT_BK_T = 512
+DEFAULT_BN_T = 512
+# kernel A's contraction limit: (128, K) lhs + (K, bn) rhs blocks must
+# double-buffer in ~16MB VMEM
+MAX_K_A = 4096
+# kernel B's scratch is (bm, N) f32
+MAX_N_B = 4096
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+# ---------------------------------------------------------------------------
+# span-pair metadata (traced; E- and tile-count-sized arrays only)
+
+
+def span_pairs(group_offsets: jnp.ndarray, m: int, bm: int,
+               include_empty: bool) -> dict[str, jnp.ndarray]:
+    """Tile×group span pairs for kernels that walk ``bm``-row tiles.
+
+    ``group_offsets`` is [E+1] int32 with ``offsets[0]=0``,
+    ``offsets[E]=m``, every entry ALIGN-aligned. A *pair* is a (row
+    tile, group) intersection; listing pairs in offset order makes
+    consecutive pairs of one tile adjacent (so output-buffer revisits
+    are consecutive — a Mosaic requirement) and consecutive pairs of
+    one group adjacent (so weight blocks stay resident).
+
+    Static length: T + E pairs (T = m // bm), padded with inert pairs
+    (group = E, the dummy; tile = T, the dummy out row). With
+    ``include_empty``, zero-size groups still get a pair (tgmm must
+    write zeros to their gradient block); without it they are skipped
+    (kernel B writes rows, and empty groups own none).
+
+    Returns int32 arrays of length L = T + E:
+      ``tile``   lhs/out row-tile index (clamped real tile for inert
+                 pairs — inputs may be read, masks zero them out)
+      ``otile``  kernel B's out row tile: ``tile`` or the dummy T
+      ``group``  expert id, E for inert pads
+      ``write``  1 on the last pair of each real tile (kernel B writes)
+      ``gfirst``/``glast`` group-accumulation boundaries (tgmm)
+    """
+    E = group_offsets.shape[0] - 1
+    T = m // bm
+    L = T + E
+    starts = group_offsets[:-1]
+    ends = group_offsets[1:]
+    sizes = ends - starts
+    nonempty = sizes > 0
+    # tiles spanned by each group (0 for empty groups unless included)
+    first_tile = starts // bm
+    last_tile = jnp.where(nonempty, (ends - 1) // bm, first_tile)
+    ntiles = jnp.where(nonempty, last_tile - first_tile + 1, 0)
+    if include_empty:
+        ntiles = jnp.maximum(ntiles, 1)
+    cum = jnp.cumsum(ntiles)  # pairs before group e+1
+    total = cum[-1]
+    i = jnp.arange(L, dtype=jnp.int32)
+    # group of pair i: first g with cum[g] > i; pads get E
+    group = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
+    pad = i >= total
+    group_c = jnp.minimum(group, E - 1)
+    within = i - jnp.where(group_c > 0, cum[group_c - 1], 0)
+    tile = jnp.clip(first_tile[group_c] + within, 0, T - 1)
+    group = jnp.where(pad, E, group_c)
+    # write: last pair of its tile — next pair has a different tile (or
+    # is a pad). Pads never write. Empty-group pairs sit at their
+    # offset's tile but their mask is empty; they must not steal the
+    # write flag, so exclude them from tile ownership.
+    owns = ~pad & (sizes[group_c] > 0)
+    nxt_tile = jnp.concatenate([tile[1:], jnp.full((1,), -1, jnp.int32)])
+    nxt_owns = jnp.concatenate([owns[1:], jnp.zeros((1,), bool)])
+    write = (owns & ((nxt_tile != tile) | ~nxt_owns)).astype(jnp.int32)
+    otile = jnp.where(owns, tile, T).astype(jnp.int32)
+    # group accumulation boundaries (tgmm): compare neighbour groups
+    prv_group = jnp.concatenate([jnp.full((1,), -1, jnp.int32), group[:-1]])
+    nxt_group = jnp.concatenate([group[1:], jnp.full((1,), -2, jnp.int32)])
+    gfirst = (group != prv_group).astype(jnp.int32)
+    glast = (group != nxt_group).astype(jnp.int32)
+    return {
+        "tile": tile.astype(jnp.int32),
+        "otile": otile,
+        "group": group.astype(jnp.int32),
+        "write": write,
+        "gfirst": gfirst,
+        "glast": glast,
+    }
+
+
+# ---------------------------------------------------------------------------
+# kernel A: small K, rhs-resident, maskless
+
+
+def _gmm_a_kernel(gid_ref, lhs_ref, rhs_ref, out_ref, *, trans_rhs):
+    rhs = rhs_ref[0].astype(lhs_ref.dtype)
+    dn = (((1,), (1,)), ((), ())) if trans_rhs else (((1,), (0,)), ((), ()))
+    acc = jax.lax.dot_general(
+        lhs_ref[...], rhs, dn, preferred_element_type=jnp.float32
+    )
+    out_ref[...] = acc.astype(out_ref.dtype)
+
+
+def _gmm_a_kernel_q(gid_ref, lhs_ref, rhs_ref, scale_ref, out_ref, *,
+                    trans_rhs):
+    """int8 bank variant: the per-output-channel scale (bank's last
+    axis — ``models/quant.py``) factors out of the contraction, so the
+    weight block is convert-only and one cheap vector multiply lands
+    on the f32 accumulator (non-trans) or the streamed lhs tile
+    (trans, where the scaled axis is the contraction)."""
+    rhs = rhs_ref[0].astype(lhs_ref.dtype)
+    dn = (((1,), (1,)), ((), ())) if trans_rhs else (((1,), (0,)), ((), ()))
+    if trans_rhs:
+        lhs = lhs_ref[...] * scale_ref[0, 0][None, :].astype(lhs_ref.dtype)
+        acc = jax.lax.dot_general(
+            lhs, rhs, dn, preferred_element_type=jnp.float32
+        )
+    else:
+        acc = jax.lax.dot_general(
+            lhs_ref[...], rhs, dn, preferred_element_type=jnp.float32
+        )
+        acc = acc * scale_ref[0, 0][None, :]
+    out_ref[...] = acc.astype(out_ref.dtype)
+
+
+def _gmm_a(lhs, rhs, group_of_tile, *, trans_rhs, interpret,
+           scale=None):
+    m, k = lhs.shape
+    E = rhs.shape[0]
+    n = rhs.shape[1] if trans_rhs else rhs.shape[2]
+    # resident weight block ≤4MB so it double-buffers beside the
+    # streaming lhs tiles in ~16MB VMEM — int8 banks fit 2× the
+    # columns. Largest lane-aligned divisor of n that fits the budget
+    # (trace-time loop, ≤ n/128 iterations).
+    budget = 4 * 1024 * 1024 // (k * rhs.dtype.itemsize)
+    bn = n  # sub-ALIGN n (tiny test shapes) runs as one block
+    for cand in range(ALIGN, min(n, budget) + 1, ALIGN):
+        if n % cand == 0:
+            bn = cand
+    assert n % bn == 0, f"N={n} has no legal block under K={k}"
+    T = m // ALIGN
+    rhs_block = (1, bn, k) if trans_rhs else (1, k, bn)
+    rhs_idx = (
+        (lambda ni, t, g: (g[t], ni, 0))
+        if trans_rhs
+        else (lambda ni, t, g: (g[t], 0, ni))
+    )
+    grid = (n // bn, T)
+    in_specs = [
+        pl.BlockSpec((ALIGN, k), lambda ni, t, g: (t, 0)),
+        pl.BlockSpec(rhs_block, rhs_idx),
+    ]
+    operands = [group_of_tile, lhs, rhs]
+    if scale is None:
+        kernel = functools.partial(_gmm_a_kernel, trans_rhs=trans_rhs)
+    else:
+        kernel = functools.partial(_gmm_a_kernel_q, trans_rhs=trans_rhs)
+        scale_block = (1, 1, k) if trans_rhs else (1, 1, bn)
+        scale_idx = (
+            (lambda ni, t, g: (g[t], 0, 0))
+            if trans_rhs
+            else (lambda ni, t, g: (g[t], 0, ni))
+        )
+        in_specs.append(pl.BlockSpec(scale_block, scale_idx))
+        operands.append(scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((ALIGN, bn), lambda ni, t, g: (t, ni)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, n), lhs.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*operands)
+
+
+# ---------------------------------------------------------------------------
+# kernel B: split K, span pairs, full-width scratch
+
+
+def _gmm_b_kernel(
+    tile_ref, otile_ref, group_ref, write_ref, offs_ref,
+    lhs_ref, rhs_ref, *rest, bm, bn, nk, trans_rhs,
+):
+    if len(rest) == 3:
+        scale_ref, out_ref, acc_ref = rest
+    else:
+        (out_ref, acc_ref), scale_ref = rest, None
+    """Grid is (pairs, n, k) with k innermost: for one (pair, n-tile)
+    the k loop accumulates into scratch slice ``acc_ref[ni]`` and the
+    out block index stays constant, so every output block's visits are
+    consecutive and it is written exactly once (on its tile's last
+    pair, final k step). The scratch's leading axis is the n-tile —
+    indexing it is a major-dim dynamic slice (lane-dim dynamic slices
+    are not a Mosaic-friendly pattern); all n slices persist across
+    pairs so a boundary tile's earlier pairs survive until the
+    tile-closing pair merges and writes."""
+    i = pl.program_id(0)
+    ni = pl.program_id(1)
+    ki = pl.program_id(2)
+    g = group_ref[i]
+    t = tile_ref[i]
+    start = offs_ref[g]
+    end = offs_ref[g + 1]
+    rows = t * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+    mask = jnp.logical_and(rows >= start, rows < end)
+    lhs = jnp.where(mask, lhs_ref[...], 0).astype(lhs_ref.dtype)
+    if scale_ref is not None and trans_rhs:
+        # int8 bank used backwards: the scaled axis is the contraction
+        lhs = lhs * scale_ref[0, 0][None, :].astype(lhs.dtype)
+    rhs = rhs_ref[0].astype(lhs_ref.dtype)
+    dn = (((1,), (1,)), ((), ())) if trans_rhs else (((1,), (0,)), ((), ()))
+    d = jax.lax.dot_general(lhs, rhs, dn, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == 0)
+    def _init():
+        # keep earlier pairs' rows of this tile; lhs is already zeroed
+        # outside the mask so d carries no stale contribution
+        acc_ref[ni] = jnp.where(mask, d, acc_ref[ni])
+
+    @pl.when(ki > 0)
+    def _accum():
+        acc_ref[ni] = acc_ref[ni] + d
+
+    @pl.when(jnp.logical_and(ki == nk - 1, write_ref[i] == 1))
+    def _write():
+        acc = acc_ref[ni]
+        if scale_ref is not None and not trans_rhs:
+            # int8 bank forwards: per-output-column scale on the f32
+            # accumulator, once per written block
+            acc = acc * scale_ref[0, 0][None, :]
+        out_ref[...] = acc.astype(out_ref.dtype)
+
+
+def _gmm_b(lhs, rhs, pairs, group_offsets, *, trans_rhs, bm, bk, bn,
+           interpret, scale=None):
+    m, k = lhs.shape
+    E = rhs.shape[0]
+    n = rhs.shape[1] if trans_rhs else rhs.shape[2]
+    bk = min(bk, k)
+    bn = min(bn, n)
+    assert k % bk == 0 and n % bn == 0, (k, bk, n, bn)
+    nk = k // bk
+    L = pairs["tile"].shape[0]
+    rhs_block = (1, bn, bk) if trans_rhs else (1, bk, bn)
+    # inert pairs carry the dummy group E — clamp the *fetch* index to a
+    # real block (their mask zeroes the compute; an out-of-bounds block
+    # index is a hard TPU fault, though interpret mode tolerates it)
+    rhs_idx = (
+        (lambda i, ni, ki, t, ot, g, w, o: (jnp.minimum(g[i], E - 1), ni, ki))
+        if trans_rhs
+        else (lambda i, ni, ki, t, ot, g, w, o: (jnp.minimum(g[i], E - 1), ki, ni))
+    )
+    # offsets extended so the dummy group E is empty: offs[E+1] = offs[E]
+    offs = jnp.concatenate([group_offsets, group_offsets[-1:]])
+    in_specs = [
+        pl.BlockSpec(
+            (bm, bk), lambda i, ni, ki, t, ot, g, w, o: (t[i], ki)
+        ),
+        pl.BlockSpec(rhs_block, rhs_idx),
+    ]
+    operands = [
+        pairs["tile"], pairs["otile"], pairs["group"], pairs["write"],
+        offs, lhs, rhs,
+    ]
+    if scale is not None:
+        # scaled axis is the bank's last: output columns (non-trans,
+        # applied at write) or the contraction (trans, prescaled)
+        scale_block = (1, 1, bk) if trans_rhs else (1, 1, bn)
+        scale_idx = (
+            (lambda i, ni, ki, t, ot, g, w, o:
+             (jnp.minimum(g[i], E - 1), 0, ki))
+            if trans_rhs
+            else (lambda i, ni, ki, t, ot, g, w, o:
+                  (jnp.minimum(g[i], E - 1), 0, ni))
+        )
+        in_specs.append(pl.BlockSpec(scale_block, scale_idx))
+        operands.append(scale)
+    out = pl.pallas_call(
+        functools.partial(
+            _gmm_b_kernel, bm=bm, bn=bn, nk=nk, trans_rhs=trans_rhs
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(L, n // bn, nk),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (bm, bn), lambda i, ni, ki, t, ot, g, w, o: (ot[i], ni)
+            ),
+            scratch_shapes=[pltpu.VMEM((n // bn, bm, bn), jnp.float32)],
+        ),
+        # one extra bm-row dummy block absorbs inert pairs' buffer flushes
+        out_shape=jax.ShapeDtypeStruct((m + bm, n), lhs.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*operands)
+    return out[:m]
+
+
+# ---------------------------------------------------------------------------
+# tgmm: per-group weight gradient
+
+
+def _tgmm_kernel(
+    tile_ref, group_ref, gfirst_ref, glast_ref, offs_ref,
+    lhs_ref, dout_ref, out_ref, acc_ref, *, bm,
+):
+    i = pl.program_id(2)
+    g = group_ref[i]
+    t = tile_ref[i]
+    start = offs_ref[g]
+    end = offs_ref[g + 1]
+    rows = t * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+    mask = jnp.logical_and(rows >= start, rows < end)
+    lhs = jnp.where(mask, lhs_ref[...], 0).astype(lhs_ref.dtype)
+    # (bk, bn) = lhsᵀ · dout, contracting the bm rows
+    d = jax.lax.dot_general(
+        lhs, dout_ref[...], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(gfirst_ref[i] == 1)
+    def _init():
+        acc_ref[...] = d
+
+    @pl.when(gfirst_ref[i] == 0)
+    def _accum():
+        acc_ref[...] = acc_ref[...] + d
+
+    @pl.when(glast_ref[i] == 1)
+    def _write():
+        out_ref[0] = acc_ref[...].astype(out_ref.dtype)
+
+
+def _tgmm(lhs, dout, pairs, group_offsets, *, bm, bk, bn, interpret):
+    m, k = lhs.shape
+    n = dout.shape[1]
+    bk = min(bk, k)
+    bn = min(bn, n)
+    assert k % bk == 0 and n % bn == 0, (k, bk, n, bn)
+    E = group_offsets.shape[0] - 1
+    L = pairs["tile"].shape[0]
+    offs = jnp.concatenate([group_offsets, group_offsets[-1:]])
+    out = pl.pallas_call(
+        functools.partial(_tgmm_kernel, bm=bm),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(k // bk, n // bn, L),
+            in_specs=[
+                pl.BlockSpec(
+                    (bm, bk), lambda ki, ni, i, t, g, gf, gl, o: (t[i], ki)
+                ),
+                pl.BlockSpec(
+                    (bm, bn), lambda ki, ni, i, t, g, gf, gl, o: (t[i], ni)
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, bk, bn), lambda ki, ni, i, t, g, gf, gl, o: (g[i], ki, ni)
+            ),
+            scratch_shapes=[pltpu.VMEM((bk, bn), jnp.float32)],
+        ),
+        # dummy group E absorbs inert pairs' flushes
+        out_shape=jax.ShapeDtypeStruct((E + 1, k, n), dout.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        pairs["tile"], pairs["group"], pairs["gfirst"], pairs["glast"],
+        offs, lhs, dout,
+    )
+    return out[:E]
+
+
+# ---------------------------------------------------------------------------
+# public op
+
+
+def _gmm_fwd_impl(lhs, rhs, group_offsets, *, trans_rhs, interpret,
+                  scale=None):
+    m, k = lhs.shape
+    E = rhs.shape[0]
+    n = rhs.shape[1] if trans_rhs else rhs.shape[2]
+    assert m % DEFAULT_BM_B == 0, f"M={m} must be a {DEFAULT_BM_B} multiple"
+    # kernel A holds a (K, bn) weight block double-buffered in ~16MB
+    # VMEM; scale the K limit down for wider dtypes (f32 tests) so a
+    # legal-on-CPU shape can't oversubscribe VMEM on hardware
+    max_k_a = MAX_K_A * 2 // max(lhs.dtype.itemsize, rhs.dtype.itemsize)
+    if k <= max_k_a:
+        tiles = jnp.arange(m // ALIGN, dtype=jnp.int32) * ALIGN
+        # ALIGN-aligned boundaries ⇒ each 128-row tile has one group
+        group_of_tile = (
+            jnp.searchsorted(group_offsets[1:-1], tiles, side="right")
+            .astype(jnp.int32)
+        )
+        return _gmm_a(
+            lhs, rhs, group_of_tile, trans_rhs=trans_rhs,
+            interpret=interpret, scale=scale,
+        )
+    if n > MAX_N_B:
+        raise NotImplementedError(
+            f"gmm: K={k} > {MAX_K_A} and N={n} > {MAX_N_B} — no kernel "
+            "shape fits VMEM; reshape the problem"
+        )
+    pairs = span_pairs(group_offsets, m, DEFAULT_BM_B, include_empty=False)
+    return _gmm_b(
+        lhs, rhs, pairs, group_offsets, trans_rhs=trans_rhs,
+        bm=DEFAULT_BM_B, bk=DEFAULT_BK_B, bn=DEFAULT_BN_B,
+        interpret=interpret, scale=scale,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def gmm(lhs, rhs, group_offsets, trans_rhs=False,
+        interpret: Optional[bool] = None, scale=None):
+    """Grouped matmul: rows ``[offsets[e], offsets[e+1])`` of ``lhs``
+    through ``rhs[e]``. Offsets must be ALIGN-aligned with
+    ``offsets[0] = 0`` and ``offsets[E] = M`` (the caller's sort pads
+    groups — ``models/moe.py`` ``route_sorted``). Returns [M, N] in
+    ``lhs.dtype``; differentiable in ``lhs`` and ``rhs``.
+
+    ``scale`` enables int8-native banks: ``rhs`` int8 with the
+    per-output-channel scale [E, 1, bank-last-axis] from
+    ``models/quant.py`` — the kernel reads half the weight bytes and
+    never materialises a dequantized bank in HBM. Weight gradients are
+    not defined through the quantized path (frozen banks — QLoRA)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    return _gmm_fwd_impl(
+        lhs, rhs, group_offsets, trans_rhs=trans_rhs, interpret=interpret,
+        scale=scale,
+    )
+
+
+def _gmm_fwd(lhs, rhs, group_offsets, trans_rhs, interpret, scale):
+    if interpret is None:
+        interpret = _interpret_default()
+    out = _gmm_fwd_impl(
+        lhs, rhs, group_offsets, trans_rhs=trans_rhs, interpret=interpret,
+        scale=scale,
+    )
+    return out, (lhs, rhs, group_offsets, scale)
+
+
+def _gmm_bwd(trans_rhs, interpret, res, dout):
+    lhs, rhs, group_offsets, scale = res
+    if interpret is None:
+        interpret = _interpret_default()
+    # dlhs = dout · rhsᵀ — the same grouped matmul with rhs read
+    # "the other way", so the two trans_rhs variants are each other's
+    # backward and no transposed weight copy ever hits HBM
+    dlhs = _gmm_fwd_impl(
+        dout.astype(lhs.dtype), rhs, group_offsets,
+        trans_rhs=not trans_rhs, interpret=interpret, scale=scale,
+    )
+    if scale is not None:
+        # int8 banks are frozen (QLoRA): no weight cotangents
+        return dlhs, None, None, jnp.zeros_like(scale)
+    E = rhs.shape[0]
+    m = lhs.shape[0]
+    pairs = span_pairs(group_offsets, m, DEFAULT_BM_B, include_empty=True)
+    if trans_rhs:
+        # rhs layout [E, N, K]: drhs[e] = doutᵀ · lhs
+        drhs = _tgmm(
+            dout.astype(lhs.dtype), lhs, pairs, group_offsets,
+            bm=DEFAULT_BM_B, bk=DEFAULT_BK_T, bn=DEFAULT_BN_T,
+            interpret=interpret,
+        ).astype(rhs.dtype)
+    else:
+        drhs = _tgmm(
+            lhs, dout.astype(lhs.dtype), pairs, group_offsets,
+            bm=DEFAULT_BM_B, bk=DEFAULT_BK_T, bn=DEFAULT_BN_T,
+            interpret=interpret,
+        ).astype(rhs.dtype)
+    return dlhs, drhs, None, None
+
+
+gmm.defvjp(_gmm_fwd, _gmm_bwd)
