@@ -30,6 +30,7 @@ inference path); the design is the standard TPU serving pattern
 from __future__ import annotations
 
 import dataclasses
+import functools
 import queue
 import threading
 from typing import Any, Optional, Sequence
@@ -150,6 +151,8 @@ class DecodeEngine:
         pad_id: int = 0,
         cache_dtype=jnp.bfloat16,
         seed: int = 0,
+        prefix_cache_entries: int = 0,
+        prefix_buckets: Sequence[int] = (256, 512),
     ):
         self.params = params
         self.cfg = cfg
@@ -159,6 +162,15 @@ class DecodeEngine:
         self.chunk = chunk
         self.prompt_buckets = tuple(sorted(prompt_buckets))
         self.pad_id = pad_id
+        # prompt-prefix KV reuse: entries keyed on the token tuple of a
+        # bucketed prefix; admission with a hit prefills only the
+        # remainder (a shared system prompt stops being re-prefilled
+        # per request). LRU, host-managed, device-resident KV slices.
+        self.prefix_cache_entries = prefix_cache_entries
+        self.prefix_buckets = tuple(sorted(prefix_buckets))
+        self._prefix_cache: "dict[tuple, dict]" = {}
+        self.prefix_hits = 0
+        self.prefix_misses = 0
 
         cache_cfg, self._fwd = family_forward(cfg)
         S = n_slots
@@ -189,10 +201,46 @@ class DecodeEngine:
         self._stopped = False
         self._prefill_fns: dict[int, Any] = {}
         self._decode_fn = jax.jit(self._decode_chunk, donate_argnums=1)
+        self._decode_greedy_fn = jax.jit(
+            functools.partial(self._decode_chunk, greedy=True),
+            donate_argnums=1,
+        )
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     # -- jitted programs ----------------------------------------------------
+
+    def _write_slot_state(self, state, sub_cache, kv_mask1, slot, first,
+                          total, req_vec, rng):
+        """Splice a freshly prefilled (sub_cache, kv_mask) into ``slot``
+        and arm its per-request decode fields — shared by the cold and
+        prefix-cache admission paths so their semantics cannot drift."""
+        max_tokens, temp, top_k, top_p, eos = req_vec
+        st = dict(state)
+        st["rng"] = rng
+        st["cache"] = {
+            kv: jax.lax.dynamic_update_slice(
+                state["cache"][kv], sub_cache[kv], (0, slot, 0, 0, 0)
+            )
+            for kv in ("k", "v")
+        }
+        st["kv_mask"] = jax.lax.dynamic_update_slice(
+            state["kv_mask"], kv_mask1, (slot, 0)
+        )
+        at = lambda name, v: state[name].at[slot].set(v)  # noqa: E731
+        st["cur_token"] = at("cur_token", first)
+        st["write_idx"] = at("write_idx", total)
+        st["pos"] = at("pos", total)
+        # the prefill itself emits the first token
+        st["remaining"] = at("remaining", max_tokens - 1)
+        finished = (max_tokens <= 1) | (first == eos)
+        st["active"] = at("active", ~finished)
+        st["temp"] = at("temp", temp)
+        st["top_k"] = at("top_k", top_k)
+        st["top_p"] = at("top_p", top_p)
+        st["eos"] = at("eos", eos)
+        return st, first
+
 
     def _prefill(self, params, lora, state, prompt, length, slot, req_vec):
         """Prefill one prompt (batch 1, S_bucket wide) into ``slot``.
@@ -220,33 +268,11 @@ class DecodeEngine:
         first = sample_logits_rowwise(
             last, sub, temp[None], top_k[None], top_p[None]
         )[0]
-
-        st = dict(state)
-        st["rng"] = rng
-        st["cache"] = {
-            kv: jax.lax.dynamic_update_slice(
-                state["cache"][kv], sub_cache[kv], (0, slot, 0, 0, 0)
-            )
-            for kv in ("k", "v")
-        }
-        st["kv_mask"] = jax.lax.dynamic_update_slice(
-            state["kv_mask"], kv_mask1, (slot, 0)
+        return self._write_slot_state(
+            state, sub_cache, kv_mask1, slot, first, length, req_vec, rng
         )
-        at = lambda name, v: state[name].at[slot].set(v)  # noqa: E731
-        st["cur_token"] = at("cur_token", first)
-        st["write_idx"] = at("write_idx", length)
-        st["pos"] = at("pos", length)
-        # the prefill itself emits the first token
-        st["remaining"] = at("remaining", max_tokens - 1)
-        finished = (max_tokens <= 1) | (first == eos)
-        st["active"] = at("active", ~finished)
-        st["temp"] = at("temp", temp)
-        st["top_k"] = at("top_k", top_k)
-        st["top_p"] = at("top_p", top_p)
-        st["eos"] = at("eos", eos)
-        return st, first
 
-    def _decode_chunk(self, params_lora, state):
+    def _decode_chunk(self, params_lora, state, *, greedy: bool = False):
         params, lora = params_lora
 
         def step(st, _):
@@ -268,9 +294,18 @@ class DecodeEngine:
                 lora=lora,
             )
             rng, sub = jax.random.split(st["rng"])
-            nxt = sample_logits_rowwise(
-                logits[:, 0, :], sub, st["temp"], st["top_k"], st["top_p"]
-            )
+            if greedy:
+                # all active slots are temperature<=0: skip the two
+                # full-vocab sorts of the general sampler — at V=128k
+                # they rival the model forward itself in a decode step
+                nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(
+                    jnp.int32
+                )
+            else:
+                nxt = sample_logits_rowwise(
+                    logits[:, 0, :], sub, st["temp"], st["top_k"],
+                    st["top_p"],
+                )
             remaining = st["remaining"] - active.astype(jnp.int32)
             finished = (nxt == st["eos"]) | (remaining <= 0)
             new_active = active & ~finished
@@ -299,6 +334,45 @@ class DecodeEngine:
         )
         return state, (toks.T, mask.T)  # [n_slots, chunk] each
 
+    def _prefill_ext(
+        self, params, lora, state, prefix_kv, prompt_rem, rem_len, slot,
+        req_vec, *, plen: int,
+    ):
+        """Prefill with a cached prefix: ``prefix_kv`` (k/v
+        [L, 1, plen, Hkv, hd], a prefix-cache entry) seeds the slot's
+        cache and only the remainder tokens run through the model, at
+        positions/cache offset ``plen`` (static — one compile per
+        (prefix bucket, remainder bucket))."""
+        max_tokens, temp, top_k, top_p, eos = req_vec
+        cache_cfg, _ = family_forward(self.cfg)
+        S_b = prompt_rem.shape[1]
+        sub_cache = init_cache(
+            cache_cfg, 1, self.max_len, state["cache"]["k"].dtype
+        )
+        sub_cache = {
+            kv: sub_cache[kv].at[:, :, :plen].set(prefix_kv[kv])
+            for kv in ("k", "v")
+        }
+        total = plen + rem_len
+        slots_row = jnp.arange(self.max_len, dtype=jnp.int32)[None, :]
+        kv_mask1 = slots_row < total
+        positions = plen + jnp.arange(S_b, dtype=jnp.int32)[None, :]
+        logits, sub_cache = self._fwd(
+            params, prompt_rem, self.cfg, sub_cache, jnp.int32(plen),
+            positions=positions, kv_mask=kv_mask1, lora=lora,
+            token_mask=(jnp.arange(S_b, dtype=jnp.int32) < rem_len)[None],
+        )
+        last = jnp.take_along_axis(
+            logits, (rem_len - 1)[None, None, None], axis=1
+        )[:, 0, :]
+        rng, sub = jax.random.split(state["rng"])
+        first = sample_logits_rowwise(
+            last, sub, temp[None], top_k[None], top_p[None]
+        )[0]
+        return self._write_slot_state(
+            state, sub_cache, kv_mask1, slot, first, total, req_vec, rng
+        )
+
     # -- engine loop --------------------------------------------------------
 
     def _prefill_runner(self, bucket: int):
@@ -308,28 +382,91 @@ class DecodeEngine:
             )
         return self._prefill_fns[bucket]
 
+    def _prefill_ext_runner(self, plen: int, bucket: int):
+        key = (plen, bucket)
+        if key not in self._prefill_fns:
+            self._prefill_fns[key] = jax.jit(
+                functools.partial(self._prefill_ext, plen=plen),
+                donate_argnums=2,
+            )
+        return self._prefill_fns[key]
+
+    def _match_prefix(self, prompt: list[int]):
+        """Longest cached bucketed prefix strictly shorter than the
+        prompt (the remainder must be non-empty — the model still has
+        to produce the first next-token logits)."""
+        if not self.prefix_cache_entries:
+            return None, None
+        for pb in reversed(self.prefix_buckets):
+            if len(prompt) <= pb:
+                continue
+            key = (pb, tuple(prompt[:pb]))
+            entry = self._prefix_cache.get(key)
+            if entry is not None:
+                # LRU touch
+                self._prefix_cache[key] = self._prefix_cache.pop(key)
+                return pb, entry
+        return None, None
+
+    def _maybe_insert_prefix(self, prompt: list[int], slot: int) -> None:
+        """After a cold prefill, remember the prompt's bucketed prefix
+        KV (sliced out of the slot's freshly written cache) so the
+        next request sharing it skips that prefill work."""
+        if not self.prefix_cache_entries:
+            return
+        for pb in reversed(self.prefix_buckets):
+            if len(prompt) <= pb:
+                continue
+            key = (pb, tuple(prompt[:pb]))
+            if key in self._prefix_cache:
+                return
+            entry = {
+                kv: jax.lax.dynamic_slice_in_dim(
+                    jax.lax.dynamic_slice_in_dim(
+                        self._state["cache"][kv], slot, 1, axis=1
+                    ),
+                    0, pb, axis=2,
+                )
+                for kv in ("k", "v")
+            }
+            while len(self._prefix_cache) >= self.prefix_cache_entries:
+                self._prefix_cache.pop(next(iter(self._prefix_cache)))
+            self._prefix_cache[key] = entry
+            return
+
     def _admit(self, req: _Request) -> None:
         slot = self._slot_req.index(None)
         L = len(req.prompt)
-        bucket = next(b for b in self.prompt_buckets if L <= b)
-        prompt = jnp.asarray(
-            [req.prompt + [self.pad_id] * (bucket - L)], jnp.int32
+        req_vec = (
+            jnp.int32(req.max_tokens),
+            jnp.float32(req.temperature),
+            jnp.int32(req.top_k),
+            jnp.float32(req.top_p),
+            jnp.int32(req.eos_id),
         )
-        self._state, first = self._prefill_runner(bucket)(
-            self.params,
-            self.lora,
-            self._state,
-            prompt,
-            jnp.int32(L),
-            jnp.int32(slot),
-            (
-                jnp.int32(req.max_tokens),
-                jnp.float32(req.temperature),
-                jnp.int32(req.top_k),
-                jnp.float32(req.top_p),
-                jnp.int32(req.eos_id),
-            ),
-        )
+        plen, entry = self._match_prefix(req.prompt)
+        if plen is not None:
+            rem = req.prompt[plen:]
+            bucket = next(b for b in self.prompt_buckets if len(rem) <= b)
+            prompt_rem = jnp.asarray(
+                [rem + [self.pad_id] * (bucket - len(rem))], jnp.int32
+            )
+            self.prefix_hits += 1
+            self._state, first = self._prefill_ext_runner(plen, bucket)(
+                self.params, self.lora, self._state, entry, prompt_rem,
+                jnp.int32(len(rem)), jnp.int32(slot), req_vec,
+            )
+        else:
+            self.prefix_misses += 1
+            bucket = next(b for b in self.prompt_buckets if L <= b)
+            prompt = jnp.asarray(
+                [req.prompt + [self.pad_id] * (bucket - L)], jnp.int32
+            )
+            self._state, first = self._prefill_runner(bucket)(
+                self.params, self.lora, self._state, prompt,
+                jnp.int32(L), jnp.int32(slot), req_vec,
+            )
+            self._maybe_insert_prefix(req.prompt, slot)
         tok = int(first)
         req._emit(tok)
         if req.max_tokens <= 1 or tok == req.eos_id:
@@ -403,8 +540,17 @@ class DecodeEngine:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                 continue
+            # two compiled chunk programs: the greedy one (argmax, no
+            # vocab sorts) whenever every in-flight request is greedy —
+            # the common serving mix — else the general sampler
+            all_greedy = all(
+                r is None or r.temperature <= 0 for r in self._slot_req
+            )
+            decode = (
+                self._decode_greedy_fn if all_greedy else self._decode_fn
+            )
             try:
-                self._state, (toks, mask) = self._decode_fn(
+                self._state, (toks, mask) = decode(
                     (self.params, self.lora), self._state
                 )
                 toks, mask = jax.device_get((toks, mask))

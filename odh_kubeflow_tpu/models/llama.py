@@ -424,8 +424,28 @@ def _select_attention(cfg: LlamaConfig) -> Callable:
     )
 
 
-def _make_layer_fn(cfg: LlamaConfig, attention_fn: Callable) -> Callable:
-    layer_fn = partial(_decoder_layer, cfg, attention_fn)
+def _make_layer_fn(cfg: LlamaConfig, attention_fn: Callable,
+                   gather_from=None) -> Callable:
+    """``gather_from`` = (stacked_layers, stacked_lora_or_None): the
+    returned fn takes a layer INDEX instead of layer trees and gathers
+    inside the rematted region — gathering outside would make every
+    per-layer parameter slice a saved residual (a full extra copy of
+    the model across the scan; the 8B-int8 16k OOM)."""
+    raw_fn = partial(_decoder_layer, cfg, attention_fn)
+    if gather_from is None:
+        layer_fn = raw_fn
+    else:
+        stacked_layers, stacked_lora = gather_from
+
+        def layer_fn(x, i, _unused_lora, sin, cos, segment_ids):
+            layer = jax.tree.map(lambda a: a[i], stacked_layers)
+            lora_l = (
+                None
+                if stacked_lora is None
+                else jax.tree.map(lambda a: a[i], stacked_lora)
+            )
+            return raw_fn(x, layer, lora_l, sin, cos, segment_ids)
+
     if cfg.remat:
         if cfg.remat_policy == "dots":
             # dots_with_no_batch_dims does NOT cover pallas_call, so on
@@ -441,7 +461,7 @@ def _make_layer_fn(cfg: LlamaConfig, attention_fn: Callable) -> Callable:
                     ),
                 )
             layer_fn = jax.checkpoint(layer_fn, policy=policy)
-        elif cfg.remat_policy == "attn":
+        elif cfg.remat_policy in ("attn", "attn_offload"):
             # "flash_out"/"flash_lse" are the flash kernel's custom-vjp
             # residuals (ops/pallas_attention.py _flash_fwd): with them
             # saved, remat's recompute is projections-only — the O(S²)
@@ -449,16 +469,31 @@ def _make_layer_fn(cfg: LlamaConfig, attention_fn: Callable) -> Callable:
             # un-padded "attn_out" view is re-derived from "flash_out"
             # by a free moveaxis/slice (saving both would double the
             # residency). Dense/ring impls have no flash residuals, so
-            # there "attn_out" itself is pinned.
+            # there "attn_out" itself is pinned. "attn_offload" parks
+            # the residuals in pinned host memory instead of HBM —
+            # the 8B/16k config, whose ~4GB of residuals don't fit
+            # beside the int8 base, trades PCIe round-trips for the
+            # O(S²) recompute.
             names = (
                 ("flash_out", "flash_lse")
                 if resolved_attention_impl(cfg) == "flash"
                 else ("attn_out",)
             )
-            layer_fn = jax.checkpoint(
-                layer_fn,
-                policy=jax.checkpoint_policies.save_only_these_names(*names),
-            )
+            if cfg.remat_policy == "attn_offload":
+                policy = (
+                    jax.checkpoint_policies
+                    .save_and_offload_only_these_names(
+                        names_which_can_be_saved=[],
+                        names_which_can_be_offloaded=list(names),
+                        offload_src="device",
+                        offload_dst="pinned_host",
+                    )
+                )
+            else:
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    *names
+                )
+            layer_fn = jax.checkpoint(layer_fn, policy=policy)
         else:  # "none": full recompute, minimum residency
             layer_fn = jax.checkpoint(layer_fn)
     return layer_fn
@@ -529,31 +564,36 @@ def forward(
             and 0 < pin < cfg.num_layers
         ):
             # two scans: a full-recompute prefix and a pinned suffix —
-            # per-layer policies can't vary inside one scan
+            # per-layer policies can't vary inside one scan. The scans
+            # iterate over layer INDICES and gather each layer from the
+            # stacked params in-body: slicing the stacked trees into
+            # prefix/suffix copies would double the (8GB at 8B-int8)
+            # base-weight residency and OOM exactly the configs this
+            # knob exists for.
             n_first = cfg.num_layers - pin
-            fn_none = _make_layer_fn(
-                dataclasses.replace(cfg, remat_policy="none"), attention_fn
+            gf = (params["layers"], lora_layers)
+            fn_none_g = _make_layer_fn(
+                dataclasses.replace(cfg, remat_policy="none"),
+                attention_fn, gather_from=gf,
             )
-            split = lambda t, a, b: (  # noqa: E731
-                None
-                if t is None
-                else jax.tree.map(lambda v: v[a:b], t)
+            fn_pin_g = _make_layer_fn(cfg, attention_fn, gather_from=gf)
+
+            def body_gather(fn):
+                def body(x, i):
+                    x, _ = fn(x, i, None, sin, cos, segment_ids)
+                    return x, None
+
+                return body
+
+            x, _ = jax.lax.scan(
+                body_gather(fn_none_g),
+                x,
+                jnp.arange(n_first, dtype=jnp.int32),
             )
             x, _ = jax.lax.scan(
-                body_with(fn_none),
+                body_gather(fn_pin_g),
                 x,
-                (
-                    split(params["layers"], 0, n_first),
-                    split(lora_layers, 0, n_first),
-                ),
-            )
-            x, _ = jax.lax.scan(
-                body_with(layer_fn),
-                x,
-                (
-                    split(params["layers"], n_first, cfg.num_layers),
-                    split(lora_layers, n_first, cfg.num_layers),
-                ),
+                jnp.arange(n_first, cfg.num_layers, dtype=jnp.int32),
             )
         else:
             x, _ = jax.lax.scan(
@@ -731,12 +771,17 @@ def forward_with_cache(
 
 
 def _maybe_dequant(tree: Params, dtype) -> Params:
-    """Dequantize any {"q","scale"} leaves one level down (the shape a
-    per-layer slice of a quantized param tree has)."""
+    """Dequantize any {"q","scale"} (int8) or {"q4","scale4"} (int4)
+    leaves one level down (the shape a per-layer slice of a quantized
+    param tree has)."""
+    from odh_kubeflow_tpu.models.quant import dequantize_tensor
+
     out = {}
     for k, v in tree.items():
-        if isinstance(v, dict) and set(v) == {"q", "scale"}:
-            out[k] = (v["q"].astype(dtype) * v["scale"].astype(dtype)).astype(dtype)
+        if isinstance(v, dict) and (
+            set(v) == {"q", "scale"} or set(v) == {"q4", "scale4"}
+        ):
+            out[k] = dequantize_tensor(v, dtype)
         else:
             out[k] = v
     return out

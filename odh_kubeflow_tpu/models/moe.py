@@ -610,7 +610,10 @@ def _moe_decoder_layer(
         banks = {
             k: layer[k]
             for k in ("moe_gate", "moe_up", "moe_down")
-            if isinstance(layer[k], dict)
+            # int8 only: the grouped kernels read {"q","scale"} banks
+            # natively; int4 ({"q4","scale4"}) banks dequantize below
+            # like any other leaf
+            if isinstance(layer[k], dict) and "q" in layer[k]
         }
         rest = {k: v for k, v in layer.items() if k not in banks}
         layer = {**llama._maybe_dequant(rest, b.dtype), **banks}
@@ -753,8 +756,27 @@ def forward(
         b, attention_impl=llama.resolved_attention_impl(b)
     )
     attention_fn = llama._select_attention(b)
-    def make_layer_fn(pin_acts: bool, policy: Optional[str] = None):
-        layer_fn = partial(_moe_decoder_layer, cfg, attention_fn)
+    def make_layer_fn(pin_acts: bool, policy: Optional[str] = None,
+                      gather_from=None):
+        """``gather_from`` = (stacked_layers, stacked_lora): returned
+        fn takes a layer index and gathers INSIDE the rematted region
+        (outside, each gathered layer slice becomes a saved residual —
+        a full extra copy of the expert banks across the scan)."""
+        raw_fn = partial(_moe_decoder_layer, cfg, attention_fn)
+        if gather_from is None:
+            layer_fn = raw_fn
+        else:
+            stacked_layers, stacked_lora = gather_from
+
+            def layer_fn(x, i, _unused, sin, cos, segment_ids):
+                lyr = jax.tree.map(lambda a: a[i], stacked_layers)
+                lora_l = (
+                    None
+                    if stacked_lora is None
+                    else jax.tree.map(lambda a: a[i], stacked_lora)
+                )
+                return raw_fn(x, lyr, lora_l, sin, cos, segment_ids)
+
         if not b.remat:
             return layer_fn
         policy = policy or b.remat_policy
@@ -782,6 +804,18 @@ def forward(
             return jax.checkpoint(layer_fn)
         if policy == "attn":
             return jax.checkpoint(layer_fn, policy=named)
+        if policy == "attn_offload":
+            # same vocabulary as the dense family (llama._make_layer_fn)
+            return jax.checkpoint(
+                layer_fn,
+                policy=jax.checkpoint_policies
+                .save_and_offload_only_these_names(
+                    names_which_can_be_saved=[],
+                    names_which_can_be_offloaded=names,
+                    offload_src="device",
+                    offload_dst="pinned_host",
+                ),
+            )
         if policy == "dots":
             # dense-family semantics (save every matmul output) plus
             # the named kernel residuals. NOTE: at MoE scale the expert
@@ -796,7 +830,7 @@ def forward(
             )
         raise ValueError(
             f"unknown remat_policy {policy!r}; expected "
-            "'dots', 'attn', or 'none'"
+            "'dots', 'attn', 'attn_offload', or 'none'"
         )
 
     layer_fn = make_layer_fn(cfg.pin_expert_acts)
@@ -842,34 +876,35 @@ def forward(
             # earliest in the backward sweep); the prefix drops to the
             # cheap tier (no "moe_g", or full recompute when
             # pin_expert_acts is off). Two scans because per-layer
-            # policies can't vary inside one — note the tree slices
-            # COPY the stacked params, so this costs a params-sized
-            # HBM allowance and only pays when the pinned residuals
-            # are the larger term.
+            # policies can't vary inside one; the scans iterate over
+            # layer indices and gather in-body so the stacked params
+            # are never sliced into prefix/suffix copies.
             n_first = b.num_layers - pin
-            sl = lambda t, a, z: (  # noqa: E731
-                None if t is None else jax.tree.map(lambda v: v[a:z], t)
-            )
+            gf = (params["layers"], lora_layers)
             prefix_fn = (
-                make_layer_fn(False)
+                make_layer_fn(False, gather_from=gf)
                 if cfg.pin_expert_acts
-                else make_layer_fn(False, policy="none")
+                else make_layer_fn(False, policy="none", gather_from=gf)
+            )
+            suffix_fn = make_layer_fn(cfg.pin_expert_acts, gather_from=gf)
+
+            def body_gather(fn):
+                def body(carry, i):
+                    x, aux = carry
+                    x, layer_aux = fn(x, i, None, sin, cos, segment_ids)
+                    return (x, aux + layer_aux), None
+
+                return body
+
+            carry, _ = jax.lax.scan(
+                body_gather(prefix_fn),
+                carry,
+                jnp.arange(n_first, dtype=jnp.int32),
             )
             carry, _ = jax.lax.scan(
-                body_with(prefix_fn),
+                body_gather(suffix_fn),
                 carry,
-                (
-                    sl(params["layers"], 0, n_first),
-                    sl(lora_layers, 0, n_first),
-                ),
-            )
-            carry, _ = jax.lax.scan(
-                body_with(layer_fn),
-                carry,
-                (
-                    sl(params["layers"], n_first, b.num_layers),
-                    sl(lora_layers, n_first, b.num_layers),
-                ),
+                jnp.arange(n_first, b.num_layers, dtype=jnp.int32),
             )
         else:
             carry, _ = jax.lax.scan(

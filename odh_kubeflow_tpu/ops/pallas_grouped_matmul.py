@@ -271,25 +271,52 @@ def _gmm_b_kernel(
     t = tile_ref[i]
     start = offs_ref[g]
     end = offs_ref[g + 1]
-    rows = t * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
-    mask = jnp.logical_and(rows >= start, rows < end)
-    lhs = jnp.where(mask, lhs_ref[...], 0).astype(lhs_ref.dtype)
-    if scale_ref is not None and trans_rhs:
-        # int8 bank used backwards: the scaled axis is the contraction
-        lhs = lhs * scale_ref[0, 0][None, :].astype(lhs.dtype)
-    rhs = rhs_ref[0].astype(lhs_ref.dtype)
-    dn = (((1,), (1,)), ((), ())) if trans_rhs else (((1,), (0,)), ((), ()))
-    d = jax.lax.dot_general(lhs, rhs, dn, preferred_element_type=jnp.float32)
+    # most pairs cover their whole tile (boundary pairs are ≤E of
+    # T+E); the full case skips the row mask select and the masked
+    # accumulator merge — VPU work between the MXU dots
+    full = jnp.logical_and(start <= t * bm, end >= (t + 1) * bm)
 
-    @pl.when(ki == 0)
-    def _init():
-        # keep earlier pairs' rows of this tile; lhs is already zeroed
-        # outside the mask so d carries no stale contribution
-        acc_ref[ni] = jnp.where(mask, d, acc_ref[ni])
+    def _dot(lhs):
+        if scale_ref is not None and trans_rhs:
+            # int8 bank used backwards: scaled axis is the contraction
+            lhs = lhs * scale_ref[0, 0][None, :].astype(lhs.dtype)
+        rhs = rhs_ref[0].astype(lhs_ref.dtype)
+        dn = (
+            (((1,), (1,)), ((), ()))
+            if trans_rhs
+            else (((1,), (0,)), ((), ()))
+        )
+        return jax.lax.dot_general(
+            lhs, rhs, dn, preferred_element_type=jnp.float32
+        )
 
-    @pl.when(ki > 0)
-    def _accum():
-        acc_ref[ni] = acc_ref[ni] + d
+    @pl.when(full)
+    def _full():
+        d = _dot(lhs_ref[...])
+
+        @pl.when(ki == 0)
+        def _init():
+            acc_ref[ni] = d
+
+        @pl.when(ki > 0)
+        def _accum():
+            acc_ref[ni] = acc_ref[ni] + d
+
+    @pl.when(jnp.logical_not(full))
+    def _partial():
+        rows = t * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+        mask = jnp.logical_and(rows >= start, rows < end)
+        d = _dot(jnp.where(mask, lhs_ref[...], 0).astype(lhs_ref.dtype))
+
+        @pl.when(ki == 0)
+        def _init():
+            # keep earlier pairs' rows of this tile; lhs is already
+            # zeroed outside the mask so d carries no stale part
+            acc_ref[ni] = jnp.where(mask, d, acc_ref[ni])
+
+        @pl.when(ki > 0)
+        def _accum():
+            acc_ref[ni] = acc_ref[ni] + d
 
     @pl.when(jnp.logical_and(ki == nk - 1, write_ref[i] == 1))
     def _write():

@@ -1,0 +1,75 @@
+"""Pallas int4→bf16 weight dequantization.
+
+XLA lowers the int4 unpack chain (bit-ops + concat/reshape + group
+scaling) into passes that cost ~5× the HBM roofline on the 8B/16k
+config (+0.4s/step). This kernel is a pure streaming transform: read a
+packed uint8 block, unpack the requested nibble half, apply the
+group-wise scales in VMEM, write the bf16 block — one pass at memory
+speed. The grid's leading dimension selects the nibble half, matching
+``models/quant.py``'s split-halves packing (low nibbles = rows
+[0, K/2), high = [K/2, K)), so each output block is contiguous.
+
+Used by ``quant.dequantize_tensor4`` on TPU for shapes the blocking
+divides; everything else (CPU tests, tiny shapes) takes the jnp path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BK = 1024  # output rows per block (scale block = 8 sublanes)
+DEFAULT_BN = 512
+
+
+def _dequant_kernel(packed_ref, scale_ref, out_ref, *, group, bk):
+    h = pl.program_id(0)
+    # i32 lanes: Mosaic has no u8 vector shift (arith.shrui fails to
+    # legalize); the widen/narrow is VPU-local
+    p = packed_ref[...].astype(jnp.int32)
+    nib = jnp.where(h == 0, p & 0xF, (p >> 4) & 0xF)
+    v = (nib - 8).astype(jnp.float32)
+    rows = bk // group
+    vg = v.reshape(rows, group, v.shape[-1])
+    vg = vg * scale_ref[...][:, None, :]
+    out_ref[...] = vg.reshape(bk, v.shape[-1]).astype(out_ref.dtype)
+
+
+def int4_dequant(packed, scale, dtype=jnp.bfloat16, *, group=128,
+                 bk=DEFAULT_BK, bn=DEFAULT_BN):
+    """``packed`` [K//2, N] uint8 (split-halves), ``scale`` [K//group,
+    N] f32 → [K, N] ``dtype``. 2-D only — callers vmap leading dims."""
+    K2, N = packed.shape
+    K = 2 * K2
+    bk = min(bk, K2)
+    bn = min(bn, N)
+    if (
+        K2 % bk
+        or N % bn
+        or bk % group
+        or scale.shape != (K // group, N)
+    ):
+        raise ValueError(f"int4_dequant blocking mismatch: {packed.shape}")
+    srows = bk // group
+    return pl.pallas_call(
+        functools.partial(_dequant_kernel, group=group, bk=bk),
+        grid=(2, K2 // bk, N // bn),
+        in_specs=[
+            pl.BlockSpec((bk, bn), lambda h, i, j: (i, j)),
+            pl.BlockSpec(
+                (srows, bn),
+                lambda h, i, j: (h * (K2 // bk) + i, j),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (bk, bn), lambda h, i, j: (h * (K2 // bk) + i, j)
+        ),
+        out_shape=jax.ShapeDtypeStruct((K, N), dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+        ),
+    )(packed, scale)

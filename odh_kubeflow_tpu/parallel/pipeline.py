@@ -187,3 +187,216 @@ def pipeline_apply(
         y, aux_sum = out
         return y.reshape(B, *x.shape[1:]).astype(x.dtype), aux_sum
     return out.reshape(B, *x.shape[1:]).astype(x.dtype)
+
+
+def pipeline_train_1f1b(
+    stage_fn: Callable,
+    head_fn: Callable,
+    layer_params: Params,  # leaves [L, ...], dim0 sharded over `axis`
+    head_params: Params,
+    x: jnp.ndarray,  # [B, ...]
+    *,
+    num_microbatches: int,
+    axis: str = AXIS_PIPE,
+):
+    """One fused forward+backward pass under the 1F1B schedule.
+
+    GPipe (``pipeline_apply`` + ``jax.grad``) runs all M forwards, then
+    all M backwards: every stage holds **M** in-flight microbatch
+    inputs. 1F1B interleaves — device s runs F(m) at tick ``s + 2m``
+    and B(m) at tick ``2S-1-s+2m``, so an input is freed S-s ticks
+    after it is stored and peak residency is **min(S, M)** inputs, at
+    the same bubble 2(S-1)/(2(M+S-1)-1). The schedule cannot be
+    reached through ``jax.grad`` of a forward-only combinator (the
+    backward would only start after the last forward), so this is a
+    hand-written fused loop; it requires the LOSS to be computable per
+    microbatch at the last stage — ``head_fn(head_params, y_mb)`` →
+    scalar — which is also what lets B(m) begin one tick after F(m).
+
+    Returns ``(loss_mean, dlayer_params, dhead_params, dx)`` with
+    ``dlayer_params`` stage-sharded like ``layer_params``, so the
+    result plugs into the same optimizer update as the GPipe+autodiff
+    path (equivalence is pinned by tests/test_pipeline.py).
+
+    Each tick runs at most one of {F, B} per device (``lax.cond`` — no
+    double compute) plus two point-to-point hops (activations down,
+    cotangents up). The backward recomputes the stage forward from the
+    stored input (remat-style ``jax.vjp``), matching the GPipe path's
+    per-layer remat cost.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
+    S = mesh.shape[axis]
+    B = x.shape[0]
+    M = num_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+    xm = x.reshape(M, mb, *x.shape[1:])
+
+    transit_f32 = (
+        x.dtype == jnp.bfloat16 and jax.default_backend() == "cpu"
+    )
+    stage_dtype = x.dtype
+    if transit_f32:
+        xm = xm.astype(jnp.float32)
+    carry_dtype = xm.dtype
+
+    param_specs = jax.tree_util.tree_map(lambda _l: P(axis), layer_params)
+    head_specs = jax.tree_util.tree_map(lambda _l: P(), head_params)
+    # last tick is stage 0's B(M-1) at 2S-1+2(M-1) = 2(M+S)-3,
+    # so the schedule spans 2(M+S-1) ticks
+    T = 2 * (M + S - 1)
+    depth = min(S, M)  # in-flight input ring — the 1F1B memory bound
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        axis_names=frozenset({axis}),
+        in_specs=(param_specs, head_specs, P()),
+        out_specs=(P(), param_specs, P(), P()),
+        check_vma=False,
+    )
+    def run(stage_layers, head_params, xm):
+        idx = jax.lax.axis_index(axis)
+        down = [(i, (i + 1) % S) for i in range(S)]
+        up = [((i + 1) % S, i) for i in range(S)]
+
+        def fwd_stage(layers, x_in):
+            x_c = x_in.astype(stage_dtype) if transit_f32 else x_in
+            out = stage_fn(layers, x_c)
+            return out.astype(carry_dtype) if transit_f32 else out
+
+        def head_loss(hp, y_mb):
+            y_c = y_mb.astype(stage_dtype) if transit_f32 else y_mb
+            return head_fn(hp, y_c)
+
+        zero_grads = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), stage_layers
+        )
+        zero_hgrads = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), head_params
+        )
+        carry0 = dict(
+            act_in=jnp.zeros_like(xm[0]),  # from previous stage
+            grad_in=jnp.zeros_like(xm[0]),  # from next stage
+            dy_pending=jnp.zeros_like(xm[0]),  # last stage: F→B handoff
+            stack=jnp.zeros((depth, *xm.shape[1:]), carry_dtype),
+            dxm=jnp.zeros_like(xm),  # stage 0: input cotangents
+            grads=zero_grads,
+            hgrads=zero_hgrads,
+            loss=jnp.zeros((), jnp.float32),
+        )
+
+        def tick(carry, t):
+            s = idx
+            f_off = t - s
+            is_f = (f_off >= 0) & (f_off % 2 == 0) & (f_off < 2 * M)
+            f_m = jnp.clip(f_off // 2, 0, M - 1)
+            b_off = t - (2 * S - 1 - s)
+            is_b = (b_off >= 0) & (b_off % 2 == 0) & (b_off < 2 * M)
+            b_m = jnp.clip(b_off // 2, 0, M - 1)
+
+            def do_f(c):
+                x_t = jax.lax.dynamic_index_in_dim(
+                    xm, f_m, 0, keepdims=False
+                )
+                x_in = jnp.where(s == 0, x_t, c["act_in"])
+                out = fwd_stage(stage_layers, x_in)
+                stack = jax.lax.dynamic_update_index_in_dim(
+                    c["stack"], x_in, f_m % depth, 0
+                )
+                # last stage: per-microbatch loss, its activation
+                # cotangent (so B(m) runs on the very next tick), and
+                # the head-param grads — one vjp, no recompute
+                def last(c):
+                    loss_m, (dh, dy) = jax.value_and_grad(
+                        head_loss, argnums=(0, 1)
+                    )(head_params, out)
+                    dy = dy.astype(carry_dtype) if transit_f32 else dy
+                    return dict(
+                        c,
+                        dy_pending=dy,
+                        loss=c["loss"] + loss_m.astype(jnp.float32),
+                        hgrads=jax.tree_util.tree_map(
+                            lambda acc, d: acc + d.astype(jnp.float32),
+                            c["hgrads"],
+                            dh,
+                        ),
+                    )
+
+                c = dict(c, stack=stack)
+                c = jax.lax.cond(s == S - 1, last, lambda c: c, c)
+                return c, out
+
+            def skip_f(c):
+                return c, jnp.zeros_like(c["act_in"])
+
+            carry, f_out = jax.lax.cond(is_f, do_f, skip_f, carry)
+
+            def do_b(c):
+                x_saved = jax.lax.dynamic_index_in_dim(
+                    c["stack"], b_m % depth, 0, keepdims=False
+                )
+                g_in = jnp.where(
+                    s == S - 1, c["dy_pending"], c["grad_in"]
+                )
+                _, pullback = jax.vjp(
+                    fwd_stage, stage_layers, x_saved
+                )
+                dlayers, dx = pullback(g_in)
+                grads = jax.tree_util.tree_map(
+                    lambda acc, d: acc + d.astype(jnp.float32),
+                    c["grads"],
+                    dlayers,
+                )
+                dxm = jnp.where(
+                    s == 0,
+                    jax.lax.dynamic_update_index_in_dim(
+                        c["dxm"], dx, b_m, 0
+                    ),
+                    c["dxm"],
+                )
+                return dict(c, grads=grads, dxm=dxm), dx
+
+            def skip_b(c):
+                return c, jnp.zeros_like(c["grad_in"])
+
+            carry, b_dx = jax.lax.cond(is_b, do_b, skip_b, carry)
+
+            carry = dict(
+                carry,
+                act_in=jax.lax.ppermute(f_out, axis, down),
+                grad_in=jax.lax.ppermute(b_dx, axis, up),
+            )
+            return carry, None
+
+        carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+        loss = jax.lax.psum(
+            jnp.where(idx == S - 1, carry["loss"], 0.0), axis
+        )
+        hgrads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(
+                jnp.where(idx == S - 1, g, jnp.zeros_like(g)), axis
+            ),
+            carry["hgrads"],
+        )
+        dxm = jax.lax.psum(
+            jnp.where(idx == 0, carry["dxm"], jnp.zeros_like(carry["dxm"])),
+            axis,
+        )
+        return loss, carry["grads"], hgrads, dxm
+
+    # always trace under jit: jax's EAGER partial-manual shard_map impl
+    # re-enters shard_map with an all-axes out spec (_unmatch with
+    # check_vma=False) and rejects itself; under jit the path is sound
+    loss, dlayers, dhead, dxm = jax.jit(run)(layer_params, head_params, xm)
+    # everything reported against the MEAN microbatch loss (what the
+    # unpipelined trainer optimizes): per-microbatch cotangents were
+    # seeded with 1, so scale the accumulated grads by 1/M too
+    inv_m = 1.0 / M
+    dlayers = jax.tree_util.tree_map(lambda g: g * inv_m, dlayers)
+    dhead = jax.tree_util.tree_map(lambda g: g * inv_m, dhead)
+    dx = (dxm * inv_m).reshape(B, *x.shape[1:]).astype(x.dtype)
+    return loss / M, dlayers, dhead, dx

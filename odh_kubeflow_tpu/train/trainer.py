@@ -158,7 +158,7 @@ class Trainer:
         lora_cfg: Optional[lora_lib.LoraConfig] = None,
         mesh: Optional[Mesh] = None,
         seed: int = 0,
-        quantize_base: bool = False,
+        quantize_base: "bool | str" = False,  # True/"int8" or "int4"
     ):
         from odh_kubeflow_tpu.models import moe as moe_lib
 
@@ -174,9 +174,17 @@ class Trainer:
                 )
         if quantize_base and lora_cfg is None:
             raise ValueError(
-                "quantize_base freezes the base weights as int8 — it "
-                "requires LoRA adapters to have anything to train"
+                "quantize_base freezes the base weights as int8/int4 — "
+                "it requires LoRA adapters to have anything to train"
             )
+        if quantize_base not in (False, True, "int8", "int4"):
+            raise ValueError(
+                f"quantize_base must be False/True/'int8'/'int4', got "
+                f"{quantize_base!r}"
+            )
+        self.quant_bits = (
+            4 if quantize_base == "int4" else (8 if quantize_base else 0)
+        )
         self.train_cfg = train_cfg
         self.lora_cfg = lora_cfg
         self.quantize_base = quantize_base
@@ -203,7 +211,9 @@ class Trainer:
         if quantize_base:
             from odh_kubeflow_tpu.models import quant as quant_lib
 
-            p_specs = quant_lib.quantized_param_specs(p_specs)
+            p_specs = quant_lib.quantized_param_specs(
+                p_specs, bits=self.quant_bits
+            )
         if self.pipelined:
             # stage ownership: every stacked per-layer leaf shards its
             # leading L dim over the pipe axis (device p holds its
@@ -215,7 +225,8 @@ class Trainer:
                 # leaf-streamed int8 init: never holds the bf16 tree
                 # (8B bf16 alone would OOM the 16GiB v5e this targets)
                 self.params = quant_lib.streaming_quantized_init(
-                    model_cfg, k_params, mesh=self.mesh, specs=p_specs
+                    model_cfg, k_params, mesh=self.mesh, specs=p_specs,
+                    bits=self.quant_bits,
                 )
             else:
                 init_fn = jax.jit(

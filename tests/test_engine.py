@@ -191,3 +191,60 @@ def test_engine_serves_moe_family():
         assert a == b  # greedy MoE decode is deterministic per config
     finally:
         engine.stop()
+
+
+def test_prefix_cache_exact_and_hits(model):
+    """Requests sharing a bucketed prompt prefix reuse its KV: outputs
+    stay token-exact vs the cold path and the second request records a
+    cache hit (its prefill covers only the remainder)."""
+    cfg, params = model
+    engine = DecodeEngine(
+        params, cfg, n_slots=2, max_len=256, chunk=4,
+        prompt_buckets=(16, 64), cache_dtype=jnp.float32,
+        prefix_cache_entries=2, prefix_buckets=(16,),
+    )
+    try:
+        system = [3 + (i % 11) for i in range(16)]  # 16 = prefix bucket
+        p1 = system + [7, 9, 2]
+        p2 = system + [5, 1]
+        want1 = _reference_greedy(params, cfg, p1, 10)
+        want2 = _reference_greedy(params, cfg, p2, 10)
+        got1 = engine.submit(p1, max_tokens=10).result(timeout=120)
+        assert engine.prefix_misses == 1 and engine.prefix_hits == 0
+        got2 = engine.submit(p2, max_tokens=10).result(timeout=120)
+        assert engine.prefix_hits == 1, (
+            engine.prefix_hits, engine.prefix_misses
+        )
+        assert got1 == want1, (got1, want1)
+        assert got2 == want2, (got2, want2)
+    finally:
+        engine.stop()
+
+
+def test_greedy_fast_path_matches_sampling_program(model):
+    """The greedy chunk program (argmax, no vocab sorts) must produce
+    the same tokens as the general sampling program for temperature=0
+    requests — program-to-program, since the two must be
+    interchangeable chunk by chunk as the request mix changes."""
+    cfg, params = model
+    prompt = [5, 9, 13, 2]
+    results = {}
+    for force_general in (True, False):
+        engine = DecodeEngine(
+            params, cfg, n_slots=2, max_len=256, chunk=4,
+            prompt_buckets=(16,), cache_dtype=jnp.float32,
+        )
+        try:
+            if force_general:
+                engine._decode_greedy_fn = engine._decode_fn
+            results[force_general] = engine.submit(
+                prompt, max_tokens=12
+            ).result(timeout=120)
+            # a sampled request in the mix switches programs mid-flight
+            h_s = engine.submit(
+                [4, 4, 4], max_tokens=8, temperature=0.9, top_k=5
+            )
+            assert len(h_s.result(timeout=120)) == 8
+        finally:
+            engine.stop()
+    assert results[True] == results[False], results
