@@ -25,6 +25,9 @@ import signal
 import threading
 from typing import Any, Callable, Iterable, Optional
 
+import jax
+import jax.numpy as jnp
+
 PREEMPTED_EXIT_CODE = 42
 
 
@@ -88,10 +91,35 @@ def run_elastic(
     if manager.latest_step() is not None:
         resumed_from = trainer.restore_checkpoint(manager)
 
+    def gang_preempted() -> bool:
+        """Gang-agree on the preemption flag: each host's SIGTERM lands
+        at its own loop point, and a host that stops while its peers
+        enter the next step's collectives deadlocks the slice. One
+        tiny allgather per step makes the stop decision collective —
+        every host sees ANY host's reclaim notice (the
+        coordination-service analog of the reference's gang
+        semantics)."""
+        if jax.process_count() == 1:
+            return guard.preempted
+        from jax.experimental import multihost_utils as mh
+
+        flags = mh.process_allgather(
+            jnp.asarray([guard.preempted], dtype=jnp.int32)
+        )
+        return bool(flags.sum() > 0)
+
     metrics: dict = {}
+    preempted = False
     try:
         it = iter(batches)
-        while trainer.step < total_steps and not guard.preempted:
+        # one gang decision per iteration, reused by the loop condition,
+        # the eval gate, and the exit path — every collective below must
+        # see identical control flow on every host. (The allgather is a
+        # per-step host barrier; if that ever shows up in a profile,
+        # poll every N steps — grace periods are tens of seconds.)
+        while trainer.step < total_steps and not (
+            preempted := gang_preempted()
+        ):
             try:
                 batch = next(it)
             except StopIteration:
@@ -102,7 +130,6 @@ def run_elastic(
                 eval_batches is not None
                 and eval_interval > 0
                 and trainer.step % eval_interval == 0
-                and not guard.preempted
             ):
                 losses = [
                     float(trainer.eval_step(b)["loss"])
@@ -112,7 +139,11 @@ def run_elastic(
                     metrics["eval_loss"] = sum(losses) / len(losses)
             if on_step is not None:
                 on_step(trainer.step, metrics)
-        if guard.preempted:
+        if not preempted:
+            # StopIteration / step-limit exits still need the gang
+            # verdict (a peer may have been reclaimed this instant)
+            preempted = gang_preempted()
+        if preempted:
             # reclaim notice: flush a final checkpoint inside the grace
             # period, whatever the save-interval policy says
             trainer.save_checkpoint(manager, force=True)
@@ -122,6 +153,8 @@ def run_elastic(
             guard.uninstall()
     return {
         "step": trainer.step,
-        "preempted": guard.preempted,
+        # the gang decision, not the local flag: every host must exit
+        # with the same code or the supervisor sees a mixed verdict
+        "preempted": preempted,
         "resumed_from": resumed_from,
     }
