@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint loadtest images bench dryrun platform serve spawn-latency native kind-smoke
+.PHONY: all test test-unit test-manifests lint loadtest images bench dryrun platform serve spawn-latency native kind-smoke conformance
 
 all: lint test
 
@@ -18,6 +18,12 @@ test-unit:
 
 test-manifests:
 	$(PYTHON) -m pytest tests/test_manifests.py -q
+
+# one continuous capability sequence certifying the platform contract:
+# register -> spawn -> ready -> share -> quota-reject -> cull ->
+# restart -> preempt -> gang-restart -> elastic-resume -> delete
+conformance:
+	$(PYTHON) -m odh_kubeflow_tpu.conformance
 
 lint:
 	$(PYTHON) -m compileall -q odh_kubeflow_tpu tests loadtest bench.py __graft_entry__.py

@@ -117,6 +117,41 @@ class FakeCluster:
 
     # -- scheduling ---------------------------------------------------------
 
+    def _quota_denies(self, pod: Obj) -> Optional[str]:
+        """ResourceQuota admission for the TPU resource: creating this
+        pod must keep the namespace's summed ``google.com/tpu`` limits
+        within every quota's hard cap (the real admission controller's
+        contract, scoped to the resource the platform quotas —
+        ``controllers/profile.py`` writes ``requests.google.com/tpu``)."""
+        ns = obj_util.namespace_of(pod)
+        req = self._pod_tpu_request(pod)
+        if req <= 0:
+            return None
+        quotas = self.api.list("ResourceQuota", namespace=ns)
+        if not quotas:
+            return None
+        # one namespace-wide sum per admission, shared by every quota —
+        # not per quota (the O(N²) re-list pattern _sched_used exists
+        # to avoid)
+        used = sum(
+            self._pod_tpu_request(p)
+            for p in self.api.list("Pod", namespace=ns)
+            if obj_util.get_path(p, "status", "phase")
+            not in ("Succeeded", "Failed")
+        )
+        for quota in quotas:
+            hard = obj_util.get_path(quota, "spec", "hard", default={}) or {}
+            cap = hard.get(f"requests.{TPU_RESOURCE}", hard.get(TPU_RESOURCE))
+            if cap is None:
+                continue
+            if used + req > obj_util.parse_quantity(cap):
+                return (
+                    f"exceeded quota: {obj_util.name_of(quota)}, "
+                    f"requested: {TPU_RESOURCE}={int(req)}, "
+                    f"used: {int(used)}, limited: {cap}"
+                )
+        return None
+
     def _pod_tpu_request(self, pod: Obj) -> float:
         total = 0.0
         for c in obj_util.get_path(pod, "spec", "containers", default=[]) or []:
@@ -301,6 +336,19 @@ class FakeCluster:
         for pod_name, ordinal in want.items():
             if pod_name not in existing:
                 pod = self._make_pod(sts, pod_name, template, ordinal, service_name)
+                denial = self._quota_denies(pod)
+                if denial:
+                    # the ResourceQuota admission contract: pod CREATE
+                    # is refused, the workload controller records the
+                    # failure and retries — replicas stay unsatisfied
+                    self.api.emit_event(
+                        sts,
+                        "FailedCreate",
+                        denial,
+                        event_type="Warning",
+                        component="statefulset-controller",
+                    )
+                    continue
                 try:
                     created = self.api.create(pod)
                 except AlreadyExists:
@@ -334,6 +382,16 @@ class FakeCluster:
             pod = self._make_pod(
                 deploy, f"{name}-{i}-{obj_util.meta(deploy)['uid'][:5]}", template, i, None
             )
+            denial = self._quota_denies(pod)
+            if denial:
+                self.api.emit_event(
+                    deploy,
+                    "FailedCreate",
+                    denial,
+                    event_type="Warning",
+                    component="deployment-controller",
+                )
+                continue
             self.api.create(pod)
         ready = 0
         for pod in self._owned_pods(deploy):

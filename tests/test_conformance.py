@@ -1,0 +1,15 @@
+"""The platform conformance gate (odh_kubeflow_tpu/conformance.py) —
+one continuous sequence certifying that every capability composes:
+register → spawn → ready → share → quota-reject → cull → restart →
+preempt → gang-restart → elastic-resume → delete."""
+
+
+def test_conformance_gate_green():
+    from odh_kubeflow_tpu.conformance import run_conformance
+
+    scorecard = run_conformance()
+    assert all(v == "PASS" for v in scorecard.values()), scorecard
+    assert list(scorecard) == [
+        "register", "spawn", "ready", "share", "quota-reject", "cull",
+        "restart", "preempt", "gang-restart", "elastic-resume", "delete",
+    ]
