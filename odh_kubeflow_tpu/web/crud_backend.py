@@ -115,3 +115,44 @@ class CrudBackend:
                 + (f" in namespace {namespace}" if namespace else ""),
             )
         return user
+
+    # -- shared status/event treatment (reference:
+    # crud-web-apps/common/backend/.../status.py — every app derives
+    # status and mines error events the same way) -------------------------
+
+    def event_rows(self, namespace: str, match) -> list:
+        """Event feed for a resource's details drawer: every event whose
+        involvedObject satisfies ``match``, newest first, in the shape
+        the common frontend's events table renders."""
+        rows = []
+        for event in self.api.list("Event", namespace=namespace):
+            involved = event.get("involvedObject", {})
+            if not match(involved):
+                continue
+            rows.append(
+                {
+                    "type": event.get("type", "Normal"),
+                    "reason": event.get("reason", ""),
+                    "message": event.get("message", ""),
+                    "involved": (
+                        f"{involved.get('kind', '')}/"
+                        f"{involved.get('name', '')}"
+                    ),
+                    "timestamp": event.get("lastTimestamp")
+                    or event.get("firstTimestamp", ""),
+                    "count": event.get("count", 1),
+                }
+            )
+        rows.sort(key=lambda e: e["timestamp"], reverse=True)
+        return rows
+
+    def find_error_event(self, namespace: str, match) -> Optional[str]:
+        """Latest Warning-event message for a resource — what turns a
+        bare 'waiting' status into an actionable 'warning' one."""
+        message: Optional[str] = None
+        for event in self.api.list("Event", namespace=namespace):
+            if event.get("type") != "Warning":
+                continue
+            if match(event.get("involvedObject", {})):
+                message = event.get("message", event.get("reason", ""))
+        return message

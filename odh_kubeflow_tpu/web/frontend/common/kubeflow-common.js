@@ -513,3 +513,89 @@ export function age(timestamp) {
   if (s < 129600) return `${Math.round(s / 3600)}h`;
   return `${Math.round(s / 86400)}d`;
 }
+
+/* -- details / events drawer ----------------------------------------------
+ * Shared side drawer: an overview block plus a polled events table —
+ * the treatment JWA's notebook drawer established, generalised so
+ * VWA/TWA (and anything else with an /events endpoint) render details
+ * the same way. Returns a close function. */
+
+let _stopDrawerPoll = null;
+
+export function closeEventsDrawer() {
+  if (_stopDrawerPoll) _stopDrawerPoll();
+  _stopDrawerPoll = null;
+  document.querySelectorAll(".kf-drawer-backdrop").forEach((el) => el.remove());
+}
+
+export function eventsDrawer({ title, overview = [], fetchEvents }) {
+  closeEventsDrawer();
+  const eventsBody = h("div", { class: "kf-drawer-events" }, "Loading…");
+  const backdrop = h(
+    "div",
+    {
+      class: "kf-drawer-backdrop",
+      onClick: (e) => {
+        if (e.target === backdrop) closeEventsDrawer();
+      },
+    },
+    h(
+      "div",
+      { class: "kf-drawer" },
+      h(
+        "div",
+        { class: "kf-toolbar" },
+        h("h2", {}, title),
+        h("span", { class: "kf-spacer" }),
+        h(
+          "button",
+          { class: "kf-icon-btn", onClick: () => closeEventsDrawer() },
+          "✕"
+        )
+      ),
+      h("div", { class: "kf-drawer-overview" }, ...overview),
+      h("h3", {}, "Events"),
+      eventsBody
+    )
+  );
+  document.body.append(backdrop);
+
+  async function refresh() {
+    const events = await fetchEvents();
+    const table = h(
+      "table",
+      { class: "kf-table" },
+      h(
+        "thead",
+        {},
+        h(
+          "tr",
+          {},
+          ...["Type", "Reason", "Message", "Involved", "Age"].map((t) =>
+            h("th", {}, t)
+          )
+        )
+      ),
+      h(
+        "tbody",
+        {},
+        ...(events.length
+          ? events.map((ev) =>
+              h(
+                "tr",
+                { class: ev.type === "Warning" ? "kf-row-warning" : "" },
+                h("td", {}, ev.type),
+                h("td", {}, ev.reason),
+                h("td", {}, ev.message),
+                h("td", {}, h("code", {}, ev.involved)),
+                h("td", {}, age(ev.timestamp))
+              )
+            )
+          : [h("tr", {}, h("td", { colspan: 5 }, "No events yet."))])
+      )
+    );
+    clear(eventsBody).append(table);
+  }
+  _stopDrawerPoll = poll(refresh, 5000);
+  return closeEventsDrawer;
+}

@@ -16,6 +16,9 @@ import {
   namespaceSelector,
   confirmDialog,
   resourceTable,
+  formField,
+  validateFields,
+  validators,
 } from "./common/kubeflow-common.js";
 
 const root = document.getElementById("app");
@@ -167,6 +170,11 @@ function registrationView() {
     id: "reg-namespace",
     placeholder: "my-team",
   });
+  const nsField = formField({
+    label: null,
+    input,
+    validators: [validators.required(), validators.dns1123()],
+  });
   return h(
     "div",
     { class: "kf-page kd-view" },
@@ -179,18 +187,15 @@ function registrationView() {
         { class: "kf-muted" },
         `First login for ${state.user}: pick a namespace name. A Profile is created with you as owner — namespace, RBAC, TPU quota and service accounts come with it.`
       ),
-      h("div", { class: "kf-field" }, input),
+      nsField.el,
       h(
         "button",
         {
           class: "kf-btn",
           id: "register",
           onClick: async () => {
+            if (!validateFields([nsField])) return;
             const namespace = input.value.trim();
-            if (!namespace) {
-              snackbar("Namespace name required", "error");
-              return;
-            }
             try {
               await api("api/workgroup/create", {
                 method: "POST",
@@ -273,6 +278,17 @@ async function contributorsView() {
     id: "contrib-email",
     placeholder: "teammate@example.com",
   });
+  const emailField = formField({
+    label: null,
+    input,
+    validators: [
+      validators.required(),
+      (v) =>
+        /^[^@\s]+@[^@\s]+\.[^@\s]+$/.test(String(v).trim())
+          ? null
+          : "Not an email address",
+    ],
+  });
   view.append(
     h(
       "div",
@@ -317,15 +333,15 @@ async function contributorsView() {
       h(
         "div",
         { class: "kf-row", style: "margin-top:16px" },
-        h("div", { class: "kf-field" }, input),
+        emailField.el,
         h(
           "button",
           {
             class: "kf-btn",
             id: "add-contributor",
             onClick: async () => {
+              if (!validateFields([emailField])) return;
               const contributor = input.value.trim();
-              if (!contributor) return;
               try {
                 await api(`api/workgroup/add-contributor/${ns}`, {
                   method: "POST",

@@ -14,6 +14,10 @@ import {
   poll,
   currentNamespace,
   age,
+  formField,
+  validateFields,
+  validators,
+  eventsDrawer,
 } from "./common/kubeflow-common.js";
 
 const root = document.getElementById("app");
@@ -54,10 +58,31 @@ function render(tbs) {
             { title: "Status", render: (r) => statusIcon(r.status) },
             {
               title: "Name",
+              field: "name",
+              render: (r) =>
+                h(
+                  "a",
+                  {
+                    href: "#",
+                    dataset: { action: "details", name: r.name },
+                    onClick: (e) => {
+                      e.preventDefault();
+                      showDetails(r);
+                    },
+                  },
+                  r.name
+                ),
+            },
+            {
+              title: "Connect",
               render: (r) =>
                 r.status.phase === "ready"
-                  ? h("a", { href: connectHref(r), target: "_blank" }, r.name)
-                  : r.name,
+                  ? h(
+                      "a",
+                      { href: connectHref(r), target: "_blank" },
+                      "open ↗"
+                    )
+                  : "—",
             },
             { title: "Logs path", render: (r) => h("code", {}, r.logspath) },
             { title: "Age", sortValue: (r) => r.age, render: (r) => age(r.age) },
@@ -111,6 +136,21 @@ async function deleteTb(row) {
   }
 }
 
+function showDetails(row) {
+  eventsDrawer({
+    title: row.name,
+    overview: [
+      statusIcon(row.status),
+      h("div", {}, h("b", {}, "Logs path: "), h("code", {}, row.logspath)),
+      h("div", {}, h("b", {}, "Age: "), age(row.age)),
+    ],
+    fetchEvents: async () =>
+      (
+        await api(`api/namespaces/${ns}/tensorboards/${row.name}/events`)
+      ).events || [],
+  });
+}
+
 function showForm() {
   if (stopPolling) stopPolling();
   const nameInput = h("input", {
@@ -122,6 +162,25 @@ function showForm() {
     class: "kf-input",
     id: "tb-logspath",
     placeholder: "gs://bucket/xla-traces  or  pvc://my-volume/logs",
+  });
+  const nameField = formField({
+    label: "Name",
+    input: nameInput,
+    validators: [validators.required(), validators.dns1123()],
+  });
+  const pathField = formField({
+    label: "Logs path",
+    input: pathInput,
+    hint:
+      "gs:// serves XLA/TPU profiler traces straight from GCS; " +
+      "pvc:// mounts a volume from this namespace.",
+    validators: [
+      validators.required(),
+      (v) =>
+        /^(gs|pvc|s3):\/\//.test(String(v).trim())
+          ? null
+          : "Must start with gs://, s3:// or pvc://",
+    ],
   });
 
   clear(root).append(
@@ -142,30 +201,17 @@ function showForm() {
       h(
         "div",
         { class: "kf-card" },
-        h("div", { class: "kf-field" }, h("label", { for: "tb-name" }, "Name"), nameInput),
-        h(
-          "div",
-          { class: "kf-field" },
-          h("label", { for: "tb-logspath" }, "Logs path"),
-          pathInput,
-          h(
-            "div",
-            { class: "kf-hint" },
-            "gs:// serves XLA/TPU profiler traces straight from GCS; pvc:// mounts a volume from this namespace."
-          )
-        ),
+        nameField.el,
+        pathField.el,
         h(
           "button",
           {
             class: "kf-btn",
             id: "create-tensorboard",
             onClick: async () => {
+              if (!validateFields([nameField, pathField])) return;
               const name = nameInput.value.trim();
               const logspath = pathInput.value.trim();
-              if (!name || !logspath) {
-                snackbar("Name and logs path are required", "error");
-                return;
-              }
               try {
                 await api(`api/namespaces/${ns}/tensorboards`, {
                   method: "POST",

@@ -13,6 +13,10 @@ import {
   poll,
   currentNamespace,
   age,
+  formField,
+  validateFields,
+  validators,
+  eventsDrawer,
 } from "./common/kubeflow-common.js";
 
 const root = document.getElementById("app");
@@ -48,13 +52,25 @@ function render(pvcs) {
           columns: [
             {
               title: "Status",
-              render: (r) =>
-                statusIcon({
-                  phase: r.status === "Bound" ? "ready" : "waiting",
-                  message: r.status,
-                }),
+              render: (r) => statusIcon(r.status),
             },
-            { title: "Name", field: "name" },
+            {
+              title: "Name",
+              field: "name",
+              render: (r) =>
+                h(
+                  "a",
+                  {
+                    href: "#",
+                    dataset: { action: "details", name: r.name },
+                    onClick: (e) => {
+                      e.preventDefault();
+                      showDetails(r);
+                    },
+                  },
+                  r.name
+                ),
+            },
             { title: "Size", field: "capacity" },
             { title: "Access modes", render: (r) => (r.modes || []).join(", ") },
             { title: "Storage class", field: "class" },
@@ -118,6 +134,27 @@ async function deletePvc(row) {
   }
 }
 
+function showDetails(row) {
+  eventsDrawer({
+    title: row.name,
+    overview: [
+      statusIcon(row.status),
+      h("div", {}, h("b", {}, "Size: "), row.capacity),
+      h("div", {}, h("b", {}, "Access modes: "), (row.modes || []).join(", ")),
+      h("div", {}, h("b", {}, "Storage class: "), row.class || "default"),
+      h(
+        "div",
+        {},
+        h("b", {}, "Used by: "),
+        (row.usedBy || []).length ? row.usedBy.join(", ") : "nothing"
+      ),
+      h("div", {}, h("b", {}, "Age: "), age(row.age)),
+    ],
+    fetchEvents: async () =>
+      (await api(`api/namespaces/${ns}/pvcs/${row.name}/events`)).events || [],
+  });
+}
+
 function showForm() {
   if (stopPolling) stopPolling();
   const nameInput = h("input", {
@@ -126,6 +163,16 @@ function showForm() {
     placeholder: "my-volume",
   });
   const sizeInput = h("input", { class: "kf-input", id: "pvc-size", value: "10Gi" });
+  const nameField = formField({
+    label: "Name",
+    input: nameInput,
+    validators: [validators.required(), validators.dns1123()],
+  });
+  const sizeField = formField({
+    label: "Size",
+    input: sizeInput,
+    validators: [validators.required(), validators.quantity()],
+  });
   const modeSelect = h(
     "select",
     { class: "kf-select", id: "pvc-mode" },
@@ -152,11 +199,11 @@ function showForm() {
       h(
         "div",
         { class: "kf-card" },
-        h("div", { class: "kf-field" }, h("label", { for: "pvc-name" }, "Name"), nameInput),
+        nameField.el,
         h(
           "div",
           { class: "kf-row" },
-          h("div", { class: "kf-field" }, h("label", { for: "pvc-size" }, "Size"), sizeInput),
+          sizeField.el,
           h(
             "div",
             { class: "kf-field" },
@@ -170,11 +217,8 @@ function showForm() {
             class: "kf-btn",
             id: "create-volume",
             onClick: async () => {
+              if (!validateFields([nameField, sizeField])) return;
               const name = nameInput.value.trim();
-              if (!name) {
-                snackbar("Name is required", "error");
-                return;
-              }
               try {
                 await api(`api/namespaces/${ns}/pvcs`, {
                   method: "POST",

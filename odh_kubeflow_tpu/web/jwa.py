@@ -237,27 +237,12 @@ class JupyterWebApp(CrudBackend):
             newest first — reference parity with the notebook details
             page's EVENTS tab."""
             self.authorize(request, "get", "notebooks", namespace, "kubeflow.org")
-            events = []
-            for event in self.api.list("Event", namespace=namespace):
-                involved = event.get("involvedObject", {})
-                if not _event_belongs_to_notebook(involved, name):
-                    continue
-                events.append(
-                    {
-                        "type": event.get("type", "Normal"),
-                        "reason": event.get("reason", ""),
-                        "message": event.get("message", ""),
-                        "involved": (
-                            f"{involved.get('kind', '')}/"
-                            f"{involved.get('name', '')}"
-                        ),
-                        "timestamp": event.get("lastTimestamp")
-                        or event.get("firstTimestamp", ""),
-                        "count": event.get("count", 1),
-                    }
+            return success({
+                "events": self.event_rows(
+                    namespace,
+                    lambda inv: _event_belongs_to_notebook(inv, name),
                 )
-            events.sort(key=lambda e: e["timestamp"], reverse=True)
-            return success({"events": events})
+            })
 
         @app.route(
             "/api/namespaces/<namespace>/notebooks/<name>", methods=["PATCH"]

@@ -5,6 +5,8 @@ post.py:15-38, app/utils.py:4-38 (CR builder + status parse)."""
 
 from __future__ import annotations
 
+import re
+
 from typing import Any, Optional
 
 from odh_kubeflow_tpu.apis import TENSORBOARD_API_VERSION
@@ -72,18 +74,65 @@ class TensorboardsWebApp(CrudBackend):
             self.api.delete("Tensorboard", name, namespace)
             return success()
 
+        @app.route("/api/namespaces/<namespace>/tensorboards/<name>/events")
+        def tb_events(request, namespace, name):
+            """Details-drawer feed: events on the Tensorboard CR and
+            its owned Deployment/Pods (kubelet pods append
+            ``-<i>-<uid5>``, so the prefix match is kind-gated the way
+            JWA's is — a sibling CR called ``name-2`` must not leak)."""
+            self.authorize(
+                request, "get", "tensorboards", namespace,
+                "tensorboard.kubeflow.org",
+            )
+            return success({
+                "events": self.event_rows(
+                    namespace, lambda inv: _event_belongs_to_tb(inv, name)
+                )
+            })
+
     def tensorboard_row(self, tb: Obj) -> Obj:
-        ready = obj_util.get_path(tb, "status", "readyReplicas", default=0)
         return {
             "name": obj_util.name_of(tb),
             "namespace": obj_util.namespace_of(tb),
             "logspath": obj_util.get_path(tb, "spec", "logspath", default=""),
-            "status": {
-                "phase": "ready" if ready else "waiting",
-                "message": "Running" if ready else "Starting",
-            },
+            "status": self.tensorboard_status(tb),
             "age": obj_util.meta(tb).get("creationTimestamp", ""),
         }
+
+    def tensorboard_status(self, tb: Obj) -> Obj:
+        """JWA's status treatment (shared common/status.py parity):
+        deleting → terminating, ready → running, otherwise mine the
+        owned resources' Warning events before settling for waiting."""
+        if obj_util.meta(tb).get("deletionTimestamp"):
+            return {
+                "phase": "terminating", "message": "Deleting this tensorboard"
+            }
+        ready = obj_util.get_path(tb, "status", "readyReplicas", default=0)
+        if ready:
+            return {"phase": "ready", "message": "Running"}
+        name = obj_util.name_of(tb)
+        error = self.find_error_event(
+            obj_util.namespace_of(tb),
+            lambda inv: _event_belongs_to_tb(inv, name),
+        )
+        if error:
+            return {"phase": "warning", "message": error}
+        return {"phase": "waiting", "message": "Starting"}
+
+
+def _event_belongs_to_tb(involved: Obj, name: str) -> bool:
+    """Kind-gated suffix match (JWA's _event_belongs_to_notebook
+    discipline): a sibling CR named ``<name>-2`` must not leak its
+    events into this one's drawer — only this CR's exact name and its
+    Deployment pods (``<name>-<i>-<uid5>``) belong."""
+    kind = involved.get("kind", "")
+    iname = involved.get("name", "")
+    if iname == name:
+        return True
+    suffix = iname[len(name):] if iname.startswith(name) else ""
+    return kind == "Pod" and bool(
+        re.fullmatch(r"-\d+-[0-9a-f]{5}", suffix)
+    )
 
 
 def main() -> None:

