@@ -27,7 +27,9 @@ def main() -> None:
     from odh_kubeflow_tpu.models import llama
     from odh_kubeflow_tpu.models.quant import streaming_quantized_init
 
-    cfg = LlamaConfig.llama3_8b(dtype=jnp.bfloat16)
+    import os
+    w8a8 = os.environ.get("SMOKE_W8A8", "") == "1"
+    cfg = LlamaConfig.llama3_8b(dtype=jnp.bfloat16, w8a8_decode=w8a8)
     t0 = time.time()
     qparams = streaming_quantized_init(cfg, jax.random.key(7))
     jax.block_until_ready(qparams)
@@ -59,6 +61,7 @@ def main() -> None:
                 "compile_s": round(compile_s, 1),
                 "decode_tokens_per_s": round(decode_tok_s, 1),
                 "batch": B,
+                "w8a8": w8a8,
             }
         )
     )

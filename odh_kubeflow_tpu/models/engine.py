@@ -153,6 +153,10 @@ class DecodeEngine:
         seed: int = 0,
         prefix_cache_entries: int = 0,
         prefix_buckets: Sequence[int] = (256, 512),
+        draft_params: Optional[Params] = None,
+        draft_cfg: Optional[LlamaConfig] = None,
+        spec_k: int = 4,
+        spec_rounds_per_call: int = 4,
     ):
         self.params = params
         self.cfg = cfg
@@ -172,6 +176,25 @@ class DecodeEngine:
         self.prefix_hits = 0
         self.prefix_misses = 0
 
+        # speculative decoding per slot: the draft model proposes
+        # spec_k tokens, the target verifies them in ONE k+1-token
+        # forward per slot (vector cache offsets), and the accepted
+        # prefix + one target token advance the stream. Greedy-only —
+        # the engine's shared rng cannot replay per-request sampling
+        # through the accept/reject rule, and greedy keeps verify
+        # token-exact vs plain decode.
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.spec_k = spec_k
+        # host→device round-trips dominate small per-call programs (a
+        # dispatch costs ~ms locally, tens of ms over a relay): run
+        # several speculative rounds inside one jitted call, exactly as
+        # the token path batches `chunk` steps
+        self.spec_rounds_per_call = max(1, spec_rounds_per_call)
+        if draft_params is not None:
+            assert draft_cfg is not None, "draft_params needs draft_cfg"
+            _, self._dfwd = family_forward(draft_cfg)
+
         cache_cfg, self._fwd = family_forward(cfg)
         S = n_slots
         self._state = {
@@ -188,14 +211,24 @@ class DecodeEngine:
             "eos": jnp.full((S,), -1, jnp.int32),
             "rng": jax.random.key(seed),
         }
+        if draft_params is not None:
+            dcache_cfg, _ = family_forward(draft_cfg)
+            self._state["dcache"] = init_cache(
+                dcache_cfg, S, max_len, cache_dtype
+            )
         # observability: decode_steps × n_slots is the work a serial
         # server would have spent per-request; the ratio
         # tokens_emitted / decode_steps is the batching efficiency
         self.decode_steps = 0
         self.tokens_emitted = 0
+        self.spec_rounds = 0
         # set on unrecoverable device failure; submit() then raises
         self.failure: Optional[Exception] = None
         self._slot_req: list[Optional[_Request]] = [None] * S
+        # (req, device-scalar first token, slot): fetched alongside the
+        # next chunk's outputs — the prefill's first token costs no
+        # dedicated sync
+        self._pending_first: list = []
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
         self._wake = threading.Event()
         self._stopped = False
@@ -205,6 +238,12 @@ class DecodeEngine:
             functools.partial(self._decode_chunk, greedy=True),
             donate_argnums=1,
         )
+        self._spec_fn = (
+            jax.jit(self._spec_chunk, donate_argnums=1)
+            if draft_params is not None
+            else None
+        )
+        self._draft_prefill_fns: dict[int, Any] = {}
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -242,9 +281,50 @@ class DecodeEngine:
         return st, first
 
 
-    def _prefill(self, params, lora, state, prompt, length, slot, req_vec):
-        """Prefill one prompt (batch 1, S_bucket wide) into ``slot``.
-        ``req_vec`` = (max_tokens, temp, top_k, top_p, eos) scalars."""
+    @staticmethod
+    def _unpack_admission(packed, bucket):
+        """One host→device transfer per admission: ``packed`` [1,
+        bucket+7] int32 = padded prompt ‖ [L, slot, max_tokens, top_k,
+        eos, temp_bits, top_p_bits] (floats bit-cast). Relay transports
+        charge a full round-trip per array — six scalar uploads per
+        admission measured ~2s of the ~3s admission cost."""
+        prompt = packed[:, :bucket]
+        meta = packed[0, bucket:]
+        length, slot, max_tokens, top_k, eos = (
+            meta[0], meta[1], meta[2], meta[3], meta[4]
+        )
+        temp = jax.lax.bitcast_convert_type(meta[5], jnp.float32)
+        top_p = jax.lax.bitcast_convert_type(meta[6], jnp.float32)
+        return prompt, length, slot, (max_tokens, temp, top_k, top_p, eos)
+
+    @staticmethod
+    def pack_admission(prompt, pad_id, bucket, req):
+        import numpy as np
+
+        meta = np.asarray(
+            [
+                len(prompt), 0, req.max_tokens, req.top_k, req.eos_id,
+                np.float32(req.temperature).view(np.int32),
+                np.float32(req.top_p).view(np.int32),
+            ],
+            np.int32,
+        )
+        row = np.concatenate(
+            [
+                np.asarray(
+                    prompt + [pad_id] * (bucket - len(prompt)), np.int32
+                ),
+                meta,
+            ]
+        )
+        return row[None, :]
+
+    def _prefill(self, params, lora, state, packed, *, bucket):
+        """Prefill one prompt (batch 1, ``bucket`` wide) into the slot
+        carried in ``packed`` (see ``_unpack_admission``)."""
+        prompt, length, slot, req_vec = self._unpack_admission(
+            packed, bucket
+        )
         max_tokens, temp, top_k, top_p, eos = req_vec
         cache_cfg, _ = family_forward(self.cfg)
         S_b = prompt.shape[1]
@@ -335,14 +415,17 @@ class DecodeEngine:
         return state, (toks.T, mask.T)  # [n_slots, chunk] each
 
     def _prefill_ext(
-        self, params, lora, state, prefix_kv, prompt_rem, rem_len, slot,
-        req_vec, *, plen: int,
+        self, params, lora, state, prefix_kv, packed, *, plen: int,
+        bucket: int,
     ):
         """Prefill with a cached prefix: ``prefix_kv`` (k/v
         [L, 1, plen, Hkv, hd], a prefix-cache entry) seeds the slot's
         cache and only the remainder tokens run through the model, at
         positions/cache offset ``plen`` (static — one compile per
         (prefix bucket, remainder bucket))."""
+        prompt_rem, rem_len, slot, req_vec = self._unpack_admission(
+            packed, bucket
+        )
         max_tokens, temp, top_k, top_p, eos = req_vec
         cache_cfg, _ = family_forward(self.cfg)
         S_b = prompt_rem.shape[1]
@@ -373,12 +456,160 @@ class DecodeEngine:
             state, sub_cache, kv_mask1, slot, first, total, req_vec, rng
         )
 
+    def _draft_prefill(self, dparams, state, packed, *, bucket):
+        """Fill the DRAFT model's cache for a freshly admitted slot
+        over the full prompt (the draft is cheap — even on a
+        prefix-cache hit the draft re-prefills from scratch, which is
+        what lets prefix entries stay target-only)."""
+        prompt, length, slot, _ = self._unpack_admission(packed, bucket)
+        dcache_cfg, _ = family_forward(self.draft_cfg)
+        sub = init_cache(
+            dcache_cfg, 1, self.max_len, state["dcache"]["k"].dtype
+        )
+        S_b = prompt.shape[1]
+        slots_row = jnp.arange(self.max_len, dtype=jnp.int32)[None, :]
+        kv_mask1 = slots_row < length
+        positions = jnp.arange(S_b, dtype=jnp.int32)[None, :]
+        _, sub = self._dfwd(
+            dparams, prompt, self.draft_cfg, sub, jnp.int32(0),
+            positions=positions, kv_mask=kv_mask1,
+        )
+        st = dict(state)
+        st["dcache"] = {
+            kv: jax.lax.dynamic_update_slice(
+                state["dcache"][kv], sub[kv], (0, slot, 0, 0, 0)
+            )
+            for kv in ("k", "v")
+        }
+        return st
+
+    def _draft_prefill_runner(self, bucket: int):
+        if bucket not in self._draft_prefill_fns:
+            self._draft_prefill_fns[bucket] = jax.jit(
+                functools.partial(self._draft_prefill, bucket=bucket),
+                donate_argnums=1,
+            )
+        return self._draft_prefill_fns[bucket]
+
+    def _spec_chunk(self, params_all, state):
+        """``spec_rounds_per_call`` speculative rounds in one jitted
+        call. Each round: the draft proposes ``spec_k`` tokens
+        (sequential draft decode steps), the target verifies all of
+        them in a single k+1-token forward at per-slot offsets, and
+        each slot advances by its accepted prefix.
+
+        Greedy acceptance: proposal i stands iff it equals the
+        target's own argmax at that position, so emitted tokens are
+        token-exact vs plain decode. Emission is capped at k per round
+        (the all-accepted bonus token is forfeited) so the draft cache
+        never falls behind the stream — the draft wrote slots
+        [widx, widx+k) during proposal, and a cap-k advance keeps
+        every needed position covered without a catch-up pass.
+        """
+        params, lora, dparams = params_all
+        k = self.spec_k
+        S = self.n_slots
+        slots_row = jnp.arange(self.max_len, dtype=jnp.int32)[None, :]
+        rows = jnp.arange(S)
+
+        def one_round(state, _):
+            active = state["active"]
+            widx = state["write_idx"]
+            pos = state["pos"]
+
+            def dstep(carry, i):
+                cur, dcache = carry
+                kv_mask = slots_row < (widx + i + 1)[:, None]
+                logits, dcache = self._dfwd(
+                    dparams, cur[:, None], self.draft_cfg, dcache,
+                    widx + i, positions=(pos + i)[:, None],
+                    kv_mask=kv_mask,
+                )
+                nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(
+                    jnp.int32
+                )
+                return (nxt, dcache), nxt
+
+            (_, dcache), props = jax.lax.scan(
+                dstep, (state["cur_token"], state["dcache"]),
+                jnp.arange(k, dtype=jnp.int32),
+            )
+            props = props.T  # [S, k]
+
+            tokens_v = jnp.concatenate(
+                [state["cur_token"][:, None], props], axis=1
+            )  # [S, k+1]
+            verify_mask = slots_row < (widx + k + 1)[:, None]
+            positions_v = (
+                pos[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+            )
+            logits_v, cache = self._fwd(
+                params, tokens_v, self.cfg, state["cache"], widx,
+                positions=positions_v, kv_mask=verify_mask, lora=lora,
+            )
+            targets = jnp.argmax(logits_v, axis=-1).astype(jnp.int32)
+
+            match = props == targets[:, :k]
+            n_acc = jnp.sum(
+                jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1
+            )
+            n_eff = jnp.minimum(n_acc + 1, k)
+            emit_window = targets[:, :k]
+            eos_hit = (emit_window == state["eos"][:, None]) & (
+                state["eos"][:, None] >= 0
+            )
+            any_eos = eos_hit.any(axis=1)
+            first_eos = jnp.argmax(eos_hit, axis=1)
+            n_eff = jnp.where(
+                any_eos, jnp.minimum(n_eff, first_eos + 1), n_eff
+            )
+            n_eff = jnp.minimum(n_eff, jnp.maximum(state["remaining"], 0))
+            n_eff = jnp.where(active, n_eff, 0)
+
+            new_widx = widx + n_eff
+            remaining = state["remaining"] - n_eff
+            ended = (any_eos & (first_eos < n_eff)) | (remaining <= 0)
+            new_active = active & ~ended
+            cur_new = jnp.where(
+                active & (n_eff > 0),
+                emit_window[rows, jnp.clip(n_eff - 1, 0, k - 1)],
+                state["cur_token"],
+            )
+            # contiguous validity [0, new_widx): verify wrote k+1 slots
+            # but only the accepted prefix is real stream
+            kv_mask_new = slots_row < new_widx[:, None]
+            emit_mask = active[:, None] & (
+                jnp.arange(k, dtype=jnp.int32)[None, :] < n_eff[:, None]
+            )
+            st = dict(
+                state,
+                cache=cache,
+                dcache=dcache,
+                kv_mask=kv_mask_new,
+                cur_token=cur_new,
+                write_idx=jnp.minimum(new_widx, self.max_len - 1),
+                pos=pos + n_eff,
+                remaining=remaining,
+                active=new_active,
+            )
+            return st, (emit_window, emit_mask)
+
+        state, (toks, masks) = jax.lax.scan(
+            one_round, state, None, length=self.spec_rounds_per_call
+        )
+        # [R, S, k] → [S, R·k]: rounds concatenate in stream order
+        R = self.spec_rounds_per_call
+        toks = jnp.swapaxes(toks, 0, 1).reshape(S, R * k)
+        masks = jnp.swapaxes(masks, 0, 1).reshape(S, R * k)
+        return state, (toks, masks)
+
     # -- engine loop --------------------------------------------------------
 
     def _prefill_runner(self, bucket: int):
         if bucket not in self._prefill_fns:
             self._prefill_fns[bucket] = jax.jit(
-                self._prefill, donate_argnums=2
+                functools.partial(self._prefill, bucket=bucket),
+                donate_argnums=2,
             )
         return self._prefill_fns[bucket]
 
@@ -386,7 +617,9 @@ class DecodeEngine:
         key = (plen, bucket)
         if key not in self._prefill_fns:
             self._prefill_fns[key] = jax.jit(
-                functools.partial(self._prefill_ext, plen=plen),
+                functools.partial(
+                    self._prefill_ext, plen=plen, bucket=bucket
+                ),
                 donate_argnums=2,
             )
         return self._prefill_fns[key]
@@ -437,42 +670,44 @@ class DecodeEngine:
     def _admit(self, req: _Request) -> None:
         slot = self._slot_req.index(None)
         L = len(req.prompt)
-        req_vec = (
-            jnp.int32(req.max_tokens),
-            jnp.float32(req.temperature),
-            jnp.int32(req.top_k),
-            jnp.float32(req.top_p),
-            jnp.int32(req.eos_id),
-        )
         plen, entry = self._match_prefix(req.prompt)
         if plen is not None:
             rem = req.prompt[plen:]
             bucket = next(b for b in self.prompt_buckets if len(rem) <= b)
-            prompt_rem = jnp.asarray(
-                [rem + [self.pad_id] * (bucket - len(rem))], jnp.int32
-            )
+            row = self.pack_admission(rem, self.pad_id, bucket, req)
+            row[0, bucket + 1] = slot
+            packed = jnp.asarray(row)
             self.prefix_hits += 1
             self._state, first = self._prefill_ext_runner(plen, bucket)(
-                self.params, self.lora, self._state, entry, prompt_rem,
-                jnp.int32(len(rem)), jnp.int32(slot), req_vec,
+                self.params, self.lora, self._state, entry, packed,
             )
         else:
             self.prefix_misses += 1
             bucket = next(b for b in self.prompt_buckets if L <= b)
-            prompt = jnp.asarray(
-                [req.prompt + [self.pad_id] * (bucket - L)], jnp.int32
-            )
+            row = self.pack_admission(req.prompt, self.pad_id, bucket, req)
+            row[0, bucket + 1] = slot
+            packed = jnp.asarray(row)
             self._state, first = self._prefill_runner(bucket)(
-                self.params, self.lora, self._state, prompt,
-                jnp.int32(L), jnp.int32(slot), req_vec,
+                self.params, self.lora, self._state, packed,
             )
             self._maybe_insert_prefix(req.prompt, slot)
-        tok = int(first)
-        req._emit(tok)
-        if req.max_tokens <= 1 or tok == req.eos_id:
+        if self.draft_params is not None:
+            full_bucket = next(b for b in self.prompt_buckets if L <= b)
+            row = self.pack_admission(req.prompt, self.pad_id, full_bucket, req)
+            row[0, full_bucket + 1] = slot
+            self._state = self._draft_prefill_runner(full_bucket)(
+                self.draft_params, self._state, jnp.asarray(row),
+            )
+        # defer the first-token fetch: the device value is collected
+        # with the NEXT chunk's device_get (one round-trip for both)
+        # unless the request can't enter a slot at all
+        if req.max_tokens <= 1:
+            tok = int(first)
+            req._emit(tok)
             req._finish()
             return
-        self._slot_req[slot] = req
+        self._slot_req[slot] = req  # claim before the next admission
+        self._pending_first.append((req, first, slot))
 
     def _fail_engine(self, exc: Exception) -> None:
         """A device-level failure (OOM, preemption, XLA runtime error)
@@ -546,18 +781,49 @@ class DecodeEngine:
             all_greedy = all(
                 r is None or r.temperature <= 0 for r in self._slot_req
             )
-            decode = (
-                self._decode_greedy_fn if all_greedy else self._decode_fn
-            )
             try:
-                self._state, (toks, mask) = decode(
-                    (self.params, self.lora), self._state
+                if self._spec_fn is not None:
+                    # draft attached (greedy-only by submit contract):
+                    # spec_rounds_per_call rounds per loop turn
+                    self._state, (toks, mask) = self._spec_fn(
+                        (self.params, self.lora, self.draft_params),
+                        self._state,
+                    )
+                    self.spec_rounds += self.spec_rounds_per_call
+                else:
+                    decode = (
+                        self._decode_greedy_fn
+                        if all_greedy
+                        else self._decode_fn
+                    )
+                    self._state, (toks, mask) = decode(
+                        (self.params, self.lora), self._state
+                    )
+                pending = self._pending_first
+                self._pending_first = []
+                toks, mask, firsts = jax.device_get(
+                    (toks, mask, [f for (_r, f, _s) in pending])
                 )
-                toks, mask = jax.device_get((toks, mask))
             except Exception as e:  # noqa: BLE001 — state integrity unknown
                 self._fail_engine(e)
                 return
-            self.decode_steps += self.chunk
+            for (preq, _f, pslot), tok in zip(pending, firsts):
+                tok = int(tok)
+                preq._emit(tok)
+                self.tokens_emitted += 1
+                if tok == preq.eos_id:
+                    preq._finish()
+                    # free the slot on device: its chunk emissions are
+                    # masked off by the active flag at the next update
+                    self._state["active"] = (
+                        self._state["active"].at[pslot].set(False)
+                    )
+                    self._slot_req[pslot] = None
+            self.decode_steps += (
+                self.spec_rounds_per_call
+                if self._spec_fn is not None
+                else self.chunk
+            )
             for slot, req in enumerate(self._slot_req):
                 if req is None:
                     continue
@@ -601,13 +867,23 @@ class DecodeEngine:
             )
         if not prompt:
             raise ValueError("empty prompt")
+        if self.draft_params is not None and temperature > 0:
+            raise ValueError(
+                "draft-enabled engine decodes greedily (speculative "
+                "verify is exact only under argmax); use the one-shot "
+                "sampling path for temperature > 0"
+            )
         if len(prompt) > self.prompt_buckets[-1]:
             raise ValueError(
                 f"prompt longer than max bucket {self.prompt_buckets[-1]}"
             )
-        if len(prompt) + max_tokens > self.max_len:
+        headroom = self.spec_k if self.draft_params is not None else 0
+        if len(prompt) + max_tokens + headroom > self.max_len:
+            # the speculative verify may write up to spec_k slots past
+            # the final kept token — the cache needs that scratch tail
             raise ValueError(
-                f"prompt+max_tokens exceeds engine max_len {self.max_len}"
+                f"prompt+max_tokens (+{headroom} speculative headroom) "
+                f"exceeds engine max_len {self.max_len}"
             )
         req = _Request(
             prompt=list(prompt),

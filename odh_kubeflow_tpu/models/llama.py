@@ -85,6 +85,14 @@ class LlamaConfig:
     # suffix (not prefix) frees residuals earliest in the backward
     # sweep. None = all layers.
     remat_pin_layers: Optional[int] = None
+    # Decode-path W8A8: keep int8 weights AS int8 through the matmul
+    # (per-token symmetric activation quant, s8×s8→s32 on the MXU)
+    # instead of dequantizing to bf16 first. Weight-only int8 decode is
+    # CONVERT-bound on the VPU (~8B weight elements widen per step —
+    # measured ~2× the HBM roofline on 8B batch-4); the int8 MXU path
+    # removes the widening entirely. Opt-in: activation quantization
+    # perturbs logits (rare greedy tie flips).
+    w8a8_decode: bool = False
 
     @staticmethod
     def llama3_8b(**kw) -> "LlamaConfig":
@@ -249,9 +257,31 @@ def param_specs(cfg: LlamaConfig) -> Params:
 # forward
 
 
-def _maybe_lora(name: str, x: jnp.ndarray, w: jnp.ndarray, lora_layer) -> jnp.ndarray:
-    """x @ w, plus the low-rank LoRA delta when an adapter is attached."""
-    y = x @ w.astype(x.dtype)
+def _int8_matmul(x: jnp.ndarray, w: dict, out_dtype=None) -> jnp.ndarray:
+    """W8A8: per-token symmetric activation quant → s8×s8 MXU dot →
+    rescale by (activation scale × per-channel weight scale)."""
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    sx = jnp.maximum(amax.astype(jnp.float32), 1e-8) / 127.0
+    xq = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / sx), -127, 127
+    ).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, w["q"],
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (acc.astype(jnp.float32) * sx * w["scale"][0][None, :]).astype(
+        out_dtype or x.dtype
+    )
+
+
+def _maybe_lora(name: str, x: jnp.ndarray, w, lora_layer) -> jnp.ndarray:
+    """x @ w, plus the low-rank LoRA delta when an adapter is attached.
+    ``w`` may be an un-dequantized int8 leaf (the W8A8 decode path)."""
+    if isinstance(w, dict):
+        y = _int8_matmul(x, w)
+    else:
+        y = x @ w.astype(x.dtype)
     if lora_layer is not None and name in lora_layer:
         a = lora_layer[name]["a"].astype(x.dtype)  # [D, r]
         b = lora_layer[name]["b"].astype(x.dtype)  # [r, out]
@@ -298,7 +328,10 @@ def _decoder_layer(
     # layer's bf16 copy ever materialises, and the backward pass
     # recomputes the dequant from int8 instead of holding 2× weights.
     # This is what lets an 8B QLoRA fine-tune fit a single 16GiB v5e.
-    layer = _maybe_dequant(layer, cfg.dtype)
+    # Under w8a8_decode (cache path only), int8 matmul weights skip
+    # dequant entirely — _maybe_lora runs them on the int8 MXU.
+    keep = cache_layer is not None and cfg.w8a8_decode
+    layer = _maybe_dequant(layer, cfg.dtype, keep_int8_matmuls=keep)
 
     h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
     q = _maybe_lora("wq", h, layer["wq"], lora_layer)
@@ -346,15 +379,30 @@ def cache_write_and_attend(
     depth, so writes scatter per-row — S must be 1 on that path.
     """
     if getattr(cache_index, "ndim", 0) == 1:
-        B = q.shape[0]
-        assert q.shape[1] == 1, "vector cache_index requires S == 1"
+        B, S = q.shape[0], q.shape[1]
         rows = jnp.arange(B)
-        ck = cache_layer["k"].at[rows, cache_index].set(
-            kk[:, 0].astype(cache_layer["k"].dtype)
-        )
-        cv = cache_layer["v"].at[rows, cache_index].set(
-            vv[:, 0].astype(cache_layer["v"].dtype)
-        )
+        if S == 1:
+            ck = cache_layer["k"].at[rows, cache_index].set(
+                kk[:, 0].astype(cache_layer["k"].dtype)
+            )
+            cv = cache_layer["v"].at[rows, cache_index].set(
+                vv[:, 0].astype(cache_layer["v"].dtype)
+            )
+        else:
+            # per-row offsets with a multi-token window — the engine's
+            # speculative verify (k+1 tokens per slot, each slot at its
+            # own depth). Clamp keeps ragged slots in bounds; the
+            # engine's kv_mask excludes anything beyond the real window.
+            S_max = cache_layer["k"].shape[1]
+            cols = jnp.clip(
+                cache_index[:, None] + jnp.arange(S)[None, :], 0, S_max - 1
+            )
+            ck = cache_layer["k"].at[rows[:, None], cols].set(
+                kk.astype(cache_layer["k"].dtype)
+            )
+            cv = cache_layer["v"].at[rows[:, None], cols].set(
+                vv.astype(cache_layer["v"].dtype)
+            )
     else:
         ck = jax.lax.dynamic_update_slice(
             cache_layer["k"],
@@ -763,24 +811,35 @@ def forward_with_cache(
     )
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = lm_head_weight(params, cfg)
-    logits = jnp.einsum(
-        "bsd,dv->bsv", x, head.astype(cfg.dtype), preferred_element_type=jnp.float32
-    )
+    head_leaf = params.get("lm_head")
+    if (
+        cfg.w8a8_decode
+        and isinstance(head_leaf, dict)
+        and set(head_leaf) == {"q", "scale"}
+    ):
+        # the single biggest decode matmul (D×V): int8 MXU, f32 logits
+        logits = _int8_matmul(x, head_leaf, out_dtype=jnp.float32)
+    else:
+        head = lm_head_weight(params, cfg)
+        logits = jnp.einsum(
+            "bsd,dv->bsv", x, head.astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        )
     return logits, new_cache
 
 
-def _maybe_dequant(tree: Params, dtype) -> Params:
+def _maybe_dequant(tree: Params, dtype, keep_int8_matmuls: bool = False) -> Params:
     """Dequantize any {"q","scale"} (int8) or {"q4","scale4"} (int4)
     leaves one level down (the shape a per-layer slice of a quantized
-    param tree has)."""
+    param tree has). ``keep_int8_matmuls`` leaves int8 leaves packed
+    for the W8A8 decode path (int4 always dequantizes — no 4-bit MXU)."""
     from odh_kubeflow_tpu.models.quant import dequantize_tensor
 
     out = {}
     for k, v in tree.items():
-        if isinstance(v, dict) and (
-            set(v) == {"q", "scale"} or set(v) == {"q4", "scale4"}
-        ):
+        if isinstance(v, dict) and set(v) == {"q", "scale"}:
+            out[k] = v if keep_int8_matmuls else dequantize_tensor(v, dtype)
+        elif isinstance(v, dict) and set(v) == {"q4", "scale4"}:
             out[k] = dequantize_tensor(v, dtype)
         else:
             out[k] = v

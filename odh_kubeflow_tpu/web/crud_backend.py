@@ -150,9 +150,19 @@ class CrudBackend:
         """Latest Warning-event message for a resource — what turns a
         bare 'waiting' status into an actionable 'warning' one."""
         message: Optional[str] = None
+        latest = ""
         for event in self.api.list("Event", namespace=namespace):
             if event.get("type") != "Warning":
                 continue
-            if match(event.get("involvedObject", {})):
+            if not match(event.get("involvedObject", {})):
+                continue
+            ts = event.get("lastTimestamp") or event.get(
+                "firstTimestamp", ""
+            )
+            # latest by recurrence time, not list position: the store
+            # dedupes repeats in place, so a recurring warning keeps an
+            # early list slot while only its lastTimestamp advances
+            if ts >= latest:
+                latest = ts
                 message = event.get("message", event.get("reason", ""))
         return message

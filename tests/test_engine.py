@@ -248,3 +248,75 @@ def test_greedy_fast_path_matches_sampling_program(model):
         finally:
             engine.stop()
     assert results[True] == results[False], results
+
+
+def test_spec_decode_engine_greedy_exact(model):
+    """Draft-attached engine: continuous batching × speculative
+    decoding must stay token-exact vs the engine's own plain greedy
+    decode (acceptance only keeps proposals the target would have
+    emitted anyway), across concurrent in-flight streams — and reject
+    sampled requests (verify is exact only under argmax)."""
+    cfg, params = model
+    # the target doubles as a perfect draft: acceptance ≈ 1, so the
+    # exactness check also covers the all-accepted cap path
+    plain = DecodeEngine(
+        params, cfg, n_slots=2, max_len=256, chunk=4,
+        prompt_buckets=(16, 64), cache_dtype=jnp.float32,
+    )
+    spec = DecodeEngine(
+        params, cfg, n_slots=2, max_len=256, chunk=4,
+        prompt_buckets=(16, 64), cache_dtype=jnp.float32,
+        draft_params=params, draft_cfg=cfg, spec_k=3,
+    )
+    try:
+        prompts = [[5, 9, 13], list(range(3, 40)), [7] * 10]
+        want = {}
+        for i, p in enumerate(prompts):
+            want[i] = plain.submit(p, max_tokens=11).result(timeout=120)
+        handles = [
+            spec.submit(p, max_tokens=11) for p in prompts
+        ]
+        for i, h in enumerate(handles):
+            got = h.result(timeout=120)
+            assert got == want[i], (i, got, want[i])
+        assert spec.spec_rounds > 0
+        # a perfect draft should average well over 1 token per round
+        assert spec.tokens_emitted / spec.spec_rounds > 1.5, (
+            spec.tokens_emitted, spec.spec_rounds
+        )
+        with pytest.raises(ValueError):
+            spec.submit([1, 2, 3], max_tokens=4, temperature=0.8)
+    finally:
+        plain.stop()
+        spec.stop()
+
+
+def test_spec_engine_composes_with_prefix_cache(model):
+    """All three serving levers in one engine: a shared prompt prefix
+    is reused (target-side), the draft re-prefills from scratch, and
+    outputs remain exact vs the plain engine."""
+    cfg, params = model
+    plain = DecodeEngine(
+        params, cfg, n_slots=2, max_len=256, chunk=4,
+        prompt_buckets=(16, 64), cache_dtype=jnp.float32,
+    )
+    spec = DecodeEngine(
+        params, cfg, n_slots=2, max_len=256, chunk=4,
+        prompt_buckets=(16, 64), cache_dtype=jnp.float32,
+        draft_params=params, draft_cfg=cfg, spec_k=3,
+        prefix_cache_entries=2, prefix_buckets=(16,),
+    )
+    try:
+        system = [3 + (i % 11) for i in range(16)]
+        p1 = system + [7, 9, 2]
+        p2 = system + [5, 1]
+        for p in (p1, p2):
+            want = plain.submit(p, max_tokens=10).result(timeout=120)
+            got = spec.submit(p, max_tokens=10).result(timeout=120)
+            assert got == want, (got, want)
+        assert spec.prefix_hits == 1, (
+            spec.prefix_hits, spec.prefix_misses
+        )
+    finally:
+        plain.stop()
+        spec.stop()

@@ -280,3 +280,39 @@ def test_int4_specs_and_trainer_smoke():
     losses = [float(tr.train_step(batch)["loss"]) for _ in range(8)]
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_w8a8_decode_matches_dequant_path():
+    """W8A8 int8-MXU decode: _int8_matmul tracks the dequantized
+    matmul closely, and the cached forward's greedy decode agrees with
+    the dequant path on a tiny model (the opt-in serving fast path —
+    a scale-layout regression must fail HERE, not in a TPU loadtest)."""
+    import dataclasses
+
+    from odh_kubeflow_tpu.models import LlamaConfig, llama
+    from odh_kubeflow_tpu.models.generate import GenerateConfig, generate
+    from odh_kubeflow_tpu.models.llama import _int8_matmul
+    from odh_kubeflow_tpu.models.quant import quantize_params, quantize_tensor
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 3, 64)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((64, 96)) * 0.1, jnp.float32)
+    got = _int8_matmul(x, quantize_tensor(w))
+    want = x @ w
+    err = float(jnp.abs(got - want).max() / jnp.abs(want).max())
+    assert err < 0.05, err
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+    qp = quantize_params(params)
+    prompt = jnp.asarray([[5, 9, 13, 2]], jnp.int32)
+    g = GenerateConfig(max_new_tokens=10, temperature=0.0)
+    o1 = generate(qp, prompt, cfg, g)
+    o2 = generate(
+        qp, prompt, dataclasses.replace(cfg, w8a8_decode=True), g
+    )
+    t1 = np.asarray(o1["tokens"])[0][: int(o1["lengths"][0])]
+    t2 = np.asarray(o2["tokens"])[0][: int(o2["lengths"][0])]
+    n = min(len(t1), len(t2))
+    agree = (t1[:n] == t2[:n]).mean()
+    assert agree >= 0.8, (t1.tolist(), t2.tolist())
