@@ -260,14 +260,21 @@ def main() -> None:
                 detail["long_context"] = {"error": str(e)[:200]}
             finally:
                 # free the ~8GB int8 base even when benchmark() raised,
-                # or every remaining row inherits the OOM
+                # or every remaining row inherits the OOM; the explicit
+                # gc + cache clear matters since r4's "attn_mlp" 1B row
+                # pins ~6.5GB of residuals — it only fits if the 8B
+                # row's arena actually drained first
                 del t8l
+                import gc
+
+                gc.collect()
+                jax.clear_caches()
 
         long_trainer = None
         if not over_budget():
             try:
                 long_trainer = Trainer(
-                    _dc.replace(cfg, remat_policy="attn"),
+                    _dc.replace(cfg, remat_policy="attn_mlp"),
                     TrainConfig(warmup_steps=2, total_steps=100),
                     lora_cfg=LoraConfig(rank=16),
                     mesh=mesh,

@@ -473,6 +473,9 @@ class DecodeEngine:
         _, sub = self._dfwd(
             dparams, prompt, self.draft_cfg, sub, jnp.int32(0),
             positions=positions, kv_mask=kv_mask1,
+            # an MoE draft's router must not let bucket-padding tokens
+            # consume expert capacity (same contract as _prefill)
+            token_mask=kv_mask1[:, :S_b],
         )
         st = dict(state)
         st["dcache"] = {
@@ -691,21 +694,34 @@ class DecodeEngine:
                 self.params, self.lora, self._state, packed,
             )
             self._maybe_insert_prefix(req.prompt, slot)
-        if self.draft_params is not None:
-            full_bucket = next(b for b in self.prompt_buckets if L <= b)
-            row = self.pack_admission(req.prompt, self.pad_id, full_bucket, req)
-            row[0, full_bucket + 1] = slot
-            self._state = self._draft_prefill_runner(full_bucket)(
-                self.draft_params, self._state, jnp.asarray(row),
-            )
         # defer the first-token fetch: the device value is collected
         # with the NEXT chunk's device_get (one round-trip for both)
-        # unless the request can't enter a slot at all
+        # unless the request can't enter a slot at all. Checked BEFORE
+        # the draft prefill — a max_tokens<=1 request never decodes, so
+        # filling a draft cache for it (plus possibly a fresh bucket
+        # compile) would be pure waste.
         if req.max_tokens <= 1:
             tok = int(first)
             req._emit(tok)
             req._finish()
             return
+        if self.draft_params is not None:
+            full_bucket = next(b for b in self.prompt_buckets if L <= b)
+            if plen is None and full_bucket == bucket:
+                # cache-miss path: the target admission row is the
+                # same full prompt in the same bucket — one upload,
+                # not two (a prefix HIT's row holds only the remainder,
+                # so it is never reusable here)
+                drow = packed
+            else:
+                row = self.pack_admission(
+                    req.prompt, self.pad_id, full_bucket, req
+                )
+                row[0, full_bucket + 1] = slot
+                drow = jnp.asarray(row)
+            self._state = self._draft_prefill_runner(full_bucket)(
+                self.draft_params, self._state, drow,
+            )
         self._slot_req[slot] = req  # claim before the next admission
         self._pending_first.append((req, first, slot))
 

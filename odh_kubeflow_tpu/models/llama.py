@@ -73,8 +73,13 @@ class LlamaConfig:
     # on dense/ring the "attn_out" tensor (~D floats) — so the
     # backward never re-executes the quadratic attention forward, at
     # a fraction of "dots" residency; the long-context sweet spot;
-    # "none": save only layer boundaries and recompute everything
-    # (minimum residency, maximum recompute).
+    # "attn_mlp": "attn" plus the roped q/k/v (the flash backward's
+    # inputs) and the MLP gate activation — the recompute shrinks to
+    # norms, the up matmul, and elementwise ops, at ~(S·F + S·D)·2B
+    # extra per layer (the 16k single-chip winner when it fits);
+    # "attn_offload": "attn" with residuals parked in pinned host
+    # memory; "none": save only layer boundaries and recompute
+    # everything (minimum residency, maximum recompute).
     remat_policy: str = "dots"
     # Memory-budgeted partial pinning: apply ``remat_policy`` to only
     # the LAST n layers and full recompute ("none") to the rest.
@@ -342,6 +347,12 @@ def _decoder_layer(
     vv = vv.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
     q = apply_rope(q, sin, cos)
     kk = apply_rope(kk, sin, cos)
+    # named for the "attn_mlp" remat policy: the flash backward kernels
+    # consume q/k/v — pinning the roped values removes the qkv
+    # projection + rope from the recompute entirely
+    q = _checkpoint_name(q, "q_rope")
+    kk = _checkpoint_name(kk, "k_rope")
+    vv = _checkpoint_name(vv, "v_proj")
     if cache_layer is not None:
         attn, cache_layer = cache_write_and_attend(
             q, kk, vv, cache_layer, cache_index, kv_mask
@@ -356,6 +367,11 @@ def _decoder_layer(
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
     gate = _maybe_lora("w_gate", h, layer["w_gate"], lora_layer)
     up = _maybe_lora("w_up", h, layer["w_up"], lora_layer)
+    # named for "attn_mlp": gate is pinned, up is NOT — silu' needs
+    # both, so the backward recomputes exactly one D→F matmul (up);
+    # pinning u as well (another S·F·2B/layer) OOMs the 16k configs
+    # the policy exists for (see _make_layer_fn)
+    gate = _checkpoint_name(gate, "mlp_g")
     x = x + _maybe_lora("w_down", jax.nn.silu(gate) * up, layer["w_down"], lora_layer)
     return x, cache_layer
 
@@ -509,7 +525,7 @@ def _make_layer_fn(cfg: LlamaConfig, attention_fn: Callable,
                     ),
                 )
             layer_fn = jax.checkpoint(layer_fn, policy=policy)
-        elif cfg.remat_policy in ("attn", "attn_offload"):
+        elif cfg.remat_policy in ("attn", "attn_offload", "attn_mlp"):
             # "flash_out"/"flash_lse" are the flash kernel's custom-vjp
             # residuals (ops/pallas_attention.py _flash_fwd): with them
             # saved, remat's recompute is projections-only — the O(S²)
@@ -527,6 +543,16 @@ def _make_layer_fn(cfg: LlamaConfig, attention_fn: Callable,
                 if resolved_attention_impl(cfg) == "flash"
                 else ("attn_out",)
             )
+            if cfg.remat_policy == "attn_mlp":
+                # "attn" + the roped q/k/v (the flash backward's other
+                # inputs) + the MLP gate activation: silu' needs g AND
+                # u, so one matmul (up) is still recomputed — pinning u
+                # as well (another S·F·2B/layer) OOMs the 16k configs
+                # this policy exists for (the models/moe.py
+                # pin_expert_acts trade, same reasoning). Residency
+                # ~(S·F + S·(D+2·Hkv·hd))·2B per layer (1B @ 16k:
+                # ~0.35GB/layer); budget with remat_pin_layers
+                names = names + ("q_rope", "k_rope", "v_proj", "mlp_g")
             if cfg.remat_policy == "attn_offload":
                 policy = (
                     jax.checkpoint_policies
@@ -542,8 +568,16 @@ def _make_layer_fn(cfg: LlamaConfig, attention_fn: Callable,
                     *names
                 )
             layer_fn = jax.checkpoint(layer_fn, policy=policy)
-        else:  # "none": full recompute, minimum residency
+        elif cfg.remat_policy == "none":
+            # full recompute, minimum residency
             layer_fn = jax.checkpoint(layer_fn)
+        else:
+            # a typo'd policy silently falling through to full
+            # recompute would be a ~2× slower backward with no signal
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r}; expected "
+                "'dots', 'attn', 'attn_mlp', 'attn_offload', or 'none'"
+            )
     return layer_fn
 
 

@@ -632,6 +632,12 @@ def _moe_decoder_layer(
     )
     q = llama.apply_rope(q, sin, cos)
     k = llama.apply_rope(k, sin, cos)
+    # named for the "attn_mlp" policy (same contract as the dense
+    # family): pinning the roped q/k/v removes the qkv projection +
+    # rope from the backward's recompute
+    q = llama._checkpoint_name(q, "q_rope")
+    k = llama._checkpoint_name(k, "k_rope")
+    v = llama._checkpoint_name(v, "v_proj")
     attn = attention_fn(q, k, v, segment_ids=segment_ids).reshape(B, S, b.q_dim)
     attn = llama._checkpoint_name(attn, "attn_out")
     x = x + llama._maybe_lora("wo", attn, layer["wo"], lora_layer)
@@ -799,10 +805,16 @@ def forward(
             if b.attention_impl == "flash"
             else ["attn_out"]
         )
+        if policy == "attn_mlp":
+            # dense-family "attn_mlp" analogue: also pin the roped
+            # q/k/v (the flash backward's inputs), removing the qkv
+            # projection + rope from the recompute; the MoE MLP's
+            # equivalent is pin_expert_acts ("moe_g")
+            names += ["q_rope", "k_rope", "v_proj"]
         named = jax.checkpoint_policies.save_only_these_names(*names)
         if policy == "none":
             return jax.checkpoint(layer_fn)
-        if policy == "attn":
+        if policy in ("attn", "attn_mlp"):
             return jax.checkpoint(layer_fn, policy=named)
         if policy == "attn_offload":
             # same vocabulary as the dense family (llama._make_layer_fn)
@@ -829,8 +841,8 @@ def forward(
                 ),
             )
         raise ValueError(
-            f"unknown remat_policy {policy!r}; expected "
-            "'dots', 'attn', 'attn_offload', or 'none'"
+            f"unknown remat_policy {policy!r}; expected 'dots', "
+            "'attn', 'attn_mlp', 'attn_offload', or 'none'"
         )
 
     layer_fn = make_layer_fn(cfg.pin_expert_acts)

@@ -53,53 +53,95 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _ki_live_fn(causal: bool, q_offset: int, block_q: int, block_k: int):
-    """Remap causally-dead K-block indices onto the live boundary block.
+def _pair_tables(*, num_q, num_k, causal, q_offset, sk, block_q, block_k,
+                 order, group=1):
+    """Static live-(Q-block, K-block) pair tables for the triangular
+    grids (scalar-prefetched, like ``pallas_grouped_matmul.span_pairs``
+    but fully host-side: liveness depends only on static geometry).
 
-    The kernel predicates dead blocks out of *compute*; this keeps them
-    out of *memory traffic* too — consecutive grid steps that map to the
-    same block index skip the re-fetch, so the dead upper-triangle
-    blocks cost neither MXU nor HBM bandwidth.
+    The old grids ran the full num_q×num_k rectangle and predicated
+    dead blocks off — at 16k/1024 causal that is ~half the programs
+    dispatched for nothing. Here the grid's last axis walks live pairs
+    only.
+
+    order="row": pairs sorted by (qi, ki) — fwd/dq walk, accumulator
+    keyed on the Q block. order="col": sorted by (ki, g, qj) with the
+    GQA group folded in — the dkv walk, accumulator keyed on the K
+    block. An owner with no live partner gets one synthetic masked
+    pair so its output block is still initialised and finalised
+    (l = 0 ⇒ zero output — the dense semantics fully-masked ring
+    shards rely on).
+
+    Returns int32 arrays of length L: ``qi``, ``ki``, ``g`` (0 unless
+    order="col"), ``first``/``last`` (accumulator init/flush flags).
     """
-    if not causal:
-        return lambda qi, ki: ki
+    import numpy as np
 
-    def live(qi, ki):
-        boundary = (qi * block_q + block_q - 1 + q_offset) // block_k
-        return jnp.maximum(0, jnp.minimum(ki, boundary))
+    def live(qb, kb):
+        if kb * block_k >= sk:
+            return False
+        if not causal:
+            return True
+        return kb * block_k <= qb * block_q + (block_q - 1) + q_offset
 
-    return live
+    qi_l, ki_l, g_l, first_l, last_l = [], [], [], [], []
+
+    def emit(items):
+        for j, (qi, kb, g) in enumerate(items):
+            qi_l.append(qi)
+            ki_l.append(kb)
+            g_l.append(g)
+            first_l.append(int(j == 0))
+            last_l.append(int(j == len(items) - 1))
+
+    if order == "row":
+        for qi in range(num_q):
+            kbs = [kb for kb in range(num_k) if live(qi, kb)]
+            emit([(qi, kb, 0) for kb in (kbs or [0])])
+    else:
+        for kb in range(num_k):
+            qjs = [qj for qj in range(num_q) if live(qj, kb)]
+            items = [(qj, kb, g) for g in range(group) for qj in qjs]
+            emit(items or [(0, kb, 0)])
+    return tuple(
+        jnp.asarray(np.asarray(a, np.int32))
+        for a in (qi_l, ki_l, g_l, first_l, last_l)
+    )
 
 
-def _qj_live_fn(causal: bool, q_offset: int, block_q: int, block_k: int,
-                num_q: int):
-    """Mirror of _ki_live_fn for the dK/dV kernel's Q-block axis."""
-    if not causal:
-        return lambda ki, qj: qj
-
-    def live(ki, qj):
-        boundary = (ki * block_k - q_offset) // block_q
-        return jnp.minimum(num_q - 1, jnp.maximum(qj, boundary))
-
-    return live
 
 
-def _block_predicates(qb, ki, *, causal, q_offset, sk, block_q, block_k):
-    """(run, full) for the block at Q-block index ``qb`` / K-block
-    index ``ki``. ``run``: any (row, col) pair is live under the causal
-    skip. ``full``: EVERY pair is live — interior causal blocks with no
-    padded K columns, the hot case at long context (S=16k, block 1024:
-    120 of 136 live blocks are full). Full blocks skip the iota/
-    compare/select mask arithmetic, which is what the VPU otherwise
-    burns time on between the MXU dots."""
-    run = True
+def _block_full(qb, ki, *, causal, q_offset, sk, block_q, block_k):
+    """True iff EVERY (row, col) pair of the block is live — interior
+    causal blocks with no padded K columns, the hot case at long
+    context (S=16k, block 1024: 120 of 136 live blocks are full). Full
+    blocks skip the iota/compare/select mask arithmetic, which is what
+    the VPU otherwise burns time on between the MXU dots. (Liveness
+    itself is static now — _pair_tables enumerates live pairs — so
+    there is no 'run' predicate anymore.)"""
     full = (ki + 1) * block_k <= sk
     if causal:
-        run = ki * block_k <= qb * block_q + (block_q - 1) + q_offset
         full = jnp.logical_and(
             full, qb * block_q + q_offset >= ki * block_k + (block_k - 1)
         )
-    return run, full
+    return full
+
+
+def _dispatch_body(full, has_segments, body):
+    """Full/edge split shared by the three kernels: segmented kernels
+    always take the masked path (segment walls can cut any block);
+    otherwise interior blocks run the mask-free fast path."""
+    if has_segments:
+        body(masked=True)
+    else:
+
+        @pl.when(full)
+        def _full():
+            body(masked=False)
+
+        @pl.when(jnp.logical_not(full))
+        def _edge():
+            body(masked=True)
 
 
 def _block_mask(qb, ki, qseg_ref, kseg_ref, *, causal, q_offset, sk,
@@ -119,74 +161,58 @@ def _block_mask(qb, ki, qseg_ref, kseg_ref, *, causal, q_offset, sk,
     return mask
 
 
-def _when_blocks(run, full, has_segments, body):
-    """Dispatch a kernel body over the full/edge split. Segmented
-    kernels always take the masked path (segment walls can cut any
-    block); otherwise interior blocks run the mask-free fast path."""
-    if has_segments:
-
-        @pl.when(run)
-        def _masked():
-            body(masked=True)
-
-    else:
-
-        @pl.when(jnp.logical_and(run, full))
-        def _full():
-            body(masked=False)
-
-        @pl.when(jnp.logical_and(run, jnp.logical_not(full)))
-        def _edge():
-            body(masked=True)
-
-
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 
 def _fwd_kernel(
-    q_ref,  # [1, 1, block_q, hd]
+    qi_ref,  # [L] scalar-prefetch: Q-block of pair i
+    ki_ref,  # [L] K-block of pair i
+    g_ref,  # [L] unused here (order="row")
+    first_ref,  # [L] 1 on the first pair of each Q block
+    last_ref,  # [L] 1 on the last pair of each Q block
+    q_ref,  # [1, 1, block_q, hd]   (prescaled by scale·log2e in HBM)
     k_ref,  # [1, 1, block_k, hd]
-    v_ref,  # [1, 1, block_k, hd]
+    v_ref,  # [1, 1, block_k, hd+1] when aug (ones column), else hd
     qseg_ref,  # [1, block_q] or None
     kseg_ref,  # [1, block_k] or None
     o_ref,  # [1, 1, block_q, hd]
     lse_ref,  # [1, 1, block_q, 1]
-    acc_scr,  # [block_q, hd] f32
+    acc_scr,  # [block_q, hd+1] f32 when aug (last column = l), else hd
     m_scr,  # [block_q, 1] f32
-    l_scr,  # [block_q, 1] f32
+    l_scr,  # [block_q, 1] f32 — used only when not aug
     *,
-    scale: float,
     causal: bool,
     q_offset: int,
     sk: int,
     block_q: int,
     block_k: int,
-    num_k: int,
+    hd: int,
+    aug: bool,
 ):
-    qi = pl.program_id(2)
-    ki = pl.program_id(3)
+    i = pl.program_id(2)
+    qi = qi_ref[i]
+    ki = ki_ref[i]
 
-    @pl.when(ki == 0)
+    @pl.when(first_ref[i] == 1)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
+        if not aug:
+            l_scr[...] = jnp.zeros_like(l_scr)
 
     geom = dict(
         causal=causal, q_offset=q_offset, sk=sk,
         block_q=block_q, block_k=block_k,
     )
-    run, full = _block_predicates(qi, ki, **geom)
+    full = _block_full(qi, ki, **geom)
 
     def body(masked: bool):
         # Dots take the native (bf16) operands — the MXU runs bf16
         # inputs at full rate — and accumulate in f32 via
         # preferred_element_type. Softmax statistics stay f32.
-        # Scaling (incl. the base-2 fold) rides on the [bq, hd] q block
-        # (block_k/hd ≈ 16× cheaper than scaling the [bq, bk] scores).
-        q = q_ref[0, 0] * (scale * _LOG2E)
+        q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
 
@@ -211,7 +237,14 @@ def _fwd_kernel(
             # m_new == _NEG_INF and exp(s - m_new) == 1 for masked
             # entries, which would poison l/acc with phantom mass.
             p = jnp.where(mask, p, 0.0)
-        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        if not aug:
+            l_scr[...] = l_scr[...] * alpha + jnp.sum(
+                p, axis=1, keepdims=True
+            )
+        # when aug, v's appended ones column makes the pv dot carry the
+        # softmax denominator through the same rescale recurrence as
+        # the numerator (l_new = α·l + Σp rides in acc[:, hd]) — the
+        # VPU row-sum pass moves onto MXU lanes that were pad at hd=64
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             p.astype(v.dtype),
             v,
@@ -220,13 +253,14 @@ def _fwd_kernel(
         )
         m_scr[...] = m_new
 
-    _when_blocks(run, full, qseg_ref is not None, body)
+    _dispatch_body(full, qseg_ref is not None, body)
 
-    @pl.when(ki == num_k - 1)
+    @pl.when(last_ref[i] == 1)
     def _finalize():
-        l = l_scr[...]
+        acc = acc_scr[...]
+        l = acc[:, hd:] if aug else l_scr[...]
         l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows
-        o_ref[0, 0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc[:, :hd] / l_safe).astype(o_ref.dtype)
         lse_ref[0, 0] = m_scr[...] + jnp.log2(l_safe)
 
 
@@ -249,19 +283,41 @@ def _fwd(
     _, Hkv, Sk, _ = k.shape
     group = Hq // Hkv
     num_q, num_k = Sq // block_q, Sk // block_k
+    # operand augmentation rides MXU lanes that are pad at hd=64 — but
+    # at 128-aligned head dims it would push every block to the next
+    # 128 multiple (hd=128 → 2× dot cost), so gate it
+    aug = hd % 128 != 0
 
-    ki_live = _ki_live_fn(causal, q_offset, block_q, block_k)
+    tabs = _pair_tables(
+        num_q=num_q, num_k=num_k, causal=causal, q_offset=q_offset,
+        sk=sk, block_q=block_q, block_k=block_k, order="row",
+    )
+    L = tabs[0].shape[0]
+    # base-2 softmax fold rides the q prescale, done once in HBM (the
+    # in-kernel variant redid the multiply on every (qi, ki) revisit);
+    # python-float × bf16 rounds identically either way
+    q = q * (scale * _LOG2E)
+    if aug:
+        # ones column: the pv dot computes numerator AND denominator
+        v = jnp.concatenate([v, jnp.ones_like(v[..., :1])], axis=-1)
+    hd_v = v.shape[-1]
+
     qspec = pl.BlockSpec(
         (1, 1, block_q, hd),
-        lambda b, h, qi, ki: (b, h, qi, 0),
+        lambda b, h, i, qi, ki, g, fs, ls: (b, h, qi[i], 0),
         memory_space=pltpu.VMEM,
     )
-    kvspec = pl.BlockSpec(
+    kspec = pl.BlockSpec(
         (1, 1, block_k, hd),
-        lambda b, h, qi, ki: (b, h // group, ki_live(qi, ki), 0),
+        lambda b, h, i, qi, ki, g, fs, ls: (b, h // group, ki[i], 0),
         memory_space=pltpu.VMEM,
     )
-    in_specs = [qspec, kvspec, kvspec]
+    vspec = pl.BlockSpec(
+        (1, 1, block_k, hd_v),
+        lambda b, h, i, qi, ki, g, fs, ls: (b, h // group, ki[i], 0),
+        memory_space=pltpu.VMEM,
+    )
+    in_specs = [qspec, kspec, vspec]
     args = [q, k, v]
     if qseg is not None:
         # qseg rides as a [B, Sq, 1] column, kseg as a [B, 1, Sk] row:
@@ -270,14 +326,14 @@ def _fwd(
         in_specs.append(
             pl.BlockSpec(
                 (1, block_q, 1),
-                lambda b, h, qi, ki: (b, qi, 0),
+                lambda b, h, i, qi, ki, g, fs, ls: (b, qi[i], 0),
                 memory_space=pltpu.VMEM,
             )
         )
         in_specs.append(
             pl.BlockSpec(
                 (1, 1, block_k),
-                lambda b, h, qi, ki: (b, 0, ki),
+                lambda b, h, i, qi, ki, g, fs, ls: (b, 0, ki[i]),
                 memory_space=pltpu.VMEM,
             )
         )
@@ -285,53 +341,57 @@ def _fwd(
 
     kernel = functools.partial(
         _fwd_kernel,
-        scale=scale,
         causal=causal,
         q_offset=q_offset,
         sk=sk,
         block_q=block_q,
         block_k=block_k,
-        num_k=num_k,
+        hd=hd,
+        aug=aug,
     )
     if qseg is None:
         base = kernel
 
-        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l):
-            return base(q_ref, k_ref, v_ref, None, None,
+        def kernel(qi_r, ki_r, g_r, fs_r, ls_r,
+                   q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l):
+            return base(qi_r, ki_r, g_r, fs_r, ls_r,
+                        q_ref, k_ref, v_ref, None, None,
                         o_ref, lse_ref, acc, m, l)
 
     out, lse = pl.pallas_call(
         kernel,
-        grid=(B, Hq, num_q, num_k),
-        in_specs=in_specs,
-        out_specs=(
-            pl.BlockSpec(
-                (1, 1, block_q, hd),
-                lambda b, h, qi, ki: (b, h, qi, 0),
-                memory_space=pltpu.VMEM,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(B, Hq, L),
+            in_specs=in_specs,
+            out_specs=(
+                pl.BlockSpec(
+                    (1, 1, block_q, hd),
+                    lambda b, h, i, qi, ki, g, fs, ls: (b, h, qi[i], 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_q, 1),
+                    lambda b, h, i, qi, ki, g, fs, ls: (b, h, qi[i], 0),
+                    memory_space=pltpu.VMEM,
+                ),
             ),
-            pl.BlockSpec(
-                (1, 1, block_q, 1),
-                lambda b, h, qi, ki: (b, h, qi, 0),
-                memory_space=pltpu.VMEM,
-            ),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, hd_v), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+            ],
         ),
         out_shape=(
             jax.ShapeDtypeStruct((B, Hq, Sq, hd), q.dtype),
             jax.ShapeDtypeStruct((B, Hq, Sq, 1), jnp.float32),
         ),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, hd), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-        ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(*args)
+    )(*tabs, *args)
     return out, lse
-
 
 # ---------------------------------------------------------------------------
 # backward
@@ -339,16 +399,22 @@ def _fwd(
 
 
 def _dq_kernel(
-    q_ref,
-    k_ref,
-    v_ref,
-    do_ref,
-    lse_ref,  # [1, 1, block_q, 1]
-    delta_ref,  # [1, 1, block_q]
+    qi_ref,  # [L] scalar-prefetch (see _pair_tables, order="row")
+    ki_ref,
+    g_ref,  # unused (order="row")
+    first_ref,
+    last_ref,
+    q_ref,  # aug: [1,1,bq,hd+2] = [q·scale·log2e | lse_hi | lse_lo];
+            # else [1,1,bq,hd] prescaled q
+    k_ref,  # aug: [1,1,bk,hd+2] = [k | -1 | -1]; else [1,1,bk,hd]
+    v_ref,  # aug: [1,1,bk,hd+2] = [v | -1 | -1]; else [1,1,bk,hd]
+    do_ref,  # aug: [1,1,bq,hd+2] = [do | δ_hi | δ_lo]; else [1,1,bq,hd]
+    lse_ref,  # [1,1,bq,1] f32 — only when not aug (else folded into q)
+    delta_ref,  # [1,1,bq,1] f32 — only when not aug
     qseg_ref,
     kseg_ref,
     dq_ref,  # [1, 1, block_q, hd]
-    dq_scr,  # [block_q, hd] f32
+    dq_scr,  # [block_q, operand width] f32
     *,
     scale: float,
     causal: bool,
@@ -356,12 +422,13 @@ def _dq_kernel(
     sk: int,
     block_q: int,
     block_k: int,
-    num_k: int,
+    hd: int,
 ):
-    qi = pl.program_id(2)
-    ki = pl.program_id(3)
+    i = pl.program_id(2)
+    qi = qi_ref[i]
+    ki = ki_ref[i]
 
-    @pl.when(ki == 0)
+    @pl.when(first_ref[i] == 1)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
@@ -369,24 +436,31 @@ def _dq_kernel(
         causal=causal, q_offset=q_offset, sk=sk,
         block_q=block_q, block_k=block_k,
     )
-    run, full = _block_predicates(qi, ki, **geom)
+    full = _block_full(qi, ki, **geom)
 
     def body(masked: bool):
-        # s comes from the pre-scaled q (base-2 fold included); the
-        # outer `* scale` on ds is linear, so it moves to the finalize
-        # (one [bq, hd] multiply instead of a [bq, bk] one per block).
-        q = q_ref[0, 0] * (scale * _LOG2E)
+        # Augmented mode (hd not 128-aligned): the row constants ride
+        # the contraction instead of the VPU — q's two appended columns
+        # carry lse (hi/lo split; one bf16 column would cost ~3 decimal
+        # digits on the exponent), k's carry -1, so the s dot lands
+        # directly on s·log2e·scale − lse and exp2 applies with no
+        # [bq, bk] subtract pass; same for delta via do/v. The extra
+        # columns are free — at hd=64 the MXU lanes were pad anyway.
+        # At hd % 128 == 0 the same trick would push blocks to the next
+        # lane multiple (2× dot cost), so lse/delta arrive as row
+        # operands and subtract on the VPU instead.
+        q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0]  # base-2 (see _LOG2E)
-        delta = delta_ref[0, 0]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        p = jnp.exp2(s - lse)
+        if lse_ref is not None:
+            s = s - lse_ref[0, 0]
+        p = jnp.exp2(s)
         if masked:
             p = jnp.where(
                 _block_mask(qi, ki, qseg_ref, kseg_ref, **geom), p, 0.0
@@ -395,31 +469,40 @@ def _dq_kernel(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta)
+        if delta_ref is not None:
+            dp = dp - delta_ref[0, 0]
+        ds = p * dp
+        # aug: contracting against k_aug writes junk into dq_scr[:, hd:],
+        # sliced off at the finalize
         dq_scr[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    _when_blocks(run, full, qseg_ref is not None, body)
+    _dispatch_body(full, qseg_ref is not None, body)
 
-    @pl.when(ki == num_k - 1)
+    @pl.when(last_ref[i] == 1)
     def _finalize():
-        dq_ref[0, 0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+        dq_ref[0, 0] = (dq_scr[:, :hd] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
-    q_ref,
+    qi_ref,  # [L] scalar-prefetch (order="col": sorted by K block)
+    ki_ref,
+    g_ref,  # GQA group member of pair i
+    first_ref,
+    last_ref,
+    q_ref,  # same operand layouts as _dq_kernel (aug vs not)
     k_ref,
     v_ref,
     do_ref,
-    lse_ref,
-    delta_ref,
+    lse_ref,  # [1,1,bq,1] f32 — only when not aug
+    delta_ref,  # [1,1,bq,1] f32 — only when not aug
     qseg_ref,
     kseg_ref,
     dk_ref,  # [1, 1, block_k, hd]  per-KV-head
     dv_ref,
-    dk_scr,
+    dk_scr,  # [block_k, operand width] f32
     dv_scr,
     *,
     scale: float,
@@ -428,14 +511,13 @@ def _dkv_kernel(
     sk: int,
     block_q: int,
     block_k: int,
-    num_q: int,
-    total_q: int,
+    hd: int,
 ):
-    ki = pl.program_id(2)
-    t = pl.program_id(3)  # t = group_member * num_q + q_block
-    qj = t % num_q
+    i = pl.program_id(2)
+    qj = qi_ref[i]
+    ki = ki_ref[i]
 
-    @pl.when(t == 0)
+    @pl.when(first_ref[i] == 1)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -444,31 +526,30 @@ def _dkv_kernel(
         causal=causal, q_offset=q_offset, sk=sk,
         block_q=block_q, block_k=block_k,
     )
-    # run/full are symmetric in (Q block, K block): same predicates as
-    # the forward, evaluated at this program's qj.
-    run, full = _block_predicates(qj, ki, **geom)
+    # full is symmetric in (Q block, K block): same predicate as the
+    # forward, evaluated at this pair's qj.
+    full = _block_full(qj, ki, **geom)
 
     def body(masked: bool):
+        # Same operand folds as _dq_kernel (see the comment there).
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
 
-        # s from pre-scaled q (base-2 fold included); dK's `* scale` is
-        # linear and moves to the finalize. The dk dot below contracts
-        # against the ORIGINAL q — its scale factor is exactly the
-        # deferred one.
         s = jax.lax.dot_general(
-            q * (scale * _LOG2E), k, (((1,), (1,)), ((), ())),
+            q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        p = jnp.exp2(s - lse)  # [bq, bk]; lse is base-2
+        if lse_ref is not None:
+            s = s - lse_ref[0, 0]
+        p = jnp.exp2(s)
         if masked:
             p = jnp.where(
                 _block_mask(qj, ki, qseg_ref, kseg_ref, **geom), p, 0.0
             )
+        # aug: do's δ columns write junk into dv_scr[:, hd:], sliced at
+        # the finalize; likewise q's lse columns for dk_scr
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -477,18 +558,25 @@ def _dkv_kernel(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta)
+        if delta_ref is not None:
+            dp = dp - delta_ref[0, 0]
+        ds = p * dp
         dk_scr[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    _when_blocks(run, full, qseg_ref is not None, body)
+    _dispatch_body(full, qseg_ref is not None, body)
 
-    @pl.when(t == total_q - 1)
+    @pl.when(last_ref[i] == 1)
     def _finalize():
-        dk_ref[0, 0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
-        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+        # the dk dot contracted against the PRE-SCALED q (·scale·log2e);
+        # the raw-s gradient needs ·scale against raw q, so divide the
+        # log2e back out
+        dk_ref[0, 0] = (dk_scr[:, :hd] * (1.0 / _LOG2E)).astype(
+            dk_ref.dtype
+        )
+        dv_ref[0, 0] = dv_scr[:, :hd].astype(dv_ref.dtype)
 
 
 def _bwd(
@@ -513,189 +601,215 @@ def _bwd(
     _, Hkv, Sk, _ = k.shape
     group = Hq // Hkv
     num_q, num_k = Sq // block_q, Sk // block_k
+    # see _fwd: operand augmentation only where the lanes are pad anyway
+    aug = hd % 128 != 0
 
     # delta_i = rowsum(dO_i * O_i): cheap elementwise+reduce, XLA fuses it.
     delta = jnp.sum(
-        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+        keepdims=True,
     )
+    q = q * (scale * _LOG2E)  # base-2 fold, once in HBM
+    if aug:
+        # Row constants fold into the dots via two appended operand
+        # columns (hi/lo bf16 split keeps f32-grade precision; one bf16
+        # column would cost ~2% on exp2).
+        def _hi_lo(x):
+            hi = x.astype(k.dtype)
+            lo = (x - hi.astype(x.dtype)).astype(k.dtype)
+            return hi, lo
 
-    ki_live = _ki_live_fn(causal, q_offset, block_q, block_k)
-    qj_live = _qj_live_fn(causal, q_offset, block_q, block_k, num_q)
-
-    # --- dQ: grid (B, Hq, num_q, num_k), accumulate over k blocks ---
-    specs = dict(
-        q=pl.BlockSpec(
-            (1, 1, block_q, hd),
-            lambda b, h, qi, ki: (b, h, qi, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        kv=pl.BlockSpec(
-            (1, 1, block_k, hd),
-            lambda b, h, qi, ki: (b, h // group, ki_live(qi, ki), 0),
-            memory_space=pltpu.VMEM,
-        ),
-        row=pl.BlockSpec(
-            (1, 1, block_q, 1),
-            lambda b, h, qi, ki: (b, h, qi, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        qseg=pl.BlockSpec(
-            (1, block_q, 1),
-            lambda b, h, qi, ki: (b, qi, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        kseg=pl.BlockSpec(
-            (1, 1, block_k),
-            lambda b, h, qi, ki: (b, 0, ki),
-            memory_space=pltpu.VMEM,
-        ),
-    )
-
-    dq_args = [q, k, v, do, lse, delta]
-    dq_specs = [
-        specs["q"], specs["kv"], specs["kv"], specs["q"],
-        specs["row"], specs["row"],
-    ]
-    if qseg is not None:
-        dq_args += [qseg, kseg]
-        dq_specs += [specs["qseg"], specs["kseg"]]
+        lse_hi, lse_lo = _hi_lo(lse)
+        d_hi, d_lo = _hi_lo(delta)
+        neg1 = -jnp.ones_like(k[..., :1])
+        q = jnp.concatenate([q, lse_hi, lse_lo], -1)
+        k = jnp.concatenate([k, neg1, neg1], -1)
+        v = jnp.concatenate([v, neg1, neg1], -1)
+        do = jnp.concatenate([do, d_hi, d_lo], -1)
+    hd2 = q.shape[-1]
 
     common = dict(
         scale=scale, causal=causal, q_offset=q_offset, sk=sk,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, hd=hd,
     )
 
+    def row_spec(idx):
+        return pl.BlockSpec(
+            (1, 1, block_q, 1), idx, memory_space=pltpu.VMEM,
+        )
+
+    # --- dQ: grid (B, Hq, live pairs), accumulate over K blocks ------
+    dq_tabs = _pair_tables(
+        num_q=num_q, num_k=num_k, causal=causal, q_offset=q_offset,
+        sk=sk, block_q=block_q, block_k=block_k, order="row",
+    )
+    qblk = pl.BlockSpec(
+        (1, 1, block_q, hd2),
+        lambda b, h, i, qi, ki, g, fs, ls: (b, h, qi[i], 0),
+        memory_space=pltpu.VMEM,
+    )
+    kvblk = pl.BlockSpec(
+        (1, 1, block_k, hd2),
+        lambda b, h, i, qi, ki, g, fs, ls: (b, h // group, ki[i], 0),
+        memory_space=pltpu.VMEM,
+    )
+    dq_args = [q, k, v, do]
+    dq_specs = [qblk, kvblk, kvblk, qblk]
+    if not aug:
+        dq_args += [lse, delta]
+        dq_specs += [
+            row_spec(lambda b, h, i, qi, ki, g, fs, ls: (b, h, qi[i], 0)),
+            row_spec(lambda b, h, i, qi, ki, g, fs, ls: (b, h, qi[i], 0)),
+        ]
+    if qseg is not None:
+        dq_args += [qseg, kseg]
+        dq_specs += [
+            pl.BlockSpec(
+                (1, block_q, 1),
+                lambda b, h, i, qi, ki, g, fs, ls: (b, qi[i], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k),
+                lambda b, h, i, qi, ki, g, fs, ls: (b, 0, ki[i]),
+                memory_space=pltpu.VMEM,
+            ),
+        ]
+
     def dq_kernel(*refs):
+        tabs, rest = refs[:5], list(refs[5:])
+        q_r, k_r, v_r, do_r = rest[:4]
+        rest = rest[4:]
+        lse_r = delta_r = qs_r = ks_r = None
+        if not aug:
+            lse_r, delta_r = rest[:2]
+            rest = rest[2:]
         if qseg is not None:
-            (q_r, k_r, v_r, do_r, lse_r, dl_r, qs_r, ks_r, dq_r, scr) = refs
-        else:
-            (q_r, k_r, v_r, do_r, lse_r, dl_r, dq_r, scr) = refs
-            qs_r = ks_r = None
+            qs_r, ks_r = rest[:2]
+            rest = rest[2:]
+        dq_r, scr = rest
         _dq_kernel(
-            q_r, k_r, v_r, do_r, lse_r, dl_r, qs_r, ks_r, dq_r, scr,
-            num_k=num_k, **common,
+            *tabs, q_r, k_r, v_r, do_r, lse_r, delta_r, qs_r, ks_r,
+            dq_r, scr, **common,
         )
 
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(B, Hq, num_q, num_k),
-        in_specs=dq_specs,
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, hd),
-            lambda b, h, qi, ki: (b, h, qi, 0),
-            memory_space=pltpu.VMEM,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(B, Hq, dq_tabs[0].shape[0]),
+            in_specs=dq_specs,
+            out_specs=pl.BlockSpec(
+                (1, 1, block_q, hd),
+                lambda b, h, i, qi, ki, g, fs, ls: (b, h, qi[i], 0),
+                memory_space=pltpu.VMEM,
+            ),
+            scratch_shapes=[pltpu.VMEM((block_q, hd2), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, hd), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(*dq_args)
+    )(*dq_tabs, *dq_args)
 
-    # --- dK/dV: grid (B, Hkv, num_k, group*num_q). The GQA group is
-    # folded into the accumulation axis (t = g*num_q + qj), so dK/dV
-    # accumulate per KV head in VMEM scratch and hit HBM exactly once,
-    # in k.dtype — no per-Q-head f32 transients.
-    total_q = group * num_q
-
-    dkv_args = [q, k, v, do, lse, delta]
-    dkv_specs = [
-        pl.BlockSpec(
-            (1, 1, block_q, hd),
-            lambda b, h, ki, t: (
-                b, h * group + t // num_q, qj_live(ki, t % num_q), 0
-            ),
-            memory_space=pltpu.VMEM,
+    # --- dK/dV: grid (B, Hkv, live (ki, g, qj) triples). The GQA
+    # group is folded into the pair walk, so dK/dV accumulate per KV
+    # head in VMEM scratch and hit HBM exactly once, in k.dtype — no
+    # per-Q-head f32 transients.
+    dkv_tabs = _pair_tables(
+        num_q=num_q, num_k=num_k, causal=causal, q_offset=q_offset,
+        sk=sk, block_q=block_q, block_k=block_k, order="col",
+        group=group,
+    )
+    qhblk = pl.BlockSpec(
+        (1, 1, block_q, hd2),
+        lambda b, h, i, qi, ki, g, fs, ls: (
+            b, h * group + g[i], qi[i], 0
         ),
-        pl.BlockSpec(
-            (1, 1, block_k, hd),
-            lambda b, h, ki, t: (b, h, ki, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        pl.BlockSpec(
-            (1, 1, block_k, hd),
-            lambda b, h, ki, t: (b, h, ki, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        pl.BlockSpec(
-            (1, 1, block_q, hd),
-            lambda b, h, ki, t: (
-                b, h * group + t // num_q, qj_live(ki, t % num_q), 0
-            ),
-            memory_space=pltpu.VMEM,
-        ),
-        pl.BlockSpec(
-            (1, 1, block_q, 1),
-            lambda b, h, ki, t: (
-                b, h * group + t // num_q, qj_live(ki, t % num_q), 0
-            ),
-            memory_space=pltpu.VMEM,
-        ),
-        pl.BlockSpec(
-            (1, 1, block_q, 1),
-            lambda b, h, ki, t: (
-                b, h * group + t // num_q, qj_live(ki, t % num_q), 0
-            ),
-            memory_space=pltpu.VMEM,
-        ),
-    ]
+        memory_space=pltpu.VMEM,
+    )
+    kvhblk = pl.BlockSpec(
+        (1, 1, block_k, hd2),
+        lambda b, h, i, qi, ki, g, fs, ls: (b, h, ki[i], 0),
+        memory_space=pltpu.VMEM,
+    )
+    dkv_args = [q, k, v, do]
+    dkv_specs = [qhblk, kvhblk, kvhblk, qhblk]
+    if not aug:
+        dkv_args += [lse, delta]
+        dkv_specs += [
+            row_spec(lambda b, h, i, qi, ki, g, fs, ls: (
+                b, h * group + g[i], qi[i], 0
+            )),
+            row_spec(lambda b, h, i, qi, ki, g, fs, ls: (
+                b, h * group + g[i], qi[i], 0
+            )),
+        ]
     if qseg is not None:
         dkv_args += [qseg, kseg]
         dkv_specs += [
             pl.BlockSpec(
                 (1, block_q, 1),
-                lambda b, h, ki, t: (b, qj_live(ki, t % num_q), 0),
+                lambda b, h, i, qi, ki, g, fs, ls: (b, qi[i], 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
                 (1, 1, block_k),
-                lambda b, h, ki, t: (b, 0, ki),
+                lambda b, h, i, qi, ki, g, fs, ls: (b, 0, ki[i]),
                 memory_space=pltpu.VMEM,
             ),
         ]
 
     def dkv_kernel(*refs):
+        tabs, rest = refs[:5], list(refs[5:])
+        q_r, k_r, v_r, do_r = rest[:4]
+        rest = rest[4:]
+        lse_r = delta_r = qs_r = ks_r = None
+        if not aug:
+            lse_r, delta_r = rest[:2]
+            rest = rest[2:]
         if qseg is not None:
-            (q_r, k_r, v_r, do_r, lse_r, dl_r, qs_r, ks_r,
-             dk_r, dv_r, kscr, vscr) = refs
-        else:
-            (q_r, k_r, v_r, do_r, lse_r, dl_r, dk_r, dv_r, kscr, vscr) = refs
-            qs_r = ks_r = None
+            qs_r, ks_r = rest[:2]
+            rest = rest[2:]
+        dk_r, dv_r, kscr, vscr = rest
         _dkv_kernel(
-            q_r, k_r, v_r, do_r, lse_r, dl_r, qs_r, ks_r,
-            dk_r, dv_r, kscr, vscr, num_q=num_q, total_q=total_q, **common,
+            *tabs, q_r, k_r, v_r, do_r, lse_r, delta_r, qs_r, ks_r,
+            dk_r, dv_r, kscr, vscr, **common,
         )
 
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(B, Hkv, num_k, total_q),
-        in_specs=dkv_specs,
-        out_specs=(
-            pl.BlockSpec(
-                (1, 1, block_k, hd),
-                lambda b, h, ki, t: (b, h, ki, 0),
-                memory_space=pltpu.VMEM,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(B, Hkv, dkv_tabs[0].shape[0]),
+            in_specs=dkv_specs,
+            out_specs=(
+                pl.BlockSpec(
+                    (1, 1, block_k, hd),
+                    lambda b, h, i, qi, ki, g, fs, ls: (b, h, ki[i], 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_k, hd),
+                    lambda b, h, i, qi, ki, g, fs, ls: (b, h, ki[i], 0),
+                    memory_space=pltpu.VMEM,
+                ),
             ),
-            pl.BlockSpec(
-                (1, 1, block_k, hd),
-                lambda b, h, ki, t: (b, h, ki, 0),
-                memory_space=pltpu.VMEM,
-            ),
+            scratch_shapes=[
+                pltpu.VMEM((block_k, hd2), jnp.float32),
+                pltpu.VMEM((block_k, hd2), jnp.float32),
+            ],
         ),
         out_shape=(
             jax.ShapeDtypeStruct((B, Hkv, Sk, hd), k.dtype),
             jax.ShapeDtypeStruct((B, Hkv, Sk, hd), v.dtype),
         ),
-        scratch_shapes=[
-            pltpu.VMEM((block_k, hd), jnp.float32),
-            pltpu.VMEM((block_k, hd), jnp.float32),
-        ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(*dkv_args)
+    )(*dkv_tabs, *dkv_args)
 
     return dq, dk, dv
 

@@ -85,13 +85,16 @@ def chunked_cross_entropy(
     n = S // chunk
     if loss_mask is None:
         loss_mask = jnp.ones((B, S), dtype=jnp.float32)
-    hidden_c = hidden.reshape(B, n, chunk, D).swapaxes(0, 1)  # [n,B,c,D]
-    targets_c = targets.reshape(B, n, chunk).swapaxes(0, 1)
-    mask_c = loss_mask.reshape(B, n, chunk).swapaxes(0, 1)
 
     @jax.checkpoint  # backward recomputes this chunk's logits
-    def one_chunk(args):
-        h, t, m = args
+    def one_chunk(i):
+        # slice chunks out of the live activations instead of
+        # pre-stacking a [n, B, c, D] scan input: the stack (and its
+        # backward's unstack) is a full relayout of hidden at a
+        # different tiling — two more ~45 ms passes the slice avoids
+        h = jax.lax.dynamic_slice_in_dim(hidden, i * chunk, chunk, axis=1)
+        t = jax.lax.dynamic_slice_in_dim(targets, i * chunk, chunk, axis=1)
+        m = jax.lax.dynamic_slice_in_dim(loss_mask, i * chunk, chunk, axis=1)
         logits = jnp.einsum(
             "bcd,dv->bcv",
             h,
@@ -99,14 +102,23 @@ def chunked_cross_entropy(
             preferred_element_type=jnp.float32,
         )
         logz = jax.scipy.special.logsumexp(logits, axis=-1)
-        target_logit = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
+        # target logit via a head-column gather + rowwise dot, NOT
+        # take_along_axis on the [B, c, V] logits — whose backward is
+        # a scatter XLA lowers through a linear-layout relayout of the
+        # whole 0.5GB f32 chunk (~90 ms/step at 16k); the gather's
+        # backward is a gather. Gathering columns of [D, V] directly
+        # (axis=1) avoids materialising a [V, D] transposed copy of
+        # the head (1.05GB at 8B — an OOM at 16k).
+        ht = jnp.take(head, t.reshape(-1), axis=1)  # [D, B·c]
+        ht = ht.T.reshape(h.shape).astype(jnp.float32)
+        target_logit = jnp.sum(h.astype(jnp.float32) * ht, axis=-1)
         nll = logz - target_logit
         if z_loss:
             nll = nll + z_loss * jnp.square(logz)
         m = m.astype(jnp.float32)
         return jnp.sum(nll * m), jnp.sum(m)
 
-    nll_sum, mask_sum = jax.lax.map(one_chunk, (hidden_c, targets_c, mask_c))
+    nll_sum, mask_sum = jax.lax.map(one_chunk, jnp.arange(n))
     return jnp.sum(nll_sum) / jnp.maximum(jnp.sum(mask_sum), 1.0)
 
 

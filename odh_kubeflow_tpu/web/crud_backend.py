@@ -156,8 +156,14 @@ class CrudBackend:
                 continue
             if not match(event.get("involvedObject", {})):
                 continue
-            ts = event.get("lastTimestamp") or event.get(
-                "firstTimestamp", ""
+            # trailing `or ""`: modern Events carry eventTime with
+            # BOTH timestamp fields explicitly null, so the .get
+            # default never applies (same guard as the controller's
+            # re-emission path, controllers/notebook.py)
+            ts = (
+                event.get("lastTimestamp")
+                or event.get("firstTimestamp")
+                or ""
             )
             # latest by recurrence time, not list position: the store
             # dedupes repeats in place, so a recurring warning keeps an

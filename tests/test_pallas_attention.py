@@ -86,6 +86,29 @@ def test_grads_match_dense():
         assert jnp.allclose(r, g, atol=5e-4, rtol=1e-3), (name, err)
 
 
+def test_grads_match_dense_hd128():
+    """The production llama3 head_dim (128) takes the NON-augmented
+    backward path — lse/delta as row operands, VPU subtract —
+    while hd=64 tests cover the augmented-operand path; both branches
+    need gradient coverage (pallas_attention._bwd ``aug``)."""
+    B, S, Hq, Hkv, hd = 1, 256, 4, 2, 128
+    q, k, v = _qkv(jax.random.key(40), B, S, S, Hq, Hkv, hd)
+    tangent = jax.random.normal(jax.random.key(41), (B, S, Hq, hd))
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v, causal=True) * tangent)
+
+    ref_grads = jax.grad(lambda *a: loss(dense_attention, *a), argnums=(0, 1, 2))(
+        q, k, v
+    )
+    got_grads = jax.grad(lambda *a: loss(flash_attention, *a), argnums=(0, 1, 2))(
+        q, k, v
+    )
+    for name, r, g in zip("qkv", ref_grads, got_grads):
+        err = float(jnp.abs(r - g).max())
+        assert jnp.allclose(r, g, atol=5e-4, rtol=1e-3), (name, err)
+
+
 def test_grads_segment_ids():
     B, S = 1, 256
     q, k, v = _qkv(jax.random.key(6), B, S, S, 4, 4, 64)
