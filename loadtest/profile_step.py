@@ -36,7 +36,7 @@ def build_trainer(args):
         cfg = MoeConfig.mixtral_8x1b(
             base=LlamaConfig.llama3_1b(
                 dtype=jnp.bfloat16,
-                remat_policy="attn",
+                remat_policy=args.policy or "attn",
                 remat_pin_layers=args.pin_layers,
             ),
             dispatch=args.dispatch,

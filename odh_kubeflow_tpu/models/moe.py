@@ -311,16 +311,30 @@ def moe_mlp(
     layer: Params,  # router [D,E], moe_gate/up [E,D,F], moe_down [E,F,D]
     cfg: MoeConfig,
     token_mask: Optional[jnp.ndarray] = None,  # [B, S] bool; False = pad
+    bank_base: Optional[jnp.ndarray] = None,  # int32 [1]; stacked banks
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (out [B,S,D], aux_loss). Dispatch/combine implementation
     selected by ``cfg.dispatch``: "grouped" (dropless sorted-token
     pallas grouped-GEMM — the single-chip perf path), "ragged"
     (default — index-table gather/scatter, zero bookkeeping matmul
     FLOPs) or "einsum" (the GShard one-hot form, kept as the reference
-    semantics)."""
+    semantics).
+
+    ``bank_base``: the expert-bank leaves of ``layer`` hold EVERY
+    layer's banks ([L·E, ...], ``forward``'s stacked-bank scan) and
+    this layer's groups start at ``bank_base`` — grouped dispatch
+    only."""
     if cfg.dispatch == "grouped":
         if _grouped_usable(x, cfg):
-            return _moe_mlp_grouped(x, layer, cfg, token_mask)
+            return _moe_mlp_grouped(
+                x, layer, cfg, token_mask, bank_base=bank_base
+            )
+        if bank_base is not None:
+            raise ValueError(
+                "stacked expert banks (bank_base) require the grouped "
+                "dispatch path; forward() only selects them when "
+                "_grouped_usable holds for the whole scan"
+            )
         import warnings
 
         warnings.warn(
@@ -451,10 +465,13 @@ def route_sorted(
     router_logits: jnp.ndarray,  # [B, S, E] float32
     cfg: MoeConfig,
     token_mask: Optional[jnp.ndarray] = None,  # [B, S] bool; False = pad
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> tuple[
+    jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray
+]:
     """Dropless sorted-by-expert routing for the grouped-GEMM path.
 
-    Returns ``(src [M] int32, w [M] f32, offsets [E+1] int32, aux)``:
+    Returns ``(src [M] int32, w [M] f32, offsets [E+1] int32,
+    inv [B·S, k] int32, aux)``:
     row ``r`` of the sorted layout reads flat token ``src[r]`` and
     contributes with combine weight ``w[r]`` (0 on alignment-padding
     rows); rows ``[offsets[e], offsets[e+1])`` belong to expert ``e``.
@@ -516,6 +533,7 @@ def route_sorted(
     src = jnp.zeros((M,), jnp.int32)
     w = jnp.zeros((M,), jnp.float32)
     sent_fill = astarts[E]  # pad tokens go past every aligned group
+    dsts = []  # per slot: each token's row in the sorted layout
     for slot in range(k):
         e_sel, rank = experts[slot], ranks[slot]
         w_sel = top_p[..., slot].reshape(B * S)
@@ -533,7 +551,70 @@ def route_sorted(
             w_sel = jnp.where(mask_flat, w_sel, 0.0)
         src = src.at[dst].set(tok_ids)
         w = w.at[dst].set(w_sel)
-    return src, w, offsets, aux_loss
+        dsts.append(dst)
+    # inverse table [B·S, k]: token t's k rows in the sorted layout —
+    # what lets dispatch/combine run scatter-free (_gather_sorted /
+    # _combine_sorted)
+    inv = jnp.stack(dsts, axis=1)
+    return src, w, offsets, inv, aux_loss
+
+
+@jax.custom_vjp
+def _gather_sorted(x2d, src, inv):
+    """``x2d[src]`` with a scatter-free transpose.
+
+    A plain gather's AD backward is a scatter-add, which XLA lowers
+    row-serially on TPU (~24 ms/step at the 8×1B shape). Dropless
+    routing means every flat token appears EXACTLY once per slot in
+    the sorted layout, so the transpose is itself a gather via the
+    inverse table: dx[t] = Σ_j dxs[inv[t, j]]. Alignment-pad and
+    masked-sentinel rows carry zero cotangents (their whole backward
+    chain is scaled by their combine weight w = 0), so skipping them
+    is exact."""
+    return jnp.take(x2d, src, axis=0)
+
+
+def _gather_sorted_fwd(x2d, src, inv):
+    return jnp.take(x2d, src, axis=0), (src, inv)
+
+
+def _gather_sorted_bwd(res, dxs):
+    _, inv = res
+    dx = jnp.take(dxs, inv[:, 0], axis=0)
+    for j in range(1, inv.shape[1]):
+        dx = dx + jnp.take(dxs, inv[:, j], axis=0)
+    return dx, None, None
+
+
+_gather_sorted.defvjp(_gather_sorted_fwd, _gather_sorted_bwd)
+
+
+@jax.custom_vjp
+def _combine_sorted(contrib, src, inv):
+    """Weighted combine as a k-row gather per token instead of a
+    [M, D] scatter-add into token order (same argument as
+    ``_gather_sorted``, in the other direction: the forward gathers by
+    ``inv``, the backward by ``src``). The backward fills
+    alignment-pad rows with ``dout[0]`` garbage instead of zero — dead
+    by construction: dy pad rows are zeroed by w = 0, and w's own
+    gradient is read back only at real dst rows (w is assembled by
+    ``.at[dst].set``, whose transpose gathers at dst)."""
+    out = jnp.take(contrib, inv[:, 0], axis=0)
+    for j in range(1, inv.shape[1]):
+        out = out + jnp.take(contrib, inv[:, j], axis=0)
+    return out
+
+
+def _combine_sorted_fwd(contrib, src, inv):
+    return _combine_sorted(contrib, src, inv), (src,)
+
+
+def _combine_sorted_bwd(res, dout):
+    (src,) = res
+    return jnp.take(dout, src, axis=0), None, None
+
+
+_combine_sorted.defvjp(_combine_sorted_fwd, _combine_sorted_bwd)
 
 
 def _moe_mlp_grouped(
@@ -541,6 +622,7 @@ def _moe_mlp_grouped(
     layer: Params,
     cfg: MoeConfig,
     token_mask: Optional[jnp.ndarray] = None,
+    bank_base: Optional[jnp.ndarray] = None,  # int32 [1]
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Sorted-token dropless dispatch through the pallas grouped GEMM
     (``ops/pallas_grouped_matmul.py``): gather tokens into
@@ -554,22 +636,35 @@ def _moe_mlp_grouped(
 
     dtype = x.dtype
     B, S, D = x.shape
-    src, w, offsets, aux = route_sorted(
+    src, w, offsets, inv, aux = route_sorted(
         _router_logits(x, layer), cfg, token_mask
     )
-    # named so the remat policies can pin them (~200KB/layer): the
+    # named so the remat policies can pin them (~300KB/layer): the
     # backward then re-runs gather→gmm→silu but never the routing
     # chain (softmax, top-k, cumsum ranking)
     src = llama._checkpoint_name(src, "moe_route_src")
     w = llama._checkpoint_name(w, "moe_route_w")
     offsets = llama._checkpoint_name(offsets, "moe_route_offs")
+    inv = llama._checkpoint_name(inv, "moe_route_inv")
     def bank_gmm(lhs, bank):
         if isinstance(bank, dict):  # int8-native (models/quant.py leaf)
-            # positional args: custom_vjp functions reject kwargs
-            return gmm(lhs, bank["q"], offsets, False, None, bank["scale"])
+            # positional args: custom_vjp functions reject kwargs;
+            # bank_base selects this layer's span of a stacked
+            # [L·E, ...] bank (no per-layer 100+MB slice copies)
+            return gmm(
+                lhs, bank["q"], offsets, False, None, bank["scale"],
+                bank_base,
+            )
+        if bank_base is not None:
+            # stacked mode is int8-only (forward's all-dict guard); a
+            # stacked bf16 bank here would silently read layer 0
+            raise NotImplementedError(
+                "stacked expert banks (bank_base) require int8 "
+                "{'q','scale'} leaves"
+            )
         return gmm(lhs, bank.astype(dtype), offsets)
 
-    x_sorted = x.reshape(B * S, D)[src]
+    x_sorted = _gather_sorted(x.reshape(B * S, D), src, inv)
     g = bank_gmm(x_sorted, layer["moe_gate"])
     u = bank_gmm(x_sorted, layer["moe_up"])
     g = llama._checkpoint_name(g, "moe_g")
@@ -577,14 +672,9 @@ def _moe_mlp_grouped(
     h = (jax.nn.silu(g.astype(jnp.float32)) * u.astype(jnp.float32)).astype(
         dtype
     )
-    y = bank_gmm(h, layer["moe_down"])
+    y = llama._checkpoint_name(bank_gmm(h, layer["moe_down"]), "moe_y")
     contrib = y * w[:, None].astype(dtype)
-    out = (
-        jnp.zeros((B * S, D), dtype)
-        .at[src]
-        .add(contrib)
-        .reshape(B, S, D)
-    )
+    out = _combine_sorted(contrib, src, inv).reshape(B, S, D)
     out = constrain(out, llama._activation_spec())
     return out, aux
 
@@ -594,7 +684,8 @@ def _moe_mlp_grouped(
 
 
 def _moe_decoder_layer(
-    cfg: MoeConfig, attention_fn, x, layer, lora_layer, sin, cos, segment_ids
+    cfg: MoeConfig, attention_fn, x, layer, lora_layer, sin, cos,
+    segment_ids, bank_base=None,
 ):
     """LoRA adapters attach to the attention projections only (the
     standard MoE-LoRA recipe — expert banks stay frozen); int8 leaves
@@ -648,6 +739,7 @@ def _moe_decoder_layer(
     moe_out, aux = moe_mlp(
         h, layer, cfg,
         token_mask=None if segment_ids is None else segment_ids > 0,
+        bank_base=bank_base,
     )
     # named so the remat policy can pin the combined expert output:
     # the backward needs gate/up for silu' but never the down einsum's
@@ -763,16 +855,24 @@ def forward(
     )
     attention_fn = llama._select_attention(b)
     def make_layer_fn(pin_acts: bool, policy: Optional[str] = None,
-                      gather_from=None):
+                      gather_from=None, stacked_banks=None):
         """``gather_from`` = (stacked_layers, stacked_lora): returned
         fn takes a layer index and gathers INSIDE the rematted region
         (outside, each gathered layer slice becomes a saved residual —
-        a full extra copy of the expert banks across the scan)."""
+        a full extra copy of the expert banks across the scan).
+        ``stacked_banks``: [L·E, ...] int8 bank dict kept OUT of the
+        gathered tree — the grouped kernels fetch via bank_base = i·E
+        instead of the gather slicing a 100+MB bank copy per layer."""
         raw_fn = partial(_moe_decoder_layer, cfg, attention_fn)
         if gather_from is None:
             layer_fn = raw_fn
         else:
             stacked_layers, stacked_lora = gather_from
+            if stacked_banks is not None:
+                stacked_layers = {
+                    k: v for k, v in stacked_layers.items()
+                    if k not in stacked_banks
+                }
 
             def layer_fn(x, i, _unused, sin, cos, segment_ids):
                 lyr = jax.tree.map(lambda a: a[i], stacked_layers)
@@ -781,6 +881,11 @@ def forward(
                     if stacked_lora is None
                     else jax.tree.map(lambda a: a[i], stacked_lora)
                 )
+                if stacked_banks is not None:
+                    return raw_fn(
+                        x, {**lyr, **stacked_banks}, lora_l, sin, cos,
+                        segment_ids, (i * cfg.num_experts)[None],
+                    )
                 return raw_fn(x, lyr, lora_l, sin, cos, segment_ids)
 
         if not b.remat:
@@ -793,7 +898,8 @@ def forward(
         # so saving "moe_out" drops down + combine + attention from
         # the recompute.
         names = [
-            "moe_out", "moe_route_src", "moe_route_w", "moe_route_offs",
+            "moe_out", "moe_y", "moe_route_src", "moe_route_w",
+            "moe_route_offs", "moe_route_inv",
         ] + (
             # "moe_g" alone: with frozen (QLoRA) banks the backward
             # needs g and u only for silu' — pinning g leaves one
@@ -875,6 +981,37 @@ def forward(
             return body
 
         carry = (x, jnp.zeros((), jnp.float32))
+        layers_xs = params["layers"]
+        bank_names = ("moe_gate", "moe_up", "moe_down")
+        # Stacked-bank mode: the int8 expert banks (the bulk of the
+        # params — 400+MB/layer at 8×1B) stay OUT of the scanned /
+        # gathered trees; the layer body closes over the full
+        # [L·E, ...] reshape and the grouped kernels fetch this
+        # layer's span via bank_base. A scanned bank leaf would be
+        # dynamic-sliced into a fresh contiguous copy every layer
+        # (fwd + backward recompute) just to feed the custom call —
+        # ~39 ms/step measured at 8×1B/4k.
+        stacked = (
+            _grouped_usable(x, cfg)
+            and cfg.dispatch == "grouped"
+            and all(
+                isinstance(layers_xs[nm], dict) and "q" in layers_xs[nm]
+                for nm in bank_names
+            )
+        )
+        banks = None
+        if stacked:
+            banks = {
+                nm: {
+                    "q": layers_xs[nm]["q"].reshape(
+                        (-1,) + layers_xs[nm]["q"].shape[2:]
+                    ),
+                    "scale": layers_xs[nm]["scale"].reshape(
+                        (-1,) + layers_xs[nm]["scale"].shape[2:]
+                    ),
+                }
+                for nm in bank_names
+            }
         pin = b.remat_pin_layers
         if (
             b.remat
@@ -894,11 +1031,16 @@ def forward(
             n_first = b.num_layers - pin
             gf = (params["layers"], lora_layers)
             prefix_fn = (
-                make_layer_fn(False, gather_from=gf)
+                make_layer_fn(False, gather_from=gf, stacked_banks=banks)
                 if cfg.pin_expert_acts
-                else make_layer_fn(False, policy="none", gather_from=gf)
+                else make_layer_fn(
+                    False, policy="none", gather_from=gf,
+                    stacked_banks=banks,
+                )
             )
-            suffix_fn = make_layer_fn(cfg.pin_expert_acts, gather_from=gf)
+            suffix_fn = make_layer_fn(
+                cfg.pin_expert_acts, gather_from=gf, stacked_banks=banks
+            )
 
             def body_gather(fn):
                 def body(carry, i):
@@ -917,6 +1059,31 @@ def forward(
                 body_gather(suffix_fn),
                 carry,
                 jnp.arange(n_first, b.num_layers, dtype=jnp.int32),
+            )
+        elif stacked:
+            rest = {
+                k: v for k, v in layers_xs.items() if k not in banks
+            }
+            E = cfg.num_experts
+
+            def body_stacked(carry, scanned):
+                x, aux = carry
+                i, rest_layer, lora_layer = scanned
+                layer = {**rest_layer, **banks}
+                x, layer_aux = layer_fn(
+                    x, layer, lora_layer, sin, cos, segment_ids,
+                    (i * E)[None],
+                )
+                return (x, aux + layer_aux), None
+
+            carry, _ = jax.lax.scan(
+                body_stacked,
+                carry,
+                (
+                    jnp.arange(b.num_layers, dtype=jnp.int32),
+                    rest,
+                    lora_layers,
+                ),
             )
         else:
             carry, _ = jax.lax.scan(

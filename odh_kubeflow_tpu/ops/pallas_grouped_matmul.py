@@ -188,9 +188,8 @@ def _gmm_a_kernel_q(gid_ref, lhs_ref, rhs_ref, scale_ref, out_ref, *,
 
 
 def _gmm_a(lhs, rhs, group_of_tile, *, trans_rhs, interpret,
-           scale=None):
+           scale=None, base=None):
     m, k = lhs.shape
-    E = rhs.shape[0]
     n = rhs.shape[1] if trans_rhs else rhs.shape[2]
     # resident weight block ≤4MB so it double-buffers beside the
     # streaming lhs tiles in ~16MB VMEM — int8 banks fit 2× the
@@ -204,36 +203,55 @@ def _gmm_a(lhs, rhs, group_of_tile, *, trans_rhs, interpret,
     assert n % bn == 0, f"N={n} has no legal block under K={k}"
     T = m // ALIGN
     rhs_block = (1, bn, k) if trans_rhs else (1, k, bn)
+    # stacked-bank mode (``base``): rhs holds every layer's expert
+    # banks [L·E, ...] and the fetch index offsets by the layer's
+    # group base — the scan never dynamic-slices a 100+MB bank copy
+    # per layer just to feed the custom call (see models/moe.py)
+    pref = [group_of_tile] if base is None else [group_of_tile, base]
+
+    def _g(p, t):
+        g = p[0][t]
+        return g if base is None else p[1][0] + g
+
     rhs_idx = (
-        (lambda ni, t, g: (g[t], ni, 0))
+        (lambda ni, t, *p: (_g(p, t), ni, 0))
         if trans_rhs
-        else (lambda ni, t, g: (g[t], 0, ni))
+        else (lambda ni, t, *p: (_g(p, t), 0, ni))
     )
     grid = (n // bn, T)
     in_specs = [
-        pl.BlockSpec((ALIGN, k), lambda ni, t, g: (t, 0)),
+        pl.BlockSpec((ALIGN, k), lambda ni, t, *p: (t, 0)),
         pl.BlockSpec(rhs_block, rhs_idx),
     ]
-    operands = [group_of_tile, lhs, rhs]
+    operands = pref + [lhs, rhs]
+    nker = len(pref)
+
+    def strip(fn):
+        # kernel positional args: prefetch refs first — drop them all
+        # (bodies never read the ids; index maps consume them)
+        def wrapped(*refs):
+            return fn(refs[0], *refs[nker:])
+        return wrapped
+
     if scale is None:
-        kernel = functools.partial(_gmm_a_kernel, trans_rhs=trans_rhs)
+        kernel = strip(functools.partial(_gmm_a_kernel, trans_rhs=trans_rhs))
     else:
-        kernel = functools.partial(_gmm_a_kernel_q, trans_rhs=trans_rhs)
+        kernel = strip(functools.partial(_gmm_a_kernel_q, trans_rhs=trans_rhs))
         scale_block = (1, 1, k) if trans_rhs else (1, 1, bn)
         scale_idx = (
-            (lambda ni, t, g: (g[t], 0, 0))
+            (lambda ni, t, *p: (_g(p, t), 0, 0))
             if trans_rhs
-            else (lambda ni, t, g: (g[t], 0, ni))
+            else (lambda ni, t, *p: (_g(p, t), 0, ni))
         )
         in_specs.append(pl.BlockSpec(scale_block, scale_idx))
         operands.append(scale)
     return pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=len(pref),
             grid=grid,
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((ALIGN, bn), lambda ni, t, g: (t, ni)),
+            out_specs=pl.BlockSpec((ALIGN, bn), lambda ni, t, *p: (t, ni)),
         ),
         out_shape=jax.ShapeDtypeStruct((m, n), lhs.dtype),
         compiler_params=pltpu.CompilerParams(
@@ -329,9 +347,9 @@ def _gmm_b_kernel(
 
 
 def _gmm_b(lhs, rhs, pairs, group_offsets, *, trans_rhs, bm, bk, bn,
-           interpret, scale=None):
+           interpret, scale=None, base=None):
     m, k = lhs.shape
-    E = rhs.shape[0]
+    E = group_offsets.shape[0] - 1  # layer-LOCAL group count
     n = rhs.shape[1] if trans_rhs else rhs.shape[2]
     bk = min(bk, k)
     bn = min(bn, n)
@@ -339,49 +357,62 @@ def _gmm_b(lhs, rhs, pairs, group_offsets, *, trans_rhs, bm, bk, bn,
     nk = k // bk
     L = pairs["tile"].shape[0]
     rhs_block = (1, bn, bk) if trans_rhs else (1, bk, bn)
+
     # inert pairs carry the dummy group E — clamp the *fetch* index to a
     # real block (their mask zeroes the compute; an out-of-bounds block
-    # index is a hard TPU fault, though interpret mode tolerates it)
+    # index is a hard TPU fault, though interpret mode tolerates it).
+    # Stacked-bank mode (``base``, models/moe.py): rhs is [L·E, ...] and
+    # the fetch offsets into this layer's bank span.
+    def _g(p, i):
+        g = jnp.minimum(p[2][i], E - 1)
+        return g if base is None else p[5][0] + g
+
     rhs_idx = (
-        (lambda i, ni, ki, t, ot, g, w, o: (jnp.minimum(g[i], E - 1), ni, ki))
+        (lambda i, ni, ki, *p: (_g(p, i), ni, ki))
         if trans_rhs
-        else (lambda i, ni, ki, t, ot, g, w, o: (jnp.minimum(g[i], E - 1), ki, ni))
+        else (lambda i, ni, ki, *p: (_g(p, i), ki, ni))
     )
     # offsets extended so the dummy group E is empty: offs[E+1] = offs[E]
     offs = jnp.concatenate([group_offsets, group_offsets[-1:]])
     in_specs = [
         pl.BlockSpec(
-            (bm, bk), lambda i, ni, ki, t, ot, g, w, o: (t[i], ki)
+            (bm, bk), lambda i, ni, ki, *p: (p[0][i], ki)
         ),
         pl.BlockSpec(rhs_block, rhs_idx),
     ]
     operands = [
         pairs["tile"], pairs["otile"], pairs["group"], pairs["write"],
-        offs, lhs, rhs,
-    ]
+        offs,
+    ] + ([] if base is None else [base]) + [lhs, rhs]
+    npref = 5 if base is None else 6
+
+    def strip(fn):
+        # bodies read the first five prefetch refs; drop the base ref
+        def wrapped(*refs):
+            return fn(*refs[:5], *refs[npref:])
+        return wrapped
+
     if scale is not None:
         # scaled axis is the bank's last: output columns (non-trans,
         # applied at write) or the contraction (trans, prescaled)
         scale_block = (1, 1, bk) if trans_rhs else (1, 1, bn)
         scale_idx = (
-            (lambda i, ni, ki, t, ot, g, w, o:
-             (jnp.minimum(g[i], E - 1), 0, ki))
+            (lambda i, ni, ki, *p: (_g(p, i), 0, ki))
             if trans_rhs
-            else (lambda i, ni, ki, t, ot, g, w, o:
-                  (jnp.minimum(g[i], E - 1), 0, ni))
+            else (lambda i, ni, ki, *p: (_g(p, i), 0, ni))
         )
         in_specs.append(pl.BlockSpec(scale_block, scale_idx))
         operands.append(scale)
     out = pl.pallas_call(
-        functools.partial(
+        strip(functools.partial(
             _gmm_b_kernel, bm=bm, bn=bn, nk=nk, trans_rhs=trans_rhs
-        ),
+        )),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=5,
+            num_scalar_prefetch=npref,
             grid=(L, n // bn, nk),
             in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (bm, bn), lambda i, ni, ki, t, ot, g, w, o: (ot[i], ni)
+                (bm, bn), lambda i, ni, ki, *p: (p[1][i], ni)
             ),
             scratch_shapes=[pltpu.VMEM((n // bn, bm, bn), jnp.float32)],
         ),
@@ -475,9 +506,8 @@ def _tgmm(lhs, dout, pairs, group_offsets, *, bm, bk, bn, interpret):
 
 
 def _gmm_fwd_impl(lhs, rhs, group_offsets, *, trans_rhs, interpret,
-                  scale=None):
+                  scale=None, base=None):
     m, k = lhs.shape
-    E = rhs.shape[0]
     n = rhs.shape[1] if trans_rhs else rhs.shape[2]
     assert m % DEFAULT_BM_B == 0, f"M={m} must be a {DEFAULT_BM_B} multiple"
     # kernel A holds a (K, bn) weight block double-buffered in ~16MB
@@ -493,7 +523,7 @@ def _gmm_fwd_impl(lhs, rhs, group_offsets, *, trans_rhs, interpret,
         )
         return _gmm_a(
             lhs, rhs, group_of_tile, trans_rhs=trans_rhs,
-            interpret=interpret, scale=scale,
+            interpret=interpret, scale=scale, base=base,
         )
     if n > MAX_N_B:
         raise NotImplementedError(
@@ -504,13 +534,13 @@ def _gmm_fwd_impl(lhs, rhs, group_offsets, *, trans_rhs, interpret,
     return _gmm_b(
         lhs, rhs, pairs, group_offsets, trans_rhs=trans_rhs,
         bm=DEFAULT_BM_B, bk=DEFAULT_BK_B, bn=DEFAULT_BN_B,
-        interpret=interpret, scale=scale,
+        interpret=interpret, scale=scale, base=base,
     )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def gmm(lhs, rhs, group_offsets, trans_rhs=False,
-        interpret: Optional[bool] = None, scale=None):
+        interpret: Optional[bool] = None, scale=None, group_base=None):
     """Grouped matmul: rows ``[offsets[e], offsets[e+1])`` of ``lhs``
     through ``rhs[e]``. Offsets must be ALIGN-aligned with
     ``offsets[0] = 0`` and ``offsets[E] = M`` (the caller's sort pads
@@ -521,27 +551,39 @@ def gmm(lhs, rhs, group_offsets, trans_rhs=False,
     per-output-channel scale [E, 1, bank-last-axis] from
     ``models/quant.py`` — the kernel reads half the weight bytes and
     never materialises a dequantized bank in HBM. Weight gradients are
-    not defined through the quantized path (frozen banks — QLoRA)."""
+    not defined through the quantized path (frozen banks — QLoRA).
+
+    ``group_base`` (stacked-bank mode, int32 [1]): ``rhs``/``scale``
+    hold EVERY layer's banks ([L·E, ...]) and fetch indices offset by
+    this layer's first group — so a per-layer scan never materialises
+    a bank copy just to feed the kernel. Frozen (``scale``) banks only:
+    the weight-gradient tgmm has no stacked form."""
     if interpret is None:
         interpret = _interpret_default()
+    if group_base is not None and scale is None:
+        raise NotImplementedError(
+            "gmm: group_base (stacked banks) requires int8 frozen "
+            "banks (scale) — no stacked weight-gradient path"
+        )
     return _gmm_fwd_impl(
         lhs, rhs, group_offsets, trans_rhs=trans_rhs, interpret=interpret,
-        scale=scale,
+        scale=scale, base=group_base,
     )
 
 
-def _gmm_fwd(lhs, rhs, group_offsets, trans_rhs, interpret, scale):
+def _gmm_fwd(lhs, rhs, group_offsets, trans_rhs, interpret, scale,
+             group_base):
     if interpret is None:
         interpret = _interpret_default()
     out = _gmm_fwd_impl(
         lhs, rhs, group_offsets, trans_rhs=trans_rhs, interpret=interpret,
-        scale=scale,
+        scale=scale, base=group_base,
     )
-    return out, (lhs, rhs, group_offsets, scale)
+    return out, (lhs, rhs, group_offsets, scale, group_base)
 
 
 def _gmm_bwd(trans_rhs, interpret, res, dout):
-    lhs, rhs, group_offsets, scale = res
+    lhs, rhs, group_offsets, scale, group_base = res
     if interpret is None:
         interpret = _interpret_default()
     # dlhs = dout · rhsᵀ — the same grouped matmul with rhs read
@@ -550,10 +592,11 @@ def _gmm_bwd(trans_rhs, interpret, res, dout):
     dlhs = _gmm_fwd_impl(
         dout.astype(lhs.dtype), rhs, group_offsets,
         trans_rhs=not trans_rhs, interpret=interpret, scale=scale,
+        base=group_base,
     )
     if scale is not None:
         # int8 banks are frozen (QLoRA): no weight cotangents
-        return dlhs, None, None, jnp.zeros_like(scale)
+        return (dlhs, None, None, jnp.zeros_like(scale), None)
     E = rhs.shape[0]
     m = lhs.shape[0]
     pairs = span_pairs(group_offsets, m, DEFAULT_BM_B, include_empty=True)
@@ -570,7 +613,7 @@ def _gmm_bwd(trans_rhs, interpret, res, dout):
             bm=DEFAULT_BM_B, bk=DEFAULT_BK_T, bn=DEFAULT_BN_T,
             interpret=interpret,
         ).astype(rhs.dtype)
-    return dlhs, drhs, None, None
+    return dlhs, drhs, None, None, None
 
 
 gmm.defvjp(_gmm_fwd, _gmm_bwd)

@@ -176,7 +176,7 @@ def test_route_sorted_is_dropless_and_aligned():
     B, S, E = 2, 512, cfg.num_experts
     k = cfg.num_experts_per_tok
     logits = jax.random.normal(jax.random.key(3), (B, S, E))
-    src, w, offsets, _ = route_sorted(logits, cfg)
+    src, w, offsets, _inv, _ = route_sorted(logits, cfg)
     offs = np.asarray(offsets)
     assert offs[0] == 0 and (np.diff(offs) >= 0).all()
     assert (offs[:-1] % ALIGN == 0).all()
@@ -201,3 +201,93 @@ def test_grouped_falls_back_when_sharded_or_tiny():
     np.testing.assert_allclose(
         np.asarray(out_g), np.asarray(out_r), rtol=1e-5, atol=1e-5
     )
+
+
+def test_gmm_group_base_matches_sliced_bank():
+    """Stacked-bank mode (group_base): fetching layer l's groups out of
+    a [L·E, K, N] int8 bank must equal running the per-layer slice —
+    forward and grad-lhs (models/moe.py forward's stacked scan)."""
+    from odh_kubeflow_tpu.models.quant import quantize_tensor
+
+    m, L, e, k, n = 1024, 3, 4, 256, 256
+    key = jax.random.key(11)
+    lhs = jax.random.normal(key, (m, k), jnp.float32) * 0.3
+    banks = jax.random.normal(jax.random.key(12), (L, e, k, n)) * 0.3
+    q = quantize_tensor(banks)  # q [L,e,k,n], scale [L,e,1,n]
+    offs = jnp.asarray(_OFFS)
+
+    stacked_q = q["q"].reshape(L * e, k, n)
+    stacked_s = q["scale"].reshape(L * e, 1, n)
+
+    for layer in range(L):
+        ref = gmm(
+            lhs, q["q"][layer], offs, False, None, q["scale"][layer]
+        )
+        base = jnp.asarray([layer * e], jnp.int32)
+        got = gmm(lhs, stacked_q, offs, False, None, stacked_s, base)
+        assert float(jnp.abs(ref - got).max()) == 0.0, layer
+
+        def loss(lhs, stacked):
+            return jnp.sum(
+                gmm(lhs, stacked_q, offs, False, None, stacked_s, base)
+                ** 2
+                if stacked
+                else gmm(
+                    lhs, q["q"][layer], offs, False, None,
+                    q["scale"][layer]
+                )
+                ** 2
+            )
+
+        dref = jax.grad(lambda a: loss(a, False))(lhs)
+        dgot = jax.grad(lambda a: loss(a, True))(lhs)
+        err = float(jnp.abs(dref - dgot).max())
+        assert err <= 1e-5 * float(jnp.abs(dref).max() + 1), (layer, err)
+
+
+def test_stacked_bank_forward_matches_sliced():
+    """moe.forward's stacked-bank scan (int8 grouped, single chip) must
+    match the per-layer sliced path it replaces. The sliced path is
+    recovered by bypassing the stacked branch: run each layer's
+    moe_mlp with the bank slices directly."""
+    from odh_kubeflow_tpu.models import moe as moe_lib
+    from odh_kubeflow_tpu.models.quant import quantize_tensor
+
+    cfg = dataclasses.replace(
+        MoeConfig.mixtral_tiny(), dispatch="grouped"
+    )
+    params = init_params(jax.random.key(3), cfg)
+    for nm in ("moe_gate", "moe_up", "moe_down"):
+        params["layers"][nm] = quantize_tensor(params["layers"][nm])
+    B, S = 2, 512  # B*S*k = 2048 ≥ the grouped threshold
+    tokens = jax.random.randint(
+        jax.random.key(4), (B, S), 0, cfg.vocab_size, jnp.int32
+    )
+    logits, aux = moe_lib.forward(params, tokens, cfg)
+    assert bool(jnp.isfinite(logits).all()) and bool(jnp.isfinite(aux))
+
+    # per-layer reference: same math through moe_mlp on bank slices
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.base.dtype)
+    # just the first layer's MLP as a spot equivalence probe
+    layer0 = jax.tree.map(lambda a: a[0], params["layers"])
+    h = x  # probe the MLP on the raw embedding activations
+    out_ref, _ = moe_lib.moe_mlp(h, layer0, cfg)
+    banks = {
+        nm: {
+            "q": params["layers"][nm]["q"].reshape(
+                (-1,) + params["layers"][nm]["q"].shape[2:]
+            ),
+            "scale": params["layers"][nm]["scale"].reshape(
+                (-1,) + params["layers"][nm]["scale"].shape[2:]
+            ),
+        }
+        for nm in ("moe_gate", "moe_up", "moe_down")
+    }
+    stacked_layer0 = {**{
+        kk: vv for kk, vv in layer0.items()
+        if kk not in ("moe_gate", "moe_up", "moe_down")
+    }, **banks}
+    out_got, _ = moe_lib.moe_mlp(
+        h, stacked_layer0, cfg, bank_base=jnp.zeros((1,), jnp.int32)
+    )
+    assert float(jnp.abs(out_ref - out_got).max()) == 0.0
