@@ -3,15 +3,18 @@
     python -m loadtest.moe_qlora_8x1b [--capacity-factor 1.25] [--batch 2]
 
 Strict-sparse MFU (k=2 of 8 experts credited; frozen matmuls credit
-2×, attention 3× — Trainer.benchmark). Round-3 numbers (ragged
-index-table dispatch + pinned flash/moe_out remat residuals,
-models/moe.py):
+2×, attention 3× — Trainer.benchmark). Round-4 numbers (grouped
+dropless pallas GEMMs + moe_y pin + scatter-free dispatch/combine +
+stacked banks, models/moe.py):
 
-    cf=1.25 (zero token drops):   0.329 strict-sparse MFU, 1.13 s/step
-    cf=1.0  (1.14% assignment drops at random routing — the
-             Switch-style trade): 0.376 strict-sparse MFU, 0.99 s/step
+    grouped --pin-expert-acts (dropless — no capacity concept,
+             zero drops ever):   0.368–0.375 strict-sparse, ~1.00 s/step
+    ragged cf=1.25 (zero drops): 0.330 strict-sparse MFU, 1.13 s/step
+    ragged cf=1.0  (~1.1% assignment drops at random routing — the
+             Switch-style trade): 0.370 strict-sparse MFU, 1.01 s/step
 
-r2 baseline was 0.297 (one-hot einsum dispatch, full remat).
+r3 was 0.329/0.376 (ragged only); r2 0.297 (one-hot einsum, full
+remat). The dropless path now matches the dropping path's speed.
 """
 
 from __future__ import annotations

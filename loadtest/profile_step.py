@@ -23,6 +23,17 @@ import jax
 import jax.numpy as jnp
 
 
+def _quant(args, default):
+    """--quant int8|int4|none (per-config default otherwise)."""
+    if args.quant is None:
+        return default
+    if args.quant == "none":
+        return False
+    if args.quant not in ("int8", "int4"):
+        raise SystemExit(f"--quant must be int8|int4|none, got {args.quant}")
+    return args.quant
+
+
 def build_trainer(args):
     from odh_kubeflow_tpu.models import LoraConfig
     from odh_kubeflow_tpu.models.llama import LlamaConfig
@@ -43,7 +54,7 @@ def build_trainer(args):
             pin_expert_acts=args.pin_expert_acts,
         )
         batch, seq = args.batch or 2, args.seq or 4096
-        quant = True
+        quant = _quant(args, "int8")
     elif args.config == "1b16k":
         cfg = LlamaConfig.llama3_1b(
             dtype=jnp.bfloat16,
@@ -51,11 +62,16 @@ def build_trainer(args):
             remat_pin_layers=args.pin_layers,
         )
         batch, seq = args.batch or 1, args.seq or 16384
-        quant = False
+        quant = _quant(args, False)
     elif args.config == "8b16k":
-        cfg = LlamaConfig.llama3_8b(dtype=jnp.bfloat16, remat_policy="none")
+        cfg = LlamaConfig.llama3_8b(
+            dtype=jnp.bfloat16,
+            remat_policy=args.policy or "none",
+            remat_pin_layers=args.pin_layers,
+            remat_prefix_policy=args.prefix_policy or "none",
+        )
         batch, seq = args.batch or 1, args.seq or 16384
-        quant = True
+        quant = _quant(args, "int8")
     else:
         raise SystemExit(f"unknown --config {args.config}")
     trainer = Trainer(
@@ -99,6 +115,8 @@ def main() -> None:
                     default=True)
     ap.add_argument("--pin-layers", type=int, default=None)
     ap.add_argument("--policy", default=None)
+    ap.add_argument("--quant", default=None, help="int8|int4|none")
+    ap.add_argument("--prefix-policy", default=None)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=None)
     ap.add_argument("--top", type=int, default=25)

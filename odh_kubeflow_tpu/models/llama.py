@@ -90,6 +90,12 @@ class LlamaConfig:
     # suffix (not prefix) frees residuals earliest in the backward
     # sweep. None = all layers.
     remat_pin_layers: Optional[int] = None
+    # Policy for the NON-pinned prefix when remat_pin_layers is set:
+    # "none" (historical default — full recompute) or any remat_policy
+    # value cheaper than the suffix's, e.g. suffix "attn_mlp" over a
+    # prefix "attn" keeps the flash residuals pinned everywhere while
+    # budgeting the bigger q/k/v+gate pins to the suffix only.
+    remat_prefix_policy: str = "none"
     # Decode-path W8A8: keep int8 weights AS int8 through the matmul
     # (per-token symmetric activation quant, s8×s8→s32 on the MXU)
     # instead of dequantizing to bf16 first. Weight-only int8 decode is
@@ -645,7 +651,7 @@ def forward(
             and pin is not None
             and 0 < pin < cfg.num_layers
         ):
-            # two scans: a full-recompute prefix and a pinned suffix —
+            # two scans: a cheap-policy prefix and a pinned suffix —
             # per-layer policies can't vary inside one scan. The scans
             # iterate over layer INDICES and gather each layer from the
             # stacked params in-body: slicing the stacked trees into
@@ -655,7 +661,9 @@ def forward(
             n_first = cfg.num_layers - pin
             gf = (params["layers"], lora_layers)
             fn_none_g = _make_layer_fn(
-                dataclasses.replace(cfg, remat_policy="none"),
+                dataclasses.replace(
+                    cfg, remat_policy=cfg.remat_prefix_policy
+                ),
                 attention_fn, gather_from=gf,
             )
             fn_pin_g = _make_layer_fn(cfg, attention_fn, gather_from=gf)
