@@ -7,14 +7,15 @@ Strict-sparse MFU (k=2 of 8 experts credited; frozen matmuls credit
 dropless pallas GEMMs + moe_y pin + scatter-free dispatch/combine +
 stacked banks, models/moe.py):
 
-    grouped --pin-expert-acts (dropless — no capacity concept,
-             zero drops ever):   0.368–0.375 strict-sparse, ~1.00 s/step
+    grouped --pin-expert-acts (dropless, fused-SwiGLU kernel — no
+             capacity concept, zero drops ever):
+                                 0.40–0.41 strict-sparse, ~0.92 s/step
     ragged cf=1.25 (zero drops): 0.330 strict-sparse MFU, 1.13 s/step
     ragged cf=1.0  (~1.1% assignment drops at random routing — the
              Switch-style trade): 0.370 strict-sparse MFU, 1.01 s/step
 
 r3 was 0.329/0.376 (ragged only); r2 0.297 (one-hot einsum, full
-remat). The dropless path now matches the dropping path's speed.
+remat). The dropless path now beats the dropping path by ~8%.
 """
 
 from __future__ import annotations

@@ -665,13 +665,42 @@ def _moe_mlp_grouped(
         return gmm(lhs, bank.astype(dtype), offsets)
 
     x_sorted = _gather_sorted(x.reshape(B * S, D), src, inv)
-    g = bank_gmm(x_sorted, layer["moe_gate"])
-    u = bank_gmm(x_sorted, layer["moe_up"])
-    g = llama._checkpoint_name(g, "moe_g")
-    u = llama._checkpoint_name(u, "moe_u")
-    h = (jax.nn.silu(g.astype(jnp.float32)) * u.astype(jnp.float32)).astype(
-        dtype
+    gate_bank, up_bank = layer["moe_gate"], layer["moe_up"]
+    fused = (
+        isinstance(gate_bank, dict) and "q" in gate_bank
+        and isinstance(up_bank, dict) and "q" in up_bank
     )
+    h = None
+    if fused:
+        # fused gate+up+silu·mul kernel: u never reaches HBM and the
+        # standalone [M, F] silu/dsilu fusions disappear; g IS written
+        # (the op's vjp pins it as "moe_g") — both designs were
+        # measured and the pin beats recomputing g with an extra
+        # backward dot (0.91 vs 0.96 s/step at 8×1B/4k), the custom
+        # backward fusing the u-recompute with the dsilu epilogue
+        from odh_kubeflow_tpu.ops.pallas_grouped_matmul import swiglu_gmm
+
+        try:
+            h, _g = swiglu_gmm(
+                x_sorted, gate_bank["q"], up_bank["q"],
+                gate_bank["scale"], up_bank["scale"], offsets, bank_base,
+            )
+            # the op pins g as "moe_g" on its OWN residual (see
+            # _swiglu_vjp_fwd) — naming the returned copy here would
+            # pin a second, never-consumed value
+            h = h.astype(dtype)
+        except NotImplementedError:
+            # hidden size past the fused kernel's VMEM budget: the
+            # separate-gmm path below handles any shape (kernel B)
+            h = None
+    if h is None:
+        g = bank_gmm(x_sorted, layer["moe_gate"])
+        u = bank_gmm(x_sorted, layer["moe_up"])
+        g = llama._checkpoint_name(g, "moe_g")
+        u = llama._checkpoint_name(u, "moe_u")
+        h = (
+            jax.nn.silu(g.astype(jnp.float32)) * u.astype(jnp.float32)
+        ).astype(dtype)
     y = llama._checkpoint_name(bank_gmm(h, layer["moe_down"]), "moe_y")
     contrib = y * w[:, None].astype(dtype)
     out = _combine_sorted(contrib, src, inv).reshape(B, S, D)

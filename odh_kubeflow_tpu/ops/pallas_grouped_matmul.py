@@ -49,6 +49,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -74,6 +75,27 @@ def _interpret_default() -> bool:
 
 def _ceil_to(x: int, m: int) -> int:
     return -(-x // m) * m
+
+
+def _pick_bn(n: int, budget: int) -> int:
+    """Largest lane-aligned divisor of ``n`` within the VMEM column
+    budget (trace-time loop, ≤ n/128 iterations); sub-ALIGN n (tiny
+    test shapes) runs as one block."""
+    bn = n
+    for cand in range(ALIGN, min(n, budget) + 1, ALIGN):
+        if n % cand == 0:
+            bn = cand
+    return bn
+
+
+def _group_of_tile(m: int, group_offsets) -> jnp.ndarray:
+    """Expert id of each ALIGN-row tile — ALIGN-aligned group
+    boundaries guarantee each tile has exactly one."""
+    tiles = jnp.arange(m // ALIGN, dtype=jnp.int32) * ALIGN
+    return (
+        jnp.searchsorted(group_offsets[1:-1], tiles, side="right")
+        .astype(jnp.int32)
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -196,10 +218,7 @@ def _gmm_a(lhs, rhs, group_of_tile, *, trans_rhs, interpret,
     # columns. Largest lane-aligned divisor of n that fits the budget
     # (trace-time loop, ≤ n/128 iterations).
     budget = 4 * 1024 * 1024 // (k * rhs.dtype.itemsize)
-    bn = n  # sub-ALIGN n (tiny test shapes) runs as one block
-    for cand in range(ALIGN, min(n, budget) + 1, ALIGN):
-        if n % cand == 0:
-            bn = cand
+    bn = _pick_bn(n, budget)
     assert n % bn == 0, f"N={n} has no legal block under K={k}"
     T = m // ALIGN
     rhs_block = (1, bn, k) if trans_rhs else (1, k, bn)
@@ -515,15 +534,10 @@ def _gmm_fwd_impl(lhs, rhs, group_offsets, *, trans_rhs, interpret,
     # legal-on-CPU shape can't oversubscribe VMEM on hardware
     max_k_a = MAX_K_A * 2 // max(lhs.dtype.itemsize, rhs.dtype.itemsize)
     if k <= max_k_a:
-        tiles = jnp.arange(m // ALIGN, dtype=jnp.int32) * ALIGN
-        # ALIGN-aligned boundaries ⇒ each 128-row tile has one group
-        group_of_tile = (
-            jnp.searchsorted(group_offsets[1:-1], tiles, side="right")
-            .astype(jnp.int32)
-        )
         return _gmm_a(
-            lhs, rhs, group_of_tile, trans_rhs=trans_rhs,
-            interpret=interpret, scale=scale, base=base,
+            lhs, rhs, _group_of_tile(m, group_offsets),
+            trans_rhs=trans_rhs, interpret=interpret, scale=scale,
+            base=base,
         )
     if n > MAX_N_B:
         raise NotImplementedError(
@@ -617,3 +631,214 @@ def _gmm_bwd(trans_rhs, interpret, res, dout):
 
 
 gmm.defvjp(_gmm_fwd, _gmm_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused SwiGLU grouped matmul: h = silu(x·Wg) ⊙ (x·Wu) in one kernel
+# ---------------------------------------------------------------------------
+
+
+def _swiglu_fwd_kernel(gid_ref, *rest, has_base):
+    """Kernel-A-shaped fused gate+up: both expert weight blocks stay
+    resident across the group's 128-row lhs tiles, the silu·mul
+    epilogue runs on the f32 accumulators in VMEM, and only h (plus g,
+    which the QLoRA remat policy pins as "moe_g" — measured CHEAPER
+    than recomputing g with an extra backward dot, despite the scan
+    residual's stacking DUS) ever reach HBM; the separate u tensor and
+    the standalone silu fusion's passes disappear."""
+    if has_base:
+        _base, lhs_ref, wg_ref, wu_ref, sg_ref, su_ref, h_ref, g_ref = rest
+    else:
+        lhs_ref, wg_ref, wu_ref, sg_ref, su_ref, h_ref, g_ref = rest
+    lhs = lhs_ref[...]
+    g = jax.lax.dot_general(
+        lhs, wg_ref[0].astype(lhs.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * sg_ref[0, 0][None, :]
+    u = jax.lax.dot_general(
+        lhs, wu_ref[0].astype(lhs.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * su_ref[0, 0][None, :]
+    h = jax.nn.silu(g) * u
+    h_ref[...] = h.astype(h_ref.dtype)
+    g_ref[...] = g.astype(g_ref.dtype)
+
+
+def _swiglu_bwd_kernel(gid_ref, *rest, has_base):
+    """Backward fusion: recompute u (the one matmul the moe_g pin
+    leaves — recomputing g too was measured slower than reading the
+    pin), then the dsilu epilogue — dg = dh·u·silu'(g),
+    du = dh·silu(g) — on the in-VMEM tiles. Replaces a standalone
+    u-recompute kernel plus two [M, F] dsilu fusions."""
+    if has_base:
+        _base, lhs_ref, wu_ref, su_ref, g_ref, dh_ref, dg_ref, du_ref = rest
+    else:
+        lhs_ref, wu_ref, su_ref, g_ref, dh_ref, dg_ref, du_ref = rest
+    lhs = lhs_ref[...]
+    u = jax.lax.dot_general(
+        lhs, wu_ref[0].astype(lhs.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * su_ref[0, 0][None, :]
+    g = g_ref[...].astype(jnp.float32)
+    dh = dh_ref[...].astype(jnp.float32)
+    sig = jax.nn.sigmoid(g)
+    dg_ref[...] = (dh * u * (sig * (1.0 + g * (1.0 - sig)))).astype(
+        dg_ref.dtype
+    )
+    du_ref[...] = (dh * (g * sig)).astype(du_ref.dtype)
+
+
+def _swiglu_specs(m, k, n, group_of_tile, base):
+    """Shared grid/spec plumbing for the two fused kernels: kernel-A
+    walk (n-tiles outer, 128-row lhs tiles inner) with the column
+    budget halved so the forward's TWO weight blocks double-buffer.
+    int8 banks only (itemsize 1 in the budget — enforced by
+    swiglu_gmm's signature taking q/scale pairs)."""
+    budget = 4 * 1024 * 1024 // (k * 1) // 2  # two resident int8 blocks
+    if k > MAX_K_A * 2 or budget < ALIGN:
+        # mirror gmm's explicit failure instead of silently resident-
+        # loading an oversized [K, N] bank (a Mosaic VMEM fault)
+        raise NotImplementedError(
+            f"swiglu_gmm: K={k} exceeds the fused kernel-A VMEM "
+            "budget; use separate gmm calls (kernel B) for this shape"
+        )
+    bn = _pick_bn(n, budget)
+    T = m // ALIGN
+    pref = [group_of_tile] if base is None else [group_of_tile, base]
+
+    def _g(p, t):
+        g = p[0][t]
+        return g if base is None else p[1][0] + g
+
+    lhs_spec = pl.BlockSpec((ALIGN, k), lambda ni, t, *p: (t, 0))
+    w_spec = pl.BlockSpec((1, k, bn), lambda ni, t, *p: (_g(p, t), 0, ni))
+    s_spec = pl.BlockSpec((1, 1, bn), lambda ni, t, *p: (_g(p, t), 0, ni))
+    row_spec = pl.BlockSpec((ALIGN, bn), lambda ni, t, *p: (t, ni))
+    return pref, bn, T, lhs_spec, w_spec, s_spec, row_spec
+
+
+def _swiglu_fwd_impl(lhs, wg, wu, sg, su, group_of_tile, base, interpret):
+    m, k = lhs.shape
+    n = wg.shape[2]
+    pref, bn, T, lhs_spec, w_spec, s_spec, row_spec = _swiglu_specs(
+        m, k, n, group_of_tile, base
+    )
+    return pl.pallas_call(
+        functools.partial(_swiglu_fwd_kernel, has_base=base is not None),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=len(pref),
+            grid=(n // bn, T),
+            in_specs=[lhs_spec, w_spec, w_spec, s_spec, s_spec],
+            out_specs=(row_spec, row_spec),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((m, n), lhs.dtype),
+            jax.ShapeDtypeStruct((m, n), lhs.dtype),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*pref, lhs, wg, wu, sg, su)
+
+
+def _swiglu_bwd_impl(lhs, wu, su, g, dh, group_of_tile, base, interpret):
+    m, k = lhs.shape
+    n = wu.shape[2]
+    pref, bn, T, lhs_spec, w_spec, s_spec, row_spec = _swiglu_specs(
+        m, k, n, group_of_tile, base
+    )
+    return pl.pallas_call(
+        functools.partial(_swiglu_bwd_kernel, has_base=base is not None),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=len(pref),
+            grid=(n // bn, T),
+            in_specs=[lhs_spec, w_spec, s_spec, row_spec, row_spec],
+            out_specs=(row_spec, row_spec),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((m, n), lhs.dtype),
+            jax.ShapeDtypeStruct((m, n), lhs.dtype),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*pref, lhs, wu, su, g, dh)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
+def swiglu_gmm(lhs, wg_q, wu_q, sg, su, group_offsets, group_base,
+               interpret=None):
+    """Fused grouped SwiGLU for int8 expert banks:
+    ``h[r] = silu(x[r]·Wg[e]) ⊙ (x[r]·Wu[e])`` for rows in expert e's
+    group, plus the gate pre-activation ``g`` as a second output. The
+    vjp names its g residual "moe_g", so the QLoRA remat policy pins
+    it and the backward recomputes ONLY u, fused with the dsilu
+    epilogue (both measured: pinning g beats recomputing it, and the
+    fused epilogue beats standalone [M, F] dsilu fusions). K ≤ the
+    kernel-A budget only (the MoE D→F shape); frozen banks (no weight
+    grads). Returns ``(h, g)``.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    return _swiglu_fwd_fn(
+        lhs, wg_q, wu_q, sg, su, group_offsets, group_base, interpret
+    )
+
+
+def _swiglu_fwd_fn(lhs, wg_q, wu_q, sg, su, group_offsets, group_base,
+                   interpret):
+    assert lhs.shape[0] % ALIGN == 0
+    return _swiglu_fwd_impl(
+        lhs, wg_q, wu_q, sg, su,
+        _group_of_tile(lhs.shape[0], group_offsets), group_base,
+        interpret,
+    )
+
+
+def _swiglu_vjp_fwd(lhs, wg_q, wu_q, sg, su, group_offsets, group_base,
+                    interpret):
+    if interpret is None:
+        interpret = _interpret_default()
+    h, g = _swiglu_fwd_fn(
+        lhs, wg_q, wu_q, sg, su, group_offsets, group_base, interpret
+    )
+    # name the RESIDUAL itself: under save_only_these_names("moe_g")
+    # the backward then reads the pinned value instead of re-running
+    # the forward kernel (naming only the returned g would pin a value
+    # the backward never consumes)
+    g_saved = _checkpoint_name(g, "moe_g")
+    return (h, g), (
+        lhs, wg_q, wu_q, sg, su, group_offsets, group_base, g_saved
+    )
+
+
+def _swiglu_vjp_bwd(interpret, res, cts):
+    lhs, wg_q, wu_q, sg, su, group_offsets, group_base, g = res
+    dh, dg_out = cts
+    if interpret is None:
+        interpret = _interpret_default()
+    dg, du = _swiglu_bwd_impl(
+        lhs, wu_q, su, g, dh.astype(lhs.dtype),
+        _group_of_tile(lhs.shape[0], group_offsets), group_base,
+        interpret,
+    )
+    # g is also an OUTPUT (for the remat pin); fold any cotangent that
+    # arrives on it into the pre-activation gradient (normally zero —
+    # nothing consumes g downstream — and XLA DCEs the add)
+    dg = dg + dg_out.astype(dg.dtype)
+    # dlhs through both frozen banks, read "backwards" (trans) — the
+    # same kernel-B/A machinery every gmm backward uses
+    dlhs = _gmm_fwd_impl(
+        dg, wg_q, group_offsets, trans_rhs=True, interpret=interpret,
+        scale=sg, base=group_base,
+    ) + _gmm_fwd_impl(
+        du, wu_q, group_offsets, trans_rhs=True, interpret=interpret,
+        scale=su, base=group_base,
+    )
+    return (dlhs, None, None, jnp.zeros_like(sg), jnp.zeros_like(su),
+            None, None)
+
+
+swiglu_gmm.defvjp(_swiglu_vjp_fwd, _swiglu_vjp_bwd)
