@@ -109,7 +109,12 @@ def chunked_cross_entropy(
         # backward is a gather. Gathering columns of [D, V] directly
         # (axis=1) avoids materialising a [V, D] transposed copy of
         # the head (1.05GB at 8B — an OOM at 16k).
-        ht = jnp.take(head, t.reshape(-1), axis=1)  # [D, B·c]
+        # cast the gathered columns to the activation dtype FIRST so
+        # both the logsumexp path (head.astype(h.dtype) above) and the
+        # target-logit path see identically rounded head values — a
+        # higher-precision head here would bias nll = logz - target
+        # and can push it slightly negative on confident tokens
+        ht = jnp.take(head, t.reshape(-1), axis=1).astype(h.dtype)  # [D, B·c]
         ht = ht.T.reshape(h.shape).astype(jnp.float32)
         target_logit = jnp.sum(h.astype(jnp.float32) * ht, axis=-1)
         nll = logz - target_logit

@@ -145,15 +145,16 @@ def test_staggered_arrivals_share_decode_steps(model):
 
     serial_steps = N_REQ * MAX_TOK  # generate() decodes per request
     assert engine_tokens == N_REQ * MAX_TOK
-    # the bounds are deliberately loose: how many requests land before
-    # each chunk starts depends on CPU thread timing (measured 96-144
-    # steps across runs for the 192-step serial equivalent). Any
-    # sharing at all proves the slots batch; the tight quantitative
-    # claim (6.4 tokens/step, 1.75x tok/s at 8 slots) is measured on
+    # the bound is deliberately loose: how many requests land before
+    # each chunk starts depends on CPU thread timing (measured 96-176
+    # steps across runs for the 192-step serial equivalent; a 0.8×
+    # steps ceiling — and a 1.2 tokens/step floor — both flaked under
+    # full-suite load at the 176-step worst case). Any tokens/step > 1
+    # proves the slots share decode steps; the tight quantitative
+    # claim (5.3 tokens/step, 3.59x tok/s at 8 slots) is measured on
     # the real chip by loadtest/continuous_batching.py → BASELINE.md.
-    assert steps <= 0.8 * serial_steps, (steps, serial_steps)
-    assert engine.tokens_emitted / steps >= 1.2, (
-        engine.tokens_emitted, steps
+    assert engine.tokens_emitted / steps > 1.0, (
+        engine.tokens_emitted, steps, serial_steps
     )
 
 
