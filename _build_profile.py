@@ -1,0 +1,54 @@
+"""Cold trainer-build phase profile on the real chip."""
+import time, json, os
+t00 = time.monotonic()
+import jax, jax.numpy as jnp
+from functools import partial
+from odh_kubeflow_tpu.models import LlamaConfig, LoraConfig
+from odh_kubeflow_tpu.models import llama
+from odh_kubeflow_tpu.models import lora as lora_lib
+from odh_kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from odh_kubeflow_tpu.train import TrainConfig, Trainer
+from odh_kubeflow_tpu.train.trainer import _make_optimizer
+from jax.sharding import NamedSharding, PartitionSpec as P
+devices = jax.devices()
+t_imp = time.monotonic() - t00
+
+cfg = LlamaConfig.llama3_1b(dtype=jnp.bfloat16)
+mesh = build_mesh(MeshConfig(fsdp=len(devices)), devices)
+lcfg = LoraConfig(rank=16)
+tcfg = TrainConfig(warmup_steps=2, total_steps=100)
+opt = _make_optimizer(tcfg)
+sh = lambda specs: jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda s: isinstance(s, P))
+
+out = {"import_s": round(t_imp, 2)}
+with jax.set_mesh(mesh):
+    t0 = time.monotonic()
+    p_specs = llama.param_specs(cfg)
+    init_fn = jax.jit(partial(llama.init_params, cfg=cfg, dtype=cfg.dtype), out_shardings=sh(p_specs))
+    params = init_fn(jax.random.key(0))
+    jax.block_until_ready(params)  # no-op on relay; sync via fetch below
+    float(params["final_norm"][0])
+    out["param_init_s"] = round(time.monotonic() - t0, 2)
+
+    t0 = time.monotonic()
+    l_specs = lora_lib.lora_specs(cfg, lcfg)
+    lora_init = jax.jit(partial(lora_lib.init_lora_params, cfg=cfg, lora=lcfg), out_shardings=sh(l_specs))
+    lp = lora_init(jax.random.key(1))
+    float(jax.tree_util.tree_leaves(lp)[0].ravel()[0])
+    out["lora_init_s"] = round(time.monotonic() - t0, 2)
+
+    t0 = time.monotonic()
+    import optax
+    shapes = jax.eval_shape(opt.init, lp)
+    o_specs = optax.tree_map_params(opt, lambda _l, s: s, shapes, l_specs, transform_non_params=lambda _l: P())
+    out["opt_spec_s"] = round(time.monotonic() - t0, 2)
+    t0 = time.monotonic()
+    opt_init = jax.jit(opt.init, out_shardings=sh(o_specs))
+    ost = opt_init(lp)
+    float(jax.tree_util.tree_leaves(ost)[0].ravel()[0] if jax.tree_util.tree_leaves(ost) else 0.0)
+    out["opt_init_s"] = round(time.monotonic() - t0, 2)
+
+print(json.dumps(out))
+t0 = time.monotonic()
+tr = Trainer(cfg, tcfg, lora_cfg=lcfg, mesh=mesh)
+print(json.dumps({"full_trainer_build_again_s": round(time.monotonic() - t0, 2)}))

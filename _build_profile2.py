@@ -1,0 +1,26 @@
+import time, json
+import jax, jax.numpy as jnp
+from functools import partial
+from odh_kubeflow_tpu.models import LlamaConfig, LoraConfig
+from odh_kubeflow_tpu.models import llama
+from odh_kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from jax.sharding import NamedSharding, PartitionSpec as P
+devices = jax.devices()
+cfg = LlamaConfig.llama3_1b(dtype=jnp.bfloat16)
+mesh = build_mesh(MeshConfig(fsdp=len(devices)), devices)
+sh = lambda specs: jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda s: isinstance(s, P))
+out = {}
+with jax.set_mesh(mesh):
+    p_specs = llama.param_specs(cfg)
+    init_fn = jax.jit(partial(llama.init_params, cfg=cfg, dtype=cfg.dtype), out_shardings=sh(p_specs))
+    t0 = time.monotonic(); lowered = init_fn.lower(jax.random.key(0)); out["lower_s"] = round(time.monotonic()-t0, 2)
+    t0 = time.monotonic(); compiled = lowered.compile(); out["compile_s"] = round(time.monotonic()-t0, 2)
+    t0 = time.monotonic(); params = compiled(jax.random.key(0)); float(params["final_norm"][0]); out["exec_s"] = round(time.monotonic()-t0, 2)
+    # zeros-init comparison: how much of compile is the RNG graph?
+    def zinit(k):
+        shapes = jax.eval_shape(partial(llama.init_params, cfg=cfg, dtype=cfg.dtype), k)
+        return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    zfn = jax.jit(zinit, out_shardings=sh(p_specs))
+    t0 = time.monotonic(); zc = zfn.lower(jax.random.key(0)).compile(); out["zeros_compile_s"] = round(time.monotonic()-t0, 2)
+    t0 = time.monotonic(); zp = zc(jax.random.key(0)); float(zp["final_norm"][0]); out["zeros_exec_s"] = round(time.monotonic()-t0, 2)
+print(json.dumps(out))

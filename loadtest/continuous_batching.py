@@ -1,17 +1,23 @@
-"""Continuous-batching throughput on the real chip (VERDICT r2 item 10).
+"""Continuous-batching throughput + latency SLOs on the real chip.
 
-Measures aggregate decode tok/s for staggered concurrent requests:
+Measures aggregate decode tok/s for staggered concurrent requests —
 serial one-at-a-time ``generate()`` handling vs the slot-batched
-``DecodeEngine`` admitting streams into the running decode loop. On
-TPU, decode is weight-streaming-bound — the HBM reads of the layer
-weights dominate and are shared across slots — so the engine's batch-4
-decode step costs barely more than batch-1 and aggregate throughput
-scales with occupancy.
+``DecodeEngine`` — plus the latency half a serving benchmark owes
+(VERDICT r4 item 5): **TTFT and inter-token-latency p50/p95** per
+request, and a mixed short/long-prompt phase that measures p95 ITL
+with a long admission in flight, with and without chunked prefill
+(``--prefill-chunk``). Without chunking, a long-prompt admission runs
+one full-prompt prefill program while every active slot's decode
+stalls (head-of-line blocking — aggregate tok/s is structurally blind
+to it); with chunking the admission runs part-by-part between decode
+chunks and steady-state ITL survives.
 
-    python -m loadtest.continuous_batching [--config llama3_1b] [--int8]
+    python -m loadtest.continuous_batching [--config llama3_1b]
+        [--int8] [--long-prompt-len 1024] [--prefill-chunk 256]
 
 Prints one JSON line: {"serial_tok_s":..., "engine_tok_s":...,
-"speedup":..., ...} — recorded in BASELINE.md.
+"speedup":..., "ttft_p50_s":..., "itl_p95_ms":...,
+"mixed": {...}} — recorded in BASELINE.md.
 """
 
 from __future__ import annotations
@@ -24,6 +30,36 @@ import jax
 import jax.numpy as jnp
 
 
+def pctl(xs, q):
+    xs = sorted(xs)
+    if not xs:
+        return 0.0
+    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[i]
+
+
+def latency_stats(reqs) -> dict:
+    """Aggregate TTFT / ITL percentiles over finished requests (what a
+    streaming client of this process observed).
+
+    The engine emits in decode-chunk bursts, so raw inter-token gaps
+    are bimodal: ~0 within a fetched chunk, the chunk step time at
+    boundaries — a raw p95 over mostly-zero gaps hides the stalls
+    entirely. ``itl_*`` therefore reports the BURST-GAP distribution
+    (gaps > 1 ms, i.e. every pause a streaming client actually
+    perceives) and ``stall_max_ms`` the single worst pause."""
+    ttfts = [r.ttft() for r in reqs if r.times]
+    itls = [g for r in reqs for g in r.itls()]
+    gaps = [g for g in itls if g > 1e-3]
+    return {
+        "ttft_p50_s": round(pctl(ttfts, 0.50), 3),
+        "ttft_p95_s": round(pctl(ttfts, 0.95), 3),
+        "itl_p50_ms": round(pctl(gaps, 0.50) * 1e3, 1),
+        "itl_p95_ms": round(pctl(gaps, 0.95) * 1e3, 1),
+        "stall_max_ms": round(max(itls, default=0.0) * 1e3, 1),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="llama3_1b")
@@ -33,6 +69,15 @@ def main() -> None:
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--prompt-len", type=int, default=48)
     ap.add_argument("--chunk", type=int, default=32)
+    ap.add_argument(
+        "--long-prompt-len", type=int, default=1024,
+        help="long prompt injected mid-stream in the mixed phase",
+    )
+    ap.add_argument(
+        "--prefill-chunk", type=int, default=256,
+        help="chunked-prefill part width for the mixed phase's second "
+        "engine (0 disables the comparison)",
+    )
     args = ap.parse_args()
 
     from odh_kubeflow_tpu.models.engine import DecodeEngine
@@ -99,8 +144,78 @@ def main() -> None:
         engine_tokens = sum(len(h.result(600)) for h in handles)
         engine_s = time.time() - t0
         steps = engine.decode_steps
+        lat = latency_stats(handles)
     finally:
         engine.stop()
+
+    # --- mixed phase: steady short streams + one long admission --------
+    # p95 ITL of the short streams while a long-prompt prefill is in
+    # flight, measured (a) whole-prompt admission (head-of-line
+    # blocking) and (b) chunked prefill
+    def mixed_run(prefill_chunk):
+        long_prompt = [
+            int(t) for t in jax.random.randint(
+                jax.random.fold_in(rng, 999),
+                (args.long_prompt_len,), 3, 1000,
+            )
+        ]
+        eng = DecodeEngine(
+            params, cfg,
+            n_slots=args.slots,
+            max_len=args.long_prompt_len + args.max_tokens + 16,
+            # latency-shaped decode chunk: an SLO-sensitive server runs
+            # small chunks (small client-visible bursts); the
+            # throughput phase above keeps the throughput-optimal one.
+            # A chunk as large as the admission stall would also HIDE
+            # the stall inside one burst gap.
+            chunk=8,
+            prompt_buckets=(args.prompt_len, args.long_prompt_len),
+            prefill_chunk=prefill_chunk,
+        )
+        try:
+            # warm every program incl. the long bucket / parts
+            eng.submit(prompts[0], max_tokens=2).result(600)
+            eng.submit(long_prompt, max_tokens=2).result(600)
+            short = [
+                eng.submit(p, max_tokens=args.max_tokens)
+                for p in prompts[: args.slots - 1]
+            ]
+            # let the short streams reach steady state, then admit the
+            # long prompt into the last slot mid-decode
+            time.sleep(0.4)
+            lh = eng.submit(long_prompt, max_tokens=8)
+            lh.result(600)
+            for h in short:
+                h.result(600)
+            stats = latency_stats(short)
+            stats["long_ttft_s"] = round(lh.ttft(), 3)
+            # ITL gaps of short streams *overlapping the long
+            # admission window* — the head-of-line metric
+            t_lo = lh.submit_t
+            t_hi = lh.times[0]
+            # interval OVERLAP with the admission window — the stall
+            # gap typically starts mid-admission and ends after the
+            # long request's first token, so containment would miss it
+            inflight = [
+                b - a
+                for h in short
+                for a, b in zip(h.times, h.times[1:])
+                if a < t_hi and b > t_lo
+            ]
+            gaps = [g for g in inflight if g > 1e-3]
+            stats["itl_p95_during_admission_ms"] = round(
+                pctl(gaps, 0.95) * 1e3, 1
+            )
+            stats["stall_max_during_admission_ms"] = round(
+                max(inflight, default=0.0) * 1e3, 1
+            )
+            return stats
+        finally:
+            eng.stop()
+
+    mixed = {"whole_prompt": mixed_run(None)}
+    if args.prefill_chunk:
+        mixed["chunked"] = mixed_run(args.prefill_chunk)
 
     serial_rate = serial_tokens / serial_s
     engine_rate = engine_tokens / engine_s
@@ -115,6 +230,10 @@ def main() -> None:
         "speedup": round(engine_rate / serial_rate, 2),
         "engine_decode_steps": steps,
         "tokens_per_step": round(engine_tokens / max(steps, 1), 2),
+        **lat,
+        "mixed": mixed,
+        "prefill_chunk": args.prefill_chunk or None,
+        "long_prompt_len": args.long_prompt_len,
     }))
 
 

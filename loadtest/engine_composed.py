@@ -10,11 +10,13 @@ Phases (an npz chains them, same as spec_decode_distill):
     python -m loadtest.engine_composed                    # distill + measure
 
 Reported: serial one-shot tok/s, composed-engine aggregate tok/s, the
-multiplier, and the engine's own decomposition (spec rounds, tokens
-per round = acceptance, tokens per target pass). Prompts come from the
-distillation corpus (the in-distribution operating assumption of
-production spec decode — held-out acceptance on random-weight targets
-is a prompt-hash, measured honestly in spec_decode_distill).
+multiplier, the engine's own decomposition (spec rounds, tokens per
+round = acceptance, tokens per target pass), and the latency SLOs
+(TTFT p50/p95, burst-gap ITL p50/p95, max stall — VERDICT r4 item 5).
+Prompts come from the distillation corpus (the in-distribution
+operating assumption of production spec decode — held-out acceptance
+on random-weight targets is a prompt-hash, measured honestly in
+spec_decode_distill).
 """
 
 from __future__ import annotations
@@ -98,6 +100,9 @@ def main() -> None:
         engine_s = time.time() - t0
         rounds = engine.spec_rounds - base_rounds
         emitted = engine.tokens_emitted - base_emitted
+        from loadtest.continuous_batching import latency_stats
+
+        lat = latency_stats(handles)
     finally:
         engine.stop()
 
@@ -116,6 +121,7 @@ def main() -> None:
         "multiplier": round(engine_rate / serial_rate, 2),
         "spec_rounds": rounds,
         "tokens_per_round": round(emitted / max(rounds, 1), 2),
+        **lat,
     }))
 
 

@@ -33,6 +33,7 @@ import dataclasses
 import functools
 import queue
 import threading
+import time
 from typing import Any, Optional, Sequence
 
 import jax
@@ -98,6 +99,11 @@ class _Request:
     # decode; a None sentinel marks end-of-stream (check .error then)
     token_q: Optional["queue.Queue"] = None
     cancelled: bool = False
+    # SLO observability: wall-clock submit time and per-token emit
+    # times (monotonic seconds, host-side — i.e. what a client
+    # streaming from this process would see, chunk bursts included)
+    submit_t: float = 0.0
+    times: list[float] = dataclasses.field(default_factory=list)
 
     def cancel(self) -> None:
         """Abandon the stream (client went away): the engine frees the
@@ -105,8 +111,24 @@ class _Request:
         of max_tokens for nobody."""
         self.cancelled = True
 
+    def ttft(self) -> float:
+        """Time to first token (s) — submit → first emitted token."""
+        assert self.times, "no tokens emitted"
+        return self.times[0] - self.submit_t
+
+    def itls(self) -> list[float]:
+        """Inter-token latencies (s) as observed by a streaming
+        client: gaps between consecutive token emissions. Chunked
+        decode emits in bursts, so the distribution is bimodal —
+        near-zero within a fetched chunk, the chunk step time at
+        boundaries; the p95 is what an SLO cares about."""
+        return [
+            b - a for a, b in zip(self.times, self.times[1:])
+        ]
+
     def _emit(self, tok: int) -> None:
         self.tokens.append(tok)
+        self.times.append(time.monotonic())
         if self.token_q is not None:
             self.token_q.put(tok)
 
@@ -151,6 +173,8 @@ class DecodeEngine:
         pad_id: int = 0,
         cache_dtype=jnp.bfloat16,
         seed: int = 0,
+        prefill_chunk: Optional[int] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
         prefix_cache_entries: int = 0,
         prefix_buckets: Sequence[int] = (256, 512),
         draft_params: Optional[Params] = None,
@@ -166,6 +190,16 @@ class DecodeEngine:
         self.chunk = chunk
         self.prompt_buckets = tuple(sorted(prompt_buckets))
         self.pad_id = pad_id
+        # Chunked prefill: prompts longer than this admit in
+        # ``prefill_chunk``-token parts, one part per engine-loop turn,
+        # so active slots keep decoding between parts instead of
+        # stalling for the whole prompt's prefill (head-of-line
+        # blocking — a 1k-token admission would otherwise freeze every
+        # stream for the full prefill). None = whole-prompt admission.
+        self.prefill_chunk = prefill_chunk
+        # in-flight chunked admission (one at a time): dict with req /
+        # slot / sub(cache) / consumed / had_prefix
+        self._admitting: Optional[dict] = None
         # prompt-prefix KV reuse: entries keyed on the token tuple of a
         # bucketed prefix; admission with a hit prefills only the
         # remainder (a shared system prompt stops being re-prefilled
@@ -195,6 +229,17 @@ class DecodeEngine:
             assert draft_cfg is not None, "draft_params needs draft_cfg"
             _, self._dfwd = family_forward(draft_cfg)
 
+        # multi-chip serving: a mesh shards the persistent cache (slot
+        # batch over data/fsdp, KV heads over tensor —
+        # ``generate.cache_specs``) and every engine program compiles
+        # under the mesh, so an 8B-class model that needs >1 chip gets
+        # continuous batching / spec decode / the prefix cache like any
+        # single-chip model. The caller passes params already sharded
+        # (``parallel.mesh.shard_tree``); the host-side loop is
+        # unchanged — one process drives the whole mesh (the standard
+        # single-controller JAX serving shape).
+        self._mesh = mesh
+
         cache_cfg, self._fwd = family_forward(cfg)
         S = n_slots
         self._state = {
@@ -216,6 +261,25 @@ class DecodeEngine:
             self._state["dcache"] = init_cache(
                 dcache_cfg, S, max_len, cache_dtype
             )
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from odh_kubeflow_tpu.models.generate import cache_specs
+
+            cspec = {
+                kv: NamedSharding(mesh, s)
+                for kv, s in cache_specs(cache_cfg).items()
+            }
+            rep = NamedSharding(mesh, jax.sharding.PartitionSpec())
+            self._state = {
+                k: (
+                    jax.device_put(v, cspec)
+                    if k in ("cache", "dcache")
+                    # per-slot control vectors are tiny: replicate
+                    else jax.device_put(v, rep)
+                )
+                for k, v in self._state.items()
+            }
         # observability: decode_steps × n_slots is the work a serial
         # server would have spent per-request; the ratio
         # tokens_emitted / decode_steps is the batching efficiency
@@ -319,38 +383,73 @@ class DecodeEngine:
         )
         return row[None, :]
 
-    def _prefill(self, params, lora, state, packed, *, bucket):
-        """Prefill one prompt (batch 1, ``bucket`` wide) into the slot
-        carried in ``packed`` (see ``_unpack_admission``)."""
-        prompt, length, slot, req_vec = self._unpack_admission(
+    def _prefill_tail(self, params, lora, state, sub_cache, packed,
+                      start, *, bucket):
+        """Run the FINAL (possibly only) prompt segment — ``packed``'s
+        remainder tokens at traced cache offset ``start`` — through an
+        already-seeded batch-1 ``sub_cache``, sample the first token,
+        and splice the finished slot into ``state``. Shared tail of
+        every admission flavor: cold (start 0, fresh cache), prefix-hit
+        (cache seeded with the prefix KV), and chunked (cache filled by
+        ``_prefill_part`` calls), so their semantics cannot drift."""
+        prompt_rem, rem_len, slot, req_vec = self._unpack_admission(
             packed, bucket
         )
         max_tokens, temp, top_k, top_p, eos = req_vec
-        cache_cfg, _ = family_forward(self.cfg)
-        S_b = prompt.shape[1]
-        sub_cache = init_cache(
-            cache_cfg, 1, self.max_len, state["cache"]["k"].dtype
-        )
+        S_b = prompt_rem.shape[1]
+        total = start + rem_len
         slots_row = jnp.arange(self.max_len, dtype=jnp.int32)[None, :]
-        kv_mask1 = slots_row < length
-        positions = jnp.arange(S_b, dtype=jnp.int32)[None, :]
+        kv_mask1 = slots_row < total
+        positions = start + jnp.arange(S_b, dtype=jnp.int32)[None, :]
         logits, sub_cache = self._fwd(
-            params, prompt, self.cfg, sub_cache, jnp.int32(0),
+            params, prompt_rem, self.cfg, sub_cache, start,
             positions=positions, kv_mask=kv_mask1, lora=lora,
             # bucket padding is not content: the MoE router must not
             # let pad positions consume expert capacity
-            token_mask=kv_mask1[:, :S_b],
+            token_mask=(
+                jnp.arange(S_b, dtype=jnp.int32) < rem_len
+            )[None],
         )
         last = jnp.take_along_axis(
-            logits, (length - 1)[None, None, None], axis=1
+            logits, (rem_len - 1)[None, None, None], axis=1
         )[:, 0, :]
         rng, sub = jax.random.split(state["rng"])
         first = sample_logits_rowwise(
             last, sub, temp[None], top_k[None], top_p[None]
         )[0]
         return self._write_slot_state(
-            state, sub_cache, kv_mask1, slot, first, length, req_vec, rng
+            state, sub_cache, kv_mask1, slot, first, total, req_vec, rng
         )
+
+    def _prefill(self, params, lora, state, packed, *, bucket):
+        """Prefill one whole prompt (batch 1, ``bucket`` wide) into the
+        slot carried in ``packed`` (see ``_unpack_admission``)."""
+        cache_cfg, _ = family_forward(self.cfg)
+        sub_cache = init_cache(
+            cache_cfg, 1, self.max_len, state["cache"]["k"].dtype
+        )
+        return self._prefill_tail(
+            params, lora, state, sub_cache, packed, jnp.int32(0),
+            bucket=bucket,
+        )
+
+    def _prefill_part(self, params, lora, sub_cache, toks, start, *,
+                      width: int):
+        """One FULL interior segment of a chunked admission: ``width``
+        prompt tokens written into the batch-1 ``sub_cache`` at traced
+        offset ``start``. No sampling, no slot splice — interior parts
+        only extend the KV; ``_prefill_tail`` finishes the admission.
+        One compile total (start is traced), independent of prompt
+        length."""
+        slots_row = jnp.arange(self.max_len, dtype=jnp.int32)[None, :]
+        kv_mask1 = slots_row < (start + width)
+        positions = start + jnp.arange(width, dtype=jnp.int32)[None, :]
+        _, sub_cache = self._fwd(
+            params, toks, self.cfg, sub_cache, start,
+            positions=positions, kv_mask=kv_mask1, lora=lora,
+            token_mask=jnp.ones((1, width), jnp.bool_),
+        )
+        return sub_cache
 
     def _decode_chunk(self, params_lora, state, *, greedy: bool = False):
         params, lora = params_lora
@@ -423,38 +522,23 @@ class DecodeEngine:
         cache and only the remainder tokens run through the model, at
         positions/cache offset ``plen`` (static — one compile per
         (prefix bucket, remainder bucket))."""
-        prompt_rem, rem_len, slot, req_vec = self._unpack_admission(
-            packed, bucket
-        )
-        max_tokens, temp, top_k, top_p, eos = req_vec
         cache_cfg, _ = family_forward(self.cfg)
-        S_b = prompt_rem.shape[1]
         sub_cache = init_cache(
             cache_cfg, 1, self.max_len, state["cache"]["k"].dtype
         )
-        sub_cache = {
+        sub_cache = self._seed_prefix(sub_cache, prefix_kv, plen=plen)
+        return self._prefill_tail(
+            params, lora, state, sub_cache, packed, jnp.int32(plen),
+            bucket=bucket,
+        )
+
+    def _seed_prefix(self, sub_cache, prefix_kv, *, plen: int):
+        """Seed a fresh batch-1 cache with a prefix-cache entry (the
+        chunked-admission analogue of _prefill_ext's seeding)."""
+        return {
             kv: sub_cache[kv].at[:, :, :plen].set(prefix_kv[kv])
             for kv in ("k", "v")
         }
-        total = plen + rem_len
-        slots_row = jnp.arange(self.max_len, dtype=jnp.int32)[None, :]
-        kv_mask1 = slots_row < total
-        positions = plen + jnp.arange(S_b, dtype=jnp.int32)[None, :]
-        logits, sub_cache = self._fwd(
-            params, prompt_rem, self.cfg, sub_cache, jnp.int32(plen),
-            positions=positions, kv_mask=kv_mask1, lora=lora,
-            token_mask=(jnp.arange(S_b, dtype=jnp.int32) < rem_len)[None],
-        )
-        last = jnp.take_along_axis(
-            logits, (rem_len - 1)[None, None, None], axis=1
-        )[:, 0, :]
-        rng, sub = jax.random.split(state["rng"])
-        first = sample_logits_rowwise(
-            last, sub, temp[None], top_k[None], top_p[None]
-        )[0]
-        return self._write_slot_state(
-            state, sub_cache, kv_mask1, slot, first, total, req_vec, rng
-        )
 
     def _draft_prefill(self, dparams, state, packed, *, bucket):
         """Fill the DRAFT model's cache for a freshly admitted slot
@@ -627,6 +711,36 @@ class DecodeEngine:
             )
         return self._prefill_fns[key]
 
+    def _prefill_part_runner(self, width: int):
+        key = ("part", width)
+        if key not in self._prefill_fns:
+            self._prefill_fns[key] = jax.jit(
+                functools.partial(self._prefill_part, width=width),
+                donate_argnums=2,
+            )
+        return self._prefill_fns[key]
+
+    def _prefill_final_runner(self, bucket: int):
+        key = ("final", bucket)
+        if key not in self._prefill_fns:
+            # donate the engine state only: the sub-cache is spliced
+            # into state's larger buffers, so its donation could never
+            # be used (it would just warn)
+            self._prefill_fns[key] = jax.jit(
+                functools.partial(self._prefill_tail, bucket=bucket),
+                donate_argnums=2,
+            )
+        return self._prefill_fns[key]
+
+    def _seed_prefix_runner(self, plen: int):
+        key = ("seed", plen)
+        if key not in self._prefill_fns:
+            self._prefill_fns[key] = jax.jit(
+                functools.partial(self._seed_prefix, plen=plen),
+                donate_argnums=0,
+            )
+        return self._prefill_fns[key]
+
     def _match_prefix(self, prompt: list[int]):
         """Longest cached bucketed prefix strictly shorter than the
         prompt (the remainder must be non-empty — the model still has
@@ -725,6 +839,83 @@ class DecodeEngine:
         self._slot_req[slot] = req  # claim before the next admission
         self._pending_first.append((req, first, slot))
 
+    def _begin_chunked_admit(self, req: _Request) -> None:
+        """Reserve a slot and set up the part-by-part admission: the
+        slot stays device-inactive (no emissions) until the final part
+        splices it in, and decode chunks run between parts."""
+        slot = self._slot_req.index(None)
+        cache_cfg, _ = family_forward(self.cfg)
+        sub_cache = init_cache(
+            cache_cfg, 1, self.max_len, self._state["cache"]["k"].dtype
+        )
+        start = 0
+        plen, entry = self._match_prefix(req.prompt)
+        if plen is not None:
+            self.prefix_hits += 1
+            sub_cache = self._seed_prefix_runner(plen)(sub_cache, entry)
+            start = plen
+        else:
+            self.prefix_misses += 1
+        self._slot_req[slot] = req  # reserve; device-inactive until final
+        self._admitting = dict(
+            req=req, slot=slot, sub=sub_cache, consumed=start,
+            had_prefix=plen is not None,
+        )
+
+    def _admit_step(self) -> None:
+        """Advance the in-flight chunked admission by ONE part (called
+        once per engine-loop turn, between decode chunks — the
+        anti-head-of-line-blocking contract)."""
+        adm = self._admitting
+        req, slot = adm["req"], adm["slot"]
+        if req.cancelled:
+            self._admitting = None
+            self._slot_req[slot] = None
+            req._finish()
+            return
+        C = self.prefill_chunk
+        consumed = adm["consumed"]
+        L = len(req.prompt)
+        if L - consumed > C:
+            seg = jnp.asarray(
+                [req.prompt[consumed:consumed + C]], jnp.int32
+            )
+            adm["sub"] = self._prefill_part_runner(C)(
+                self.params, self.lora, adm["sub"], seg,
+                jnp.int32(consumed),
+            )
+            adm["consumed"] = consumed + C
+            return
+        # final part: remainder ≤ C — sample + splice into the slot
+        rem = req.prompt[consumed:]
+        row = self.pack_admission(rem, self.pad_id, C, req)
+        row[0, C + 1] = slot
+        packed = jnp.asarray(row)
+        self._state, first = self._prefill_final_runner(C)(
+            self.params, self.lora, self._state, adm["sub"], packed,
+            jnp.int32(consumed),
+        )
+        self._admitting = None
+        if not adm["had_prefix"]:
+            self._maybe_insert_prefix(req.prompt, slot)
+        if req.max_tokens <= 1:
+            self._slot_req[slot] = None
+            req._emit(int(first))
+            req._finish()
+            return
+        if self.draft_params is not None:
+            full_bucket = next(
+                b for b in self.prompt_buckets if L <= b
+            )
+            drow = self.pack_admission(
+                req.prompt, self.pad_id, full_bucket, req
+            )
+            drow[0, full_bucket + 1] = slot
+            self._state = self._draft_prefill_runner(full_bucket)(
+                self.draft_params, self._state, jnp.asarray(drow),
+            )
+        self._pending_first.append((req, first, slot))
+
     def _fail_engine(self, exc: Exception) -> None:
         """A device-level failure (OOM, preemption, XLA runtime error)
         anywhere in the loop is fatal: the jitted programs donate the
@@ -738,6 +929,7 @@ class DecodeEngine:
         for its consumers."""
         if self.failure is None:
             self.failure = exc
+        self._admitting = None  # its request is failed via _slot_req
         for slot, req in enumerate(self._slot_req):
             if req is not None:
                 req.error = exc
@@ -754,7 +946,13 @@ class DecodeEngine:
 
     def _loop(self) -> None:
         try:
-            self._run_loop()
+            if self._mesh is not None:
+                # the mesh context is thread-local: the loop thread
+                # (where every jit compiles and runs) must enter it
+                with jax.set_mesh(self._mesh):
+                    self._run_loop()
+            else:
+                self._run_loop()
         finally:
             # drain on ANY exit (stop sentinel, device failure, bug):
             # the loop thread owns _slot_req, so draining here — never
@@ -765,7 +963,19 @@ class DecodeEngine:
     def _run_loop(self) -> None:
         while not self._stopped:
             admitted = False
-            while None in self._slot_req:
+            if self._admitting is not None:
+                # one prefill part per loop turn: active slots get a
+                # decode chunk below before the next part runs
+                req = self._admitting["req"]
+                try:
+                    self._admit_step()
+                except Exception as e:  # noqa: BLE001 — state integrity unknown
+                    req.error = e
+                    req._finish()
+                    self._fail_engine(e)
+                    return
+                admitted = True
+            while self._admitting is None and None in self._slot_req:
                 try:
                     req = self._queue.get_nowait()
                 except queue.Empty:
@@ -779,14 +989,30 @@ class DecodeEngine:
                     req._finish()
                     continue
                 try:
-                    self._admit(req)
+                    if (
+                        self.prefill_chunk is not None
+                        and len(req.prompt) > self.prefill_chunk
+                    ):
+                        self._begin_chunked_admit(req)
+                    else:
+                        self._admit(req)
                     admitted = True
                 except Exception as e:  # noqa: BLE001 — state integrity unknown
                     req.error = e
                     req._finish()
                     self._fail_engine(e)
                     return
-            if not any(r is not None for r in self._slot_req):
+            adm_slot = (
+                self._admitting["slot"]
+                if self._admitting is not None
+                else -1
+            )
+            if not any(
+                r is not None and s != adm_slot
+                for s, r in enumerate(self._slot_req)
+            ):
+                if self._admitting is not None:
+                    continue  # nothing decoding: run parts back-to-back
                 if not admitted:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
@@ -843,6 +1069,14 @@ class DecodeEngine:
             for slot, req in enumerate(self._slot_req):
                 if req is None:
                     continue
+                if (
+                    self._admitting is not None
+                    and self._admitting["slot"] == slot
+                ):
+                    # mid-admission slot: device-inactive, no
+                    # emissions; cancellation is _admit_step's job
+                    # (freeing it here would race a re-claim)
+                    continue
                 if req.cancelled:
                     # client abandoned the stream: deactivate the slot
                     # on device (stops its kv growth and emission) and
@@ -889,7 +1123,13 @@ class DecodeEngine:
                 "verify is exact only under argmax); use the one-shot "
                 "sampling path for temperature > 0"
             )
-        if len(prompt) > self.prompt_buckets[-1]:
+        chunkable = (
+            self.prefill_chunk is not None
+            and len(prompt) > self.prefill_chunk
+            # the draft prefill still needs a full-prompt bucket
+            and self.draft_params is None
+        )
+        if not chunkable and len(prompt) > self.prompt_buckets[-1]:
             raise ValueError(
                 f"prompt longer than max bucket {self.prompt_buckets[-1]}"
             )
@@ -909,6 +1149,7 @@ class DecodeEngine:
             top_p=top_p,
             eos_id=-1 if eos_id is None else int(eos_id),
             token_q=queue.Queue() if stream else None,
+            submit_t=time.monotonic(),
         )
         self._queue.put(req)
         self._wake.set()

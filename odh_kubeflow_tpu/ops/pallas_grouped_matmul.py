@@ -113,19 +113,28 @@ def span_pairs(group_offsets: jnp.ndarray, m: int, bm: int,
     are consecutive — a Mosaic requirement) and consecutive pairs of
     one group adjacent (so weight blocks stay resident).
 
-    Static length: T + E pairs (T = m // bm), padded with inert pairs
-    (group = E, the dummy; tile = T, the dummy out row). With
-    ``include_empty``, zero-size groups still get a pair (tgmm must
-    write zeros to their gradient block); without it they are skipped
-    (kernel B writes rows, and empty groups own none).
+    Static length: T + E pairs (T = m // bm), padded with inert pairs.
+    Inert pads REUSE the last real pair's block indices and carry
+    ``live = 0``: identical consecutive indices mean Mosaic's pipeliner
+    issues no DMA for them, and the kernels' ``pl.when(live)`` guard
+    skips their dots — before this, every pad burned a full fetch plus
+    a masked dot, E/(T+E) ≈ 19% of the grid at the 8×1B kernel-B shape
+    (measured: the bulk of kernel B's gap to the dense padded-dot
+    bound, ``loadtest/gmm_microbench.py``). With ``include_empty``,
+    zero-size groups still get a live pair (tgmm must write zeros to
+    their gradient block); without it they are skipped (kernel B
+    writes rows, and empty groups own none).
 
     Returns int32 arrays of length L = T + E:
-      ``tile``   lhs/out row-tile index (clamped real tile for inert
-                 pairs — inputs may be read, masks zero them out)
-      ``otile``  kernel B's out row tile: ``tile`` or the dummy T
-      ``group``  expert id, E for inert pads
+      ``tile``   lhs/out row-tile index (pads: the last real pair's)
+      ``otile``  kernel B's out row tile (pads: the last real pair's —
+                 revisits without a write are free; the dummy row T is
+                 used only when there are no real pairs at all)
+      ``group``  expert id (pads: the last real pair's, for the fetch)
+      ``live``   1 on real pairs — the kernels' compute guard
       ``write``  1 on the last pair of each real tile (kernel B writes)
-      ``gfirst``/``glast`` group-accumulation boundaries (tgmm)
+      ``gfirst``/``glast`` group-accumulation boundaries (tgmm; 0 on
+                 pads so a pad can never re-write a real block)
     """
     E = group_offsets.shape[0] - 1
     T = m // bm
@@ -160,14 +169,25 @@ def span_pairs(group_offsets: jnp.ndarray, m: int, bm: int,
     write = (owns & ((nxt_tile != tile) | ~nxt_owns)).astype(jnp.int32)
     otile = jnp.where(owns, tile, T).astype(jnp.int32)
     # group accumulation boundaries (tgmm): compare neighbour groups
+    # BEFORE the pad remap below (a pad's fetch-group aliases the last
+    # real pair's, which must not clear that pair's glast)
     prv_group = jnp.concatenate([jnp.full((1,), -1, jnp.int32), group[:-1]])
     nxt_group = jnp.concatenate([group[1:], jnp.full((1,), -2, jnp.int32)])
-    gfirst = (group != prv_group).astype(jnp.int32)
-    glast = (group != nxt_group).astype(jnp.int32)
+    live = (~pad).astype(jnp.int32)
+    gfirst = (group != prv_group).astype(jnp.int32) * live
+    glast = (group != nxt_group).astype(jnp.int32) * live
+    # pads alias the last real pair's indices: unchanged consecutive
+    # block indices cost no DMA, and live=0 skips their compute
+    last = jnp.maximum(total - 1, 0)
+
+    def pad_alias(arr):
+        return jnp.where(pad, arr[last], arr)
+
     return {
-        "tile": tile.astype(jnp.int32),
-        "otile": otile,
-        "group": group.astype(jnp.int32),
+        "tile": pad_alias(tile).astype(jnp.int32),
+        "otile": pad_alias(otile).astype(jnp.int32),
+        "group": pad_alias(group).astype(jnp.int32),
+        "live": live,
         "write": write,
         "gfirst": gfirst,
         "glast": glast,
@@ -285,7 +305,7 @@ def _gmm_a(lhs, rhs, group_of_tile, *, trans_rhs, interpret,
 
 
 def _gmm_b_kernel(
-    tile_ref, otile_ref, group_ref, write_ref, offs_ref,
+    tile_ref, otile_ref, group_ref, write_ref, live_ref, offs_ref,
     lhs_ref, rhs_ref, *rest, bm, bn, nk, trans_rhs,
 ):
     if len(rest) == 3:
@@ -300,12 +320,15 @@ def _gmm_b_kernel(
     indexing it is a major-dim dynamic slice (lane-dim dynamic slices
     are not a Mosaic-friendly pattern); all n slices persist across
     pairs so a boundary tile's earlier pairs survive until the
-    tile-closing pair merges and writes."""
+    tile-closing pair merges and writes. Pad pairs (live = 0) alias
+    the last real pair's block indices, so they cost neither a DMA
+    nor (guarded below) a dot."""
     i = pl.program_id(0)
     ni = pl.program_id(1)
     ki = pl.program_id(2)
     g = group_ref[i]
     t = tile_ref[i]
+    live = live_ref[i] == 1
     start = offs_ref[g]
     end = offs_ref[g + 1]
     # most pairs cover their whole tile (boundary pairs are ≤E of
@@ -327,7 +350,7 @@ def _gmm_b_kernel(
             lhs, rhs, dn, preferred_element_type=jnp.float32
         )
 
-    @pl.when(full)
+    @pl.when(jnp.logical_and(live, full))
     def _full():
         d = _dot(lhs_ref[...])
 
@@ -339,7 +362,7 @@ def _gmm_b_kernel(
         def _accum():
             acc_ref[ni] = acc_ref[ni] + d
 
-    @pl.when(jnp.logical_not(full))
+    @pl.when(jnp.logical_and(live, jnp.logical_not(full)))
     def _partial():
         rows = t * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
         mask = jnp.logical_and(rows >= start, rows < end)
@@ -377,14 +400,13 @@ def _gmm_b(lhs, rhs, pairs, group_offsets, *, trans_rhs, bm, bk, bn,
     L = pairs["tile"].shape[0]
     rhs_block = (1, bn, bk) if trans_rhs else (1, bk, bn)
 
-    # inert pairs carry the dummy group E — clamp the *fetch* index to a
-    # real block (their mask zeroes the compute; an out-of-bounds block
-    # index is a hard TPU fault, though interpret mode tolerates it).
-    # Stacked-bank mode (``base``, models/moe.py): rhs is [L·E, ...] and
-    # the fetch offsets into this layer's bank span.
+    # pad pairs alias a real pair's group (span_pairs) — the clamp
+    # stays as belt-and-braces against an out-of-bounds fetch (a hard
+    # TPU fault). Stacked-bank mode (``base``, models/moe.py): rhs is
+    # [L·E, ...] and the fetch offsets into this layer's bank span.
     def _g(p, i):
         g = jnp.minimum(p[2][i], E - 1)
-        return g if base is None else p[5][0] + g
+        return g if base is None else p[6][0] + g
 
     rhs_idx = (
         (lambda i, ni, ki, *p: (_g(p, i), ni, ki))
@@ -401,14 +423,14 @@ def _gmm_b(lhs, rhs, pairs, group_offsets, *, trans_rhs, bm, bk, bn,
     ]
     operands = [
         pairs["tile"], pairs["otile"], pairs["group"], pairs["write"],
-        offs,
+        pairs["live"], offs,
     ] + ([] if base is None else [base]) + [lhs, rhs]
-    npref = 5 if base is None else 6
+    npref = 6 if base is None else 7
 
     def strip(fn):
-        # bodies read the first five prefetch refs; drop the base ref
+        # bodies read the first six prefetch refs; drop the base ref
         def wrapped(*refs):
-            return fn(*refs[:5], *refs[npref:])
+            return fn(*refs[:6], *refs[npref:])
         return wrapped
 
     if scale is not None:
@@ -450,7 +472,7 @@ def _gmm_b(lhs, rhs, pairs, group_offsets, *, trans_rhs, bm, bk, bn,
 
 
 def _tgmm_kernel(
-    tile_ref, group_ref, gfirst_ref, glast_ref, offs_ref,
+    tile_ref, group_ref, gfirst_ref, glast_ref, live_ref, offs_ref,
     lhs_ref, dout_ref, out_ref, acc_ref, *, bm,
 ):
     i = pl.program_id(2)
@@ -458,26 +480,32 @@ def _tgmm_kernel(
     t = tile_ref[i]
     start = offs_ref[g]
     end = offs_ref[g + 1]
-    rows = t * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
-    mask = jnp.logical_and(rows >= start, rows < end)
-    lhs = jnp.where(mask, lhs_ref[...], 0).astype(lhs_ref.dtype)
-    # (bk, bn) = lhsᵀ · dout, contracting the bm rows
-    d = jax.lax.dot_general(
-        lhs, dout_ref[...], (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
 
-    @pl.when(gfirst_ref[i] == 1)
-    def _init():
-        acc_ref[...] = d
+    # pad pairs (live = 0, aliased indices — no DMA) must not touch
+    # the accumulator: their gfirst/glast are 0, so an unguarded body
+    # would ACCUMULATE a stale dot into the last real group
+    @pl.when(live_ref[i] == 1)
+    def _compute():
+        rows = t * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+        mask = jnp.logical_and(rows >= start, rows < end)
+        lhs = jnp.where(mask, lhs_ref[...], 0).astype(lhs_ref.dtype)
+        # (bk, bn) = lhsᵀ · dout, contracting the bm rows
+        d = jax.lax.dot_general(
+            lhs, dout_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
-    @pl.when(gfirst_ref[i] == 0)
-    def _accum():
-        acc_ref[...] = acc_ref[...] + d
+        @pl.when(gfirst_ref[i] == 1)
+        def _init():
+            acc_ref[...] = d
 
-    @pl.when(glast_ref[i] == 1)
-    def _write():
-        out_ref[0] = acc_ref[...].astype(out_ref.dtype)
+        @pl.when(gfirst_ref[i] == 0)
+        def _accum():
+            acc_ref[...] = acc_ref[...] + d
+
+        @pl.when(glast_ref[i] == 1)
+        def _write():
+            out_ref[0] = acc_ref[...].astype(out_ref.dtype)
 
 
 def _tgmm(lhs, dout, pairs, group_offsets, *, bm, bk, bn, interpret):
@@ -492,22 +520,25 @@ def _tgmm(lhs, dout, pairs, group_offsets, *, bm, bk, bn, interpret):
     out = pl.pallas_call(
         functools.partial(_tgmm_kernel, bm=bm),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=5,
+            num_scalar_prefetch=6,
             grid=(k // bk, n // bn, L),
             in_specs=[
                 pl.BlockSpec(
-                    (bm, bk), lambda ki, ni, i, t, g, gf, gl, o: (t[i], ki)
+                    (bm, bk),
+                    lambda ki, ni, i, t, g, gf, gl, lv, o: (t[i], ki),
                 ),
                 pl.BlockSpec(
-                    (bm, bn), lambda ki, ni, i, t, g, gf, gl, o: (t[i], ni)
+                    (bm, bn),
+                    lambda ki, ni, i, t, g, gf, gl, lv, o: (t[i], ni),
                 ),
             ],
             out_specs=pl.BlockSpec(
-                (1, bk, bn), lambda ki, ni, i, t, g, gf, gl, o: (g[i], ki, ni)
+                (1, bk, bn),
+                lambda ki, ni, i, t, g, gf, gl, lv, o: (g[i], ki, ni),
             ),
             scratch_shapes=[pltpu.VMEM((bk, bn), jnp.float32)],
         ),
-        # dummy group E absorbs inert pairs' flushes
+        # dummy group E absorbs the no-real-pairs degenerate flush
         out_shape=jax.ShapeDtypeStruct((E + 1, k, n), dout.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
@@ -515,7 +546,7 @@ def _tgmm(lhs, dout, pairs, group_offsets, *, bm, bk, bn, interpret):
         interpret=interpret,
     )(
         pairs["tile"], pairs["group"], pairs["gfirst"], pairs["glast"],
-        offs, lhs, dout,
+        pairs["live"], offs, lhs, dout,
     )
     return out[:E]
 

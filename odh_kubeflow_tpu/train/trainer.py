@@ -176,6 +176,7 @@ class Trainer:
         mesh: Optional[Mesh] = None,
         seed: int = 0,
         quantize_base: "bool | str" = False,  # True/"int8" or "int4"
+        precompile_batch: Optional[tuple] = None,  # (batch, seq[, keys])
     ):
         from odh_kubeflow_tpu.models import moe as moe_lib
 
@@ -208,7 +209,15 @@ class Trainer:
         self.mesh = mesh if mesh is not None else build_mesh()
         self.optimizer = _make_optimizer(train_cfg)
 
-        key = jax.random.key(seed)
+        # "rbg" keys: jax.random.* on them lowers to XLA's builtin
+        # RngBitGenerator instead of an inlined threefry graph — the
+        # threefry init graph for a 1B-param tree takes XLA ~17s to
+        # COMPILE (measured; zeros-init compiles in 0.7s), and init
+        # compile was the bulk of the 25s cold trainer build the
+        # spawn-latency north star pays. Same per-backend determinism;
+        # split/fold_in still derive via threefry (cheap — they hash
+        # keys, not param-sized tensors).
+        key = jax.random.key(seed, impl="rbg")
         k_params, k_lora = jax.random.split(key)
 
         pipe = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get(
@@ -237,6 +246,46 @@ class Trainer:
             # stage's layers; parallel/pipeline.py runs the schedule)
             p_specs = _pipe_shard_layer_specs(p_specs)
         self._frozen_specs = p_specs
+
+        # ---- everything ABSTRACT first (no device work): specs and
+        # shape trees, so the train-step AOT compile can start on a
+        # background thread BEFORE the inits run — the step compile
+        # (~14s cold on 1B) then overlaps the init compiles instead of
+        # adding to them (spawn→first-step north star).
+        frozen_shapes = jax.eval_shape(init_partial, k_params)
+        if quantize_base:
+            frozen_shapes = jax.eval_shape(
+                lambda t: quant_lib.quantize_params(t, bits=self.quant_bits),
+                frozen_shapes,
+            )
+        lora_init_partial = None
+        if lora_cfg is not None:
+            # adapters mirror the *backbone* dims (for MoE that is
+            # cfg.base — targets are the attention projections)
+            lora_dims_cfg = model_cfg.base if self.is_moe else model_cfg
+            l_specs = lora_lib.lora_specs(lora_dims_cfg, lora_cfg)
+            if self.pipelined:
+                l_specs = _pipe_shard_layer_specs(l_specs)
+            lora_init_partial = partial(
+                lora_lib.init_lora_params, cfg=lora_dims_cfg, lora=lora_cfg
+            )
+            self._train_specs = l_specs
+            trainable_shapes = jax.eval_shape(lora_init_partial, k_lora)
+        else:
+            self._train_specs = p_specs
+            trainable_shapes = frozen_shapes
+        self._opt_specs = self._opt_state_specs(
+            trainable_shapes, self._train_specs
+        )
+        self.step = 0
+        self._compiled = self._build_step()
+        self._aot: dict = {}
+        self._aot_threads: dict = {}
+        self._abstract_state = (trainable_shapes, frozen_shapes)
+        if precompile_batch is not None:
+            self.precompile_async(*precompile_batch)
+
+        # ---- device work
         with jax.set_mesh(self.mesh):
             if quantize_base:
                 # leaf-streamed int8 init: never holds the bf16 tree
@@ -252,33 +301,18 @@ class Trainer:
                 )
                 self.params = init_fn(k_params)
             if lora_cfg is not None:
-                # adapters mirror the *backbone* dims (for MoE that is
-                # cfg.base — targets are the attention projections)
-                lora_dims_cfg = model_cfg.base if self.is_moe else model_cfg
-                l_specs = lora_lib.lora_specs(lora_dims_cfg, lora_cfg)
-                if self.pipelined:
-                    l_specs = _pipe_shard_layer_specs(l_specs)
                 lora_init = jax.jit(
-                    partial(
-                        lora_lib.init_lora_params,
-                        cfg=lora_dims_cfg,
-                        lora=lora_cfg,
-                    ),
-                    out_shardings=self._sh(l_specs),
+                    lora_init_partial,
+                    out_shardings=self._sh(self._train_specs),
                 )
                 self.lora_params = lora_init(k_lora)
-                self._train_specs = l_specs
             else:
                 self.lora_params = None
-                self._train_specs = p_specs
             trainable = self.lora_params if lora_cfg is not None else self.params
-            self._opt_specs = self._opt_state_specs(trainable, self._train_specs)
             opt_init = jax.jit(
                 self.optimizer.init, out_shardings=self._sh(self._opt_specs)
             )
             self.opt_state = opt_init(trainable)
-        self.step = 0
-        self._compiled = self._build_step()
 
     # -- sharding helpers ---------------------------------------------------
 
@@ -436,13 +470,115 @@ class Trainer:
             loss = self._compiled_eval(trainable, self.params, batch)
         return {"loss": loss}
 
+    # -- async step precompile ---------------------------------------------
+
+    def _batch_abstract(self, batch_size: int, seq_len: int, keys):
+        from odh_kubeflow_tpu.parallel.mesh import batch_spec
+
+        bsh = NamedSharding(self.mesh, batch_spec())
+        dt = {"loss_mask": jnp.float32, "segment_ids": jnp.int32}
+        return {
+            k: jax.ShapeDtypeStruct(
+                (batch_size, seq_len), dt.get(k, jnp.int32), sharding=bsh
+            )
+            for k in keys
+        }
+
+    def precompile_async(
+        self,
+        batch_size: int,
+        seq_len: int,
+        keys: tuple = ("tokens", "targets", "loss_mask"),
+    ) -> None:
+        """Start compiling the train step for this batch shape on a
+        background thread, from ABSTRACT shapes — no params needed, so
+        the (expensive, ~14s cold at 1B) step compile runs concurrently
+        with the trainer's own init work instead of serially on the
+        first ``train_step``. A notebook's first cell (or
+        ``Trainer(precompile_batch=(B, S))``) calls this right after
+        construction; ``train_step`` joins the thread and uses the
+        ahead-of-time executable."""
+        import threading
+
+        akey = (batch_size, seq_len, tuple(sorted(keys)))
+        if akey in self._aot or akey in self._aot_threads:
+            return
+        trainable_shapes, frozen_shapes = self._abstract_state
+
+        def annotate(shapes, specs):
+            return jax.tree_util.tree_map(
+                lambda sh, sp: jax.ShapeDtypeStruct(
+                    sh.shape, sh.dtype, sharding=NamedSharding(self.mesh, sp)
+                ),
+                shapes,
+                specs,
+            )
+
+        a_train = annotate(trainable_shapes, self._train_specs)
+        a_frozen = annotate(frozen_shapes, self._frozen_specs)
+        a_opt = annotate(
+            jax.eval_shape(self.optimizer.init, trainable_shapes),
+            self._opt_specs,
+        )
+        a_batch = self._batch_abstract(batch_size, seq_len, keys)
+
+        def work():
+            try:
+                with jax.set_mesh(self.mesh):
+                    self._aot[akey] = self._compiled.lower(
+                        a_train, a_frozen, a_opt, a_batch
+                    ).compile()
+            except Exception as e:  # noqa: BLE001 — fall back to lazy jit
+                self._aot[akey] = e
+
+        th = threading.Thread(target=work, daemon=True)
+        self._aot_threads[akey] = th
+        th.start()
+
+    def _aot_executable(self, batch: dict):
+        akey = (
+            *batch["tokens"].shape, tuple(sorted(batch)),
+        )
+        th = self._aot_threads.pop(akey, None)
+        if th is not None:
+            th.join()
+        exe = self._aot.get(akey)
+        return exe if not isinstance(exe, Exception) else None
+
     def train_step(self, batch: dict) -> dict:
         trainable = self.lora_params if self.lora_cfg is not None else self.params
         frozen = self.params
         with jax.set_mesh(self.mesh):
-            trainable, self.opt_state, metrics = self._compiled(
-                trainable, frozen, self.opt_state, batch
-            )
+            exe = self._aot_executable(batch)
+            if exe is not None:
+                from odh_kubeflow_tpu.parallel.mesh import batch_spec
+
+                bsh = NamedSharding(self.mesh, batch_spec())
+                batch = {
+                    k: jax.device_put(v, bsh) for k, v in batch.items()
+                }
+                try:
+                    trainable, self.opt_state, metrics = exe(
+                        trainable, frozen, self.opt_state, batch
+                    )
+                except (TypeError, ValueError):
+                    # pre-dispatch incompatibility (arg structure /
+                    # sharding mismatch) — donated buffers are still
+                    # intact, so the lazy jit path is a safe fallback.
+                    # Runtime device errors (OOM, preemption) PROPAGATE:
+                    # the executable donates trainable/opt_state, so a
+                    # mid-execution failure leaves them unusable and a
+                    # retry would just mask the real error.
+                    self._aot[(
+                        *batch["tokens"].shape, tuple(sorted(batch)),
+                    )] = RuntimeError("aot fallback")
+                    trainable, self.opt_state, metrics = self._compiled(
+                        trainable, frozen, self.opt_state, batch
+                    )
+            else:
+                trainable, self.opt_state, metrics = self._compiled(
+                    trainable, frozen, self.opt_state, batch
+                )
         if self.lora_cfg is not None:
             self.lora_params = trainable
         else:

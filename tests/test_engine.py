@@ -321,3 +321,136 @@ def test_spec_engine_composes_with_prefix_cache(model):
     finally:
         plain.stop()
         spec.stop()
+
+
+def test_chunked_prefill_greedy_exact(model):
+    """Long prompts admitted part-by-part (prefill_chunk) must decode
+    token-for-token identically to whole-prompt admission — the KV a
+    chunked prefill writes is positionally identical."""
+    cfg, params = model
+    prompt = list(range(3, 3 + 50))
+    want = _reference_greedy(params, cfg, prompt, 10)
+    engine = DecodeEngine(
+        params, cfg, n_slots=2, max_len=256, chunk=4,
+        prompt_buckets=(16, 64), cache_dtype=jnp.float32,
+        prefill_chunk=16,
+    )
+    try:
+        got = engine.submit(prompt, max_tokens=10).result(timeout=120)
+        assert got == want, (got, want)
+        # short prompts skip the state machine entirely
+        short = [5, 9, 13]
+        want_s = _reference_greedy(params, cfg, short, 8)
+        got_s = engine.submit(short, max_tokens=8).result(timeout=120)
+        assert got_s == want_s, (got_s, want_s)
+    finally:
+        engine.stop()
+
+
+def test_chunked_prefill_allows_prompts_past_buckets(model):
+    """With chunked prefill the max prompt is bounded by max_len, not
+    the bucket table: a prompt longer than every bucket admits in
+    parts (the final ≤chunk remainder is its own compile width)."""
+    cfg, params = model
+    prompt = list(range(2, 2 + 100))  # > largest bucket (64)
+    engine = DecodeEngine(
+        params, cfg, n_slots=2, max_len=256, chunk=4,
+        prompt_buckets=(16, 64), cache_dtype=jnp.float32,
+        prefill_chunk=32,
+    )
+    try:
+        want = _reference_greedy(params, cfg, prompt, 8)
+        got = engine.submit(prompt, max_tokens=8).result(timeout=180)
+        assert got == want, (got, want)
+    finally:
+        engine.stop()
+
+
+def test_chunked_prefill_interleaves_decode(model):
+    """The anti-head-of-line-blocking contract: while a long admission
+    runs part-by-part, an already-active stream keeps emitting tokens
+    BETWEEN parts instead of stalling for the whole prefill."""
+    cfg, params = model
+    engine = DecodeEngine(
+        params, cfg, n_slots=2, max_len=512, chunk=2,
+        prompt_buckets=(16, 64), cache_dtype=jnp.float32,
+        prefill_chunk=16,
+    )
+    try:
+        # warm every program OUTSIDE the observed window (compiles
+        # would otherwise dominate the emit timeline)
+        engine.submit(list(range(3, 53)), max_tokens=2).result(300)
+        engine.submit([5, 9, 13], max_tokens=2).result(300)
+
+        a = engine.submit([7] * 8, max_tokens=40, stream=True)
+        # let a start decoding, then push a long admission behind it
+        first = next(a.iter_tokens())
+        b = engine.submit(list(range(3, 3 + 60)), max_tokens=4)
+        b.result(timeout=300)
+        a_tokens = list(a.iter_tokens())
+        # b's admission spans ≥3 parts (60 tokens / 16-chunk); a must
+        # have kept emitting during that window — check that a's emit
+        # timeline overlaps b's admission window rather than pausing
+        # until after b's first token
+        b_first_t = b.times[0]
+        emitted_during = sum(
+            1 for t in a.times if a.times[0] < t < b_first_t
+        )
+        assert emitted_during >= 2, (
+            emitted_during, len(a.times), first
+        )
+        assert len([first] + a_tokens) == 40
+    finally:
+        engine.stop()
+
+
+def test_ttft_itl_metrics_recorded(model):
+    """SLO observability: every request carries submit→first-token
+    latency and the per-token emit timeline the loadtests aggregate
+    into p50/p95."""
+    cfg, params = model
+    engine = DecodeEngine(
+        params, cfg, n_slots=2, max_len=128, chunk=4,
+        prompt_buckets=(16,), cache_dtype=jnp.float32,
+    )
+    try:
+        req = engine.submit([3, 5, 8], max_tokens=10)
+        toks = req.result(timeout=120)
+        assert len(toks) == 10
+        assert req.ttft() > 0
+        itls = req.itls()
+        assert len(itls) == 9
+        assert all(g >= 0 for g in itls)
+    finally:
+        engine.stop()
+
+
+def test_engine_under_mesh_greedy_exact(model, devices8):
+    """Multi-chip serving (VERDICT r4 item 6): the engine's persistent
+    cache shards over the mesh (slots on data/fsdp, KV heads on
+    tensor), every program compiles under it, and greedy decode stays
+    token-exact vs the single-device engine — continuous batching is
+    no longer a single-chip-only feature."""
+    from odh_kubeflow_tpu.models.llama import param_specs
+    from odh_kubeflow_tpu.parallel.mesh import (
+        MeshConfig, build_mesh, shard_tree,
+    )
+
+    cfg, params = model
+    prompts = [[5, 9, 13], list(range(3, 40)), [7] * 10, [11, 2]]
+    want = [_reference_greedy(params, cfg, p, 10) for p in prompts]
+
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2), jax.devices())
+    with jax.set_mesh(mesh):
+        sharded = shard_tree(params, mesh, param_specs(cfg))
+    engine = DecodeEngine(
+        sharded, cfg, n_slots=4, max_len=256, chunk=4,
+        prompt_buckets=(16, 64), cache_dtype=jnp.float32,
+        mesh=mesh, prefill_chunk=16,
+    )
+    try:
+        handles = [engine.submit(p, max_tokens=10) for p in prompts]
+        got = [h.result(timeout=300) for h in handles]
+        assert got == want, (got, want)
+    finally:
+        engine.stop()
