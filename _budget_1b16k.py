@@ -1,0 +1,64 @@
+"""Per-component device-time budget for the 1B@16k step (VERDICT r4 item 4)."""
+import json, tempfile, collections
+import jax, jax.numpy as jnp
+from odh_kubeflow_tpu.models import LlamaConfig, LoraConfig
+from odh_kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from odh_kubeflow_tpu.train import TrainConfig, Trainer
+from odh_kubeflow_tpu.utils import profiling
+
+cfg = LlamaConfig.llama3_1b(dtype=jnp.bfloat16, remat_policy="attn_mlp")
+tr = Trainer(cfg, TrainConfig(warmup_steps=2, total_steps=100), lora_cfg=LoraConfig(rank=16),
+             mesh=build_mesh(MeshConfig(fsdp=1), jax.devices()))
+batch = tr.make_fake_batch(1, 16384)
+for _ in range(2):
+    m = tr.train_step(batch)
+float(m["loss"])
+logdir = tempfile.mkdtemp(prefix="prof_")
+with jax.profiler.trace(logdir):
+    m = tr.train_step(batch)
+    float(m["loss"])
+events = profiling.latest_trace_events(logdir)
+proc, thr = {}, {}
+for e in events:
+    if e.get("ph") == "M" and e.get("name") == "process_name":
+        proc[e["pid"]] = e["args"].get("name", "")
+    if e.get("ph") == "M" and e.get("name") == "thread_name":
+        thr[(e["pid"], e.get("tid"))] = e["args"].get("name", "")
+dpids = {p for p, n in proc.items() if "TPU" in n or "xla" in n.lower() or "/device" in n.lower()}
+lanes = collections.defaultdict(list)
+for e in events:
+    if e.get("ph") != "X" or e.get("pid") not in dpids: continue
+    t = thr.get((e["pid"], e.get("tid")), "").lower()
+    if "step" in t or "module" in t: continue
+    lanes[(e["pid"], e.get("tid"))].append(e)
+
+def cat(e):
+    n = e.get("name", "")
+    ln = e.get("args", {}).get("long_name", "") or n
+    if "custom-call" in ln or n.startswith(("checkpoint", "closed_call")):
+        return "flash_kernels"
+    if "128256" in ln:
+        return "ce_head"
+    if "8192" in ln:
+        return "mlp_matmuls"
+    if "32,64" in ln or "16384,32" in ln or "16384,8," in ln or ",8,16384" in ln:
+        return "attn_proj_rope"
+    if n.startswith(("copy", "bitcast")) and "fusion" not in n:
+        return "copies"
+    return "elementwise_other"
+
+by = collections.Counter(); total = 0.0
+for lane in lanes.values():
+    lane.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
+    stack, recs = [], []
+    for e in lane:
+        ts, dur = e["ts"], e.get("dur", 0)
+        while stack and ts >= stack[-1][0]: stack.pop()
+        rec = [e, dur, 0.0]
+        if stack: recs[stack[-1][1]][2] += dur
+        recs.append(rec); stack.append((ts + dur, len(recs) - 1))
+    for e, dur, child in recs:
+        st = max(dur - child, 0.0)
+        by[cat(e)] += st; total += st
+print(json.dumps({"total_ms": round(total/1e3, 1),
+    **{k: round(v/1e3, 1) for k, v in by.most_common()}}))

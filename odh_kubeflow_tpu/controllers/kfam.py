@@ -61,6 +61,12 @@ class KfamService:
                     return True
         return False
 
+    def has_binding(self, user: str, namespace: str) -> bool:
+        """Any kfam-managed binding for ``user`` in ``namespace`` —
+        contributors see read-only namespace panels (quota, activities)
+        the owner does."""
+        return bool(self.list_bindings(namespace=namespace, user=user))
+
     # -- profiles ------------------------------------------------------------
 
     def create_profile(self, profile: Obj) -> Obj:

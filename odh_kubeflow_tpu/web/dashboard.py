@@ -152,6 +152,36 @@ class DashboardApp:
             ]
             return success({"contributors": sorted(set(contributors))})
 
+        @app.route("/api/workgroup/quota/<namespace>")
+        def namespace_quota(request, namespace):
+            """Quota panel feed: the namespace's ResourceQuota
+            hard/used pairs (the profile controller materialises
+            ``kf-resource-quota`` from Profile.spec.resourceQuotaSpec,
+            TPU chips included — reference: the resources panel the
+            dashboard renders from cluster metrics, made first-class
+            for quotas here)."""
+            user = user_of(request)
+            if not (
+                self.kfam.is_owner_or_admin(user, namespace)
+                or self.kfam.is_cluster_admin(user)
+                or self.kfam.has_binding(user, namespace)
+            ):
+                return failure(f"{user} has no access to {namespace}", 403)
+            rows = []
+            for rq in self.api.list("ResourceQuota", namespace=namespace):
+                hard = obj_util.get_path(rq, "spec", "hard", default={}) or {}
+                used = (
+                    obj_util.get_path(rq, "status", "used", default={}) or {}
+                )
+                for resource in sorted(hard):
+                    rows.append({
+                        "quota": obj_util.name_of(rq),
+                        "resource": resource,
+                        "hard": str(hard[resource]),
+                        "used": str(used.get(resource, "0")),
+                    })
+            return success({"quota": rows})
+
         @app.route("/api/workgroup/get-all-namespaces")
         def all_namespaces(request):
             user = user_of(request)

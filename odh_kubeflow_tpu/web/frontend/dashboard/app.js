@@ -23,6 +23,19 @@ import {
 
 const root = document.getElementById("app");
 
+/* Kubernetes quantity → number in base units. Without suffix handling
+ * the quota meter misreads "500m" used vs "2" hard as 250x (and
+ * "512Mi" vs "16Gi" as full) — the suffix IS the value. */
+function parseQuantity(q) {
+  const m = /^([0-9.]+)(m|k|M|G|T|Ki|Mi|Gi|Ti)?$/.exec(String(q || "").trim());
+  if (!m) return 0;
+  const mult = {
+    m: 1e-3, k: 1e3, M: 1e6, G: 1e9, T: 1e12,
+    Ki: 1024, Mi: 1024 ** 2, Gi: 1024 ** 3, Ti: 1024 ** 4,
+  }[m[2]] || 1;
+  return parseFloat(m[1]) * mult;
+}
+
 const APPS = {
   notebooks: { title: "Notebooks", prefix: "/jupyter/" },
   volumes: { title: "Volumes", prefix: "/volumes/" },
@@ -160,6 +173,57 @@ async function homeView() {
     );
   } catch (e) {
     view.append(h("div", { class: "kf-card kf-muted" }, `Metrics unavailable: ${e.message}`));
+  }
+  if (state.namespace) {
+    /* Namespace quota panel (reference: the dashboard's resources
+     * panel, made quota-first): kf-resource-quota hard/used rows from
+     * the profile controller, TPU chips included. */
+    try {
+      const q = await api(`api/workgroup/quota/${state.namespace}`);
+      const rows = q.quota || [];
+      view.append(
+        h(
+          "div",
+          { class: "kf-card" },
+          h("h2", {}, `Quota — ${state.namespace}`),
+          rows.length
+            ? resourceTable({
+                columns: [
+                  { title: "Resource", field: "resource" },
+                  { title: "Used", field: "used" },
+                  { title: "Limit", field: "hard" },
+                  {
+                    title: "",
+                    render: (r) => {
+                      const used = parseQuantity(r.used);
+                      const hard = parseQuantity(r.hard);
+                      return h(
+                        "div",
+                        { class: "kf-meter", style: "width:140px" },
+                        h("div", {
+                          style: `width:${
+                            hard ? Math.min(100, Math.round((100 * used) / hard)) : 0
+                          }%`,
+                        })
+                      );
+                    },
+                  },
+                ],
+                rows,
+                empty: "No ResourceQuota in this namespace.",
+              })
+            : h(
+                "div",
+                { class: "kf-muted" },
+                "No ResourceQuota in this namespace."
+              )
+        )
+      );
+    } catch (e) {
+      view.append(
+        h("div", { class: "kf-card kf-muted" }, `Quota unavailable: ${e.message}`)
+      );
+    }
   }
   return view;
 }

@@ -199,6 +199,7 @@ function closeDrawer() {
 async function showDetails(row) {
   closeDrawer();
   const eventsBody = h("div", { class: "kf-drawer-events" }, "Loading…");
+  const detailBody = h("div", { class: "kf-drawer-conditions" }, "Loading…");
   const backdrop = h(
     "div",
     {
@@ -237,11 +238,84 @@ async function showDetails(row) {
         h("div", {}, h("b", {}, "CPU: "), row.cpu, " · ", h("b", {}, "Memory: "), row.memory),
         h("div", {}, h("b", {}, "Age: "), age(row.age))
       ),
+      h("h3", {}, "Spec & conditions"),
+      detailBody,
       h("h3", {}, "Events"),
       eventsBody
     )
   );
   document.body.append(backdrop);
+
+  /* detail-page feed (GET .../details): mirrored CR conditions, the
+   * volume mounts, and the live pod family — the reference notebook
+   * page's overview tab content beyond the list row */
+  api(`api/namespaces/${ns}/notebooks/${row.name}/details`)
+    .then((d) => {
+      const det = d.details || {};
+      clear(detailBody).append(
+        (det.conditions || []).length
+          ? resourceTable({
+              stateKey: `nb-conditions:${row.name}`,
+              pageSize: 6,
+              columns: [
+                { title: "Type", field: "type" },
+                {
+                  title: "Status",
+                  render: (c) =>
+                    h(
+                      "span",
+                      { class: c.status === "False" ? "kf-danger" : "" },
+                      c.status
+                    ),
+                },
+                { title: "Reason", field: "reason" },
+                {
+                  title: "Last transition",
+                  sortValue: (c) => c.lastTransitionTime || "",
+                  render: (c) => age(c.lastTransitionTime),
+                },
+              ],
+              rows: det.conditions,
+              empty: "No conditions",
+            })
+          : h("div", { class: "kf-muted" }, "No conditions reported yet"),
+        h("h4", {}, "Volumes"),
+        (det.volumes || []).length
+          ? resourceTable({
+              columns: [
+                { title: "Volume", field: "name" },
+                {
+                  title: "PVC",
+                  render: (v) => (v.pvc ? h("code", {}, v.pvc) : "—"),
+                },
+                {
+                  title: "Mount path",
+                  render: (v) => h("code", {}, v.mountPath || "—"),
+                },
+              ],
+              rows: det.volumes,
+              empty: "No volumes",
+            })
+          : h("div", { class: "kf-muted" }, "No volumes"),
+        h("h4", {}, "Pods"),
+        (det.pods || []).length
+          ? resourceTable({
+              columns: [
+                { title: "Pod", field: "name" },
+                { title: "Phase", field: "phase" },
+                { title: "Node", field: "node" },
+              ],
+              rows: det.pods,
+              empty: "No pods",
+            })
+          : h("div", { class: "kf-muted" }, "No pods scheduled yet")
+      );
+    })
+    .catch((e) => {
+      clear(detailBody).append(
+        h("div", { class: "kf-muted" }, `Details unavailable: ${e.message}`)
+      );
+    });
 
   const refresh = async () => {
     const data = await api(

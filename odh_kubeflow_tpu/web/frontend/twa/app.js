@@ -137,18 +137,71 @@ async function deleteTb(row) {
 }
 
 function showDetails(row) {
+  /* log-directory browser (GET tensorboards/<name>/logs): local
+   * logdirs list the run files TensorBoard indexes (the XLA-trace
+   * layout included); remote schemes show their parsed bucket/prefix */
+  const logsBody = h("div", { class: "kf-drawer-logs" }, "Loading…");
   eventsDrawer({
     title: row.name,
     overview: [
       statusIcon(row.status),
       h("div", {}, h("b", {}, "Logs path: "), h("code", {}, row.logspath)),
       h("div", {}, h("b", {}, "Age: "), age(row.age)),
+      h("h4", {}, "Log directory"),
+      logsBody,
     ],
     fetchEvents: async () =>
       (
         await api(`api/namespaces/${ns}/tensorboards/${row.name}/events`)
       ).events || [],
   });
+  api(`api/namespaces/${ns}/tensorboards/${row.name}/logs`)
+    .then((d) => {
+      const files = d.files || [];
+      clear(logsBody).append(
+        d.listable && files.length
+          ? resourceTable({
+              stateKey: `tb-logs:${row.name}`,
+              pageSize: 8,
+              columns: [
+                {
+                  title: "File",
+                  render: (f) => h("code", {}, f.path),
+                },
+                {
+                  title: "Size",
+                  sortValue: (f) => f.size,
+                  render: (f) =>
+                    f.size > 1048576
+                      ? `${(f.size / 1048576).toFixed(1)} MiB`
+                      : `${(f.size / 1024).toFixed(1)} KiB`,
+                },
+                {
+                  title: "Modified",
+                  sortValue: (f) => f.modified,
+                  render: (f) =>
+                    age(new Date(f.modified * 1000).toISOString()),
+                },
+              ],
+              rows: files,
+              empty: "Empty log directory",
+            })
+          : h(
+              "div",
+              { class: "kf-muted" },
+              d.scheme === "local"
+                ? "Log directory not found or empty"
+                : `${d.scheme}:// path — browse ${
+                    d.bucket || d.claim || ""
+                  }/${d.prefix || ""} in its own console`
+            )
+      );
+    })
+    .catch((e) => {
+      clear(logsBody).append(
+        h("div", { class: "kf-muted" }, `Unavailable: ${e.message}`)
+      );
+    });
 }
 
 function showForm() {

@@ -135,6 +135,10 @@ async function deletePvc(row) {
 }
 
 function showDetails(row) {
+  /* detail page (GET pvcs/<name>): the mounting pods as live objects
+   * — phase + mount path per pod, the reference volume page's pods
+   * tab — populated async into the drawer */
+  const podsBody = h("div", { class: "kf-drawer-pods" }, "Loading…");
   eventsDrawer({
     title: row.name,
     overview: [
@@ -142,17 +146,39 @@ function showDetails(row) {
       h("div", {}, h("b", {}, "Size: "), row.capacity),
       h("div", {}, h("b", {}, "Access modes: "), (row.modes || []).join(", ")),
       h("div", {}, h("b", {}, "Storage class: "), row.class || "default"),
-      h(
-        "div",
-        {},
-        h("b", {}, "Used by: "),
-        (row.usedBy || []).length ? row.usedBy.join(", ") : "nothing"
-      ),
       h("div", {}, h("b", {}, "Age: "), age(row.age)),
+      h("h4", {}, "Used by"),
+      podsBody,
     ],
     fetchEvents: async () =>
       (await api(`api/namespaces/${ns}/pvcs/${row.name}/events`)).events || [],
   });
+  api(`api/namespaces/${ns}/pvcs/${row.name}`)
+    .then((d) => {
+      const pods = (d.details || {}).pods || [];
+      clear(podsBody).append(
+        pods.length
+          ? resourceTable({
+              columns: [
+                { title: "Pod", field: "name" },
+                { title: "Phase", field: "phase" },
+                {
+                  title: "Mount path",
+                  render: (p) =>
+                    (p.mountPaths || []).map((m) => h("code", {}, m)),
+                },
+              ],
+              rows: pods,
+              empty: "Not mounted",
+            })
+          : h("div", { class: "kf-muted" }, "Not mounted by any pod")
+      );
+    })
+    .catch((e) => {
+      clear(podsBody).append(
+        h("div", { class: "kf-muted" }, `Unavailable: ${e.message}`)
+      );
+    });
 }
 
 function showForm() {

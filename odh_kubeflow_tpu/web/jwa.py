@@ -227,6 +227,75 @@ class JupyterWebApp(CrudBackend):
             return success({"notebook": nb})
 
         @app.route(
+            "/api/namespaces/<namespace>/notebooks/<name>/details",
+            methods=["GET"],
+        )
+        def notebook_details(request, namespace, name):
+            """The detail-page feed (reference: the notebook detail
+            page's OVERVIEW tab — jupyter/frontend .../notebook-page):
+            parsed spec + mirrored CONDITIONS + the live pod family,
+            one request."""
+            self.authorize(request, "get", "notebooks", namespace, "kubeflow.org")
+            nb = self.api.get("Notebook", name, namespace)
+            container = obj_util.get_path(
+                nb, "spec", "template", "spec", "containers", 0, default={}
+            ) or {}
+            pods = [
+                {
+                    "name": obj_util.name_of(p),
+                    "phase": obj_util.get_path(
+                        p, "status", "phase", default=""
+                    ),
+                    "node": obj_util.get_path(
+                        p, "spec", "nodeName", default=""
+                    ),
+                }
+                for p in self.api.list("Pod", namespace=namespace)
+                if _event_belongs_to_notebook(
+                    {"kind": "Pod", "name": obj_util.name_of(p)}, name
+                )
+            ]
+            return success({
+                "details": {
+                    **self.notebook_row(nb),
+                    "conditions": obj_util.get_path(
+                        nb, "status", "conditions", default=[]
+                    )
+                    or [],
+                    "containerState": obj_util.get_path(
+                        nb, "status", "containerState", default={}
+                    )
+                    or {},
+                    "volumes": [
+                        {
+                            "name": v.get("name", ""),
+                            "mountPath": next(
+                                (
+                                    m.get("mountPath", "")
+                                    for m in container.get(
+                                        "volumeMounts", []
+                                    )
+                                    if m.get("name") == v.get("name")
+                                ),
+                                "",
+                            ),
+                            "pvc": obj_util.get_path(
+                                v, "persistentVolumeClaim", "claimName",
+                                default="",
+                            ),
+                        }
+                        for v in obj_util.get_path(
+                            nb, "spec", "template", "spec", "volumes",
+                            default=[],
+                        )
+                        or []
+                    ],
+                    "pods": pods,
+                    "annotations": obj_util.annotations_of(nb),
+                }
+            })
+
+        @app.route(
             "/api/namespaces/<namespace>/notebooks/<name>/events",
             methods=["GET"],
         )

@@ -74,6 +74,67 @@ class TensorboardsWebApp(CrudBackend):
             self.api.delete("Tensorboard", name, namespace)
             return success()
 
+        @app.route("/api/namespaces/<namespace>/tensorboards/<name>/logs")
+        def tb_logs(request, namespace, name):
+            """Log-directory browser for the detail page: the parsed
+            logspath plus, when the path resolves to a LOCAL directory
+            (standalone/dev platforms and the profiling tier's
+            XLA-trace layouts — ``utils/profiling.py``), the run/file
+            listing TensorBoard would index. Remote schemes (gs://,
+            s3://) report listable=False with their parsed bucket and
+            prefix — browsing those is the bucket console's job, not a
+            BFF proxy's."""
+            self.authorize(
+                request, "get", "tensorboards", namespace,
+                "tensorboard.kubeflow.org",
+            )
+            tb = self.api.get("Tensorboard", name, namespace)
+            logspath = obj_util.get_path(tb, "spec", "logspath", default="")
+            parsed = _parse_logspath(logspath)
+            rows = []
+            if parsed["scheme"] == "local":
+                import os
+
+                # CONTAINMENT: spec.logspath is user-controlled — only
+                # list under the operator-declared root (standalone/dev
+                # deployments set TWA_LOCAL_LOGS_ROOT; unset = local
+                # listing disabled), resolved against symlink escapes.
+                # Without this, a namespace user could browse arbitrary
+                # server filesystem metadata via logspath="/etc".
+                root = os.environ.get("TWA_LOCAL_LOGS_ROOT", "")
+                base = os.path.realpath(parsed["path"])
+                contained = bool(root) and (
+                    base == os.path.realpath(root)
+                    or base.startswith(
+                        os.path.realpath(root).rstrip("/") + "/"
+                    )
+                )
+                if contained and os.path.isdir(base):
+                    parsed["listable"] = True
+                    cap = 500  # browse, don't mirror
+                    for dirpath, _dirs, files in os.walk(base):
+                        rel = os.path.relpath(dirpath, base)
+                        for f in sorted(files):
+                            if len(rows) >= cap:
+                                break
+                            full = os.path.join(dirpath, f)
+                            try:
+                                st = os.stat(full)
+                            except OSError:
+                                continue
+                            rows.append({
+                                "path": (
+                                    f if rel == "." else f"{rel}/{f}"
+                                ),
+                                "size": st.st_size,
+                                "modified": int(st.st_mtime),
+                            })
+                        if len(rows) >= cap:
+                            break
+            return success({
+                "logspath": logspath, **parsed, "files": rows
+            })
+
         @app.route("/api/namespaces/<namespace>/tensorboards/<name>/events")
         def tb_events(request, namespace, name):
             """Details-drawer feed: events on the Tensorboard CR and
@@ -118,6 +179,20 @@ class TensorboardsWebApp(CrudBackend):
         if error:
             return {"phase": "warning", "message": error}
         return {"phase": "waiting", "message": "Starting"}
+
+
+def _parse_logspath(logspath: str) -> Obj:
+    """Scheme split matching the controller's path parsing
+    (controllers/tensorboard.py): pvc://claim/sub, gs://bucket/prefix,
+    s3://bucket/prefix, anything else = a local filesystem path."""
+    m = re.fullmatch(r"(pvc|gs|s3)://([^/]+)/?(.*)", logspath)
+    if not m:
+        return {"scheme": "local", "path": logspath, "listable": False}
+    scheme, root, sub = m.groups()
+    key = "claim" if scheme == "pvc" else "bucket"
+    return {
+        "scheme": scheme, key: root, "prefix": sub, "listable": False
+    }
 
 
 def _event_belongs_to_tb(involved: Obj, name: str) -> bool:

@@ -54,6 +54,25 @@ class VolumesWebApp(CrudBackend):
             self.api.delete("PersistentVolumeClaim", name, namespace)
             return success()
 
+        @app.route("/api/namespaces/<namespace>/pvcs/<name>", methods=["GET"])
+        def get_pvc(request, namespace, name):
+            """Detail-page feed (reference: volumes/frontend's
+            per-volume page with its pods tab): the list row plus the
+            full spec and the MOUNTING PODS with phase + mount path —
+            'used by' as live objects, not just names."""
+            self.authorize(request, "get", "persistentvolumeclaims", namespace)
+            pvc = self.api.get("PersistentVolumeClaim", name, namespace)
+            pods = self._mounting_pods(namespace, name)
+            return success({
+                "details": {
+                    **self.pvc_row(
+                        pvc, mounted_by=[p["name"] for p in pods]
+                    ),
+                    "spec": pvc.get("spec", {}),
+                    "pods": pods,
+                }
+            })
+
         @app.route("/api/namespaces/<namespace>/pvcs/<name>/events")
         def pvc_events(request, namespace, name):
             """Details-drawer feed: events on the PVC itself plus on
@@ -75,22 +94,45 @@ class VolumesWebApp(CrudBackend):
                 )
             })
 
-    def _mounted_by(self, namespace: str, name: str) -> list:
-        return [
-            obj_util.name_of(pod)
-            for pod in self.api.list("Pod", namespace=namespace)
-            if any(
-                obj_util.get_path(v, "persistentVolumeClaim", "claimName")
+    def _mounting_pods(self, namespace: str, name: str) -> list:
+        """The pods mounting ``name``, as rich rows (name, phase, mount
+        paths) — the ONE pod scan every used-by surface derives from."""
+        out = []
+        for pod in self.api.list("Pod", namespace=namespace):
+            vols = obj_util.get_path(pod, "spec", "volumes", default=[]) or []
+            vol_names = {
+                v.get("name")
+                for v in vols
+                if obj_util.get_path(v, "persistentVolumeClaim", "claimName")
                 == name
-                for v in obj_util.get_path(pod, "spec", "volumes", default=[])
-                or []
-            )
-        ]
+            }
+            if not vol_names:
+                continue
+            out.append({
+                "name": obj_util.name_of(pod),
+                "phase": obj_util.get_path(
+                    pod, "status", "phase", default=""
+                ),
+                "mountPaths": [
+                    m.get("mountPath", "")
+                    for c in obj_util.get_path(
+                        pod, "spec", "containers", default=[]
+                    )
+                    or []
+                    for m in c.get("volumeMounts", []) or []
+                    if m.get("name") in vol_names
+                ],
+            })
+        return out
 
-    def pvc_row(self, pvc: Obj) -> Obj:
+    def _mounted_by(self, namespace: str, name: str) -> list:
+        return [p["name"] for p in self._mounting_pods(namespace, name)]
+
+    def pvc_row(self, pvc: Obj, mounted_by: Optional[list] = None) -> Obj:
         name = obj_util.name_of(pvc)
         ns = obj_util.namespace_of(pvc)
-        mounted_by = self._mounted_by(ns, name)
+        if mounted_by is None:
+            mounted_by = self._mounted_by(ns, name)
         return {
             "name": name,
             "namespace": ns,
