@@ -316,3 +316,53 @@ def test_w8a8_decode_matches_dequant_path():
     n = min(len(t1), len(t2))
     agree = (t1[:n] == t2[:n]).mean()
     assert agree >= 0.8, (t1.tolist(), t2.tolist())
+
+
+def test_int4_matmul_matches_dequant_path():
+    """The fused-consumer int4 matmul (ops/pallas_int4.int4_matmul):
+    weights stay packed, unpack + group scales ride the accumulator in
+    VMEM. Forward and dlhs must match the dequantize-then-matmul path
+    (same bf16 weight rounding); weights are frozen (no bank grads)."""
+    import jax
+    import jax.numpy as jnp
+
+    from odh_kubeflow_tpu.models.quant import (
+        quantize_tensor4,
+        dequantize_tensor4,
+    )
+    from odh_kubeflow_tpu.ops.pallas_int4 import int4_matmul
+
+    key = jax.random.key(0)
+    M, K, N = 1024, 2048, 1024
+    w = jax.random.normal(key, (K, N), jnp.float32) * 0.3
+    t = quantize_tensor4(w)
+    x = jax.random.normal(jax.random.fold_in(key, 1), (M, K)) * 0.5
+    wd = dequantize_tensor4(t, jnp.float32)
+
+    ref = x @ wd
+    got = int4_matmul(x, t["q4"], t["scale4"])
+    assert float(jnp.abs(ref - got).max() / jnp.abs(ref).max()) < 1e-5
+
+    gr = jax.grad(lambda x: jnp.sum((x @ wd) ** 2))(x)
+    gg = jax.grad(
+        lambda x: jnp.sum(int4_matmul(x, t["q4"], t["scale4"]) ** 2)
+    )(x)
+    assert float(jnp.abs(gr - gg).max() / jnp.abs(gr).max()) < 1e-5
+
+
+def test_int4_matmul_rejects_unsupported_blocking():
+    """Shapes the kernel's blocking doesn't divide raise (callers fall
+    back to the dequantize path) instead of computing garbage."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from odh_kubeflow_tpu.models.quant import quantize_tensor4
+    from odh_kubeflow_tpu.ops.pallas_int4 import int4_matmul
+
+    t = quantize_tensor4(
+        jax.random.normal(jax.random.key(0), (512, 640), jnp.float32)
+    )
+    x = jnp.ones((256, 512), jnp.float32)
+    with pytest.raises(NotImplementedError):
+        int4_matmul(x, t["q4"], t["scale4"])
