@@ -287,6 +287,25 @@ class Trainer:
 
         # ---- device work
         with jax.set_mesh(self.mesh):
+            def init_rest(kl, params):
+                """Adapters + optimizer state given the frozen/base
+                params — shared by both init flavors (traced into the
+                fused program below, or jitted standalone after the
+                streaming quantized init)."""
+                lora = (
+                    lora_init_partial(kl)
+                    if lora_cfg is not None
+                    else None
+                )
+                trainable = lora if lora_cfg is not None else params
+                return lora, self.optimizer.init(trainable)
+
+            rest_shardings = (
+                self._sh(self._train_specs)
+                if lora_cfg is not None
+                else None,
+                self._sh(self._opt_specs),
+            )
             if quantize_base:
                 # leaf-streamed int8 init: never holds the bf16 tree
                 # (8B bf16 alone would OOM the 16GiB v5e this targets)
@@ -294,25 +313,26 @@ class Trainer:
                     model_cfg, k_params, mesh=self.mesh, specs=p_specs,
                     bits=self.quant_bits,
                 )
+                self.lora_params, self.opt_state = jax.jit(
+                    init_rest, out_shardings=rest_shardings
+                )(k_lora, self.params)
             else:
+                # ONE jitted program for params + adapters + optimizer
+                # state: separate jits pay separate traces and
+                # (persistent-)cache lookups — host-side time the warm
+                # spawn path cannot hide (the compiles themselves are
+                # cached; the tracing is GIL-bound Python)
+                def init_all(kp, kl):
+                    params = init_partial(kp)
+                    return (params, *init_rest(kl, params))
+
                 init_fn = jax.jit(
-                    init_partial,
-                    out_shardings=self._sh(p_specs),
+                    init_all,
+                    out_shardings=(self._sh(p_specs), *rest_shardings),
                 )
-                self.params = init_fn(k_params)
-            if lora_cfg is not None:
-                lora_init = jax.jit(
-                    lora_init_partial,
-                    out_shardings=self._sh(self._train_specs),
+                self.params, self.lora_params, self.opt_state = init_fn(
+                    k_params, k_lora
                 )
-                self.lora_params = lora_init(k_lora)
-            else:
-                self.lora_params = None
-            trainable = self.lora_params if lora_cfg is not None else self.params
-            opt_init = jax.jit(
-                self.optimizer.init, out_shardings=self._sh(self._opt_specs)
-            )
-            self.opt_state = opt_init(trainable)
 
     # -- sharding helpers ---------------------------------------------------
 
