@@ -35,6 +35,7 @@ from odh_kubeflow_tpu.apis import (
 )
 from odh_kubeflow_tpu.controllers.runtime import Result
 from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.events import EventRecorder
 from odh_kubeflow_tpu.machinery.store import APIServer, Conflict, NotFound
 
 Obj = dict[str, Any]
@@ -89,6 +90,7 @@ class Culler:
         self.now = now_fn
         self.m_cull = cull_counter
         self.m_last_cull = None  # gauge, wired by the notebook controller
+        self.recorder = EventRecorder(api, "notebook-controller")
 
     def _default_base_url(self, notebook: Obj) -> str:
         name = obj_util.name_of(notebook)
@@ -206,11 +208,12 @@ class Culler:
                 self.m_cull.inc()
             if self.m_last_cull is not None:
                 self.m_last_cull.set(now)
-            self.api.emit_event(
+            # a re-cull of the same notebook (restarted, idled again)
+            # bumps the Event count instead of stacking duplicates
+            self.recorder.normal(
                 notebook,
-                "Culling",
+                "Culled",
                 "Notebook idle beyond threshold; scaling to zero",
-                component="notebook-controller",
             )
         self._patch_annotations(notebook)
         return Result(requeue_after=period)

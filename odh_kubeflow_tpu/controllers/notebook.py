@@ -38,6 +38,7 @@ from odh_kubeflow_tpu.apis import (
 from odh_kubeflow_tpu.controllers import reconcilehelper
 from odh_kubeflow_tpu.controllers.runtime import Manager, Request, Result
 from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.events import EventRecorder
 from odh_kubeflow_tpu.machinery.store import APIServer, Conflict, NotFound
 from odh_kubeflow_tpu.utils import prometheus
 from odh_kubeflow_tpu.utils.tpu import TPU_TOPOLOGIES, chips_in_topology, hosts_in_slice
@@ -137,6 +138,7 @@ class NotebookController:
         self.api = api
         self.config = config or NotebookControllerConfig()
         self.culler = culler
+        self.recorder = EventRecorder(api, "notebook-controller")
         reg = registry or prometheus.default_registry
         self.m_create = reg.counter(
             "notebook_create_total", "Total times of creating notebooks"
@@ -278,18 +280,27 @@ class NotebookController:
             return Result()
 
         sts = self.generate_statefulset(notebook, tpu)
-        existed = True
         try:
-            self.api.get("StatefulSet", req.name, req.namespace)
-        except NotFound:
-            existed = False
-        try:
-            reconcilehelper.reconcile_object(self.api, sts, owner=notebook)
-            if not existed:
+            _, created = reconcilehelper.reconcile_object(
+                self.api, sts, owner=notebook
+            )
+            if created:
                 self.m_create.inc()
-        except Exception:
-            if not existed:
+                self.recorder.normal(
+                    notebook, "Created", f"Created StatefulSet {req.name}"
+                )
+        except Exception as e:
+            # the failure path probes existence (it is rare; the steady
+            # state pays no extra GET): only a failed CREATE counts
+            try:
+                self.api.get("StatefulSet", req.name, req.namespace)
+            except NotFound:
                 self.m_create_failed.inc()
+                self.recorder.warning(
+                    notebook,
+                    "FailedCreate",
+                    f"Failed to create StatefulSet {req.name}: {e}",
+                )
             raise
 
         svc = self.generate_service(notebook, tpu)
@@ -599,6 +610,9 @@ class NotebookController:
         container, error-event surfacing."""
         name = obj_util.name_of(notebook)
         ns = obj_util.namespace_of(notebook)
+        prev_ready = obj_util.get_path(
+            notebook, "status", "readyReplicas", default=0
+        )
         status: Obj = {
             "readyReplicas": 0,
             "conditions": [],
@@ -633,6 +647,16 @@ class NotebookController:
                     status["containerState"] = cs.get("state") or {}
         except NotFound:
             pass
+        # ready-transition Event (0 → ready): level-triggered, so the
+        # guard is the stored status — re-reconciles of a ready
+        # notebook see prev_ready > 0 and stay quiet
+        if status["readyReplicas"] and not prev_ready:
+            self.recorder.normal(
+                notebook,
+                "Started",
+                f"Notebook server started ({status['readyReplicas']} "
+                "ready host(s))",
+            )
         notebook["status"] = status
         updated = self.api.update_status(notebook)
         # keep the in-hand dict fresh for follow-up status writes in the
@@ -685,7 +709,11 @@ def main() -> None:
                     cluster_domain=cfg.cluster_domain,
                 ),
             )
-        NotebookController(api, cfg, culler=culler).register(mgr)
+        # the controller's own counters must live on the registry the
+        # runner serves at /metrics, not the process default
+        NotebookController(
+            api, cfg, registry=mgr.metrics_registry, culler=culler
+        ).register(mgr)
 
     run_controller("notebook-controller", register)
 

@@ -78,10 +78,12 @@ def reconcile_object(
     desired: Obj,
     owner: Optional[Obj] = None,
     copier: Optional[Callable[[Obj, Obj], bool]] = None,
-) -> Obj:
+) -> tuple[Obj, bool]:
     """Create ``desired`` (with controller ownerReference) or update the
     existing object using the kind-appropriate field copier. Retries
-    once on Conflict (reference: notebook_route.go:119-131 pattern)."""
+    once on Conflict (reference: notebook_route.go:119-131 pattern).
+    Returns ``(object, created)`` — the flag lets callers count/emit on
+    first materialisation without a pre-flight existence GET."""
     if owner is not None:
         obj_util.set_controller_reference(desired, owner)
     kind = desired.get("kind", "")
@@ -91,13 +93,13 @@ def reconcile_object(
         try:
             current = api.get(kind, meta.get("name", ""), meta.get("namespace"))
         except NotFound:
-            return api.create(desired)
+            return api.create(desired), True
         if copier(desired, current):
             try:
-                return api.update(current)
+                return api.update(current), False
             except Conflict:
                 if attempt:
                     raise
                 continue
-        return current
-    return current
+        return current, False
+    return current, False

@@ -26,10 +26,60 @@ from typing import Any, Callable, Optional
 
 from odh_kubeflow_tpu.machinery import objects as obj_util
 from odh_kubeflow_tpu.machinery.store import APIServer, Watch
+from odh_kubeflow_tpu.utils import prometheus, tracing
 
 log = logging.getLogger("controller-runtime")
 
 Obj = dict[str, Any]
+
+# workqueue latencies span µs (drain tests) to many seconds (backoff)
+_QUEUE_BUCKETS = (
+    0.0001, 0.001, 0.01, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
+)
+_RECONCILE_BUCKETS = (
+    0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0,
+)
+
+
+class RuntimeMetrics:
+    """The controller-runtime metric surface (its exact metric names,
+    so reference dashboards/alerts port over), labelled per controller.
+    One instance per Manager; registering twice against one shared
+    registry converges on the same series (Registry is get-or-create)."""
+
+    def __init__(self, registry: prometheus.Registry):
+        self.depth = registry.gauge(
+            "workqueue_depth",
+            "Current depth of the workqueue",
+            labelnames=("name",),
+        )
+        self.adds = registry.counter(
+            "workqueue_adds_total",
+            "Total number of adds handled by the workqueue",
+            labelnames=("name",),
+        )
+        self.queue_duration = registry.histogram(
+            "workqueue_queue_duration_seconds",
+            "How long a request stays in the workqueue before processing",
+            buckets=_QUEUE_BUCKETS,
+            labelnames=("name",),
+        )
+        self.reconcile_time = registry.histogram(
+            "controller_runtime_reconcile_time_seconds",
+            "Length of time per reconciliation",
+            buckets=_RECONCILE_BUCKETS,
+            labelnames=("controller",),
+        )
+        self.reconcile_errors = registry.counter(
+            "controller_runtime_reconcile_errors_total",
+            "Total number of reconciliations that returned an error",
+            labelnames=("controller",),
+        )
+        self.reconcile_total = registry.counter(
+            "controller_runtime_reconcile_total",
+            "Total number of reconciliations per controller and result",
+            labelnames=("controller", "result"),
+        )
 
 
 @dataclass(frozen=True)
@@ -51,20 +101,25 @@ class _WatchSpec:
 
 
 class _RateLimiter:
-    """Per-key exponential backoff: 5ms * 2^failures, capped at 16s."""
+    """Per-key exponential backoff: 5ms * 2^failures, capped at 16s.
+    ``when``/``forget`` run from every worker thread (``_process``), so
+    the failure map is guarded by its own lock."""
 
     def __init__(self, base: float = 0.005, cap: float = 16.0):
         self.base = base
         self.cap = cap
         self.failures: dict[Request, int] = {}
+        self._lock = threading.Lock()
 
     def when(self, req: Request) -> float:
-        n = self.failures.get(req, 0)
-        self.failures[req] = n + 1
+        with self._lock:
+            n = self.failures.get(req, 0)
+            self.failures[req] = n + 1
         return min(self.base * (2**n), self.cap)
 
     def forget(self, req: Request) -> None:
-        self.failures.pop(req, None)
+        with self._lock:
+            self.failures.pop(req, None)
 
 
 class Controller:
@@ -76,12 +131,25 @@ class Controller:
         for_kind: str,
         time_fn: Callable[[], float] = time.monotonic,
         workers: int = 1,
+        metrics: Optional[RuntimeMetrics] = None,
     ):
         self.name = name
         self.api = api
         self.reconcile = reconcile
         self.for_kind = for_kind
         self.time_fn = time_fn
+        # a standalone Controller gets a private sink registry; the
+        # Manager path shares its RuntimeMetrics across controllers
+        self.metrics = metrics or RuntimeMetrics(prometheus.Registry())
+        self._m_depth = self.metrics.depth.labels(name=name)
+        self._m_adds = self.metrics.adds.labels(name=name)
+        self._m_queue_duration = self.metrics.queue_duration.labels(name=name)
+        self._m_reconcile_time = self.metrics.reconcile_time.labels(
+            controller=name
+        )
+        self._m_reconcile_errors = self.metrics.reconcile_errors.labels(
+            controller=name
+        )
         # MaxConcurrentReconciles: workers share the queue but a key is
         # never reconciled by two workers at once (controller-runtime
         # semantics). >1 keeps one slow reconcile — e.g. a culler probe
@@ -94,6 +162,11 @@ class Controller:
         self._queue: list[Request] = []
         self._queued: set[Request] = set()
         self._delayed: list[tuple[float, Request]] = []
+        # per-request enqueue instant (workqueue_queue_duration) and
+        # the trace id carried from the triggering watch object; both
+        # live under _cv with the queue itself
+        self._enqueued_at: dict[Request, float] = {}
+        self._req_trace: dict[Request, str] = {}
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._limiter = _RateLimiter()
@@ -131,13 +204,23 @@ class Controller:
 
     # -- queue --------------------------------------------------------------
 
-    def enqueue(self, req: Request, after: Optional[float] = None) -> None:
+    def enqueue(
+        self,
+        req: Request,
+        after: Optional[float] = None,
+        trace_id: Optional[str] = None,
+    ) -> None:
         with self._cv:
+            if trace_id:
+                self._req_trace[req] = trace_id
             if after:
                 self._delayed.append((self.time_fn() + after, req))
             elif req not in self._queued:
                 self._queue.append(req)
                 self._queued.add(req)
+                self._enqueued_at.setdefault(req, self.time_fn())
+                self._m_adds.inc()
+                self._m_depth.set(len(self._queue))
             self._cv.notify_all()
 
     def _pop(self, timeout: Optional[float]) -> Optional[Request]:
@@ -151,6 +234,11 @@ class Controller:
                     if d[1] not in self._queued:
                         self._queue.append(d[1])
                         self._queued.add(d[1])
+                        # queue duration measures READY time: a delayed
+                        # requeue starts its clock when it becomes due
+                        self._enqueued_at.setdefault(d[1], now)
+                        self._m_adds.inc()
+                        self._m_depth.set(len(self._queue))
                 # hand out the first key not currently being reconciled
                 # by another worker (per-key exclusion)
                 for i, req in enumerate(self._queue):
@@ -158,6 +246,12 @@ class Controller:
                         self._queue.pop(i)
                         self._queued.discard(req)
                         self._inflight.add(req)
+                        self._m_depth.set(len(self._queue))
+                        t0 = self._enqueued_at.pop(req, None)
+                        if t0 is not None:
+                            self._m_queue_duration.observe(
+                                max(self.time_fn() - t0, 0.0)
+                            )
                         return req
                 if self._stop.is_set():
                     return None
@@ -171,13 +265,47 @@ class Controller:
                 self._cv.wait(timeout=min(waits))
 
     def _process(self, req: Request) -> None:
-        try:
-            result = self.reconcile(req) or Result()
-        except Exception:
-            log.exception("%s: reconcile %s failed", self.name, req)
-            self._done(req)
-            self.enqueue(req, after=self._limiter.when(req))
-            return
+        with self._cv:
+            trace_id = self._req_trace.pop(req, None)
+        key = f"{req.namespace}/{req.name}"
+        start = self.time_fn()
+        with tracing.span(
+            "reconcile",
+            trace_id=trace_id,
+            controller=self.name,
+            reconcile_key=key,
+        ):
+            try:
+                result = self.reconcile(req) or Result()
+            except Exception:
+                elapsed = self.time_fn() - start
+                self._m_reconcile_time.observe(elapsed)
+                self._m_reconcile_errors.inc()
+                self.metrics.reconcile_total.inc(
+                    {"controller": self.name, "result": "error"}
+                )
+                log.exception("%s: reconcile %s failed", self.name, req)
+                self._done(req)
+                # the retry is the same unit of work: it keeps the trace
+                self.enqueue(req, after=self._limiter.when(req), trace_id=trace_id)
+                return
+            elapsed = self.time_fn() - start
+            self._m_reconcile_time.observe(elapsed)
+            self.metrics.reconcile_total.inc(
+                {
+                    "controller": self.name,
+                    "result": "requeue_after" if result.requeue_after else "success",
+                }
+            )
+            log.debug(
+                "%s: reconciled %s in %.6fs%s",
+                self.name,
+                key,
+                elapsed,
+                f" (requeue after {result.requeue_after}s)"
+                if result.requeue_after
+                else "",
+            )
         self._done(req)
         self._limiter.forget(req)
         if result.requeue_after:
@@ -199,26 +327,19 @@ class Controller:
         """Drain one event from watch ``spec_idx``; returns False if none."""
         w = self._watches[spec_idx]
         spec = self._watch_specs[spec_idx]
-        item = w.get(timeout=timeout) if timeout else self._try_get(w)
+        item = w.get(timeout=timeout) if timeout else w.try_get()
         if item is None:
             return False
         etype, obj = item
         if spec.predicate and not spec.predicate(etype, obj):
             return True
+        # the store stamps the creating request's trace id onto the
+        # object; carry it so the reconcile logs in the same trace
+        trace_id = tracing.trace_id_of(obj)
         for req in spec.map_fn(etype, obj):
             if req.name:
-                self.enqueue(req)
+                self.enqueue(req, trace_id=trace_id)
         return True
-
-    @staticmethod
-    def _try_get(w: Watch):
-        import queue as _q
-
-        try:
-            item = w._q.get_nowait()
-        except _q.Empty:
-            return None
-        return item
 
     # -- execution ----------------------------------------------------------
 
@@ -227,8 +348,7 @@ class Controller:
 
         def pump(i: int):
             while not self._stop.is_set():
-                if not self._pump_once(i, timeout=0.2):
-                    continue
+                self._pump_once(i, timeout=0.2)
 
         for i in range(len(self._watch_specs)):
             t = threading.Thread(target=pump, args=(i,), daemon=True)
@@ -279,10 +399,20 @@ class Controller:
 
 
 class Manager:
-    def __init__(self, api: APIServer, time_fn: Callable[[], float] = time.monotonic):
+    def __init__(
+        self,
+        api: APIServer,
+        time_fn: Callable[[], float] = time.monotonic,
+        registry: Optional[prometheus.Registry] = None,
+    ):
         self.api = api
         self.time_fn = time_fn
         self.controllers: list[Controller] = []
+        # every controller the manager runs instruments into this one
+        # registry (controller-runtime's metrics.Registry equivalent);
+        # the platform serves it at /metrics
+        self.metrics_registry = registry or prometheus.Registry()
+        self._runtime_metrics = RuntimeMetrics(self.metrics_registry)
 
     def new_controller(
         self,
@@ -302,6 +432,7 @@ class Manager:
             for_kind,
             time_fn=self.time_fn,
             workers=workers,
+            metrics=self._runtime_metrics,
         )
         self.controllers.append(ctrl)
         return ctrl

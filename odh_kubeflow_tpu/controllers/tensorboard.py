@@ -22,6 +22,7 @@ from typing import Any, Optional
 from odh_kubeflow_tpu.controllers import reconcilehelper
 from odh_kubeflow_tpu.controllers.runtime import Manager, Request, Result
 from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.events import EventRecorder
 from odh_kubeflow_tpu.machinery.store import APIServer, NotFound
 
 Obj = dict[str, Any]
@@ -37,6 +38,7 @@ class TensorboardController:
         self.rwo_scheduling = (
             os.environ.get("RWO_PVC_SCHEDULING", "true").lower() == "true"
         )
+        self.recorder = EventRecorder(api, "tensorboard-controller")
 
     def register(self, mgr: Manager) -> None:
         ctrl = mgr.new_controller(
@@ -50,7 +52,24 @@ class TensorboardController:
         except NotFound:
             return Result()
         deployment = self.generate_deployment(tb)
-        reconcilehelper.reconcile_object(self.api, deployment, owner=tb)
+        try:
+            _, created = reconcilehelper.reconcile_object(
+                self.api, deployment, owner=tb
+            )
+            if created:
+                self.recorder.normal(
+                    tb, "Created", f"Created Deployment {req.name}"
+                )
+        except Exception as e:
+            try:
+                self.api.get("Deployment", req.name, req.namespace)
+            except NotFound:
+                self.recorder.warning(
+                    tb,
+                    "FailedCreate",
+                    f"Failed to create Deployment {req.name}: {e}",
+                )
+            raise
         service = self.generate_service(tb)
         reconcilehelper.reconcile_object(self.api, service, owner=tb)
         route = self.generate_route(tb)
@@ -239,6 +258,9 @@ class TensorboardController:
         except NotFound:
             return
         ready = obj_util.get_path(deploy, "status", "readyReplicas", default=0)
+        prev_ready = obj_util.get_path(tb, "status", "readyReplicas", default=0)
+        if ready and not prev_ready:
+            self.recorder.normal(tb, "Started", "Tensorboard server started")
         tb["status"] = {
             "readyReplicas": ready,
             "conditions": [

@@ -26,6 +26,7 @@ from collections import OrderedDict
 from typing import Any, Optional
 
 from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.utils import tracing
 from odh_kubeflow_tpu.machinery.store import (
     AlreadyExists,
     APIError,
@@ -208,6 +209,16 @@ class RemoteAPIServer:
         tok = self._bearer_token()
         if tok:
             headers["Authorization"] = f"Bearer {tok}"
+        # propagate the caller's span so webhook → apiserver →
+        # controller hops share one trace (httpapi parses it back)
+        span = tracing.current()
+        if span is not None:
+            headers["traceparent"] = span.traceparent()
+            if "controller" in span.attrs:
+                # mark reconcile-originated requests (W3C tracestate)
+                # so the remote store skips trace-stamping children,
+                # same as the embedded path
+                headers["tracestate"] = "odh=controller"
         return headers
 
     def _request(

@@ -34,6 +34,8 @@ from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
 from socketserver import ThreadingMixIn
 
 from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.utils import tracing
+from odh_kubeflow_tpu.utils.prometheus import Registry
 from odh_kubeflow_tpu.machinery.store import (
     AlreadyExists,
     APIError,
@@ -141,9 +143,13 @@ class RestAPI:
         self,
         server: APIServer,
         authenticator: Optional[Any] = None,  # environ -> username | None
+        metrics_registry: Optional[Registry] = None,
     ):
         self.server = server
         self.authenticator = authenticator
+        # served at /metrics when given (anonymous, like the health
+        # probes — the controller-runtime metrics-listener posture)
+        self.metrics_registry = metrics_registry
 
     # -- helpers ------------------------------------------------------------
 
@@ -201,6 +207,37 @@ class RestAPI:
     # -- WSGI ---------------------------------------------------------------
 
     def __call__(self, environ, start_response):
+        if (
+            environ.get("PATH_INFO", "/") == "/metrics"
+            and self.metrics_registry is not None
+        ):
+            # anonymous, like the health probes: controller-runtime
+            # serves its metrics listener without authn too
+            payload = self.metrics_registry.exposition().encode()
+            start_response(
+                "200 OK",
+                [
+                    ("Content-Type", "text/plain; version=0.0.4"),
+                    ("Content-Length", str(len(payload))),
+                ],
+            )
+            return [payload]
+        # an inbound traceparent joins this request to the caller's
+        # trace: every store op (and admission hook) below runs inside
+        # the span, so the CREATE path stamps the caller's trace id
+        remote = tracing.parse_traceparent(environ.get("HTTP_TRACEPARENT"))
+        if remote is None:
+            return self._handle(environ, start_response)
+        attrs = {}
+        if "odh=controller" in environ.get("HTTP_TRACESTATE", ""):
+            # reconcile-originated call (client.py's tracestate marker):
+            # the store must treat its creates like embedded reconcile
+            # writes and skip the trace-annotation stamp
+            attrs["controller"] = "remote"
+        with tracing.span("apiserver", parent=remote, **attrs):
+            return self._handle(environ, start_response)
+
+    def _handle(self, environ, start_response):
         path = environ.get("PATH_INFO", "/")
         method = environ.get("REQUEST_METHOD", "GET")
         qs = parse_qs(environ.get("QUERY_STRING", ""))
@@ -385,6 +422,7 @@ def serve(
     port: int = 0,
     ssl_context: Optional[Any] = None,
     authenticator: Optional[Any] = None,
+    metrics_registry: Optional[Registry] = None,
 ) -> tuple[threading.Thread, int, Any]:
     """Serve the REST façade on a daemon thread; returns (thread,
     bound_port, httpd). ``httpd.shutdown()`` stops it.
@@ -392,8 +430,11 @@ def serve(
     ``ssl_context`` (an ``ssl.SSLContext``) serves HTTPS — the posture
     a real kube-apiserver always has; ``authenticator`` (see
     ``TokenAuthenticator``) turns on bearer authn, rejecting anonymous
-    requests with 401 except on health probes."""
-    app = RestAPI(server, authenticator=authenticator)
+    requests with 401 except on health probes; ``metrics_registry``
+    exposes Prometheus text exposition at ``/metrics``."""
+    app = RestAPI(
+        server, authenticator=authenticator, metrics_registry=metrics_registry
+    )
     httpd = make_server(
         host, port, app, server_class=_ThreadingServer, handler_class=_QuietHandler
     )

@@ -48,6 +48,20 @@ def run_controller(name: str, register: Callable) -> None:
     mgr = Manager(api)
     register(api, mgr)
     mgr.start()
+
+    # controller-runtime's --metrics-bind-address: every split-process
+    # controller serves its manager registry on its own port.
+    # METRICS_PORT=0 disables (e.g. sidecar-less debug runs).
+    metrics_port = int(os.environ.get("METRICS_PORT", "8080"))
+    if metrics_port:
+        from odh_kubeflow_tpu.utils import prometheus
+
+        _, bound, _ = prometheus.serve_metrics(
+            mgr.metrics_registry,
+            os.environ.get("METRICS_HOST", "0.0.0.0"),
+            metrics_port,
+        )
+        print(f"{name} metrics on :{bound}/metrics", flush=True)
     print(f"{name} running", flush=True)
     try:
         while True:

@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, Optional
 
 from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.utils import tracing
 
 Obj = dict[str, Any]
 
@@ -165,6 +166,15 @@ class Watch:
             return None
         return item
 
+    def try_get(self) -> Optional[tuple[str, Obj]]:
+        """Non-blocking ``get``: the next pending event, or None when
+        the queue is empty (or the stop sentinel is next)."""
+        try:
+            item = self._q.get_nowait()
+        except queue.Empty:
+            return None
+        return item
+
 
 class APIServer:
     def __init__(self):
@@ -269,6 +279,24 @@ class APIServer:
                 raise AlreadyExists(f"{kind} {namespace or ''}/{name} exists")
             if dry_run:
                 return obj
+            # stamp the creating request's trace id so the async hop to
+            # the controller (watch event → reconcile) stays in one
+            # trace. CREATE only — updates never rewrite it, so
+            # level-triggered no-op detection is untouched. Excluded:
+            # Events (they'd re-trace every dedupe lookup) and
+            # reconcile-span writes (children a controller creates —
+            # reconcilehelper owns their annotations and would strip
+            # the stamp on the next pass, churning a write).
+            span = tracing.current()
+            if (
+                span is not None
+                and kind != "Event"
+                and "controller" not in span.attrs
+            ):
+                ann = meta.get("annotations")
+                if not isinstance(ann, dict):
+                    ann = meta["annotations"] = {}
+                ann.setdefault(tracing.TRACE_ANNOTATION, span.trace_id)
             meta["uid"] = str(uuid.uuid4())
             meta["creationTimestamp"] = obj_util.now_rfc3339()
             meta["generation"] = 1

@@ -41,8 +41,19 @@ import jax.numpy as jnp
 
 from odh_kubeflow_tpu.models.generate import family_forward, init_cache
 from odh_kubeflow_tpu.models.llama import LlamaConfig
+from odh_kubeflow_tpu.utils import prometheus
 
 Params = dict[str, Any]
+
+# TTFT spans fast warm admissions to cold-compile prefills
+_TTFT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+# inter-token gaps are near-zero within a fetched chunk and a chunk
+# step at boundaries (bimodal — the p95 is the SLO number)
+_ITL_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
 
 
 def sample_logits_rowwise(
@@ -181,6 +192,7 @@ class DecodeEngine:
         draft_cfg: Optional[LlamaConfig] = None,
         spec_k: int = 4,
         spec_rounds_per_call: int = 4,
+        metrics_registry: Optional[prometheus.Registry] = None,
     ):
         self.params = params
         self.cfg = cfg
@@ -280,6 +292,26 @@ class DecodeEngine:
                 )
                 for k, v in self._state.items()
             }
+        # serving SLO metrics (arXiv:2605.25645's TTFT/TPOT surface):
+        # the same registry the platform scrapes at /metrics
+        reg = metrics_registry or prometheus.default_registry
+        self.m_ttft = reg.histogram(
+            "serving_ttft_seconds",
+            "Time from request submit to first emitted token",
+            buckets=_TTFT_BUCKETS,
+        )
+        self.m_itl = reg.histogram(
+            "serving_inter_token_seconds",
+            "Gap between consecutive token emissions (streaming-client view)",
+            buckets=_ITL_BUCKETS,
+        )
+        self.m_queue_depth = reg.gauge(
+            "serving_queue_depth", "Requests waiting for a decode slot"
+        )
+        self.m_occupancy = reg.gauge(
+            "serving_batch_occupancy",
+            "Fraction of decode slots active after the last chunk",
+        )
         # observability: decode_steps × n_slots is the work a serial
         # server would have spent per-request; the ratio
         # tokens_emitted / decode_steps is the batching efficiency
@@ -817,6 +849,7 @@ class DecodeEngine:
         if req.max_tokens <= 1:
             tok = int(first)
             req._emit(tok)
+            self._observe_emit(req)
             req._finish()
             return
         if self.draft_params is not None:
@@ -901,6 +934,7 @@ class DecodeEngine:
         if req.max_tokens <= 1:
             self._slot_req[slot] = None
             req._emit(int(first))
+            self._observe_emit(req)
             req._finish()
             return
         if self.draft_params is not None:
@@ -915,6 +949,15 @@ class DecodeEngine:
                 self.draft_params, self._state, jnp.asarray(drow),
             )
         self._pending_first.append((req, first, slot))
+
+    def _observe_emit(self, req: _Request) -> None:
+        """Feed the SLO histograms after a ``req._emit``: the first
+        token is the request's TTFT, every later one an inter-token
+        gap (exactly what a streaming client measures)."""
+        if len(req.times) == 1:
+            self.m_ttft.observe(req.times[0] - req.submit_t)
+        else:
+            self.m_itl.observe(req.times[-1] - req.times[-2])
 
     def _fail_engine(self, exc: Exception) -> None:
         """A device-level failure (OOM, preemption, XLA runtime error)
@@ -1002,6 +1045,7 @@ class DecodeEngine:
                     req._finish()
                     self._fail_engine(e)
                     return
+            self.m_queue_depth.set(self._queue.qsize())
             adm_slot = (
                 self._admitting["slot"]
                 if self._admitting is not None
@@ -1052,6 +1096,7 @@ class DecodeEngine:
             for (preq, _f, pslot), tok in zip(pending, firsts):
                 tok = int(tok)
                 preq._emit(tok)
+                self._observe_emit(preq)
                 self.tokens_emitted += 1
                 if tok == preq.eos_id:
                     preq._finish()
@@ -1090,6 +1135,7 @@ class DecodeEngine:
                 for t, live in zip(toks[slot], mask[slot]):
                     if live:
                         req._emit(int(t))
+                        self._observe_emit(req)
                         self.tokens_emitted += 1
                 if (
                     len(req.tokens) >= req.max_tokens
@@ -1097,6 +1143,10 @@ class DecodeEngine:
                 ):
                     req._finish()
                     self._slot_req[slot] = None
+            self.m_occupancy.set(
+                sum(1 for r in self._slot_req if r is not None)
+                / float(self.n_slots)
+            )
 
     # -- public API ---------------------------------------------------------
 
@@ -1152,6 +1202,7 @@ class DecodeEngine:
             submit_t=time.monotonic(),
         )
         self._queue.put(req)
+        self.m_queue_depth.set(self._queue.qsize())
         self._wake.set()
         # the loop thread may have exited (stop() or a device failure)
         # between the pre-check above and the put — its final drain

@@ -41,6 +41,7 @@ from odh_kubeflow_tpu.controllers.tensorboard import TensorboardController
 from odh_kubeflow_tpu.machinery import httpapi
 from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
 from odh_kubeflow_tpu.machinery.store import APIServer
+from odh_kubeflow_tpu.utils import prometheus
 from odh_kubeflow_tpu.web.dashboard import DashboardApp
 from odh_kubeflow_tpu.web.jwa import JupyterWebApp
 from odh_kubeflow_tpu.web.kfam_app import KfamApp
@@ -97,6 +98,11 @@ class Platform:
         PodDefaultWebhook(self.api).register()
         NotebookWebhook(self.api).register()
 
+        # one platform-wide registry: controller-runtime metrics, the
+        # notebook controller's counters, and anything components add
+        # all scrape from the apiserver's /metrics
+        self.metrics_registry = prometheus.Registry()
+
         self.nb_config = nb_config or NotebookControllerConfig.from_env()
         culler_cfg = CullerConfig(
             cull_idle_seconds=self.nb_config.cull_idle_seconds,
@@ -104,10 +110,11 @@ class Platform:
             cluster_domain=self.nb_config.cluster_domain,
         )
         self.culler = Culler(self.api, culler_cfg)
-        self.manager = Manager(self.api)
+        self.manager = Manager(self.api, registry=self.metrics_registry)
         self.notebook_controller = NotebookController(
             self.api,
             self.nb_config,
+            registry=self.metrics_registry,
             culler=self.culler if self.nb_config.enable_culling else None,
         )
         self.notebook_controller.register(self.manager)
@@ -142,7 +149,9 @@ class Platform:
         """Starts controllers + servers on daemon threads; returns the
         bound (api_port, web_port)."""
         self.manager.start()
-        _, api_port, self._api_httpd = httpapi.serve(self.api, host, api_port)
+        _, api_port, self._api_httpd = httpapi.serve(
+            self.api, host, api_port, metrics_registry=self.metrics_registry
+        )
 
         web_thread, web_port, self._web_httpd = _serve_wsgi(
             self.web, host, web_port

@@ -25,8 +25,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from odh_kubeflow_tpu.models import llama, lora as lora_lib
 from odh_kubeflow_tpu.parallel.mesh import batch_spec, build_mesh, constrain
+from odh_kubeflow_tpu.utils import prometheus
 
 Params = dict[str, Any]
+
+# step times span ms-scale tiny test models to minutes-long 8B steps
+# (the first observation includes the cold compile — visible on
+# purpose: compile stalls are the spawn-latency north star's enemy)
+_STEP_TIME_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -177,6 +185,7 @@ class Trainer:
         seed: int = 0,
         quantize_base: "bool | str" = False,  # True/"int8" or "int4"
         precompile_batch: Optional[tuple] = None,  # (batch, seq[, keys])
+        metrics_registry: Optional[prometheus.Registry] = None,
     ):
         from odh_kubeflow_tpu.models import moe as moe_lib
 
@@ -208,6 +217,14 @@ class Trainer:
         self.quantize_base = quantize_base
         self.mesh = mesh if mesh is not None else build_mesh()
         self.optimizer = _make_optimizer(train_cfg)
+        self._m_step_time = (
+            metrics_registry or prometheus.default_registry
+        ).histogram(
+            "train_step_time_seconds",
+            "Wall-clock time per train_step call (first call includes "
+            "compile)",
+            buckets=_STEP_TIME_BUCKETS,
+        )
 
         # "rbg" keys: jax.random.* on them lowers to XLA's builtin
         # RngBitGenerator instead of an inlined threefry graph — the
@@ -566,6 +583,7 @@ class Trainer:
         return exe if not isinstance(exe, Exception) else None
 
     def train_step(self, batch: dict) -> dict:
+        t_start = time.perf_counter()
         trainable = self.lora_params if self.lora_cfg is not None else self.params
         frozen = self.params
         with jax.set_mesh(self.mesh):
@@ -604,6 +622,10 @@ class Trainer:
         else:
             self.params = trainable
         self.step += 1
+        # dispatch time as the host loop sees it (async dispatch: the
+        # device may still be running; steady-state the loop is
+        # device-bound and this converges on true step time)
+        self._m_step_time.observe(time.perf_counter() - t_start)
         return metrics
 
     # -- checkpoint / resume ------------------------------------------------

@@ -1,48 +1,120 @@
-"""Minimal Prometheus client: counters/gauges + custom collectors with
-text exposition, served by the manager's metrics endpoint.
+"""Minimal Prometheus client: counters/gauges/histograms + custom
+collectors with text exposition, served by the manager's metrics
+endpoint.
 
 Replaces the reference's use of prometheus/client_golang
 (notebook-controller pkg/metrics/metrics.go:13-99, profile-controller
 controllers/monitoring.go:19-75) — same metric surface, no dependency.
+
+Exposition follows the Prometheus text format spec: label values are
+escaped (``\\``, ``"``, newline), HELP text is escaped (``\\``,
+newline), histograms emit cumulative ``le`` buckets ending in ``+Inf``
+plus ``_sum``/``_count``. Metrics declared with ``labelnames`` never
+emit a phantom unlabelled ``{name} 0`` sample; unlabelled counters and
+gauges still expose their zero value on registration (client_golang
+behaviour both ways).
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
-from typing import Callable, Iterable, Optional
+from typing import Callable, Iterable, Optional, Sequence
+
+
+def _escape_label_value(v: str) -> str:
+    """Text-format label-value escaping: backslash, double-quote,
+    line-feed (in that order — escaping the escapes first)."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(v: str) -> str:
+    """HELP text escaping: backslash and line-feed only (quotes are
+    legal in HELP)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(labels.items())
+    )
     return "{" + inner + "}"
 
 
+def _fmt_value(v: float) -> str:
+    """Integral floats print without the trailing .0 (the conventional
+    exposition shape for counters)."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+class _Child:
+    """A metric bound to one label set — ``metric.labels(name="x")``
+    returns one, so hot paths resolve their label dict once."""
+
+    __slots__ = ("_metric", "_labels")
+
+    def __init__(self, metric: "Metric", labels: dict[str, str]):
+        self._metric = metric
+        self._labels = labels
+
+    def inc(self, by: float = 1.0) -> None:
+        self._metric.inc(self._labels, by)
+
+    def set(self, value: float) -> None:
+        self._metric.set(value, self._labels)
+
+    def observe(self, value: float) -> None:
+        self._metric.observe(value, self._labels)
+
+    def value(self) -> float:
+        return self._metric.value(self._labels)
+
+
 class Metric:
-    def __init__(self, name: str, help_: str, typ: str):
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        typ: str,
+        labelnames: Sequence[str] = (),
+    ):
         self.name = name
         self.help = help_
         self.type = typ
+        self.labelnames = tuple(labelnames)
         self._values: dict[tuple, float] = {}
         self._lock = threading.Lock()
 
     def _key(self, labels: Optional[dict[str, str]]):
         return tuple(sorted((labels or {}).items()))
 
+    def labels(self, **labels: str) -> _Child:
+        return _Child(self, labels)
+
     def collect(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {self.help}"
+        yield f"# HELP {self.name} {_escape_help(self.help)}"
         yield f"# TYPE {self.name} {self.type}"
         with self._lock:
-            if not self._values:
+            if not self._values and not self.labelnames:
+                # an unlabelled metric exposes its zero value from
+                # registration; a labelled family starts empty (no
+                # phantom series)
                 yield f"{self.name} 0"
             for key, value in sorted(self._values.items()):
-                yield f"{self.name}{_fmt_labels(dict(key))} {value}"
+                yield f"{self.name}{_fmt_labels(dict(key))} {_fmt_value(value)}"
 
 
 class Counter(Metric):
-    def __init__(self, name: str, help_: str):
-        super().__init__(name, help_, "counter")
+    def __init__(self, name: str, help_: str, labelnames: Sequence[str] = ()):
+        super().__init__(name, help_, "counter", labelnames)
 
     def inc(self, labels: Optional[dict[str, str]] = None, by: float = 1.0) -> None:
         with self._lock:
@@ -55,27 +127,138 @@ class Counter(Metric):
 
 
 class Gauge(Metric):
-    def __init__(self, name: str, help_: str):
-        super().__init__(name, help_, "gauge")
+    def __init__(self, name: str, help_: str, labelnames: Sequence[str] = ()):
+        super().__init__(name, help_, "gauge", labelnames)
 
     def set(self, value: float, labels: Optional[dict[str, str]] = None) -> None:
         with self._lock:
             self._values[self._key(labels)] = value
+
+    def inc(self, labels: Optional[dict[str, str]] = None, by: float = 1.0) -> None:
+        with self._lock:
+            key = self._key(labels)
+            self._values[key] = self._values.get(key, 0.0) + by
 
     def value(self, labels: Optional[dict[str, str]] = None) -> float:
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
 
+# client_golang's DefBuckets — latency-shaped, seconds
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _fmt_le(b: float) -> str:
+    return str(int(b)) if float(b).is_integer() else repr(float(b))
+
+
+class Histogram(Metric):
+    """Cumulative-bucket histogram. Per label set it tracks one count
+    per configured bucket plus sum/count; exposition emits the
+    cumulative ``le`` series terminated by ``+Inf`` (== ``_count``)."""
+
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+        labelnames: Sequence[str] = (),
+    ):
+        super().__init__(name, help_, "histogram", labelnames)
+        if not buckets:
+            raise ValueError(f"{name}: histogram needs at least one bucket")
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        # per key: (per-bucket non-cumulative counts, sum, count)
+        self._series: dict[tuple, list] = {}
+
+    def observe(self, value: float, labels: Optional[dict[str, str]] = None) -> None:
+        value = float(value)
+        with self._lock:
+            key = self._key(labels)
+            st = self._series.get(key)
+            if st is None:
+                st = self._series[key] = [[0] * (len(self.buckets) + 1), 0.0, 0]
+            # index of the first bucket >= value; the last slot is +Inf
+            st[0][bisect.bisect_left(self.buckets, value)] += 1
+            st[1] += value
+            st[2] += 1
+
+    def value(self, labels: Optional[dict[str, str]] = None) -> float:
+        """Observation count (the natural scalar view of a histogram)."""
+        with self._lock:
+            st = self._series.get(self._key(labels))
+            return float(st[2]) if st is not None else 0.0
+
+    def sum(self, labels: Optional[dict[str, str]] = None) -> float:
+        with self._lock:
+            st = self._series.get(self._key(labels))
+            return float(st[1]) if st is not None else 0.0
+
+    def _emit_series(self, labels: dict[str, str], st) -> Iterable[str]:
+        counts, total, count = st
+        cum = 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            lab = _fmt_labels({**labels, "le": _fmt_le(b)})
+            yield f"{self.name}_bucket{lab} {cum}"
+        lab = _fmt_labels({**labels, "le": "+Inf"})
+        yield f"{self.name}_bucket{lab} {count}"
+        yield f"{self.name}_sum{_fmt_labels(labels)} {_fmt_value(total)}"
+        yield f"{self.name}_count{_fmt_labels(labels)} {count}"
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {_escape_help(self.help)}"
+        yield f"# TYPE {self.name} {self.type}"
+        with self._lock:
+            series = sorted(
+                (k, [list(st[0]), st[1], st[2]])
+                for k, st in self._series.items()
+            )
+        if not series and not self.labelnames:
+            series = [((), [[0] * (len(self.buckets) + 1), 0.0, 0])]
+        for key, st in series:
+            yield from self._emit_series(dict(key), st)
+
+
 class Registry:
     def __init__(self):
         self._metrics: list[Metric] = []
+        self._by_name: dict[str, Metric] = {}
         self._collect_fns: list[Callable[[], Iterable[str]]] = []
         self._lock = threading.Lock()
 
     def register(self, metric: Metric) -> Metric:
+        """Get-or-create by name: re-registering an existing family
+        returns the live instance (so independently constructed
+        components sharing one registry share the series — the
+        client_golang AlreadyRegisteredError-recovery idiom)."""
         with self._lock:
+            existing = self._by_name.get(metric.name)
+            if existing is not None:
+                if existing.type != metric.type:
+                    raise ValueError(
+                        f"metric {metric.name!r} already registered as "
+                        f"{existing.type}, not {metric.type}"
+                    )
+                if existing.labelnames != metric.labelnames:
+                    raise ValueError(
+                        f"metric {metric.name!r} already registered with "
+                        f"labelnames {existing.labelnames}, not "
+                        f"{metric.labelnames}"
+                    )
+                if isinstance(metric, Histogram) and (
+                    existing.buckets != metric.buckets  # type: ignore[attr-defined]
+                ):
+                    raise ValueError(
+                        f"histogram {metric.name!r} already registered "
+                        f"with buckets {existing.buckets}; a second "  # type: ignore[attr-defined]
+                        "registration would silently mis-bucket"
+                    )
+                return existing
             self._metrics.append(metric)
+            self._by_name[metric.name] = metric
         return metric
 
     def register_collector(self, fn: Callable[[], Iterable[str]]) -> None:
@@ -84,11 +267,28 @@ class Registry:
         with self._lock:
             self._collect_fns.append(fn)
 
-    def counter(self, name: str, help_: str) -> Counter:
-        return self.register(Counter(name, help_))  # type: ignore[return-value]
+    def counter(
+        self, name: str, help_: str, labelnames: Sequence[str] = ()
+    ) -> Counter:
+        return self.register(Counter(name, help_, labelnames))  # type: ignore[return-value]
 
-    def gauge(self, name: str, help_: str) -> Gauge:
-        return self.register(Gauge(name, help_))  # type: ignore[return-value]
+    def gauge(
+        self, name: str, help_: str, labelnames: Sequence[str] = ()
+    ) -> Gauge:
+        return self.register(Gauge(name, help_, labelnames))  # type: ignore[return-value]
+
+    def histogram(
+        self,
+        name: str,
+        help_: str,
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+        labelnames: Sequence[str] = (),
+    ) -> Histogram:
+        return self.register(Histogram(name, help_, buckets, labelnames))  # type: ignore[return-value]
+
+    def metrics(self) -> list[Metric]:
+        with self._lock:
+            return list(self._metrics)
 
     def exposition(self) -> str:
         lines: list[str] = []
@@ -103,3 +303,78 @@ class Registry:
 
 
 default_registry = Registry()
+
+
+# ---------------------------------------------------------------------------
+# naming lint (tier-1 guard: new metrics can't drift from conventions)
+
+
+def lint_metric_names(registry: Registry) -> list[str]:
+    """Prometheus naming conventions, enforced in CI:
+    - names are ``[a-z_][a-z0-9_]*`` (no uppercase, no leading digit);
+    - counters end in ``_total``;
+    - histograms record durations and end in ``_seconds``;
+    - nothing but counters claims the ``_total`` suffix.
+    Returns human-readable violations (empty == clean)."""
+    import re
+
+    violations = []
+    for m in registry.metrics():
+        if not re.fullmatch(r"[a-z_][a-z0-9_]*", m.name):
+            violations.append(
+                f"{m.name}: must match [a-z_][a-z0-9_]* (lowercase only)"
+            )
+        if m.type == "counter" and not m.name.endswith("_total"):
+            violations.append(f"{m.name}: counter names must end in _total")
+        if m.type != "counter" and m.name.endswith("_total"):
+            violations.append(f"{m.name}: _total suffix is reserved for counters")
+        if m.type == "histogram" and not m.name.endswith("_seconds"):
+            violations.append(
+                f"{m.name}: duration histograms must end in _seconds"
+            )
+        for ln in m.labelnames:
+            if not re.fullmatch(r"[a-z_][a-z0-9_]*", ln):
+                violations.append(f"{m.name}: label {ln!r} must be lowercase")
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# serving
+
+
+def metrics_app(registry: Registry):
+    """WSGI app exposing ``registry`` at ``/metrics`` (and ``/``, the
+    scrape-anything posture controller-runtime's metrics listener
+    has)."""
+
+    def app(environ, start_response):
+        if environ.get("PATH_INFO", "/") not in ("/", "/metrics"):
+            start_response("404 Not Found", [("Content-Type", "text/plain")])
+            return [b"not found"]
+        payload = registry.exposition().encode()
+        start_response(
+            "200 OK",
+            [
+                ("Content-Type", "text/plain; version=0.0.4"),
+                ("Content-Length", str(len(payload))),
+            ],
+        )
+        return [payload]
+
+    return app
+
+
+def serve_metrics(registry: Registry, host: str = "0.0.0.0", port: int = 8080):
+    """Serve ``/metrics`` on a daemon thread (the controller-runtime
+    metrics-bind-address equivalent for split-process components).
+    Returns (thread, bound_port, httpd)."""
+    from wsgiref.simple_server import WSGIRequestHandler, make_server
+
+    class _Quiet(WSGIRequestHandler):
+        def log_message(self, *args):  # noqa: D102 — stdlib signature
+            pass
+
+    httpd = make_server(host, port, metrics_app(registry), handler_class=_Quiet)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return t, httpd.server_address[1], httpd

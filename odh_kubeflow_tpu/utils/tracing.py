@@ -1,0 +1,199 @@
+"""Trace propagation + structured logging for the platform.
+
+The reference gets request correlation for free from controller-runtime
+zap logs and kube-apiserver audit IDs; this from-scratch runtime needs
+its own: W3C ``traceparent``-style context carried over the embedded
+REST façade, a contextvar-propagated span so any code (admission hook,
+store write, reconcile) can ask "what request am I part of", and a JSON
+log formatter that stamps every record with ``trace_id``/``span_id``
+plus span attributes (``controller``, ``reconcile_key``).
+
+The trace crosses the async apiserver→controller hop via an object
+annotation: the store stamps ``TRACE_ANNOTATION`` on CREATE when a span
+is active, and the controller runtime picks it up from the watch event
+so the reconcile's log records share the originating request's
+trace_id (webhook admission → apiserver write → reconcile is one
+trace).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import logging
+import re
+import time
+import uuid
+from contextvars import ContextVar
+from typing import Any, Iterator, Mapping, Optional
+
+# stamped by the embedded store on CREATE (see machinery/store.py)
+TRACE_ANNOTATION = "odh.kubeflow.org/trace-id"
+
+_TRACEPARENT_RE = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$"
+)
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex  # 32 hex chars
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanContext:
+    trace_id: str
+    span_id: str
+    parent_span_id: str = ""
+    name: str = ""
+    # searchable log dimensions (controller, reconcile_key, ...)
+    attrs: Mapping[str, str] = dataclasses.field(default_factory=dict)
+
+    def traceparent(self) -> str:
+        """W3C trace-context header value (version 00, sampled)."""
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+
+_current: ContextVar[Optional[SpanContext]] = ContextVar(
+    "odh_current_span", default=None
+)
+
+
+def current() -> Optional[SpanContext]:
+    return _current.get()
+
+
+def traceparent() -> Optional[str]:
+    span = _current.get()
+    return span.traceparent() if span is not None else None
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[SpanContext]:
+    """Remote context from a ``traceparent`` header value (or None for
+    absent/malformed — a bad header must never fail the request)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip())
+    if not m:
+        return None
+    return SpanContext(trace_id=m.group(1), span_id=m.group(2), name="remote")
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    trace_id: Optional[str] = None,
+    parent: Optional[SpanContext] = None,
+    **attrs: str,
+) -> Iterator[SpanContext]:
+    """Enter a span: child of ``parent`` (explicit, or the contextvar's
+    current span), or a fresh trace root. ``trace_id`` forces the trace
+    (the annotation-carried cross-process hop); attrs merge over the
+    parent's when staying in the same trace."""
+    if parent is None:
+        parent = _current.get()
+    if trace_id is not None and parent is not None and parent.trace_id != trace_id:
+        parent = None  # forced onto a different trace: not a child
+    tid = trace_id or (parent.trace_id if parent is not None else new_trace_id())
+    merged: dict[str, str] = dict(parent.attrs) if parent is not None else {}
+    merged.update(attrs)
+    ctx = SpanContext(
+        trace_id=tid,
+        span_id=new_span_id(),
+        parent_span_id=parent.span_id if parent is not None else "",
+        name=name,
+        attrs=merged,
+    )
+    token = _current.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _current.reset(token)
+
+
+@contextlib.contextmanager
+def use_span(ctx: Optional[SpanContext]) -> Iterator[Optional[SpanContext]]:
+    """Install an existing (e.g. header-parsed) context as current; a
+    None ctx is a no-op so callers needn't branch."""
+    if ctx is None:
+        yield None
+        return
+    token = _current.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _current.reset(token)
+
+
+def traced(fn=None, *, name: Optional[str] = None):
+    """Decorator: run the function inside a span named after it."""
+
+    def deco(f):
+        import functools
+
+        span_name = name or f.__qualname__
+
+        @functools.wraps(f)
+        def wrapper(*args: Any, **kwargs: Any):
+            with span(span_name):
+                return f(*args, **kwargs)
+
+        return wrapper
+
+    return deco(fn) if fn is not None else deco
+
+
+def trace_id_of(obj: Mapping[str, Any]) -> Optional[str]:
+    """The trace annotation stamped on a stored object, if any."""
+    meta = obj.get("metadata") or {}
+    ann = meta.get("annotations") or {}
+    tid = ann.get(TRACE_ANNOTATION)
+    return tid if isinstance(tid, str) and tid else None
+
+
+# ---------------------------------------------------------------------------
+# structured logging
+
+
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per record, trace-correlated: ``trace_id``/
+    ``span_id``/``span`` plus span attrs (``controller``,
+    ``reconcile_key``) come from the contextvar at emit time — handlers
+    format synchronously on the emitting thread, so the context is the
+    record's."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out: dict[str, Any] = {
+            "ts": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)
+            )
+            + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        ctx = _current.get()
+        if ctx is not None:
+            out["trace_id"] = ctx.trace_id
+            out["span_id"] = ctx.span_id
+            if ctx.name:
+                out["span"] = ctx.name
+            out.update(ctx.attrs)
+        if record.exc_info and record.exc_info[0] is not None:
+            out["exception"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+def configure_json_logging(level: int = logging.INFO) -> logging.Handler:
+    """Install a JSON-formatted stderr handler on the root logger (the
+    split-process entrypoints' default posture)."""
+    handler = logging.StreamHandler()
+    handler.setFormatter(JsonLogFormatter())
+    root = logging.getLogger()
+    root.addHandler(handler)
+    root.setLevel(level)
+    return handler

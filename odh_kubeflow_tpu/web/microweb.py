@@ -244,7 +244,19 @@ class App:
             return Response(f.read(), content_type=ctype)
 
     def __call__(self, environ, start_response):
+        from odh_kubeflow_tpu.utils import tracing
+
         request = Request(environ)
+        # every web request is a trace root (or joins the caller's via
+        # traceparent): the handler's API writes carry the trace id to
+        # the apiserver and onwards to the reconcile logs
+        remote = tracing.parse_traceparent(request.headers.get("traceparent"))
+        with tracing.span(
+            f"{self.name}:{request.method} {request.path}", parent=remote
+        ):
+            return self._call_traced(request, environ, start_response)
+
+    def _call_traced(self, request, environ, start_response):
         try:
             response = self._dispatch(request)
         except HTTPError as e:
