@@ -82,19 +82,41 @@ def measure_spawn_to_ready() -> dict:
             "tpus": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x2"},
         },
     )
-    ready_s = None
+    # breakdown milestones, polled from the same details feed the UI
+    # renders: queue wait (POST → workload Admitted), scheduling
+    # (Admitted → every gang pod bound to a node), container start
+    # (bound → row reports ready)
+    ready_s = admitted_s = bound_s = None
     deadline = time.monotonic() + 60
     while time.monotonic() < deadline:
-        rows = call("/jupyter/api/namespaces/bench-team/notebooks")["notebooks"]
-        row = next(r for r in rows if r["name"] == "latency-nb")
-        if row["status"]["phase"] == "ready":
-            ready_s = time.monotonic() - t0
+        details = call(
+            "/jupyter/api/namespaces/bench-team/notebooks/latency-nb/details"
+        )["details"]
+        now = time.monotonic() - t0
+        workload = details.get("workload") or {}
+        if admitted_s is None and workload.get("state") == "Admitted":
+            admitted_s = now
+        pods = details.get("pods") or []
+        if bound_s is None and pods and all(p.get("node") for p in pods):
+            bound_s = now
+        if details["status"]["phase"] == "ready":
+            ready_s = now
             break
         time.sleep(0.05)
     platform.stop()
     if ready_s is None:
         raise RuntimeError("notebook never became ready")
-    return {"spawn_to_ready_s": round(ready_s, 3), "kubelet": "simulated"}
+    out = {"spawn_to_ready_s": round(ready_s, 3), "kubelet": "simulated"}
+    if admitted_s is not None:
+        bound_s = bound_s if bound_s is not None else ready_s
+        out.update(
+            {
+                "queue_wait_s": round(admitted_s, 3),
+                "scheduling_s": round(max(bound_s - admitted_s, 0.0), 3),
+                "container_start_s": round(max(ready_s - bound_s, 0.0), 3),
+            }
+        )
+    return out
 
 
 def measure_first_jax_step() -> dict:
@@ -156,10 +178,18 @@ def record(result: dict) -> None:
         if warm
         else ""
     )
+    breakdown = (
+        (
+            f" [queue {result['queue_wait_s']}s / schedule "
+            f"{result['scheduling_s']}s / start {result['container_start_s']}s]"
+        )
+        if "queue_wait_s" in result
+        else ""
+    )
     line = (
         f"| Spawn → first JAX step latency | "
         f"**{result['total_s']:.1f}s** cold (spawn→ready "
-        f"{result['spawn_to_ready_s']}s platform path on sim kubelet, + "
+        f"{result['spawn_to_ready_s']}s{breakdown} platform path on sim kubelet, + "
         f"trainer build {result['first_step']['trainer_build_s']}s + "
         f"first-step compile {result['first_step']['first_step_compile_s']}s "
         f"on real {result['first_step']['device']}; excludes image pull)"

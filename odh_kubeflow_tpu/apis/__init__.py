@@ -40,6 +40,23 @@ TPU_ACCEL_NODE_LABEL = "cloud.google.com/gke-tpu-accelerator"
 TPU_TOPO_NODE_LABEL = "cloud.google.com/gke-tpu-topology"
 
 
+def pod_spec_tpu_chips(pod_spec) -> float:
+    """Summed ``google.com/tpu`` container limits of a pod spec — THE
+    chip-accounting primitive (kubelet ledger, scheduler snapshots,
+    workload derivation all count the same way)."""
+    from odh_kubeflow_tpu.machinery import objects as obj_util
+
+    total = 0.0
+    for c in (pod_spec or {}).get("containers") or []:
+        limits = obj_util.get_path(c, "resources", "limits", default={}) or {}
+        total += obj_util.parse_quantity(limits.get(TPU_RESOURCE, 0))
+    return total
+
+
+def pod_tpu_chips(pod) -> float:
+    return pod_spec_tpu_chips((pod or {}).get("spec"))
+
+
 def _validate_notebook(req):
     if req.operation not in ("CREATE", "UPDATE"):
         return
@@ -79,6 +96,8 @@ def install_default_cluster_roles(api: APIServer) -> None:
         "events",
         "configmaps",
         "nodes",
+        # the spawner shows used/hard TPU chips from kf-resource-quota
+        "resourcequotas",
     ]
     # secrets deliberately excluded from view (upstream view roles do the
     # same: a read-only observer must not hold credentials)

@@ -181,6 +181,15 @@ class Culler:
             return Result(requeue_after=max(remaining, 1.0))
 
         running = self._notebook_running(notebook)
+        if not running and self._notebook_queued(notebook):
+            # queue wait is not idleness: a gang waiting for admission
+            # (or for slice capacity) has no server to be idle. Pin
+            # last-activity to now so a long queue wait can never tip
+            # the notebook over the cull threshold the moment it
+            # finally starts.
+            obj_util.set_annotation(
+                notebook, LAST_ACTIVITY_ANNOTATION, _fmt_time(now)
+            )
         if running:
             # initialize on first sight (culler.go:118-141): without
             # this, a server that never reports activity (no kernels,
@@ -224,6 +233,27 @@ class Culler:
         if last is None:
             return False
         return self.now() - _parse_time(last) > self.config.cull_idle_seconds
+
+    def _notebook_queued(self, notebook: Obj) -> bool:
+        """Whether the notebook is waiting on admission/scheduling
+        rather than running: its Workload is not admitted, or its pods
+        exist but sit Pending (gated or unschedulable)."""
+        name = obj_util.name_of(notebook)
+        ns = obj_util.namespace_of(notebook)
+        try:
+            wl = self.api.get("Workload", name, ns)
+            if obj_util.get_path(wl, "status", "state") != "Admitted":
+                return True
+        except NotFound:
+            pass  # no workload (queueing off) or kind not registered
+        return any(
+            obj_util.get_path(p, "status", "phase") == "Pending"
+            for p in self.api.list(
+                "Pod",
+                namespace=ns,
+                label_selector={"matchLabels": {"statefulset": name}},
+            )
+        )
 
     def _notebook_running(self, notebook: Obj) -> bool:
         try:

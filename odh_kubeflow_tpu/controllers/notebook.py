@@ -40,6 +40,14 @@ from odh_kubeflow_tpu.controllers.runtime import Manager, Request, Result
 from odh_kubeflow_tpu.machinery import objects as obj_util
 from odh_kubeflow_tpu.machinery.events import EventRecorder
 from odh_kubeflow_tpu.machinery.store import APIServer, Conflict, NotFound
+from odh_kubeflow_tpu.scheduling import (
+    ADMISSION_GATE_ANNOTATION,
+    WORKLOAD_LABEL,
+)
+from odh_kubeflow_tpu.scheduling.workload import (
+    resolve_priority,
+    workload_from_statefulset,
+)
 from odh_kubeflow_tpu.utils import prometheus
 from odh_kubeflow_tpu.utils.tpu import TPU_TOPOLOGIES, chips_in_topology, hosts_in_slice
 
@@ -65,6 +73,9 @@ class NotebookControllerConfig:
     enable_culling: bool = False
     cull_idle_seconds: float = 1440 * 60.0
     idleness_check_seconds: float = 60.0
+    # gang admission through the TPU slice scheduler: TPU notebooks get
+    # a Workload + admission-gated pods instead of racing the quota
+    enable_queueing: bool = False
 
     @staticmethod
     def from_env() -> "NotebookControllerConfig":
@@ -83,6 +94,7 @@ class NotebookControllerConfig:
             cull_idle_seconds=float(env.get("CULL_IDLE_TIME", "1440")) * 60.0,
             idleness_check_seconds=float(env.get("IDLENESS_CHECK_PERIOD", "1"))
             * 60.0,
+            enable_queueing=flag("ENABLE_TPU_QUEUEING", "true"),
         )
 
 
@@ -303,6 +315,9 @@ class NotebookController:
                 )
             raise
 
+        if self.config.enable_queueing:
+            self._reconcile_workload(notebook, sts)
+
         svc = self.generate_service(notebook, tpu)
         reconcilehelper.reconcile_object(self.api, svc, owner=notebook)
         if tpu is not None and tpu.hosts > 1:
@@ -322,6 +337,40 @@ class NotebookController:
         if self.config.enable_culling and self.culler is not None:
             return self.culler.reconcile_notebook(notebook)
         return Result()
+
+    # -- gang admission (scheduling/ subsystem) -----------------------------
+
+    def _reconcile_workload(self, notebook: Obj, sts: Obj) -> None:
+        """Keep the Workload in lockstep with the generated StatefulSet
+        shape. A stopped/non-TPU notebook has no Workload (deleting it
+        releases the admission reservation — culled notebooks free
+        their chips for the queue)."""
+        name = obj_util.name_of(notebook)
+        ns = obj_util.namespace_of(notebook)
+        priority, pclass, resolved = resolve_priority(self.api, notebook)
+        if not resolved:
+            self.recorder.warning(
+                notebook,
+                "UnknownPriorityClass",
+                f"PriorityClass {pclass!r} not found; scheduling at "
+                "default priority 0",
+            )
+        desired = workload_from_statefulset(
+            sts, priority=priority, priority_class=pclass
+        )
+        try:
+            if desired is None:
+                try:
+                    self.api.delete("Workload", name, ns)
+                except NotFound:
+                    pass
+                return
+            reconcilehelper.reconcile_object(self.api, desired, owner=notebook)
+        except NotFound:
+            # Workload kind not registered — queueing enabled without
+            # the scheduling subsystem installed; degrade to the legacy
+            # per-pod path rather than wedging the reconcile
+            return
 
     # -- TPU slice health (SURVEY.md §7 hard part (d)) ----------------------
 
@@ -441,6 +490,16 @@ class NotebookController:
         if tpu is not None:
             replicas = 0 if stopped else tpu.hosts
             self._apply_tpu_scheduling(notebook, pod_spec, tpu)
+            if self.config.enable_queueing:
+                # admission gate: the kubelet sim keeps these pods
+                # Pending (SchedulingGated) until the slice scheduler
+                # admits the gang, then binds all hosts to the recorded
+                # assignment atomically
+                tmeta = template.setdefault("metadata", {})
+                tmeta.setdefault("annotations", {})[
+                    ADMISSION_GATE_ANNOTATION
+                ] = name
+                tmeta.setdefault("labels", {})[WORKLOAD_LABEL] = name
 
         labels = {"statefulset": name, "notebook-name": name}
         template.setdefault("metadata", {}).setdefault("labels", {}).update(labels)
@@ -697,8 +756,11 @@ def main() -> None:
 
     def register(api, mgr):
         from odh_kubeflow_tpu.controllers.culler import Culler, CullerConfig
+        from odh_kubeflow_tpu.scheduling import register_scheduling
 
         cfg = NotebookControllerConfig.from_env()
+        if cfg.enable_queueing:
+            register_scheduling(api)  # the remote client needs the kind
         culler = None
         if cfg.enable_culling:
             culler = Culler(

@@ -11,7 +11,9 @@ rows — and so controllers can emit on every reconcile pass without
 flooding the store.
 
 Controllers emit state transitions through it (Created / Started /
-Culled / FailedCreate and the warning paths); watch-driven reconcilers
+Culled / FailedCreate and the warning paths, plus the slice
+scheduler's admission lifecycle: Queued / Admitted / Preempted /
+NodeLost / FailedScheduling-with-reason); watch-driven reconcilers
 stay quiescent because a pure re-emission in the same reconcile state
 only happens when something re-triggered the reconcile.
 """

@@ -16,14 +16,31 @@ from __future__ import annotations
 import itertools
 from typing import Any, Optional
 
+from odh_kubeflow_tpu.apis import pod_tpu_chips
 from odh_kubeflow_tpu.machinery import objects as obj_util
 from odh_kubeflow_tpu.machinery.store import APIServer, AlreadyExists, NotFound
+from odh_kubeflow_tpu.scheduling import (
+    ADMISSION_GATE_ANNOTATION,
+    WORKLOAD_LABEL,
+)
 
 Obj = dict[str, Any]
 
 TPU_RESOURCE = "google.com/tpu"
 TPU_ACCEL_LABEL = "cloud.google.com/gke-tpu-accelerator"
 TPU_TOPO_LABEL = "cloud.google.com/gke-tpu-topology"
+ORDINAL_LABEL = "apps.kubernetes.io/pod-index"
+
+
+def _is_gated_unbound(pod: Obj) -> bool:
+    """An admission-gated pod that has not been gang-bound yet holds no
+    chips: quota charges at workload admission (the reservation), and
+    the pod-level backstop only counts pods that actually occupy a
+    node."""
+    return (
+        ADMISSION_GATE_ANNOTATION in obj_util.annotations_of(pod)
+        and not obj_util.get_path(pod, "spec", "nodeName")
+    )
 
 
 class FakeCluster:
@@ -127,18 +144,18 @@ class FakeCluster:
         req = self._pod_tpu_request(pod)
         if req <= 0:
             return None
+        if _is_gated_unbound(pod):
+            # gang-queued pods exist without holding chips; the slice
+            # scheduler enforced the workload-level quota reservation
+            # at admission time
+            return None
         quotas = self.api.list("ResourceQuota", namespace=ns)
         if not quotas:
             return None
         # one namespace-wide sum per admission, shared by every quota —
         # not per quota (the O(N²) re-list pattern _sched_used exists
         # to avoid)
-        used = sum(
-            self._pod_tpu_request(p)
-            for p in self.api.list("Pod", namespace=ns)
-            if obj_util.get_path(p, "status", "phase")
-            not in ("Succeeded", "Failed")
-        )
+        used = self._tpu_used_in_namespace(ns)
         for quota in quotas:
             hard = obj_util.get_path(quota, "spec", "hard", default={}) or {}
             cap = hard.get(f"requests.{TPU_RESOURCE}", hard.get(TPU_RESOURCE))
@@ -153,11 +170,31 @@ class FakeCluster:
         return None
 
     def _pod_tpu_request(self, pod: Obj) -> float:
-        total = 0.0
-        for c in obj_util.get_path(pod, "spec", "containers", default=[]) or []:
-            limits = obj_util.get_path(c, "resources", "limits", default={}) or {}
-            total += obj_util.parse_quantity(limits.get(TPU_RESOURCE, 0))
-        return total
+        return pod_tpu_chips(pod)
+
+    def _tpu_used_in_namespace(self, ns: str) -> float:
+        """Chips a namespace holds against its quota: non-gang active
+        pods count per-pod; gang (workload-labelled) pods count through
+        their Workload's ADMISSION instead — an admitted gang owns its
+        whole reservation even while its pods are still gated, so a
+        foreign pod can never slip into chips the scheduler promised
+        away."""
+        used = sum(
+            self._pod_tpu_request(p)
+            for p in self.api.list("Pod", namespace=ns)
+            if obj_util.get_path(p, "status", "phase")
+            not in ("Succeeded", "Failed")
+            and WORKLOAD_LABEL not in obj_util.labels_of(p)
+        )
+        try:
+            for wl in self.api.list("Workload", namespace=ns):
+                if obj_util.get_path(wl, "status", "state") == "Admitted":
+                    used += float(
+                        obj_util.get_path(wl, "spec", "chips", default=0) or 0
+                    )
+        except NotFound:
+            pass  # scheduling subsystem not installed
+        return used
 
     def _node_fits(
         self,
@@ -210,6 +247,121 @@ class FakeCluster:
                 return name
         return None
 
+    def _unschedulable_reason(self, pod: Obj) -> tuple[str, str]:
+        """Human-readable why-not: selector mismatch (the accelerator/
+        topology is not in the cluster) is a different story from
+        matching nodes that are simply full."""
+        selector = obj_util.get_path(pod, "spec", "nodeSelector", default={}) or {}
+        matching = [
+            n
+            for n in self.api.list("Node")
+            if all(
+                obj_util.labels_of(n).get(k) == v for k, v in selector.items()
+            )
+        ]
+        if not matching:
+            return (
+                "Unschedulable",
+                f"no node matches nodeSelector {selector or '{}'}",
+            )
+        want = self._pod_tpu_request(pod)
+        return (
+            "Unschedulable",
+            f"insufficient {TPU_RESOURCE}: need {int(want)} chip(s), no "
+            f"matching node has enough free capacity",
+        )
+
+    # -- gang binding (slice scheduler integration) -------------------------
+
+    def _mark_gated(self, pod: Obj, workload_name: str) -> None:
+        """Real-cluster semantics for scheduling gates: the pod stays
+        Pending with PodScheduled=False/SchedulingGated and no
+        FailedScheduling event (it is not a scheduling failure — it is
+        a queue)."""
+        pod.setdefault("status", {})
+        pod["status"]["phase"] = "Pending"
+        pod["status"]["conditions"] = [
+            {
+                "type": "PodScheduled",
+                "status": "False",
+                "reason": "SchedulingGated",
+                "message": (
+                    f"waiting for gang admission of workload "
+                    f"{workload_name}"
+                ),
+            }
+        ]
+        self.api.update_status(pod)
+
+    def _bind_gang(self, pod: Obj, workload_name: str) -> bool:
+        """Bind ALL pods of the gang to the scheduler's assignment, or
+        none. True only when the whole gang is bound (this pod
+        included): the full member set must exist, every assigned node
+        must still exist with enough free chips, and only then do the
+        nodeName writes happen — a half-alive slice is never
+        observable."""
+        ns = obj_util.namespace_of(pod)
+        try:
+            wl = self.api.get("Workload", workload_name, ns)
+        except NotFound:
+            return False
+        if obj_util.get_path(wl, "status", "state") != "Admitted":
+            return False
+        hosts = int(obj_util.get_path(wl, "spec", "hosts", default=0) or 0)
+        nodes = (
+            obj_util.get_path(
+                wl, "status", "assignment", "nodes", default=[]
+            )
+            or []
+        )
+        if not hosts or len(nodes) != hosts:
+            return False
+        members = [
+            p
+            for p in self.api.list(
+                "Pod",
+                namespace=ns,
+                label_selector={"matchLabels": {WORKLOAD_LABEL: workload_name}},
+            )
+            if obj_util.get_path(p, "status", "phase")
+            not in ("Succeeded", "Failed")
+        ]
+        by_ordinal: dict[int, Obj] = {}
+        for p in members:
+            try:
+                by_ordinal[int(obj_util.labels_of(p).get(ORDINAL_LABEL, ""))] = p
+            except ValueError:
+                return False
+        if set(by_ordinal) != set(range(hosts)):
+            return False  # gang not fully materialised yet
+        used = self._sched_used
+        if used is None:
+            used = self._build_used_by_node()
+        plan: list[tuple[Obj, str, float]] = []
+        for ordinal in range(hosts):
+            member = by_ordinal[ordinal]
+            node_name = nodes[ordinal]
+            if obj_util.get_path(member, "spec", "nodeName"):
+                continue  # already bound (re-sync after partial pass)
+            try:
+                node = self.api.get("Node", node_name)
+            except NotFound:
+                return False
+            want = self._pod_tpu_request(member)
+            alloc = obj_util.parse_quantity(
+                obj_util.get_path(
+                    node, "status", "allocatable", TPU_RESOURCE, default=0
+                )
+            )
+            if want and used.get(node_name, 0.0) + want > alloc:
+                return False
+            plan.append((member, node_name, want))
+        for member, node_name, want in plan:
+            member["spec"]["nodeName"] = node_name
+            self.api.update(member)
+            used[node_name] = used.get(node_name, 0.0) + want
+        return True
+
     # -- pod lifecycle ------------------------------------------------------
 
     def _make_pod(
@@ -250,36 +402,48 @@ class FakeCluster:
         return pod
 
     def _sync_pod_status(self, pod: Obj) -> None:
-        """Drive Pending→Running once scheduled; mark unschedulable."""
+        """Drive Pending→Running once scheduled; mark unschedulable.
+        Admission-gated pods never reach the per-pod scheduler: they
+        wait for their Workload's admission and then bind as a gang."""
         phase = obj_util.get_path(pod, "status", "phase")
         if phase in ("Succeeded", "Failed"):
             return
         node = obj_util.get_path(pod, "spec", "nodeName")
         if not node:
-            target = self._schedule(pod)
-            if target is None:
-                pod.setdefault("status", {})
-                pod["status"]["phase"] = "Pending"
-                pod["status"]["conditions"] = [
-                    {
-                        "type": "PodScheduled",
-                        "status": "False",
-                        "reason": "Unschedulable",
-                        "message": f"no node fits: insufficient {TPU_RESOURCE} "
-                        "or nodeSelector mismatch",
-                    }
-                ]
-                self.api.update_status(pod)
-                self.api.emit_event(
-                    pod,
-                    "FailedScheduling",
-                    "no node matches TPU nodeSelector/capacity",
-                    event_type="Warning",
-                    component="default-scheduler",
+            gate = obj_util.annotations_of(pod).get(ADMISSION_GATE_ANNOTATION)
+            if gate:
+                if not self._bind_gang(pod, gate):
+                    self._mark_gated(pod, gate)
+                    return
+                pod = self.api.get(
+                    "Pod", obj_util.name_of(pod), obj_util.namespace_of(pod)
                 )
-                return
-            pod["spec"]["nodeName"] = target
-            pod = self.api.update(pod)
+                node = obj_util.get_path(pod, "spec", "nodeName")
+            else:
+                target = self._schedule(pod)
+                if target is None:
+                    reason, message = self._unschedulable_reason(pod)
+                    pod.setdefault("status", {})
+                    pod["status"]["phase"] = "Pending"
+                    pod["status"]["conditions"] = [
+                        {
+                            "type": "PodScheduled",
+                            "status": "False",
+                            "reason": reason,
+                            "message": message,
+                        }
+                    ]
+                    self.api.update_status(pod)
+                    self.api.emit_event(
+                        pod,
+                        "FailedScheduling",
+                        message,
+                        event_type="Warning",
+                        component="default-scheduler",
+                    )
+                    return
+                pod["spec"]["nodeName"] = target
+                pod = self.api.update(pod)
         containers = obj_util.get_path(pod, "spec", "containers", default=[]) or []
         pod.setdefault("status", {})
         pod["status"].update(
@@ -411,6 +575,38 @@ class FakeCluster:
         )
         self.api.update_status(deploy)
 
+    # -- quota status mirroring ---------------------------------------------
+
+    def _mirror_quota_status(self) -> None:
+        """Write ``status.used`` onto every TPU-capped ResourceQuota
+        from the scheduler ledger (the real resource-quota controller's
+        job — without it ``kubectl describe quota`` and the spawner UI
+        show hard caps with no usage). Only the TPU keys the ledger
+        tracks are mirrored; gated-unbound pods hold no chips."""
+        for quota in self.api.list("ResourceQuota"):
+            hard = obj_util.get_path(quota, "spec", "hard", default={}) or {}
+            tpu_keys = [
+                k
+                for k in (f"requests.{TPU_RESOURCE}", TPU_RESOURCE)
+                if k in hard
+            ]
+            if not tpu_keys:
+                continue
+            used = int(
+                self._tpu_used_in_namespace(obj_util.namespace_of(quota))
+            )
+            # merge — only the TPU keys are ledger-tracked here; any
+            # other capped resource keeps whatever status it has
+            status = quota.setdefault("status", {})
+            hard_status = dict(status.get("hard") or {})
+            used_status = dict(status.get("used") or {})
+            for k in tpu_keys:
+                hard_status[k] = str(hard[k])
+                used_status[k] = str(used)
+            status["hard"] = hard_status
+            status["used"] = used_status
+            self.api.update_status(quota)  # no-op writes are suppressed
+
     def step(self) -> None:
         """One full sync pass over all StatefulSets and Deployments."""
         self._sched_used = self._build_used_by_node()
@@ -421,3 +617,4 @@ class FakeCluster:
                 self._sync_deployment(deploy)
         finally:
             self._sched_used = None
+        self._mirror_quota_status()

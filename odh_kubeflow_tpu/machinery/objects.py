@@ -84,6 +84,22 @@ def set_annotation(obj: Obj, key: str, value: str) -> None:
     meta(obj).setdefault("annotations", {})[key] = value
 
 
+def parse_rfc3339(s: str) -> float:
+    """RFC3339 → epoch seconds; fractional seconds dropped, malformed
+    or empty input parses as 0.0 (the epoch — i.e. 'very old')."""
+    import calendar
+    import time as _time
+
+    try:
+        return calendar.timegm(
+            _time.strptime(
+                s.split(".")[0].rstrip("Z") + "Z", "%Y-%m-%dT%H:%M:%SZ"
+            )
+        )
+    except (ValueError, AttributeError):
+        return 0.0
+
+
 def now_rfc3339() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 

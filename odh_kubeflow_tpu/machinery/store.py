@@ -127,6 +127,7 @@ BUILTIN_KINDS: list[tuple[str, str, str, bool]] = [
         False,
     ),
     ("coordination.k8s.io/v1", "Lease", "leases", True),
+    ("scheduling.k8s.io/v1", "PriorityClass", "priorityclasses", False),
 ]
 
 

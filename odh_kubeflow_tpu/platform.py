@@ -41,6 +41,8 @@ from odh_kubeflow_tpu.controllers.tensorboard import TensorboardController
 from odh_kubeflow_tpu.machinery import httpapi
 from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
 from odh_kubeflow_tpu.machinery.store import APIServer
+from odh_kubeflow_tpu.scheduling import register_scheduling
+from odh_kubeflow_tpu.scheduling.scheduler import SliceScheduler
 from odh_kubeflow_tpu.utils import prometheus
 from odh_kubeflow_tpu.web.dashboard import DashboardApp
 from odh_kubeflow_tpu.web.jwa import JupyterWebApp
@@ -94,6 +96,7 @@ class Platform:
     ):
         self.api = APIServer()
         register_crds(self.api)
+        register_scheduling(self.api)
         install_default_cluster_roles(self.api)
         PodDefaultWebhook(self.api).register()
         NotebookWebhook(self.api).register()
@@ -118,6 +121,16 @@ class Platform:
             culler=self.culler if self.nb_config.enable_culling else None,
         )
         self.notebook_controller.register(self.manager)
+        # gang admission for TPU slices (scheduling/): the notebook
+        # controller only creates Workloads when queueing is on, and
+        # without a scheduler they would pend forever
+        self.scheduler = (
+            SliceScheduler(self.api, registry=self.metrics_registry)
+            if self.nb_config.enable_queueing
+            else None
+        )
+        if self.scheduler is not None:
+            self.scheduler.register(self.manager)
         self.profile_controller = ProfileController(self.api)
         self.profile_controller.register(self.manager)
         self.tensorboard_controller = TensorboardController(self.api)

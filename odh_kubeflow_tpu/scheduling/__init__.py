@@ -1,0 +1,56 @@
+"""TPU slice queueing: a mini-Kueue for gang-scheduled pod slices.
+
+The reference platform places pods one at a time through the default
+scheduler and rejects over-quota creates outright (FailedCreate, no
+queue). TPU pod slices break both assumptions: a 4x4 multi-host slice
+bound 2-of-4 strands chips forever (jax.distributed needs every worker
+present), and interactive users expect a queue position, not an error
+(NotebookOS, arXiv 2503.20591; gang placement: Podracer, arXiv
+2104.06272). This package adds the missing subsystem:
+
+- ``workload``  — derive a gang ``Workload`` object (host count, chip
+  count, accelerator/topology selector, priority) from a Notebook's
+  StatefulSet shape;
+- ``queue``     — per-profile chip-quota pools (fed by the existing
+  ``kf-resource-quota`` objects) + a cluster-wide slice inventory
+  snapshotted from Nodes;
+- ``scheduler`` — the admission cycle: all-or-nothing topology-aware
+  fit, priority preemption, requeue with backoff.
+
+The contract with the rest of the platform:
+
+- the notebook controller creates one Workload per TPU notebook and
+  stamps the pod template with ``ADMISSION_GATE_ANNOTATION``;
+- the kubelet sim honors the gate: gated pods stay Pending
+  (``SchedulingGated``) until their Workload is admitted, then the
+  whole gang binds to the scheduler's node assignment atomically —
+  all pods or none;
+- ``web/jwa`` surfaces queue position and the pending reason.
+"""
+
+from typing import Any
+
+GROUP = "scheduling.kubeflow.org"
+WORKLOAD_API_VERSION = f"{GROUP}/v1alpha1"
+
+# pod-template annotation naming the Workload that must be admitted
+# before the pod may schedule (the kubelet sim honors it the way the
+# real cluster honors spec.schedulingGates + Kueue's ungating webhook)
+ADMISSION_GATE_ANNOTATION = f"{GROUP}/admission-gate"
+
+# pod label grouping the members of one gang (ordinal label
+# apps.kubernetes.io/pod-index maps each member to its assigned node)
+WORKLOAD_LABEL = f"{GROUP}/workload"
+
+# Notebook annotation selecting a PriorityClass (scheduling.k8s.io/v1)
+PRIORITY_CLASS_ANNOTATION = "notebooks.kubeflow.org/priority-class"
+
+# Workload status states
+STATE_PENDING = "Pending"
+STATE_ADMITTED = "Admitted"
+
+
+def register_scheduling(api: Any) -> None:
+    """Register the Workload kind on an APIServer-shaped api (embedded
+    store or RemoteAPIServer — both expose ``register_kind``)."""
+    api.register_kind(WORKLOAD_API_VERSION, "Workload", "workloads", True)

@@ -1,0 +1,229 @@
+"""Quota pools and slice inventory — the scheduler's world model.
+
+Two resources bound a Workload's admission:
+
+- **chip quota** (``QuotaSnapshot``): per-profile-namespace hard caps
+  read from the same ``kf-resource-quota`` ResourceQuota objects the
+  profile controller writes (``requests.google.com/tpu``). Admission is
+  charged at the *workload* level — an admitted gang holds its chips
+  whether or not its pods have materialised yet, which is what makes
+  the quota a reservation rather than a race.
+- **slice inventory** (``SliceInventory``): the cluster's TPU node
+  pools snapshotted from Nodes. A pool == one physical slice (the GKE
+  ``gke-nodepool`` label): same accelerator type, same topology, one
+  node per TPU host. Topology-aware fit means a gang's hosts must land
+  in ONE pool whose accelerator+topology labels match the workload's
+  selector — chips free across two half-empty slices are not a fit.
+
+Both are snapshots: the scheduler rebuilds them at the top of every
+admission cycle and charges them as it admits, so a cycle is a pure
+function of cluster state (same inputs → same admissions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from odh_kubeflow_tpu.apis import TPU_RESOURCE
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.kubelet import (
+    TPU_ACCEL_LABEL,
+    TPU_TOPO_LABEL,
+)
+from odh_kubeflow_tpu.scheduling import workload as wlutil
+
+Obj = dict[str, Any]
+
+NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+TPU_QUOTA_KEYS = (f"requests.{TPU_RESOURCE}", TPU_RESOURCE)
+
+
+# ---------------------------------------------------------------------------
+# slice inventory
+
+
+@dataclasses.dataclass
+class SlicePool:
+    """One TPU slice: a node pool of identically-labelled hosts."""
+
+    name: str
+    accelerator_type: str
+    topology: str
+    # node name → free chips (allocatable minus charges)
+    free: dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def matches(self, accelerator_type: str, topology: str) -> bool:
+        return (
+            self.accelerator_type == accelerator_type
+            and self.topology == topology
+        )
+
+    def fit_nodes(self, hosts: int, chips_per_host: int) -> Optional[list[str]]:
+        """``hosts`` distinct nodes with ``chips_per_host`` free each,
+        or None. Tightest nodes first (least free chips) so partially
+        used hosts fill up before fresh ones fragment."""
+        candidates = sorted(
+            (free, name)
+            for name, free in self.free.items()
+            if free >= chips_per_host
+        )
+        if len(candidates) < hosts:
+            return None
+        return sorted(name for _, name in candidates[:hosts])
+
+
+class SliceInventory:
+    def __init__(self) -> None:
+        self.pools: dict[str, SlicePool] = {}
+        self._node_pool: dict[str, str] = {}  # node name → pool name
+
+    @classmethod
+    def snapshot(cls, api: Any) -> "SliceInventory":
+        inv = cls()
+        for node in api.list("Node"):
+            labels = obj_util.labels_of(node)
+            accel = labels.get(TPU_ACCEL_LABEL)
+            if not accel:
+                continue
+            capacity = int(
+                obj_util.parse_quantity(
+                    obj_util.get_path(
+                        node, "status", "allocatable", TPU_RESOURCE, default=0
+                    )
+                )
+            )
+            if capacity <= 0:
+                continue
+            name = obj_util.name_of(node)
+            pool_name = labels.get(NODEPOOL_LABEL, name)
+            pool = inv.pools.get(pool_name)
+            if pool is None:
+                pool = inv.pools[pool_name] = SlicePool(
+                    pool_name, accel, labels.get(TPU_TOPO_LABEL, "")
+                )
+            pool.free[name] = capacity
+            inv._node_pool[name] = pool_name
+        return inv
+
+    def has_node(self, node: str) -> bool:
+        return node in self._node_pool
+
+    def charge(self, node: str, chips: int) -> None:
+        pool_name = self._node_pool.get(node)
+        if pool_name is not None:
+            pool = self.pools[pool_name]
+            pool.free[node] = pool.free.get(node, 0) - chips
+
+    def release(self, node: str, chips: int) -> None:
+        self.charge(node, -chips)
+
+    def charge_workload(self, wl: Obj) -> None:
+        chips = wlutil.chips_per_host_of(wl)
+        for node in wlutil.assigned_nodes(wl):
+            self.charge(node, chips)
+
+    def release_workload(self, wl: Obj) -> None:
+        chips = wlutil.chips_per_host_of(wl)
+        for node in wlutil.assigned_nodes(wl):
+            self.release(node, chips)
+
+    def fit(
+        self,
+        accelerator_type: str,
+        topology: str,
+        hosts: int,
+        chips_per_host: int,
+    ) -> Optional[tuple[str, list[str]]]:
+        """All-or-nothing topology-aware fit: ``hosts`` nodes in ONE
+        matching pool, or None. Best-fit across pools (fewest total
+        free chips first) keeps big contiguous slices available for
+        big gangs."""
+        best: Optional[tuple[int, str, list[str]]] = None
+        for pool in self.pools.values():
+            if not pool.matches(accelerator_type, topology):
+                continue
+            nodes = pool.fit_nodes(hosts, chips_per_host)
+            if nodes is None:
+                continue
+            slack = sum(pool.free.values())
+            if best is None or (slack, pool.name) < (best[0], best[1]):
+                best = (slack, pool.name, nodes)
+        if best is None:
+            return None
+        return best[1], best[2]
+
+    def capacity_exists(self, accelerator_type: str, topology: str) -> bool:
+        """Whether ANY matching pool exists at all — distinguishes
+        "queue behind other workloads" from "this topology is not in
+        the cluster" for the unschedulable message."""
+        return any(
+            p.matches(accelerator_type, topology) for p in self.pools.values()
+        )
+
+
+# ---------------------------------------------------------------------------
+# quota pools
+
+
+class QuotaSnapshot:
+    """Per-namespace TPU chip caps + charged usage. The cap is the
+    tightest hard value across the namespace's quotas that name a TPU
+    key (the same rule the admission controller applies); namespaces
+    with no TPU-capped quota are unlimited."""
+
+    def __init__(self) -> None:
+        self.hard: dict[str, int] = {}
+        self.used: dict[str, int] = {}
+
+    @classmethod
+    def snapshot(cls, api: Any) -> "QuotaSnapshot":
+        snap = cls()
+        for quota in api.list("ResourceQuota"):
+            ns = obj_util.namespace_of(quota)
+            hard = obj_util.get_path(quota, "spec", "hard", default={}) or {}
+            for key in TPU_QUOTA_KEYS:
+                if key in hard:
+                    cap = int(obj_util.parse_quantity(hard[key]))
+                    if ns not in snap.hard or cap < snap.hard[ns]:
+                        snap.hard[ns] = cap
+                    break
+        return snap
+
+    def cap(self, namespace: str) -> Optional[int]:
+        return self.hard.get(namespace)
+
+    def headroom(self, namespace: str) -> Optional[int]:
+        cap = self.hard.get(namespace)
+        if cap is None:
+            return None
+        return cap - self.used.get(namespace, 0)
+
+    def fits(self, namespace: str, chips: int) -> bool:
+        head = self.headroom(namespace)
+        return head is None or chips <= head
+
+    def charge(self, namespace: str, chips: int) -> None:
+        self.used[namespace] = self.used.get(namespace, 0) + chips
+
+    def release(self, namespace: str, chips: int) -> None:
+        self.charge(namespace, -chips)
+
+
+# ---------------------------------------------------------------------------
+# queue ordering
+
+
+def pending_order(workloads: list[Obj]) -> list[Obj]:
+    """Strict admission order: priority desc, then age (creation
+    timestamp asc — FIFO within a priority band), then name for a
+    total, deterministic order."""
+    return sorted(
+        workloads,
+        key=lambda w: (
+            -wlutil.priority_of(w),
+            obj_util.meta(w).get("creationTimestamp", ""),
+            obj_util.namespace_of(w),
+            obj_util.name_of(w),
+        ),
+    )

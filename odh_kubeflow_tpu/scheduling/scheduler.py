@@ -1,0 +1,683 @@
+"""The admission cycle: all-or-nothing gang admission with priority
+preemption over the quota pools + slice inventory.
+
+Kueue-shaped semantics, sized for this platform:
+
+- **gang admission** — a Workload is admitted only when chip quota AND
+  a topology-matching slice with enough free hosts exist for the whole
+  gang; the node assignment is decided here, atomically, and recorded
+  on the Workload so the kubelet sim binds all hosts or none.
+- **strict priority order** — pending Workloads are scanned priority
+  desc / age asc; when one cannot be admitted, every lower-priority
+  workload contending for the same pool (same profile-namespace quota
+  or same accelerator/topology flavor) is blocked behind it. No queue
+  jumping.
+- **preemption** — a starved higher-priority workload evicts the
+  minimal set of lower-priority admitted workloads (lowest priority
+  first, newest admission first) whose release lets it fit. Eviction
+  is gang-atomic: every pod of the victim is deleted and the victim
+  requeues.
+- **requeue with backoff** — unschedulable workloads retry on an
+  exponential backoff (and on any Workload/Node/Pod/quota change,
+  since every watch event re-triggers the cycle).
+
+The cycle is a pure function of cluster state: snapshot, charge
+admitted, scan pending, write statuses. Re-running it with no state
+change writes nothing (the store suppresses no-op updates), which is
+what lets the level-triggered runtime quiesce.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+from odh_kubeflow_tpu.apis import pod_tpu_chips
+from odh_kubeflow_tpu.controllers.runtime import Manager, Request, Result
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.events import EventRecorder
+from odh_kubeflow_tpu.machinery.store import Conflict, NotFound
+from odh_kubeflow_tpu.scheduling import (
+    STATE_ADMITTED,
+    STATE_PENDING,
+    WORKLOAD_LABEL,
+)
+from odh_kubeflow_tpu.scheduling import workload as wlutil
+from odh_kubeflow_tpu.scheduling.queue import (
+    QuotaSnapshot,
+    SliceInventory,
+    pending_order,
+)
+from odh_kubeflow_tpu.utils import prometheus
+
+Obj = dict[str, Any]
+
+COMPONENT = "tpu-slice-scheduler"
+
+# admission waits span sub-second (sim) to hours (a v5p pool drain)
+_WAIT_BUCKETS = (
+    0.01, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 900.0, 3600.0, 14400.0,
+)
+_BACKOFF_BASE = 0.5
+_BACKOFF_CAP = 30.0
+
+
+class SliceScheduler:
+    def __init__(
+        self,
+        api: Any,
+        registry: Optional[prometheus.Registry] = None,
+        time_fn: Callable[[], float] = time.time,
+    ):
+        self.api = api
+        self.now = time_fn
+        self.recorder = EventRecorder(api, COMPONENT)
+        reg = registry or prometheus.default_registry
+        self.m_pending = reg.gauge(
+            "pending_workloads",
+            "Workloads queued and not yet admitted, per quota pool",
+            labelnames=("queue",),
+        )
+        self.m_attempts = reg.counter(
+            "admission_attempts_total",
+            "Workload admission attempts by result",
+            labelnames=("result",),
+        )
+        self.m_wait = reg.histogram(
+            "admission_wait_seconds",
+            "Time from workload queued to admitted",
+            buckets=_WAIT_BUCKETS,
+        )
+        self.m_preemptions = reg.counter(
+            "workload_preemptions_total",
+            "Admitted workloads evicted, by cause",
+            labelnames=("reason",),
+        )
+        # per-workload failed-admission streak (in memory: backoff is
+        # scheduler-local state, not API truth — a restarted scheduler
+        # retrying immediately is correct, not a bug)
+        self._attempts: dict[tuple[str, str], int] = {}
+        self._known_queues: set[str] = set()
+
+    # -- wiring -------------------------------------------------------------
+
+    def register(self, mgr: Manager) -> None:
+        """Any Workload / Node / gang-Pod / quota change re-triggers an
+        admission cycle; the cycle itself is global (ordering across
+        workloads is the whole point), so every event maps to one
+        reconcile of the full queue."""
+        ctrl = mgr.new_controller("tpu-scheduler", "Workload", self.reconcile)
+        ctrl.watches("Node", self._map_cycle)
+        ctrl.watches("ResourceQuota", self._map_cycle)
+        ctrl.watches("Pod", self._map_cycle, predicate=self._pod_is_relevant)
+
+    @staticmethod
+    def _pod_is_relevant(_etype: str, pod: Obj) -> bool:
+        """Gang pods, and ANY pod holding TPU chips — a non-gang pod
+        binding onto reserved capacity must wake the cycle so the
+        colliding reservation re-places instead of wedging."""
+        return (
+            WORKLOAD_LABEL in obj_util.labels_of(pod)
+            or pod_tpu_chips(pod) > 0
+        )
+
+    def _map_cycle(self, _etype: str, _obj: Obj) -> list[Request]:
+        return [Request("", "admission-cycle")]
+
+    def reconcile(self, _req: Request) -> Result:
+        return self.run_cycle()
+
+    # -- the cycle ----------------------------------------------------------
+
+    def run_cycle(self) -> Result:
+        inventory = SliceInventory.snapshot(self.api)
+        quotas = QuotaSnapshot.snapshot(self.api)
+        workloads = self.api.list("Workload")
+
+        admitted: list[Obj] = []
+        pending: list[Obj] = []
+        for wl in workloads:
+            if wlutil.is_admitted(wl) and not self._assignment_lost(
+                wl, inventory
+            ):
+                admitted.append(wl)
+            elif wlutil.is_admitted(wl):
+                # gang atomicity under node loss: one lost host
+                # invalidates the whole slice — evict every pod and
+                # requeue the gang, never leave a partial binding. A
+                # spec edit under an old assignment reads differently
+                # from an actual node loss.
+                lost = [
+                    n
+                    for n in wlutil.assigned_nodes(wl)
+                    if not inventory.has_node(n)
+                ]
+                if lost:
+                    reason, metric_reason = "NodeLost", "node_lost"
+                    message = (
+                        f"assigned TPU host(s) {', '.join(lost)} lost; "
+                        "gang requeued"
+                    )
+                else:
+                    reason, metric_reason = (
+                        "AssignmentInvalid",
+                        "assignment_invalid",
+                    )
+                    message = (
+                        "slice assignment no longer matches the workload "
+                        "spec; gang requeued"
+                    )
+                self._evict(
+                    wl,
+                    reason=reason,
+                    message=message,
+                    metric_reason=metric_reason,
+                )
+                pending.append(wl)
+            else:
+                pending.append(wl)
+
+        # charge what's already admitted (workload-level reservation)…
+        for wl in admitted:
+            inventory.charge_workload(wl)
+            quotas.charge(obj_util.namespace_of(wl), wlutil.chips_of(wl))
+        # …and TPU pods outside the workload system (legacy / direct
+        # creations): bound pods hold real chips the fit must respect
+        self._charge_foreign_pods(inventory, quotas)
+
+        # a foreign pod that bound onto reserved capacity over-commits
+        # the node and would wedge the gang in SchedulingGated forever
+        # (the kubelet refuses a bind that doesn't fit); evict the
+        # colliding reservation so it re-admits somewhere that fits
+        for wl in self._overcommitted_victims(admitted, inventory):
+            self._evict(
+                wl,
+                reason="AssignmentInvalid",
+                message=(
+                    "assigned host capacity taken by pods outside the "
+                    "gang; requeuing for a fresh placement"
+                ),
+                metric_reason="assignment_invalid",
+            )
+            admitted.remove(wl)
+            inventory.release_workload(wl)
+            quotas.release(obj_util.namespace_of(wl), wlutil.chips_of(wl))
+            pending.append(wl)
+
+        # strict priority scan with head-of-line blocking per pool
+        blocked_ns: set[str] = set()
+        blocked_flavor: set[tuple[str, str]] = set()
+        pending_counts: dict[str, int] = {}
+        any_unadmitted = False
+        position = 0
+        for wl in pending_order(pending):
+            ns = obj_util.namespace_of(wl)
+            key = (ns, obj_util.name_of(wl))
+            flavor = (
+                obj_util.get_path(wl, "spec", "acceleratorType", default=""),
+                obj_util.get_path(wl, "spec", "topology", default=""),
+            )
+            outcome = self._try_admit(
+                wl,
+                inventory,
+                quotas,
+                admitted,
+                blocked=(ns in blocked_ns or flavor in blocked_flavor),
+            )
+            if outcome is None:  # admitted — wl's status was written in place
+                self._attempts.pop(key, None)
+                admitted.append(wl)
+                continue
+            reason, message = outcome
+            any_unadmitted = True
+            position += 1  # place among workloads still waiting
+            pending_counts[ns] = pending_counts.get(ns, 0) + 1
+            self._attempts[key] = self._attempts.get(key, 0) + 1
+            # head-of-line: everything lower-priority contending for
+            # this workload's pools queues behind it
+            if quotas.cap(ns) is not None:
+                blocked_ns.add(ns)
+            blocked_flavor.add(flavor)
+            self._write_pending(wl, reason, message, position)
+
+        self._gc_attempts(workloads)
+        for queue in self._known_queues | set(pending_counts):
+            self.m_pending.set(
+                pending_counts.get(queue, 0), labels={"queue": queue}
+            )
+        self._known_queues |= set(pending_counts)
+
+        if any_unadmitted:
+            streak = max(self._attempts.values(), default=1)
+            return Result(
+                requeue_after=min(
+                    _BACKOFF_BASE * (2 ** min(streak - 1, 8)), _BACKOFF_CAP
+                )
+            )
+        return Result()
+
+    # -- admission ----------------------------------------------------------
+
+    def _try_admit(
+        self,
+        wl: Obj,
+        inventory: SliceInventory,
+        quotas: QuotaSnapshot,
+        admitted: list[Obj],
+        blocked: bool,
+    ) -> Optional[tuple[str, str]]:
+        """Admit ``wl`` (returns None) or return the (reason, message)
+        it stays pending with."""
+        ns = obj_util.namespace_of(wl)
+        spec = wl.get("spec") or {}
+        accel = spec.get("acceleratorType", "")
+        topo = spec.get("topology", "")
+        hosts = wlutil.hosts_of(wl)
+        chips_per_host = wlutil.chips_per_host_of(wl)
+        chips = wlutil.chips_of(wl)
+
+        if blocked:
+            self.m_attempts.inc({"result": "blocked"})
+            return (
+                "Blocked",
+                "queued behind a higher-priority workload contending "
+                "for the same pool",
+            )
+
+        quota_ok = quotas.fits(ns, chips)
+        fit = (
+            inventory.fit(accel, topo, hosts, chips_per_host)
+            if quota_ok
+            else None
+        )
+        if not quota_ok or fit is None:
+            victims = self._plan_preemption(
+                wl, inventory, quotas, admitted
+            )
+            if victims is not None:
+                for victim in victims:
+                    self._evict(
+                        victim,
+                        reason="Preempted",
+                        message=(
+                            f"preempted by higher-priority workload "
+                            f"{ns}/{obj_util.name_of(wl)}"
+                        ),
+                        metric_reason="priority",
+                    )
+                    admitted.remove(victim)
+                quota_ok = quotas.fits(ns, chips)
+                fit = inventory.fit(accel, topo, hosts, chips_per_host)
+
+        if not quota_ok:
+            cap = quotas.cap(ns)
+            used = quotas.used.get(ns, 0)
+            self.m_attempts.inc({"result": "quota_exhausted"})
+            return (
+                "QuotaExhausted",
+                f"quota exhausted in {ns}: requests.google.com/tpu "
+                f"used {used}, hard {cap}, need {chips}",
+            )
+        if fit is None:
+            self.m_attempts.inc({"result": "unschedulable"})
+            if not inventory.capacity_exists(accel, topo):
+                return (
+                    "NoMatchingSlice",
+                    f"no node pool with accelerator {accel} topology "
+                    f"{topo} in the cluster",
+                )
+            return (
+                "SliceBusy",
+                f"no {accel}/{topo} slice with {hosts} free host(s) "
+                f"({chips_per_host} chips each)",
+            )
+
+        pool, nodes = fit
+        self._admit(wl, pool, nodes, inventory, quotas)
+        return None
+
+    def _admit(
+        self,
+        wl: Obj,
+        pool: str,
+        nodes: list[str],
+        inventory: SliceInventory,
+        quotas: QuotaSnapshot,
+    ) -> None:
+        ns = obj_util.namespace_of(wl)
+        chips_per_host = wlutil.chips_per_host_of(wl)
+        for node in nodes:
+            inventory.charge(node, chips_per_host)
+        quotas.charge(ns, wlutil.chips_of(wl))
+        queued_at = obj_util.get_path(
+            wl, "status", "queuedAt", default=""
+        ) or obj_util.meta(wl).get("creationTimestamp", "")
+        now = self.now()
+        wait = max(now - obj_util.parse_rfc3339(queued_at), 0.0) if queued_at else 0.0
+        wl.setdefault("status", {})
+        wl["status"].update(
+            {
+                "state": STATE_ADMITTED,
+                "reason": "Admitted",
+                "message": f"admitted to slice {pool}",
+                "assignment": {"pool": pool, "nodes": list(nodes)},
+                "admittedAt": obj_util.now_rfc3339(),
+                "queuedAt": queued_at,
+                "position": 0,
+            }
+        )
+        if self._write_status(wl):
+            self.m_wait.observe(wait)
+            self.m_attempts.inc({"result": "admitted"})
+            self._record(
+                wl,
+                "Normal",
+                "Admitted",
+                f"workload admitted to slice {pool} "
+                f"(hosts: {', '.join(nodes)})",
+            )
+
+    # -- preemption ---------------------------------------------------------
+
+    def _plan_preemption(
+        self,
+        wl: Obj,
+        inventory: SliceInventory,
+        quotas: QuotaSnapshot,
+        admitted: list[Obj],
+    ) -> Optional[list[Obj]]:
+        """The minimal victim prefix whose release admits ``wl``, or
+        None (in which case all trial releases are rolled back).
+        Victims: strictly lower priority, contending on quota (same
+        namespace) or capacity (assigned pool matches the selector);
+        cheapest first — lowest priority, then youngest admission."""
+        ns = obj_util.namespace_of(wl)
+        spec = wl.get("spec") or {}
+        accel = spec.get("acceleratorType", "")
+        topo = spec.get("topology", "")
+        my_priority = wlutil.priority_of(wl)
+
+        def contends(victim: Obj) -> bool:
+            if obj_util.namespace_of(victim) == ns and quotas.cap(ns) is not None:
+                return True
+            pool_name = obj_util.get_path(
+                victim, "status", "assignment", "pool", default=""
+            )
+            pool = inventory.pools.get(pool_name)
+            return pool is not None and pool.matches(accel, topo)
+
+        # cheapest victims first: lowest priority, then the most
+        # recently admitted (loses the least running work)
+        candidates = sorted(
+            (
+                v
+                for v in admitted
+                if wlutil.priority_of(v) < my_priority and contends(v)
+            ),
+            key=lambda v: (
+                wlutil.priority_of(v),
+                -obj_util.parse_rfc3339(
+                    obj_util.get_path(v, "status", "admittedAt", default="")
+                ),
+            ),
+        )
+        if not candidates:
+            return None
+        hosts = wlutil.hosts_of(wl)
+        chips_per_host = wlutil.chips_per_host_of(wl)
+
+        def release(victim: Obj) -> None:
+            inventory.release_workload(victim)
+            quotas.release(
+                obj_util.namespace_of(victim), wlutil.chips_of(victim)
+            )
+
+        def charge(victim: Obj) -> None:
+            inventory.charge_workload(victim)
+            quotas.charge(
+                obj_util.namespace_of(victim), wlutil.chips_of(victim)
+            )
+
+        def admits() -> bool:
+            return bool(
+                quotas.fits(ns, wlutil.chips_of(wl))
+                and inventory.fit(accel, topo, hosts, chips_per_host)
+            )
+
+        chosen: list[Obj] = []
+        for victim in candidates:
+            release(victim)
+            chosen.append(victim)
+            if admits():
+                break
+        else:
+            # no combination admits wl — roll every trial release back
+            for victim in chosen:
+                charge(victim)
+            return None
+        # prune: a greedy victim whose release turned out not to matter
+        # (e.g. it freed pool capacity when quota was the real blocker)
+        # must not lose its pods — keep only victims the fit depends on
+        for victim in list(chosen):
+            charge(victim)
+            if admits():
+                chosen.remove(victim)
+            else:
+                release(victim)
+        return chosen
+
+    # -- eviction -----------------------------------------------------------
+
+    def _evict(
+        self, wl: Obj, reason: str, message: str, metric_reason: str
+    ) -> None:
+        """Gang-atomic teardown: every pod of the gang goes, the
+        workload requeues Pending. Chips release implicitly — the next
+        snapshot no longer charges this workload."""
+        ns = obj_util.namespace_of(wl)
+        name = obj_util.name_of(wl)
+        for pod in self.api.list(
+            "Pod",
+            namespace=ns,
+            label_selector={"matchLabels": {WORKLOAD_LABEL: name}},
+        ):
+            try:
+                self.api.delete("Pod", obj_util.name_of(pod), ns)
+            except NotFound:
+                pass
+        wl.setdefault("status", {})
+        wl["status"].update(
+            {
+                "state": STATE_PENDING,
+                "reason": reason,
+                "message": message,
+                "assignment": None,
+                "admittedAt": None,
+                "queuedAt": obj_util.now_rfc3339(),
+            }
+        )
+        if self._write_status(wl):
+            self.m_preemptions.inc({"reason": metric_reason})
+            self._record(wl, "Warning", reason, message)
+        self._attempts[(ns, name)] = self._attempts.get((ns, name), 0) + 1
+
+    def _overcommitted_victims(
+        self, admitted: list[Obj], inventory: SliceInventory
+    ) -> list[Obj]:
+        """Workloads to evict because a node they reserved went
+        negative after real (non-gang) pod usage was charged — the
+        kubelet would refuse their gang bind forever. Newest admission
+        yields first: it lost the race to pods already on the node;
+        fully-bound gangs physically hold their chips, so a collision
+        can only involve a reservation whose members aren't all bound."""
+        deficit = {
+            node: -free
+            for pool in inventory.pools.values()
+            for node, free in pool.free.items()
+            if free < 0
+        }
+        if not deficit:
+            return []
+        victims: list[Obj] = []
+        for wl in sorted(
+            admitted,
+            key=lambda w: obj_util.get_path(
+                w, "status", "admittedAt", default=""
+            ),
+            reverse=True,
+        ):
+            if not deficit:
+                break
+            overlapping = set(wlutil.assigned_nodes(wl)) & set(deficit)
+            if not overlapping:
+                continue
+            victims.append(wl)
+            chips = wlutil.chips_per_host_of(wl)
+            for node in overlapping:
+                deficit[node] -= chips
+                if deficit[node] <= 0:
+                    del deficit[node]
+        return victims
+
+    def _assignment_lost(self, wl: Obj, inventory: SliceInventory) -> bool:
+        nodes = wlutil.assigned_nodes(wl)
+        if len(nodes) != wlutil.hosts_of(wl):
+            return True  # spec changed under an old assignment
+        if any(not inventory.has_node(n) for n in nodes):
+            return True
+        # a topology/accelerator edit invalidates the old placement
+        pool = inventory.pools.get(
+            obj_util.get_path(wl, "status", "assignment", "pool", default="")
+        )
+        spec = wl.get("spec") or {}
+        return pool is None or not pool.matches(
+            spec.get("acceleratorType", ""), spec.get("topology", "")
+        )
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _charge_foreign_pods(
+        self, inventory: SliceInventory, quotas: QuotaSnapshot
+    ) -> None:
+        """Non-gang TPU pods charge QUOTA for their whole active life
+        (ResourceQuota charges at creation — the kubelet ledger counts
+        them bound or not, and admission must agree or it overshoots
+        the cap) but charge INVENTORY only once bound to a node."""
+        for pod in self.api.list("Pod"):
+            if WORKLOAD_LABEL in obj_util.labels_of(pod):
+                continue  # gang pods are charged via their Workload
+            if obj_util.get_path(pod, "status", "phase") in (
+                "Succeeded",
+                "Failed",
+            ):
+                continue
+            chips = int(pod_tpu_chips(pod))
+            if not chips:
+                continue
+            quotas.charge(obj_util.namespace_of(pod), chips)
+            node = obj_util.get_path(pod, "spec", "nodeName")
+            if node:
+                inventory.charge(node, chips)
+
+    def _write_pending(
+        self, wl: Obj, reason: str, message: str, position: int
+    ) -> None:
+        first_time = not wlutil.state_of(wl)
+        # snapshot before update: wl["status"] is the same dict the
+        # update mutates, so comparing through it afterwards would
+        # always see "unchanged"
+        prev = dict(wl.get("status") or {})
+        wl.setdefault("status", {})
+        wl["status"].update(
+            {
+                "state": STATE_PENDING,
+                "reason": reason,
+                "message": message,
+                "position": position,
+                "queuedAt": prev.get("queuedAt") or obj_util.now_rfc3339(),
+                "assignment": None,
+            }
+        )
+        changed = self._write_status(wl)
+        if first_time:
+            self._record(
+                wl,
+                "Normal",
+                "Queued",
+                f"workload queued at position {position}: {message}",
+            )
+        if (
+            reason != "Blocked"
+            and (
+                first_time
+                or (
+                    changed
+                    and (
+                        prev.get("reason") != reason
+                        or prev.get("message") != message
+                    )
+                )
+            )
+        ):
+            # the human-readable unschedulable reason — quota exhausted
+            # vs no node with the topology — not a generic failure
+            self._record(wl, "Warning", "FailedScheduling", message)
+
+    def _write_status(self, wl: Obj) -> bool:
+        """update_status, reporting whether anything actually changed
+        (the store suppresses no-op writes — reuse its verdict via
+        resourceVersion). Conflicts are fine: the next cycle rewrites
+        from fresh state."""
+        try:
+            before = obj_util.meta(wl).get("resourceVersion")
+            updated = self.api.update_status(wl)
+            after = updated["metadata"]["resourceVersion"]
+            obj_util.meta(wl)["resourceVersion"] = after
+            return before != after
+        except (Conflict, NotFound):
+            return False
+
+    def _record(
+        self, wl: Obj, event_type: str, reason: str, message: str
+    ) -> None:
+        """Events land on the Notebook (what users describe/watch) and
+        the Workload both; the recorder dedupes repeats into count
+        bumps."""
+        emit = (
+            self.recorder.warning
+            if event_type == "Warning"
+            else self.recorder.normal
+        )
+        emit(wl, reason, message)
+        try:
+            notebook = self.api.get(
+                "Notebook", obj_util.name_of(wl), obj_util.namespace_of(wl)
+            )
+        except NotFound:
+            return
+        emit(notebook, reason, message)
+
+    def _gc_attempts(self, workloads: list[Obj]) -> None:
+        live = {
+            (obj_util.namespace_of(w), obj_util.name_of(w)) for w in workloads
+        }
+        for key in list(self._attempts):
+            if key not in live:
+                del self._attempts[key]
+
+
+def main() -> None:
+    """Split-process entrypoint (manifests/notebook-controller): attach
+    to $KUBE_API_URL and run admission cycles forever."""
+    from odh_kubeflow_tpu.machinery.runner import run_controller
+    from odh_kubeflow_tpu.scheduling import register_scheduling
+
+    def register(api, mgr):
+        register_scheduling(api)
+        SliceScheduler(api, registry=mgr.metrics_registry).register(mgr)
+
+    run_controller("tpu-scheduler", register)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""Workload derivation: StatefulSet shape → gang Workload object.
+
+A Workload is the unit of admission — the whole slice, never a pod. It
+is derived from the exact StatefulSet the notebook controller generates
+(replicas == hosts, per-host ``google.com/tpu`` limits, accelerator +
+topology nodeSelector), so admission and placement always agree with
+what the workload controller will actually create.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from odh_kubeflow_tpu.apis import (
+    TPU_ACCEL_NODE_LABEL,
+    TPU_TOPO_NODE_LABEL,
+    pod_spec_tpu_chips,
+)
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.store import NotFound
+from odh_kubeflow_tpu.scheduling import (
+    PRIORITY_CLASS_ANNOTATION,
+    STATE_ADMITTED,
+    WORKLOAD_API_VERSION,
+    WORKLOAD_LABEL,
+)
+
+Obj = dict[str, Any]
+
+
+def resolve_priority(api: Any, notebook: Obj) -> tuple[int, str, bool]:
+    """PriorityClass semantics (scheduling.k8s.io/v1): the Notebook's
+    ``PRIORITY_CLASS_ANNOTATION`` names a cluster-scoped PriorityClass
+    whose integer ``value`` orders the queue. No annotation → the
+    cluster's ``globalDefault`` class if one exists, else 0. Returns
+    ``(priority, class_name, resolved)`` — an unknown class name comes
+    back as (0, name, False) so the caller can surface the warning
+    without a second lookup."""
+    name = obj_util.annotations_of(notebook).get(PRIORITY_CLASS_ANNOTATION, "")
+    if not name:
+        try:
+            for pc in api.list("PriorityClass"):
+                if pc.get("globalDefault"):
+                    return int(pc.get("value", 0)), obj_util.name_of(pc), True
+        except NotFound:
+            pass
+        return 0, "", True
+    try:
+        pc = api.get("PriorityClass", name)
+    except NotFound:
+        return 0, name, False
+    return int(pc.get("value", 0)), name, True
+
+
+def workload_from_statefulset(
+    sts: Obj, *, priority: int = 0, priority_class: str = ""
+) -> Optional[Obj]:
+    """Derive the gang Workload from a generated StatefulSet: host
+    count from replicas, chips-per-host from the container's
+    ``google.com/tpu`` limit, the accelerator/topology selector from
+    the pod template's nodeSelector. Returns None when the shape is not
+    a TPU gang (no accelerator selector or no chip limit) or the
+    StatefulSet is scaled to zero (stopped — nothing to admit)."""
+    pod_spec = (
+        obj_util.get_path(sts, "spec", "template", "spec", default={}) or {}
+    )
+    selector = pod_spec.get("nodeSelector") or {}
+    accel = selector.get(TPU_ACCEL_NODE_LABEL, "")
+    topology = selector.get(TPU_TOPO_NODE_LABEL, "")
+    chips_per_host = int(pod_spec_tpu_chips(pod_spec))
+    hosts = int(obj_util.get_path(sts, "spec", "replicas", default=0) or 0)
+    if not accel or chips_per_host <= 0 or hosts <= 0:
+        return None
+    name = obj_util.name_of(sts)
+    return {
+        "apiVersion": WORKLOAD_API_VERSION,
+        "kind": "Workload",
+        "metadata": {
+            "name": name,
+            "namespace": obj_util.namespace_of(sts),
+            "labels": {WORKLOAD_LABEL: name},
+        },
+        "spec": {
+            "hosts": hosts,
+            "chipsPerHost": chips_per_host,
+            "chips": hosts * chips_per_host,
+            "acceleratorType": accel,
+            "topology": topology,
+            "priority": priority,
+            "priorityClassName": priority_class,
+            # the quota pool this workload draws from — one per profile
+            # namespace, matching kf-resource-quota's scope
+            "queue": obj_util.namespace_of(sts),
+        },
+    }
+
+
+# -- status accessors (the scheduler and every integration read these) ------
+
+
+def state_of(wl: Obj) -> str:
+    return obj_util.get_path(wl, "status", "state", default="") or ""
+
+
+def is_admitted(wl: Obj) -> bool:
+    return state_of(wl) == STATE_ADMITTED
+
+
+def assigned_nodes(wl: Obj) -> list[str]:
+    return list(
+        obj_util.get_path(wl, "status", "assignment", "nodes", default=[]) or []
+    )
+
+
+def hosts_of(wl: Obj) -> int:
+    return int(obj_util.get_path(wl, "spec", "hosts", default=0) or 0)
+
+
+def chips_per_host_of(wl: Obj) -> int:
+    return int(obj_util.get_path(wl, "spec", "chipsPerHost", default=0) or 0)
+
+
+def chips_of(wl: Obj) -> int:
+    return int(obj_util.get_path(wl, "spec", "chips", default=0) or 0)
+
+
+def priority_of(wl: Obj) -> int:
+    return int(obj_util.get_path(wl, "spec", "priority", default=0) or 0)

@@ -201,6 +201,23 @@ class JupyterWebApp(CrudBackend):
             user_of(request)
             return success({"tpus": self.available_tpus()})
 
+        @app.route("/api/namespaces/<namespace>/tpus")
+        def get_namespace_tpus(request, namespace):
+            """The spawner's namespaced view: accelerator inventory plus
+            the profile's chip quota (used/hard, mirrored onto the
+            ResourceQuota status by the scheduler ledger) so the form
+            can show 'TPU chips: 8 of 16 used' before the user picks a
+            topology."""
+            self.authorize(
+                request, "list", "resourcequotas", namespace
+            )
+            return success(
+                {
+                    "tpus": self.available_tpus(),
+                    "quota": self.tpu_quota(namespace),
+                }
+            )
+
         @app.route("/api/namespaces/<namespace>/notebooks")
         def list_notebooks(request, namespace):
             self.authorize(request, "list", "notebooks", namespace, "kubeflow.org")
@@ -292,6 +309,7 @@ class JupyterWebApp(CrudBackend):
                     ],
                     "pods": pods,
                     "annotations": obj_util.annotations_of(nb),
+                    "workload": self._workload_row(nb),
                 }
             })
 
@@ -401,6 +419,60 @@ class JupyterWebApp(CrudBackend):
                     }
                 )
         return out
+
+    def tpu_quota(self, namespace: str) -> Optional[Obj]:
+        """used/hard TPU chips for the namespace's quota, or None when
+        the profile is unlimited. Prefers the mirrored status (live
+        ledger); falls back to spec.hard with used=0 before the first
+        kubelet sync."""
+        for quota in self.api.list("ResourceQuota", namespace=namespace):
+            for key in (f"requests.{TPU_RESOURCE}", TPU_RESOURCE):
+                hard = obj_util.get_path(
+                    quota, "status", "hard", key,
+                    default=obj_util.get_path(quota, "spec", "hard", key),
+                )
+                if hard is None:
+                    continue
+                used = obj_util.get_path(
+                    quota, "status", "used", key, default="0"
+                )
+                return {
+                    "resource": key,
+                    "hard": str(hard),
+                    "used": str(used),
+                }
+        return None
+
+    def _workload_of(self, nb: Obj) -> Optional[Obj]:
+        try:
+            return self.api.get(
+                "Workload", obj_util.name_of(nb), obj_util.namespace_of(nb)
+            )
+        except NotFound:  # no workload, or scheduling not installed
+            return None
+
+    def _workload_row(self, nb: Obj) -> Optional[Obj]:
+        """The detail page's admission block: lifecycle timestamps feed
+        the spawn-latency breakdown (queue wait vs scheduling vs
+        container start)."""
+        wl = self._workload_of(nb)
+        if wl is None:
+            return None
+        status = wl.get("status") or {}
+        spec = wl.get("spec") or {}
+        return {
+            "state": status.get("state", "Pending"),
+            "position": status.get("position", 0),
+            "reason": status.get("reason", ""),
+            "message": status.get("message", ""),
+            "queuedAt": status.get("queuedAt", ""),
+            "admittedAt": status.get("admittedAt", ""),
+            "assignment": status.get("assignment"),
+            "priority": spec.get("priority", 0),
+            "priorityClassName": spec.get("priorityClassName", ""),
+            "hosts": spec.get("hosts", 0),
+            "chips": spec.get("chips", 0),
+        }
 
     # -- form → Notebook (form.py:17-252) ------------------------------------
 
@@ -605,6 +677,23 @@ class JupyterWebApp(CrudBackend):
         ready = obj_util.get_path(nb, "status", "readyReplicas", default=0)
         if ready and ready > 0:
             return {"phase": "ready", "message": "Running"}
+        wl = self._workload_of(nb)
+        if wl is not None and obj_util.get_path(
+            wl, "status", "state", default=""
+        ) not in ("", "Admitted"):
+            # queued, not broken: position + the human-readable reason
+            # (quota exhausted vs no matching slice vs behind a
+            # higher-priority workload)
+            position = obj_util.get_path(wl, "status", "position", default=0)
+            reason = obj_util.get_path(
+                wl, "status", "message",
+                default=obj_util.get_path(wl, "status", "reason", default=""),
+            )
+            return {
+                "phase": "waiting",
+                "message": f"Queued (position {position}): {reason}",
+                "queuePosition": position,
+            }
         error_event = self._find_error_event(nb)
         if error_event:
             return {"phase": "warning", "message": error_event}

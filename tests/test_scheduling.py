@@ -1,0 +1,869 @@
+"""TPU slice queueing: gang admission, priority preemption, quota pools.
+
+Drives the scheduling/ subsystem end-to-end against the embedded
+apiserver + kubelet sim: Workload derivation, all-or-nothing admission
+with topology-aware fit, strict priority order (no queue jumping),
+preemption, gang atomicity under node loss, the quota status mirror,
+the JWA queue surface, the culler's queue-wait guard — plus a
+property-style randomized sequence asserting the two system invariants
+(no partially-bound gang is ever observable; a higher-priority pending
+workload is admitted before any lower-priority one contending for the
+same pool).
+"""
+
+import random
+
+import pytest
+
+from odh_kubeflow_tpu.apis import (
+    LAST_ACTIVITY_ANNOTATION,
+    STOP_ANNOTATION,
+    TPU_ACCELERATOR_ANNOTATION,
+    TPU_TOPOLOGY_ANNOTATION,
+    register_crds,
+)
+from odh_kubeflow_tpu.controllers.culler import Culler, CullerConfig
+from odh_kubeflow_tpu.controllers.notebook import (
+    NotebookController,
+    NotebookControllerConfig,
+)
+from odh_kubeflow_tpu.controllers.runtime import Manager
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
+from odh_kubeflow_tpu.machinery.store import APIServer, NotFound
+from odh_kubeflow_tpu.scheduling import (
+    PRIORITY_CLASS_ANNOTATION,
+    WORKLOAD_LABEL,
+    register_scheduling,
+)
+from odh_kubeflow_tpu.scheduling.scheduler import SliceScheduler
+from odh_kubeflow_tpu.scheduling.workload import workload_from_statefulset
+from odh_kubeflow_tpu.utils.prometheus import Registry, lint_metric_names
+from odh_kubeflow_tpu.web.jwa import JupyterWebApp
+
+V5E = "tpu-v5-lite-podslice"
+V5P = "tpu-v5p-slice"
+
+
+def make_env(quota_chips=None, culling=False):
+    api = APIServer()
+    register_crds(api)
+    register_scheduling(api)
+    cluster = FakeCluster(api)
+    mgr = Manager(api)
+    registry = Registry()
+    culler = (
+        Culler(
+            api,
+            CullerConfig(cull_idle_seconds=3600.0, idleness_check_seconds=0.0),
+            base_url_fn=lambda nb: "http://127.0.0.1:9/unreachable",
+        )
+        if culling
+        else None
+    )
+    ctrl = NotebookController(
+        api,
+        NotebookControllerConfig(enable_queueing=True, enable_culling=culling),
+        registry=registry,
+        culler=culler,
+    )
+    ctrl.register(mgr)
+    scheduler = SliceScheduler(api, registry=registry)
+    scheduler.register(mgr)
+    for name, value, default in (
+        ("tpu-interactive", 1000, False),
+        ("tpu-batch", -100, False),
+    ):
+        api.create(
+            {
+                "apiVersion": "scheduling.k8s.io/v1",
+                "kind": "PriorityClass",
+                "metadata": {"name": name},
+                "value": value,
+                "globalDefault": default,
+            }
+        )
+    if quota_chips is not None:
+        api.create(
+            {
+                "apiVersion": "v1",
+                "kind": "ResourceQuota",
+                "metadata": {"name": "kf-resource-quota", "namespace": "team-a"},
+                "spec": {"hard": {"requests.google.com/tpu": str(quota_chips)}},
+            }
+        )
+    return api, cluster, mgr, registry, scheduler, culler
+
+
+def notebook(name, accel=V5E, topo="2x2", priority_class=None, ns="team-a"):
+    ann = {
+        TPU_ACCELERATOR_ANNOTATION: accel,
+        TPU_TOPOLOGY_ANNOTATION: topo,
+    }
+    if priority_class:
+        ann[PRIORITY_CLASS_ANNOTATION] = priority_class
+    return {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns, "annotations": ann},
+        "spec": {
+            "template": {
+                "spec": {"containers": [{"name": name, "image": "jax:latest"}]}
+            }
+        },
+    }
+
+
+def quiesce(cluster, mgr, rounds=3):
+    for _ in range(rounds):
+        cluster.step()
+        mgr.drain()
+
+
+def workload_state(api, name, ns="team-a"):
+    wl = api.get("Workload", name, ns)
+    return wl.get("status", {}).get("state", "")
+
+
+def bound_active_pods(api, name, ns="team-a"):
+    return [
+        p
+        for p in api.list(
+            "Pod", namespace=ns,
+            label_selector={"matchLabels": {WORKLOAD_LABEL: name}},
+        )
+        if obj_util.get_path(p, "spec", "nodeName")
+        and obj_util.get_path(p, "status", "phase")
+        not in ("Succeeded", "Failed")
+    ]
+
+
+# ---------------------------------------------------------------------------
+# workload derivation
+
+
+def test_workload_derived_from_statefulset_shape():
+    api, cluster, mgr, _, _, _ = make_env()
+    ctrl = NotebookController(
+        api, NotebookControllerConfig(enable_queueing=True), registry=Registry()
+    )
+    nb = notebook("big", accel=V5P, topo="2x2x2")
+    from odh_kubeflow_tpu.controllers.notebook import tpu_request_of
+
+    sts = ctrl.generate_statefulset(nb, tpu_request_of(nb))
+    wl = workload_from_statefulset(sts, priority=7, priority_class="x")
+    assert wl["spec"] == {
+        "hosts": 2,
+        "chipsPerHost": 4,
+        "chips": 8,
+        "acceleratorType": V5P,
+        "topology": "2x2x2",
+        "priority": 7,
+        "priorityClassName": "x",
+        "queue": "team-a",
+    }
+    # stopped notebook → replicas 0 → nothing to admit
+    nb_stopped = notebook("big", accel=V5P, topo="2x2x2")
+    nb_stopped["metadata"]["annotations"][STOP_ANNOTATION] = "t"
+    sts0 = ctrl.generate_statefulset(nb_stopped, tpu_request_of(nb_stopped))
+    assert workload_from_statefulset(sts0) is None
+    # non-TPU shape → no workload
+    plain = {"kind": "StatefulSet", "metadata": {"name": "p", "namespace": "n"},
+             "spec": {"replicas": 1, "template": {"spec": {"containers": []}}}}
+    assert workload_from_statefulset(plain) is None
+
+
+# ---------------------------------------------------------------------------
+# gang admission
+
+
+def test_gang_admission_is_all_or_nothing():
+    """A 2-host gang with only 1 host of capacity binds NOTHING; adding
+    the second host admits and binds the whole gang at once."""
+    api, cluster, mgr, _, _, _ = make_env()
+    cluster.add_tpu_node_pool("v5p", V5P, "2x2x2", num_hosts=1, chips_per_host=4)
+    api.create(notebook("big", accel=V5P, topo="2x2x2"))
+    quiesce(cluster, mgr)
+
+    assert workload_state(api, "big") == "Pending"
+    assert bound_active_pods(api, "big") == []
+    pods = api.list("Pod", namespace="team-a")
+    assert len(pods) == 2  # gang pods exist, gated
+    for p in pods:
+        cond = p["status"]["conditions"][0]
+        assert (cond["reason"], cond["status"]) == ("SchedulingGated", "False")
+
+    # second host appears (same nodepool labels) → whole gang admits
+    cluster.add_node(
+        "v5p-1",
+        labels={
+            "cloud.google.com/gke-tpu-accelerator": V5P,
+            "cloud.google.com/gke-tpu-topology": "2x2x2",
+            "cloud.google.com/gke-nodepool": "v5p",
+        },
+        extra_capacity={"google.com/tpu": "4"},
+    )
+    quiesce(cluster, mgr)
+    assert workload_state(api, "big") == "Admitted"
+    bound = bound_active_pods(api, "big")
+    assert len(bound) == 2
+    assert {p["status"]["phase"] for p in bound} == {"Running"}
+    # ordinal i → assignment node i
+    wl = api.get("Workload", "big", "team-a")
+    nodes = wl["status"]["assignment"]["nodes"]
+    for p in bound:
+        ordinal = int(p["metadata"]["labels"]["apps.kubernetes.io/pod-index"])
+        assert p["spec"]["nodeName"] == nodes[ordinal]
+
+
+def test_topology_aware_fit_rejects_split_across_pools():
+    """Two half-slices are not a slice: 1 free host in each of two
+    2-host pools must NOT admit a 2-host gang."""
+    api, cluster, mgr, _, _, _ = make_env()
+    cluster.add_tpu_node_pool("pa", V5P, "2x2x2", num_hosts=2, chips_per_host=4)
+    cluster.add_tpu_node_pool("pb", V5P, "2x2x2", num_hosts=2, chips_per_host=4)
+    # occupy one host in each pool with single-host foreign pods
+    for i, node in enumerate(["pa-0", "pb-0"]):
+        api.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": f"squat-{i}", "namespace": "team-a"},
+                "spec": {
+                    "nodeName": node,
+                    "containers": [
+                        {"name": "c", "resources": {"limits": {"google.com/tpu": "4"}}}
+                    ],
+                },
+            }
+        )
+    api.create(notebook("big", accel=V5P, topo="2x2x2"))
+    quiesce(cluster, mgr)
+    wl = api.get("Workload", "big", "team-a")
+    assert wl["status"]["state"] == "Pending"
+    assert wl["status"]["reason"] == "SliceBusy"
+    assert bound_active_pods(api, "big") == []
+
+
+# ---------------------------------------------------------------------------
+# quota pools
+
+
+def test_quota_queueing_and_release():
+    api, cluster, mgr, _, _, _ = make_env(quota_chips=4)
+    cluster.add_tpu_node_pool("a", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    cluster.add_tpu_node_pool("b", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    api.create(notebook("first"))
+    quiesce(cluster, mgr)
+    assert workload_state(api, "first") == "Admitted"
+
+    api.create(notebook("second"))
+    quiesce(cluster, mgr)
+    wl = api.get("Workload", "second", "team-a")
+    assert wl["status"]["state"] == "Pending"
+    assert wl["status"]["reason"] == "QuotaExhausted"
+    assert "used 4, hard 4" in wl["status"]["message"]
+    assert wl["status"]["position"] == 1
+    # capacity was never the problem — pool b is free — quota gates it
+    assert bound_active_pods(api, "second") == []
+
+    events = {
+        e["reason"]
+        for e in api.list("Event", namespace="team-a")
+        if e["involvedObject"]["name"] == "second"
+    }
+    assert "Queued" in events
+    assert "FailedScheduling" in events
+
+    # deleting the first notebook releases its reservation
+    api.delete("Notebook", "first", "team-a")
+    quiesce(cluster, mgr)
+    assert workload_state(api, "second") == "Admitted"
+    assert len(bound_active_pods(api, "second")) == 1
+
+
+def test_stop_annotation_releases_admission():
+    api, cluster, mgr, _, _, _ = make_env(quota_chips=4)
+    cluster.add_tpu_node_pool("a", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    api.create(notebook("first"))
+    api.create(notebook("second"))
+    quiesce(cluster, mgr)
+    states = {n: workload_state(api, n) for n in ("first", "second")}
+    assert sorted(states.values()) == ["Admitted", "Pending"]
+    admitted = next(n for n, s in states.items() if s == "Admitted")
+    waiting = next(n for n, s in states.items() if s == "Pending")
+
+    api.patch(
+        "Notebook",
+        admitted,
+        {"metadata": {"annotations": {STOP_ANNOTATION: "2026-08-03T00:00:00Z"}}},
+        "team-a",
+    )
+    quiesce(cluster, mgr)
+    # the stopped notebook's Workload is gone; the queued one admitted
+    with pytest.raises(NotFound):
+        api.get("Workload", admitted, "team-a")
+    assert workload_state(api, waiting) == "Admitted"
+
+
+# ---------------------------------------------------------------------------
+# priority & preemption
+
+
+def test_priority_preemption_evicts_lowest_newest_first():
+    api, cluster, mgr, _, _, _ = make_env()
+    cluster.add_tpu_node_pool("a", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    api.create(notebook("batch", priority_class="tpu-batch"))
+    quiesce(cluster, mgr)
+    assert workload_state(api, "batch") == "Admitted"
+
+    api.create(notebook("urgent", priority_class="tpu-interactive"))
+    quiesce(cluster, mgr)
+    assert workload_state(api, "urgent") == "Admitted"
+    assert len(bound_active_pods(api, "urgent")) == 1
+    wl = api.get("Workload", "batch", "team-a")
+    assert wl["status"]["state"] == "Pending"
+    assert bound_active_pods(api, "batch") == []
+    events = {
+        e["reason"]
+        for e in api.list("Event", namespace="team-a")
+        if e["involvedObject"]["name"] == "batch"
+    }
+    assert "Preempted" in events
+
+    # the victim re-admits once the urgent workload goes away
+    api.delete("Notebook", "urgent", "team-a")
+    quiesce(cluster, mgr)
+    assert workload_state(api, "batch") == "Admitted"
+
+
+def test_preemption_evicts_only_victims_that_unblock_admission():
+    """A lower-priority gang whose eviction would NOT help (it holds a
+    different pool and a different namespace's quota) keeps its pods;
+    only the victim actually blocking the preemptor is evicted."""
+    api, cluster, mgr, _, _, _ = make_env(quota_chips=4)  # caps team-a only
+    for pool in ("a", "b", "c"):
+        cluster.add_tpu_node_pool(pool, V5E, "2x2", num_hosts=1, chips_per_host=4)
+    api.create(notebook("low"))  # team-a: holds the whole team-a quota
+    api.create(notebook("batch", priority_class="tpu-batch", ns="team-b"))
+    quiesce(cluster, mgr)
+    assert workload_state(api, "low") == "Admitted"
+    assert workload_state(api, "batch", ns="team-b") == "Admitted"
+
+    # urgent (team-a) is quota-blocked; pool c is free, so evicting the
+    # cheapest candidate (batch, priority -100) would change nothing
+    api.create(notebook("urgent", priority_class="tpu-interactive"))
+    quiesce(cluster, mgr)
+    assert workload_state(api, "urgent") == "Admitted"
+    assert workload_state(api, "low") == "Pending"
+    assert workload_state(api, "batch", ns="team-b") == "Admitted"
+    assert len(bound_active_pods(api, "batch", ns="team-b")) == 1
+
+
+def test_equal_priority_never_preempts():
+    api, cluster, mgr, _, _, _ = make_env()
+    cluster.add_tpu_node_pool("a", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    api.create(notebook("one"))
+    quiesce(cluster, mgr)
+    api.create(notebook("two"))
+    quiesce(cluster, mgr)
+    assert workload_state(api, "one") == "Admitted"
+    assert workload_state(api, "two") == "Pending"
+
+
+def test_no_queue_jumping_within_contended_pool():
+    """A blocked higher-priority workload blocks lower-priority ones in
+    the same flavor even when the smaller one would fit right now."""
+    api, cluster, mgr, _, _, _ = make_env()
+    # one 2-host pool, fully held by an interactive workload
+    cluster.add_tpu_node_pool("a", V5P, "2x2x2", num_hosts=2, chips_per_host=4)
+    # plus one spare single-host pool of the SAME flavor topology 2x2x1
+    api.create(notebook("holder", accel=V5P, topo="2x2x2",
+                        priority_class="tpu-interactive"))
+    quiesce(cluster, mgr)
+    assert workload_state(api, "holder") == "Admitted"
+
+    # interactive 2-host gang cannot fit (holder has equal priority —
+    # no preemption) and must not be leapfrogged by the batch one
+    api.create(notebook("starved", accel=V5P, topo="2x2x2",
+                        priority_class="tpu-interactive"))
+    quiesce(cluster, mgr)
+    api.create(notebook("jumper", accel=V5P, topo="2x2x2",
+                        priority_class="tpu-batch"))
+    quiesce(cluster, mgr)
+
+    starved = api.get("Workload", "starved", "team-a")
+    jumper = api.get("Workload", "jumper", "team-a")
+    assert starved["status"]["state"] == "Pending"
+    assert jumper["status"]["state"] == "Pending"
+    assert jumper["status"]["reason"] == "Blocked"
+    assert starved["status"]["position"] < jumper["status"]["position"]
+
+    # holder leaves → strict order: starved (higher priority) admits
+    api.delete("Notebook", "holder", "team-a")
+    quiesce(cluster, mgr)
+    assert workload_state(api, "starved") == "Admitted"
+    assert workload_state(api, "jumper") == "Pending"
+
+
+# ---------------------------------------------------------------------------
+# gang atomicity under node loss (satellite)
+
+
+def test_node_loss_evicts_and_requeues_whole_gang():
+    """FakeCluster.preempt_node on ONE host of an admitted multi-host
+    slice evicts and requeues the WHOLE Workload — at no observable
+    point does a partial gang stay bound."""
+    api, cluster, mgr, _, _, _ = make_env()
+    cluster.add_tpu_node_pool("v5p", V5P, "2x2x2", num_hosts=2, chips_per_host=4)
+    api.create(notebook("big", accel=V5P, topo="2x2x2"))
+    quiesce(cluster, mgr)
+    assert workload_state(api, "big") == "Admitted"
+    assert len(bound_active_pods(api, "big")) == 2
+
+    cluster.preempt_node("v5p-0")
+    mgr.drain()
+    wl = api.get("Workload", "big", "team-a")
+    assert wl["status"]["state"] == "Pending"
+    assert bound_active_pods(api, "big") == []  # survivor evicted too
+    # the eviction is recorded as a NodeLost Warning on the notebook
+    assert any(
+        e["reason"] == "NodeLost"
+        and e["involvedObject"]["kind"] == "Notebook"
+        for e in api.list("Event", namespace="team-a")
+    )
+    quiesce(cluster, mgr)
+    assert bound_active_pods(api, "big") == []  # still nothing partial
+
+    # host returns → the gang re-admits as a unit
+    cluster.add_node(
+        "v5p-0",
+        labels={
+            "cloud.google.com/gke-tpu-accelerator": V5P,
+            "cloud.google.com/gke-tpu-topology": "2x2x2",
+            "cloud.google.com/gke-nodepool": "v5p",
+        },
+        extra_capacity={"google.com/tpu": "4"},
+    )
+    quiesce(cluster, mgr)
+    assert workload_state(api, "big") == "Admitted"
+    assert len(bound_active_pods(api, "big")) == 2
+
+
+def test_foreign_pod_on_reserved_capacity_requeues_the_gang():
+    """A non-gang TPU pod that binds onto an admitted workload's
+    reserved host must not wedge the gang in SchedulingGated: the
+    scheduler detects the over-commit, evicts the reservation, and
+    re-places it once capacity exists."""
+    api, cluster, mgr, _, _, _ = make_env()
+    cluster.add_tpu_node_pool("a", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    api.create(notebook("nb"))
+    mgr.drain()  # admitted; gang pods not yet materialised
+    assert workload_state(api, "nb") == "Admitted"
+
+    # a directly-created pod lands on the reserved host first
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "squatter", "namespace": "team-a"},
+            "spec": {
+                "nodeName": "a-0",
+                "containers": [
+                    {"name": "c", "resources": {"limits": {"google.com/tpu": "4"}}}
+                ],
+            },
+        }
+    )
+    quiesce(cluster, mgr)
+    wl = api.get("Workload", "nb", "team-a")
+    assert wl["status"]["state"] == "Pending"  # not wedged-Admitted
+    assert bound_active_pods(api, "nb") == []
+
+    # the squatter leaves → the gang re-admits and actually runs
+    api.delete("Pod", "squatter", "team-a")
+    quiesce(cluster, mgr)
+    assert workload_state(api, "nb") == "Admitted"
+    bound = bound_active_pods(api, "nb")
+    assert len(bound) == 1 and bound[0]["status"]["phase"] == "Running"
+
+
+def test_admitted_reservation_counts_against_pod_level_quota():
+    """An admitted gang owns its chips even while its pods are still
+    gated: a non-gang pod trying to ride the gap is denied by the
+    ResourceQuota backstop, so the namespace can never exceed hard."""
+    api, cluster, mgr, _, _, _ = make_env(quota_chips=4)
+    cluster.add_tpu_node_pool("a", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    cluster.add_tpu_node_pool("b", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    api.create(notebook("nb"))
+    mgr.drain()  # admitted; gang pods not yet materialised
+    assert workload_state(api, "nb") == "Admitted"
+
+    # a legacy Deployment pod asking for the whole quota
+    api.create(
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": "legacy", "namespace": "team-a"},
+            "spec": {
+                "replicas": 1,
+                "template": {
+                    "metadata": {"labels": {"app": "legacy"}},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "resources": {
+                                    "limits": {"google.com/tpu": "4"}
+                                },
+                            }
+                        ]
+                    },
+                },
+            },
+        }
+    )
+    quiesce(cluster, mgr)
+    # the legacy pod was refused (FailedCreate), the gang runs, and the
+    # mirrored usage never exceeds hard
+    assert len(bound_active_pods(api, "nb")) == 1
+    legacy_pods = [
+        p
+        for p in api.list("Pod", namespace="team-a")
+        if obj_util.labels_of(p).get("app") == "legacy"
+    ]
+    assert legacy_pods == []
+    assert any(
+        e["reason"] == "FailedCreate" and "exceeded quota" in e["message"]
+        for e in api.list("Event", namespace="team-a")
+    )
+    quota = api.get("ResourceQuota", "kf-resource-quota", "team-a")
+    assert quota["status"]["used"]["requests.google.com/tpu"] == "4"
+
+
+def test_unbound_foreign_pod_still_counts_against_admission_quota():
+    """A Pending non-gang TPU pod already charged the ResourceQuota at
+    creation; the scheduler's snapshot must agree or admission
+    overshoots the cap."""
+    api, cluster, mgr, _, _, _ = make_env(quota_chips=4)
+    cluster.add_tpu_node_pool("a", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    # unbound foreign pod: unschedulable selector keeps it Pending
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "stuck", "namespace": "team-a"},
+            "spec": {
+                "nodeSelector": {"no-such-label": "x"},
+                "containers": [
+                    {"name": "c", "resources": {"limits": {"google.com/tpu": "4"}}}
+                ],
+            },
+        }
+    )
+    api.create(notebook("nb"))
+    quiesce(cluster, mgr)
+    wl = api.get("Workload", "nb", "team-a")
+    assert wl["status"]["state"] == "Pending"
+    assert wl["status"]["reason"] == "QuotaExhausted"
+    # the stuck pod goes away → the namespace's chips free up
+    api.delete("Pod", "stuck", "team-a")
+    quiesce(cluster, mgr)
+    assert workload_state(api, "nb") == "Admitted"
+
+
+def test_unschedulable_reason_transition_emits_new_event():
+    """SliceBusy → NoMatchingSlice (the pool vanished) is a different
+    story and must surface as a fresh FailedScheduling event."""
+    api, cluster, mgr, _, _, _ = make_env()
+    cluster.add_tpu_node_pool("a", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    api.create(notebook("holder"))
+    quiesce(cluster, mgr)
+    api.create(notebook("waiter"))
+    quiesce(cluster, mgr)
+
+    def reasons(name):
+        return {
+            e["message"]
+            for e in api.list("Event", namespace="team-a")
+            if e["involvedObject"]["name"] == name
+            and e["reason"] == "FailedScheduling"
+        }
+
+    first = reasons("waiter")
+    assert any("slice with 1 free host" in m for m in first), first
+
+    # the whole pool disappears → holder evicts to the queue head and
+    # its unschedulable reason must surface as a fresh event
+    cluster.preempt_node("a-0")
+    quiesce(cluster, mgr)
+    assert any("no node pool with accelerator" in m for m in reasons("holder"))
+    wl = api.get("Workload", "holder", "team-a")
+    assert wl["status"]["reason"] == "NoMatchingSlice"
+
+
+# ---------------------------------------------------------------------------
+# quota status mirror + web surface (satellites)
+
+
+def test_quota_status_used_mirrored_and_surfaced():
+    api, cluster, mgr, _, _, _ = make_env(quota_chips=8)
+    cluster.add_tpu_node_pool("a", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    api.create(notebook("nb"))
+    quiesce(cluster, mgr)
+    quota = api.get("ResourceQuota", "kf-resource-quota", "team-a")
+    assert quota["status"]["used"]["requests.google.com/tpu"] == "4"
+    assert quota["status"]["hard"]["requests.google.com/tpu"] == "8"
+
+    jwa = JupyterWebApp(api)
+    assert jwa.tpu_quota("team-a") == {
+        "resource": "requests.google.com/tpu",
+        "hard": "8",
+        "used": "4",
+    }
+    # unlimited namespace → no quota block
+    assert jwa.tpu_quota("elsewhere") is None
+
+
+def test_jwa_surfaces_queue_position_and_reason():
+    api, cluster, mgr, _, _, _ = make_env(quota_chips=4)
+    cluster.add_tpu_node_pool("a", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    api.create(notebook("first"))
+    api.create(notebook("second"))
+    quiesce(cluster, mgr)
+
+    jwa = JupyterWebApp(api)
+    row = jwa.notebook_row(api.get("Notebook", "second", "team-a"))
+    assert row["status"]["phase"] == "waiting"
+    assert row["status"]["queuePosition"] == 1
+    assert "quota exhausted" in row["status"]["message"]
+    wl_row = jwa._workload_row(api.get("Notebook", "second", "team-a"))
+    assert wl_row["state"] == "Pending"
+    assert wl_row["reason"] == "QuotaExhausted"
+    ready_row = jwa.notebook_row(api.get("Notebook", "first", "team-a"))
+    assert ready_row["status"]["phase"] == "ready"
+    assert jwa._workload_row(api.get("Notebook", "first", "team-a"))[
+        "assignment"
+    ]["nodes"]
+
+
+def test_failedscheduling_reasons_are_specific():
+    """Quota exhaustion and missing topology read differently — the
+    events carry the why, not a generic failure (satellite)."""
+    api, cluster, mgr, _, _, _ = make_env(quota_chips=4)
+    cluster.add_tpu_node_pool("a", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    api.create(notebook("first"))
+    quiesce(cluster, mgr)
+    api.create(notebook("overquota"))
+    # a different (unlimited) namespace asking for a topology the
+    # cluster simply does not have
+    api.create(notebook("notopo", accel=V5P, topo="4x4x4", ns="team-b"))
+    quiesce(cluster, mgr)
+
+    def failed_messages(name, ns):
+        return [
+            e["message"]
+            for e in api.list("Event", namespace=ns)
+            if e["involvedObject"]["name"] == name
+            and e["reason"] == "FailedScheduling"
+        ]
+
+    over = failed_messages("overquota", "team-a")
+    assert over and "quota exhausted" in over[0] and "hard 4" in over[0]
+    missing = failed_messages("notopo", "team-b")
+    assert missing and "no node pool with accelerator" in missing[0]
+    assert "4x4x4" in missing[0]
+
+
+# ---------------------------------------------------------------------------
+# culler guard (satellite)
+
+
+def test_queue_wait_does_not_accrue_idleness():
+    """A notebook that ran, was preempted, and waits in the queue past
+    the cull threshold must NOT be stopped the moment it restarts."""
+    api, cluster, mgr, _, _, culler = make_env(culling=True)
+    clock = {"now": 1_000_000.0}
+    culler.now = lambda: clock["now"]
+    cluster.add_tpu_node_pool("a", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    api.create(notebook("nb"))
+    quiesce(cluster, mgr)
+    assert workload_state(api, "nb") == "Admitted"
+
+    # the slice goes away → gang evicted, notebook queued
+    cluster.preempt_node("a-0")
+    quiesce(cluster, mgr)
+    assert workload_state(api, "nb") == "Pending"
+
+    # queue wait 2× the cull threshold, with periodic culler checks
+    for _ in range(4):
+        clock["now"] += 1800.0
+        mgr.drain()
+        culler.reconcile_notebook(api.get("Notebook", "nb", "team-a"))
+    nb = api.get("Notebook", "nb", "team-a")
+    assert STOP_ANNOTATION not in obj_util.annotations_of(nb)
+    # the guard kept last-activity pinned to 'now' through the wait
+    last = obj_util.annotations_of(nb)[LAST_ACTIVITY_ANNOTATION]
+    from odh_kubeflow_tpu.controllers.culler import _parse_time
+
+    assert clock["now"] - _parse_time(last) < culler.config.cull_idle_seconds
+
+    # capacity returns; the notebook restarts and is not culled
+    cluster.add_node(
+        "a-0",
+        labels={
+            "cloud.google.com/gke-tpu-accelerator": V5E,
+            "cloud.google.com/gke-tpu-topology": "2x2",
+            "cloud.google.com/gke-nodepool": "a",
+        },
+        extra_capacity={"google.com/tpu": "4"},
+    )
+    quiesce(cluster, mgr)
+    clock["now"] += 60.0
+    culler.reconcile_notebook(api.get("Notebook", "nb", "team-a"))
+    nb = api.get("Notebook", "nb", "team-a")
+    assert STOP_ANNOTATION not in obj_util.annotations_of(nb)
+
+
+# ---------------------------------------------------------------------------
+# backoff + metrics
+
+
+def test_unschedulable_requeues_with_growing_backoff():
+    api, cluster, mgr, _, scheduler, _ = make_env()
+    api.create(notebook("starved"))  # no TPU nodes at all
+    mgr.drain()
+    delays = [scheduler.run_cycle().requeue_after for _ in range(4)]
+    assert all(d is not None for d in delays)
+    assert delays == sorted(delays) and delays[-1] > delays[0]
+    # admitted clusters stop requeueing
+    cluster.add_tpu_node_pool("a", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    quiesce(cluster, mgr)
+    assert workload_state(api, "starved") == "Admitted"
+    assert scheduler.run_cycle().requeue_after is None
+
+
+def test_scheduler_metrics_families_and_naming_lint():
+    """Tier-1 guard: the scheduler's metric surface exists and passes
+    the platform's Prometheus naming lint (satellite)."""
+    api, cluster, mgr, registry, _, _ = make_env(quota_chips=4)
+    cluster.add_tpu_node_pool("a", V5E, "2x2", num_hosts=1, chips_per_host=4)
+    api.create(notebook("first"))
+    api.create(notebook("second"))
+    quiesce(cluster, mgr)
+
+    assert lint_metric_names(registry) == []
+    text = registry.exposition()
+    assert 'pending_workloads{queue="team-a"} 1' in text
+    assert 'admission_attempts_total{result="admitted"} 1' in text
+    assert 'admission_attempts_total{result="quota_exhausted"}' in text
+    assert "admission_wait_seconds_count 1" in text
+
+
+# ---------------------------------------------------------------------------
+# the property test (acceptance criterion)
+
+
+def _restore_lost_nodes(api, cluster, want_nodes):
+    for name, labels in want_nodes.items():
+        try:
+            api.get("Node", name)
+        except NotFound:
+            cluster.add_node(
+                name, labels=dict(labels),
+                extra_capacity={"google.com/tpu": "4"},
+            )
+
+
+def test_property_random_admit_preempt_node_loss_sequences():
+    """Across randomized create/delete/preempt/restore sequences driven
+    by FakeCluster.step():
+
+    1. no observable quiesced state shows a partially-bound multi-host
+       gang (bound active pods per workload is 0 or hosts);
+    2. no pending workload outranks an admitted one contending for the
+       same pool (higher priority is admitted first / preempts).
+    """
+    rng = random.Random(20260803)
+    api, cluster, mgr, _, _, _ = make_env(quota_chips=16)
+    pools = {}
+    for pool in ("pa", "pb", "pc"):
+        for node in cluster.add_tpu_node_pool(
+            pool, V5P, "2x2x2", num_hosts=2, chips_per_host=4
+        ):
+            pools[node["metadata"]["name"]] = node["metadata"]["labels"]
+
+    classes = [None, "tpu-batch", "tpu-interactive"]
+    class_value = {None: 0, "tpu-batch": -100, "tpu-interactive": 1000}
+    live: dict[str, int] = {}
+    counter = 0
+
+    def check_invariants():
+        workloads = api.list("Workload")
+        for wl in workloads:
+            name = obj_util.name_of(wl)
+            hosts = wl["spec"]["hosts"]
+            bound = len(bound_active_pods(api, name))
+            assert bound in (0, hosts), (
+                f"partial gang: {name} has {bound}/{hosts} bound"
+            )
+            if wl.get("status", {}).get("state") != "Admitted":
+                assert bound == 0, f"pending workload {name} has bound pods"
+        pending = [
+            w for w in workloads
+            if w.get("status", {}).get("state") != "Admitted"
+        ]
+        admitted = [
+            w for w in workloads
+            if w.get("status", {}).get("state") == "Admitted"
+        ]
+        # uniform shapes + shared quota pool: any admitted lower-priority
+        # workload is preemptible capacity a higher-priority pending one
+        # must have claimed
+        for p in pending:
+            for a in admitted:
+                assert a["spec"]["priority"] >= p["spec"]["priority"], (
+                    f"{obj_util.name_of(a)} (prio {a['spec']['priority']}) "
+                    f"admitted while {obj_util.name_of(p)} "
+                    f"(prio {p['spec']['priority']}) waits"
+                )
+
+    for _ in range(30):
+        op = rng.choice(["create", "create", "delete", "preempt", "restore"])
+        if op == "create" and len(live) < 6:
+            counter += 1
+            name = f"nb{counter}"
+            pclass = rng.choice(classes)
+            api.create(
+                notebook(name, accel=V5P, topo="2x2x2", priority_class=pclass)
+            )
+            live[name] = class_value[pclass]
+        elif op == "delete" and live:
+            name = rng.choice(sorted(live))
+            del live[name]
+            api.delete("Notebook", name, "team-a")
+        elif op == "preempt":
+            existing = [
+                n for n in pools
+                if any(
+                    obj_util.name_of(node) == n for node in api.list("Node")
+                )
+            ]
+            if existing:
+                cluster.preempt_node(rng.choice(existing))
+        elif op == "restore":
+            _restore_lost_nodes(api, cluster, pools)
+        quiesce(cluster, mgr, rounds=3)
+        check_invariants()
+
+    # final: restore everything; every pending workload that fits must
+    # eventually admit, highest priority first
+    _restore_lost_nodes(api, cluster, pools)
+    quiesce(cluster, mgr, rounds=4)
+    check_invariants()
+    admitted_chips = sum(
+        w["spec"]["chips"]
+        for w in api.list("Workload")
+        if w.get("status", {}).get("state") == "Admitted"
+    )
+    assert admitted_chips <= 16  # quota is never oversubscribed
