@@ -1,0 +1,384 @@
+"""Control-plane read-path benchmark: uncached store scans vs the
+informer-backed shared cache (machinery/cache.py), at N notebooks.
+
+Two headline numbers, before/after on the SAME cluster state (an
+all-TPU fleet packed into a few dense team namespaces — the
+multi-tenant shape the ROADMAP targets):
+
+- **reconcile-loop throughput**: full control-plane passes — every
+  Notebook reconciled (steady state: level-triggered no-op passes, the
+  shape every watch event pays) plus the slice scheduler's gang
+  bookkeeping cycle at its event-driven cadence (one per 10 watch
+  deliveries);
+- **JWA namespace list latency**: ``GET /api/namespaces/<ns>/notebooks``
+  through the real WSGI app (authn header → RBAC authorize → list →
+  row/status derivation + error-event mining), p50/p95 across
+  namespaces.
+
+Emits ``BENCH_control_plane.json``; the acceptance gate is ≥3x
+reconcile throughput and ≥2x JWA list p95, with the cached passes'
+deepcopy counts recorded (reads on the cached path are zero-copy; the
+residual copies are the reconciler's own ``mutable()`` working copies).
+
+Run: ``python loadtest/control_plane_bench.py [--notebooks 500]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from odh_kubeflow_tpu.apis import (  # noqa: E402
+    TPU_ACCELERATOR_ANNOTATION,
+    TPU_TOPOLOGY_ANNOTATION,
+    install_default_cluster_roles,
+    register_crds,
+)
+from odh_kubeflow_tpu.controllers.notebook import (
+    NotebookController,
+    NotebookControllerConfig,
+)
+from odh_kubeflow_tpu.controllers.runtime import Request
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.cache import (
+    CachedClient,
+    InformerCache,
+    register_platform_indexers,
+)
+from odh_kubeflow_tpu.machinery.store import APIServer
+from odh_kubeflow_tpu.scheduling import register_scheduling
+from odh_kubeflow_tpu.scheduling.scheduler import SliceScheduler
+from odh_kubeflow_tpu.utils import prometheus
+from odh_kubeflow_tpu.web.jwa import JupyterWebApp
+
+USER = "bench@example.com"
+
+
+def build_cluster(n_notebooks: int, n_namespaces: int) -> APIServer:
+    api = APIServer()
+    register_crds(api)
+    register_scheduling(api)
+    install_default_cluster_roles(api)
+    api.create(
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": "bench-admin"},
+            "subjects": [{"kind": "User", "name": USER}],
+            "roleRef": {"kind": "ClusterRole", "name": "kubeflow-admin"},
+        }
+    )
+    for i in range(8):
+        api.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Node",
+                "metadata": {
+                    "name": f"tpu-node-{i}",
+                    "labels": {
+                        "cloud.google.com/gke-tpu-accelerator": (
+                            "tpu-v5-lite-podslice"
+                        ),
+                        "cloud.google.com/gke-tpu-topology": "1x1",
+                        "cloud.google.com/gke-nodepool": f"pool-{i % 2}",
+                    },
+                },
+                "status": {
+                    "capacity": {"google.com/tpu": "4"},
+                    "allocatable": {"google.com/tpu": "4"},
+                },
+            }
+        )
+    for ns_i in range(n_namespaces):
+        ns = f"team-{ns_i:02d}"
+        api.create(
+            {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": ns}}
+        )
+        api.create(
+            {
+                "apiVersion": "v1",
+                "kind": "ResourceQuota",
+                "metadata": {"name": "kf-resource-quota", "namespace": ns},
+                "spec": {"hard": {"requests.google.com/tpu": "64"}},
+            }
+        )
+    for i in range(n_notebooks):
+        ns = f"team-{i % n_namespaces:02d}"
+        name = f"nb-{i:04d}"
+        annotations = {
+            TPU_ACCELERATOR_ANNOTATION: "tpu-v5-lite-podslice",
+            TPU_TOPOLOGY_ANNOTATION: "1x1",
+        }
+        api.create(
+            {
+                "apiVersion": "kubeflow.org/v1beta1",
+                "kind": "Notebook",
+                "metadata": {
+                    "name": name,
+                    "namespace": ns,
+                    "labels": {"app": name},
+                    "annotations": annotations,
+                },
+                "spec": {
+                    "template": {
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": name,
+                                    "image": "jupyter-jax-tpu:v0.1.0",
+                                    "resources": {
+                                        "requests": {
+                                            "cpu": "0.5",
+                                            "memory": "1Gi",
+                                        }
+                                    },
+                                }
+                            ]
+                        }
+                    }
+                },
+            }
+        )
+    return api
+
+
+def materialize(api: APIServer, controller: NotebookController, ready_pct: float):
+    """First reconcile pass creates STS/Services; then simulate the
+    kubelet: Running pods + readyReplicas for ``ready_pct`` of the
+    fleet, a Warning event trail for the stragglers."""
+    notebooks = api.list("Notebook")
+    for nb in notebooks:
+        controller.reconcile(
+            Request(obj_util.namespace_of(nb), obj_util.name_of(nb))
+        )
+    for i, nb in enumerate(notebooks):
+        name = obj_util.name_of(nb)
+        ns = obj_util.namespace_of(nb)
+        if i % 5 == 0 and ready_pct < 1.0:  # 20% pending
+            sts = api.get("StatefulSet", name, ns)
+            api.emit_event(
+                sts,
+                "FailedCreate",
+                "pod pending: insufficient google.com/tpu",
+                event_type="Warning",
+                component="kubelet-sim",
+            )
+            # the controller mirrors owned-object warnings onto the CR
+            api.emit_event(
+                nb,
+                "FailedCreate",
+                "pod pending: insufficient google.com/tpu",
+                event_type="Warning",
+                component="notebook-controller",
+            )
+            continue
+        api.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": f"{name}-0",
+                    "namespace": ns,
+                    "labels": {"statefulset": name, "notebook-name": name},
+                },
+                "spec": {
+                    "nodeName": f"tpu-node-{i % 8}",
+                    "containers": [
+                        {
+                            "name": name,
+                            "resources": {
+                                "limits": {"google.com/tpu": "4"},
+                                "requests": {"google.com/tpu": "4"},
+                            },
+                        }
+                    ],
+                },
+                "status": {
+                    "phase": "Running",
+                    "conditions": [{"type": "Ready", "status": "True"}],
+                },
+            }
+        )
+        sts = api.get("StatefulSet", name, ns)
+        sts["status"] = {"readyReplicas": 1}
+        api.update_status(sts)
+
+
+def reconcile_pass(api, controller, requests, scheduler=None) -> float:
+    """One control-plane pass: every notebook reconciled, and — at the
+    cadence watch events drive it — the slice scheduler's admission/
+    bookkeeping cycle (its cluster-wide gang accounting is exactly the
+    read path the cache indexes)."""
+    t0 = time.perf_counter()
+    for i, req in enumerate(requests):
+        controller.reconcile(req)
+        if scheduler is not None and i % 10 == 9:
+            scheduler.run_cycle()
+    return time.perf_counter() - t0
+
+
+def jwa_request(app, path: str) -> int:
+    environ = {
+        "REQUEST_METHOD": "GET",
+        "PATH_INFO": path,
+        "QUERY_STRING": "",
+        "SERVER_NAME": "bench",
+        "SERVER_PORT": "80",
+        "wsgi.input": io.BytesIO(b""),
+        "wsgi.url_scheme": "http",
+        "HTTP_KUBEFLOW_USERID": USER,
+    }
+    status_out = {}
+
+    def start_response(status, headers):
+        status_out["status"] = status
+
+    body = b"".join(app(environ, start_response))
+    assert status_out["status"].startswith("200"), (
+        status_out.get("status"),
+        body[:200],
+    )
+    return len(body)
+
+
+def bench_jwa(jwa, namespaces: list[str], rounds: int) -> dict:
+    samples = []
+    for r in range(rounds):
+        for ns in namespaces:
+            t0 = time.perf_counter()
+            jwa_request(jwa.app, f"/api/namespaces/{ns}/notebooks")
+            samples.append((time.perf_counter() - t0) * 1000.0)
+    samples.sort()
+    return {
+        "requests": len(samples),
+        "p50_ms": round(statistics.median(samples), 3),
+        "p95_ms": round(samples[int(len(samples) * 0.95) - 1], 3),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--notebooks", type=int, default=500)
+    parser.add_argument("--namespaces", type=int, default=4)
+    parser.add_argument("--reconcile-passes", type=int, default=3)
+    parser.add_argument("--jwa-rounds", type=int, default=25)
+    parser.add_argument("--out", default="BENCH_control_plane.json")
+    args = parser.parse_args()
+
+    api = build_cluster(args.notebooks, args.namespaces)
+    cfg = NotebookControllerConfig(enable_queueing=False)
+    seed_controller = NotebookController(
+        api, cfg, registry=prometheus.Registry()
+    )
+    materialize(api, seed_controller, ready_pct=0.8)
+
+    requests = [
+        Request(obj_util.namespace_of(nb), obj_util.name_of(nb))
+        for nb in api.list("Notebook")
+    ]
+    namespaces = sorted({r.namespace for r in requests})
+
+    results: dict = {
+        "n_notebooks": args.notebooks,
+        "n_namespaces": args.namespaces,
+    }
+
+    # ---- uncached (direct store reads) ------------------------------------
+    uncached_controller = NotebookController(
+        api, cfg, registry=prometheus.Registry()
+    )
+    uncached_scheduler = SliceScheduler(api, registry=prometheus.Registry())
+    reconcile_pass(  # warmup → steady state
+        api, uncached_controller, requests, uncached_scheduler
+    )
+    copies0 = obj_util.deepcopy_count()
+    elapsed = min(
+        reconcile_pass(api, uncached_controller, requests, uncached_scheduler)
+        for _ in range(args.reconcile_passes)
+    )
+    uncached_rps = len(requests) / elapsed
+    uncached_copies = obj_util.deepcopy_count() - copies0
+
+    jwa_uncached = JupyterWebApp(api)
+    bench_jwa(jwa_uncached, namespaces, 1)  # warmup
+    uncached_jwa = bench_jwa(jwa_uncached, namespaces, args.jwa_rounds)
+
+    # ---- cached (informer-backed shared cache) ----------------------------
+    registry = prometheus.Registry()
+    cache = InformerCache(api, registry=registry)
+    register_platform_indexers(cache)
+    cache.start(live=False)
+    cached_api = CachedClient(api, cache)
+
+    cached_controller = NotebookController(
+        cached_api, cfg, registry=prometheus.Registry()
+    )
+    cached_scheduler = SliceScheduler(
+        cached_api, registry=prometheus.Registry()
+    )
+    reconcile_pass(  # warmup
+        cached_api, cached_controller, requests, cached_scheduler
+    )
+    copies0 = obj_util.deepcopy_count()
+    elapsed = min(
+        reconcile_pass(cached_api, cached_controller, requests, cached_scheduler)
+        for _ in range(args.reconcile_passes)
+    )
+    cached_rps = len(requests) / elapsed
+    cached_copies = obj_util.deepcopy_count() - copies0
+
+    jwa_cached = JupyterWebApp(cached_api)
+    bench_jwa(jwa_cached, namespaces, 1)  # warmup
+    cached_jwa = bench_jwa(jwa_cached, namespaces, args.jwa_rounds)
+
+    results["reconcile"] = {
+        "uncached_per_s": round(uncached_rps, 1),
+        "cached_per_s": round(cached_rps, 1),
+        "speedup": round(cached_rps / uncached_rps, 2),
+        "uncached_deepcopies_per_pass": uncached_copies // args.reconcile_passes,
+        "cached_deepcopies_per_pass": cached_copies // args.reconcile_passes,
+    }
+    results["jwa_list"] = {
+        "uncached": uncached_jwa,
+        "cached": cached_jwa,
+        "speedup_p50": round(
+            uncached_jwa["p50_ms"] / cached_jwa["p50_ms"], 2
+        ),
+        "speedup_p95": round(
+            uncached_jwa["p95_ms"] / cached_jwa["p95_ms"], 2
+        ),
+    }
+    cache.flush_metrics()
+    results["cache_metrics"] = {
+        "hits": {
+            kind: cache.m_hits.value({"kind": kind})
+            for kind in cache.kinds()
+            if cache.m_hits.value({"kind": kind})
+        },
+        "misses": {
+            kind: cache.m_misses.value({"kind": kind})
+            for kind in cache.kinds()
+            if cache.m_misses.value({"kind": kind})
+        },
+    }
+
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps(results, indent=2))
+    gate_reconcile = results["reconcile"]["speedup"]
+    gate_jwa = results["jwa_list"]["speedup_p95"]
+    print(
+        f"\nreconcile speedup: {gate_reconcile}x (gate >= 3x) | "
+        f"JWA list p95 speedup: {gate_jwa}x (gate >= 2x)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -258,6 +258,8 @@ class ExposureController:
         ns = obj_util.namespace_of(notebook)
         if auth:
             try:
+                # existence probes only — served zero-copy by the
+                # informer cache when one fronts the api
                 self.api.get("ServiceAccount", name, ns)
                 self.api.get("Secret", f"{name}-cookie-secret", ns)
                 self.api.get("Secret", f"{name}-tls", ns)
@@ -269,3 +271,26 @@ class ExposureController:
             {"metadata": {"annotations": {LOCK_ANNOTATION: None}}},
             ns,
         )
+
+
+def main() -> None:
+    """Split-process entrypoint: the second operator watching Notebook
+    (manifests/odh-notebook-controller posture), reads fronted by the
+    runner's informer cache."""
+    import os
+
+    from odh_kubeflow_tpu.machinery.runner import run_controller
+
+    run_controller(
+        "exposure-controller",
+        lambda api, mgr: ExposureController(
+            api,
+            platform_namespace=os.environ.get(
+                "PLATFORM_NAMESPACE", "kubeflow"
+            ),
+        ).register(mgr),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -38,7 +38,9 @@ from odh_kubeflow_tpu.apis import (
 from odh_kubeflow_tpu.controllers import reconcilehelper
 from odh_kubeflow_tpu.controllers.runtime import Manager, Request, Result
 from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.cache import list_by_index
 from odh_kubeflow_tpu.machinery.events import EventRecorder
+from odh_kubeflow_tpu.machinery.objects import mutable
 from odh_kubeflow_tpu.machinery.store import APIServer, Conflict, NotFound
 from odh_kubeflow_tpu.scheduling import (
     ADMISSION_GATE_ANNOTATION,
@@ -172,11 +174,19 @@ class NotebookController:
             culler.m_last_cull = self.m_last_cull
 
     def _collect_running(self):
+        # notebook StatefulSets only — the old cluster-wide list copied
+        # every StatefulSet per scrape; the Exists selector filters in
+        # the store before any copy, and through the CachedClient it is
+        # a zero-copy label-index union
         n = 0
-        for sts in self.api.list("StatefulSet"):
+        selector = {
+            "matchExpressions": [
+                {"key": "notebook-name", "operator": "Exists"}
+            ]
+        }
+        for sts in self.api.list("StatefulSet", label_selector=selector):
             if obj_util.get_path(sts, "status", "readyReplicas", default=0):
-                if "notebook-name" in obj_util.labels_of(sts):
-                    n += 1
+                n += 1
         yield "# HELP notebook_running Number of currently running notebooks"
         yield "# TYPE notebook_running gauge"
         yield f"notebook_running {n}"
@@ -198,13 +208,20 @@ class NotebookController:
         name = obj_util.labels_of(pod).get("notebook-name", "")
         return [Request(obj_util.namespace_of(pod), name)] if name else []
 
-    def _map_event(self, _etype: str, event: Obj) -> list[Request]:
+    def _map_event(self, etype: str, event: Obj) -> list[Request]:
         """Re-queue the Notebook named by an Event on its StatefulSet or
         Pods (reference nbNameFromInvolvedObject :653-677: strip the
         ordinal suffix and verify a Notebook of that name exists), and
         re-emit the event onto the Notebook CR itself so
         ``kubectl describe notebook`` tells the whole story (reference
         notebook_controller.go:94-118,649-723)."""
+        if etype == "DELETED":
+            # an event EXPIRING (store retention prune now notifies
+            # DELETED) is not a fresh observation — re-mirroring it
+            # would resurrect long-resolved failures with current
+            # timestamps, and at the retention limit each re-emission
+            # triggers another prune (a cascade)
+            return []
         involved = event.get("involvedObject") or {}
         ns = involved.get("namespace", "")
         name = involved.get("name", "")
@@ -243,8 +260,12 @@ class NotebookController:
         if not reason and not message:
             return
         name = obj_util.name_of(notebook)
-        for existing in self.api.list(
-            "Event", namespace=obj_util.namespace_of(notebook)
+        for existing in list_by_index(
+            self.api,
+            "Event",
+            "involved",
+            f"Notebook/{name}",
+            namespace=obj_util.namespace_of(notebook),
         ):
             involved = existing.get("involvedObject", {})
             if (
@@ -255,6 +276,7 @@ class NotebookController:
                 and existing.get("type") == "Warning"
             ):
                 if stamp and stamp > existing.get("lastTimestamp", ""):
+                    existing = mutable(existing)
                     existing["count"] = int(existing.get("count", 1)) + 1
                     existing["lastTimestamp"] = stamp
                     try:
@@ -274,7 +296,10 @@ class NotebookController:
 
     def reconcile(self, req: Request) -> Result:
         try:
-            notebook = self.api.get("Notebook", req.name, req.namespace)
+            # mutable(): this reconcile writes status/conditions onto
+            # the in-hand object, so a cache hit takes one private copy
+            # here (instead of the store's mandatory copy per get)
+            notebook = mutable(self.api.get("Notebook", req.name, req.namespace))
         except NotFound:
             return Result()
 
@@ -716,6 +741,12 @@ class NotebookController:
                 f"Notebook server started ({status['readyReplicas']} "
                 "ready host(s))",
             )
+        if (notebook.get("status") or {}) == status:
+            # steady state: the mirrored status is already what's
+            # stored — skip the API round-trip entirely (the store
+            # would suppress the write anyway, but only after three
+            # deepcopies; at N notebooks per drain that tax dominates)
+            return
         notebook["status"] = status
         updated = self.api.update_status(notebook)
         # keep the in-hand dict fresh for follow-up status writes in the

@@ -22,6 +22,7 @@ from typing import Any, Callable, Optional
 from odh_kubeflow_tpu.controllers import reconcilehelper
 from odh_kubeflow_tpu.controllers.runtime import Manager, Request, Result
 from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.objects import mutable
 from odh_kubeflow_tpu.machinery.store import APIServer, NotFound
 from odh_kubeflow_tpu.utils import prometheus
 
@@ -66,7 +67,7 @@ class GcpWorkloadIdentityPlugin(ProfilePlugin):
     def apply(self, api: APIServer, profile: Obj, spec: Obj) -> None:
         gcp_sa = spec.get("gcpServiceAccount", "")
         ns = obj_util.name_of(profile)
-        sa = api.get("ServiceAccount", DEFAULT_EDITOR, ns)
+        sa = mutable(api.get("ServiceAccount", DEFAULT_EDITOR, ns))
         obj_util.set_annotation(sa, "iam.gke.io/gcp-service-account", gcp_sa)
         api.update(sa)
         member = f"serviceAccount:{ns}.svc.id.goog[{ns}/{DEFAULT_EDITOR}]"
@@ -88,7 +89,7 @@ class AwsIamForServiceAccountPlugin(ProfilePlugin):
     def apply(self, api: APIServer, profile: Obj, spec: Obj) -> None:
         arn = spec.get("awsIamRole", "")
         ns = obj_util.name_of(profile)
-        sa = api.get("ServiceAccount", DEFAULT_EDITOR, ns)
+        sa = mutable(api.get("ServiceAccount", DEFAULT_EDITOR, ns))
         obj_util.set_annotation(sa, "eks.amazonaws.com/role-arn", arn)
         api.update(sa)
         self.iam_client(arn, f"{ns}/{DEFAULT_EDITOR}", "add")
@@ -161,7 +162,8 @@ class ProfileController:
     def reconcile(self, req: Request) -> Result:
         self.m_requests.inc()
         try:
-            profile = self.api.get("Profile", req.name)
+            # mutable(): the finalizer add/remove edits the in-hand object
+            profile = mutable(self.api.get("Profile", req.name))
         except NotFound:
             return Result()
 

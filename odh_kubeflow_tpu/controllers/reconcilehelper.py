@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.objects import FrozenObjectError, mutable
 from odh_kubeflow_tpu.machinery.store import APIServer, Conflict, NotFound
 
 Obj = dict[str, Any]
@@ -94,7 +95,17 @@ def reconcile_object(
             current = api.get(kind, meta.get("name", ""), meta.get("namespace"))
         except NotFound:
             return api.create(desired), True
-        if copier(desired, current):
+        # copy-on-write against the shared cache: run the copier on the
+        # (possibly frozen) cached object; the steady state — nothing
+        # to change — completes with ZERO copies. Only when the copier
+        # actually needs to write does the frozen object raise, and we
+        # retry on a private mutable copy.
+        try:
+            changed = copier(desired, current)
+        except FrozenObjectError:
+            current = mutable(current)
+            changed = copier(desired, current)
+        if changed:
             try:
                 return api.update(current), False
             except Conflict:

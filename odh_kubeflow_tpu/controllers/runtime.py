@@ -132,12 +132,17 @@ class Controller:
         time_fn: Callable[[], float] = time.monotonic,
         workers: int = 1,
         metrics: Optional[RuntimeMetrics] = None,
+        informer: Optional[Any] = None,
     ):
         self.name = name
         self.api = api
         self.reconcile = reconcile
         self.for_kind = for_kind
         self.time_fn = time_fn
+        # shared informer cache (Manager-owned): kinds it serves feed
+        # this controller through event handlers — one frozen copy per
+        # store event for ALL controllers — instead of a private watch
+        self.informer = informer
         # a standalone Controller gets a private sink registry; the
         # Manager path shares its RuntimeMetrics across controllers
         self.metrics = metrics or RuntimeMetrics(prometheus.Registry())
@@ -320,25 +325,41 @@ class Controller:
 
     def _start_watches(self) -> None:
         for spec in self._watch_specs:
-            w = self.api.watch(spec.kind)
-            self._watches.append(w)
+            if self.informer is not None and self.informer.has_kind(spec.kind):
+                # informer-fed: the shared cache pushes events (with an
+                # ADDED replay of current state) — no private watch, no
+                # per-controller event copy
+                self.informer.add_handler(
+                    spec.kind,
+                    lambda etype, obj, _spec=spec: self._handle_event(
+                        _spec, etype, obj
+                    ),
+                )
+                self._watches.append(None)
+            else:
+                self._watches.append(self.api.watch(spec.kind))
 
-    def _pump_once(self, spec_idx: int, timeout: float = 0.0) -> bool:
-        """Drain one event from watch ``spec_idx``; returns False if none."""
-        w = self._watches[spec_idx]
-        spec = self._watch_specs[spec_idx]
-        item = w.get(timeout=timeout) if timeout else w.try_get()
-        if item is None:
-            return False
-        etype, obj = item
+    def _handle_event(self, spec: _WatchSpec, etype: str, obj: Obj) -> None:
         if spec.predicate and not spec.predicate(etype, obj):
-            return True
+            return
         # the store stamps the creating request's trace id onto the
         # object; carry it so the reconcile logs in the same trace
         trace_id = tracing.trace_id_of(obj)
         for req in spec.map_fn(etype, obj):
             if req.name:
                 self.enqueue(req, trace_id=trace_id)
+
+    def _pump_once(self, spec_idx: int, timeout: float = 0.0) -> bool:
+        """Drain one event from watch ``spec_idx``; returns False if none."""
+        w = self._watches[spec_idx]
+        if w is None:  # informer-fed spec: events arrive via handler
+            return False
+        spec = self._watch_specs[spec_idx]
+        item = w.get(timeout=timeout) if timeout else w.try_get()
+        if item is None:
+            return False
+        etype, obj = item
+        self._handle_event(spec, etype, obj)
         return True
 
     # -- execution ----------------------------------------------------------
@@ -351,6 +372,8 @@ class Controller:
                 self._pump_once(i, timeout=0.2)
 
         for i in range(len(self._watch_specs)):
+            if self._watches[i] is None:
+                continue  # informer-fed: the cache's pump delivers
             t = threading.Thread(target=pump, args=(i,), daemon=True)
             t.start()
             self._threads.append(t)
@@ -368,7 +391,8 @@ class Controller:
     def stop(self) -> None:
         self._stop.set()
         for w in self._watches:
-            w.stop()
+            if w is not None:
+                w.stop()
         with self._cv:
             self._cv.notify_all()
 
@@ -380,6 +404,8 @@ class Controller:
         if not self._watches:
             self._start_watches()
         moved = False
+        if self.informer is not None and self.informer.drain_once():
+            moved = True
         for i in range(len(self._watch_specs)):
             while self._pump_once(i):
                 moved = True
@@ -404,6 +430,7 @@ class Manager:
         api: APIServer,
         time_fn: Callable[[], float] = time.monotonic,
         registry: Optional[prometheus.Registry] = None,
+        cache: Optional[Any] = None,
     ):
         self.api = api
         self.time_fn = time_fn
@@ -413,6 +440,11 @@ class Manager:
         # the platform serves it at /metrics
         self.metrics_registry = registry or prometheus.Registry()
         self._runtime_metrics = RuntimeMetrics(self.metrics_registry)
+        # the shared informer cache (machinery.cache.InformerCache):
+        # the manager owns its lifecycle — start + sync barrier before
+        # any controller runs, pumped first on every drain round
+        self.cache = cache
+        self._cache_started = False
 
     def new_controller(
         self,
@@ -433,22 +465,43 @@ class Manager:
             time_fn=self.time_fn,
             workers=workers,
             metrics=self._runtime_metrics,
+            informer=self.cache,
         )
         self.controllers.append(ctrl)
         return ctrl
 
+    def _ensure_cache(self, live: bool) -> None:
+        if self.cache is None:
+            return
+        # informer start + sync barrier: controllers must never see a
+        # half-primed cache (controller-runtime's WaitForCacheSync
+        # contract). start() is idempotent and upgrades a drain-mode
+        # cache to live pumps.
+        if not self._cache_started or live:
+            self.cache.start(live=live)
+        if not self._cache_started:
+            self.cache.wait_for_sync()
+            self._cache_started = True
+
     def start(self) -> None:
+        self._ensure_cache(live=True)
         for c in self.controllers:
             c.start()
 
     def stop(self) -> None:
         for c in self.controllers:
             c.stop()
+        if self.cache is not None and self._cache_started:
+            self.cache.stop()
 
     def drain(self, max_rounds: int = 60) -> None:
         """Run controllers synchronously until no controller has pending
         work (the deterministic test idiom — no sleeps, no races)."""
+        self._ensure_cache(live=False)
         for _ in range(max_rounds):
-            if not any(c.drain_once() for c in self.controllers):
+            cache_moved = (
+                self.cache.drain_once() if self.cache is not None else False
+            )
+            if not any(c.drain_once() for c in self.controllers) and not cache_moved:
                 return
         raise RuntimeError("manager did not quiesce; reconcile livelock?")

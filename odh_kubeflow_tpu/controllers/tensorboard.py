@@ -22,7 +22,9 @@ from typing import Any, Optional
 from odh_kubeflow_tpu.controllers import reconcilehelper
 from odh_kubeflow_tpu.controllers.runtime import Manager, Request, Result
 from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.cache import list_by_index
 from odh_kubeflow_tpu.machinery.events import EventRecorder
+from odh_kubeflow_tpu.machinery.objects import mutable
 from odh_kubeflow_tpu.machinery.store import APIServer, NotFound
 
 Obj = dict[str, Any]
@@ -48,7 +50,8 @@ class TensorboardController:
 
     def reconcile(self, req: Request) -> Result:
         try:
-            tb = self.api.get("Tensorboard", req.name, req.namespace)
+            # mutable(): _mirror_status writes onto the in-hand object
+            tb = mutable(self.api.get("Tensorboard", req.name, req.namespace))
         except NotFound:
             return Result()
         deployment = self.generate_deployment(tb)
@@ -174,7 +177,11 @@ class TensorboardController:
         modes = obj_util.get_path(pvc, "spec", "accessModes", default=[]) or []
         if "ReadWriteMany" in modes:
             return None
-        for pod in self.api.list("Pod", namespace=ns):
+        # pods mounting this claim, via the ``pvc`` field index (the
+        # uncached fallback still scans only the namespace)
+        for pod in list_by_index(
+            self.api, "Pod", "pvc", pvc_name, namespace=ns
+        ):
             node = obj_util.get_path(pod, "spec", "nodeName")
             if not node:
                 continue
@@ -261,7 +268,7 @@ class TensorboardController:
         prev_ready = obj_util.get_path(tb, "status", "readyReplicas", default=0)
         if ready and not prev_ready:
             self.recorder.normal(tb, "Started", "Tensorboard server started")
-        tb["status"] = {
+        status = {
             "readyReplicas": ready,
             "conditions": [
                 {
@@ -270,6 +277,9 @@ class TensorboardController:
                 }
             ],
         }
+        if (tb.get("status") or {}) == status:
+            return  # steady state: skip the no-op status round-trip
+        tb["status"] = status
         self.api.update_status(tb)
 
 

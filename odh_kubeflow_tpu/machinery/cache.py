@@ -1,0 +1,764 @@
+"""Informer-backed shared cache: indexed, zero-copy reads for every
+controller and web hot path.
+
+This is the platform's controller-runtime cache layer (the reference
+builds every operator on sigs.k8s.io/controller-runtime, whose manager
+feeds all reconcilers from ONE watch-fed shared informer per kind with
+field indexers). Before it existed, every read paid O(cluster):
+``Store.list`` scanned and deepcopied under the global lock, and each
+watcher got its own event copy. Now:
+
+- **one watch per kind** feeds an in-memory mirror of the store;
+- **indexes** (namespace buckets, labels-of-interest, registrable
+  field indexers: Pods by owner UID, StatefulSets by owner, Workloads
+  by queue, Nodes by nodepool, Pods by PVC claim / TPU request) turn
+  selector lists into dict lookups;
+- **zero-copy reads**: cached objects are deep-frozen
+  (``objects.FrozenDict``) so ``get``/``list`` return shared references
+  safely; mutation raises ``FrozenObjectError`` and the ``mutable()``
+  escape hatch gives a private copy-on-write copy;
+- **``CachedClient``** fronts an APIServer-shaped api with the same
+  read interface, serving cached kinds from the cache (hits) and
+  falling through to the store for everything else (misses), with
+  hit/miss/staleness metrics;
+- **rv-guarded applies + tombstones** keep concurrent drainers (live
+  pump threads and opportunistic read-time pokes) order-safe;
+- **resync** re-lists from the source of truth, healing any dropped
+  event.
+
+Event handlers let controllers source their watch streams from the
+informer instead of opening private per-controller watches — one
+frozen copy per store event now serves the cache AND every controller.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Iterable, Optional
+
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.objects import (  # noqa: F401 — public API
+    FrozenDict,
+    FrozenList,
+    FrozenObjectError,
+    freeze,
+    is_frozen,
+    mutable,
+)
+from odh_kubeflow_tpu.machinery.store import NotFound, Watch
+from odh_kubeflow_tpu.utils import prometheus
+
+Obj = dict[str, Any]
+Key = tuple[str, str]  # (namespace, name); "" for cluster-scoped
+
+IndexFn = Callable[[Obj], Iterable[str]]
+Handler = Callable[[str, Obj], None]
+
+# The kinds every in-process component reads on a hot path. CRD kinds
+# (Notebook/Workload/...) must be registered with the api before the
+# cache starts; ``for_platform`` filters to what's actually registered.
+DEFAULT_CACHED_KINDS: tuple[str, ...] = (
+    "Pod",
+    "StatefulSet",
+    "Deployment",
+    "Service",
+    "Event",
+    "Node",
+    "ResourceQuota",
+    "PersistentVolumeClaim",
+    "Namespace",
+    "Secret",
+    "ServiceAccount",
+    "Role",
+    "RoleBinding",
+    "ClusterRole",
+    "ClusterRoleBinding",
+    "PriorityClass",
+    "Notebook",
+    "Workload",
+    "Profile",
+    "Tensorboard",
+    "PodDefault",
+)
+
+_TOMBSTONE_LIMIT = 4096
+
+
+def _owner_uids(obj: Obj) -> list[str]:
+    return [
+        r["uid"]
+        for r in (obj_util.meta(obj).get("ownerReferences") or [])
+        if r.get("uid")
+    ]
+
+
+class _KindCache:
+    __slots__ = (
+        "objects",
+        "by_ns",
+        "indexes",
+        "indexers",
+        "label_indexes",
+        "synced",
+        "tombstones",
+        "last_event",
+    )
+
+    def __init__(self):
+        self.objects: dict[Key, Obj] = {}
+        self.by_ns: dict[str, dict[Key, Obj]] = {}
+        self.indexes: dict[str, dict[str, dict[Key, Obj]]] = {}
+        self.indexers: dict[str, IndexFn] = {}
+        self.label_indexes: set[str] = set()
+        self.synced = False
+        self.tombstones: dict[Key, int] = {}
+        self.last_event = 0.0
+
+
+class InformerCache:
+    """Watch-fed read mirror of an APIServer-shaped api.
+
+    Deterministic tests drive it with ``drain_once()`` (and every read
+    through ``CachedClient`` pokes pending events first, giving
+    read-your-writes against the in-process store); live deployments
+    call ``start()`` which spawns one pump thread per kind.
+    """
+
+    def __init__(
+        self,
+        api: Any,
+        kinds: Iterable[str] = DEFAULT_CACHED_KINDS,
+        registry: Optional[prometheus.Registry] = None,
+        time_fn: Callable[[], float] = time.time,
+    ):
+        self.api = api
+        self.now = time_fn
+        self._lock = threading.RLock()
+        self._kinds: dict[str, _KindCache] = {k: _KindCache() for k in kinds}
+        self._handlers: dict[str, list[Handler]] = {}
+        self._watches: dict[str, Watch] = {}
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._started = False
+
+        reg = registry or prometheus.default_registry
+        self.m_hits = reg.counter(
+            "cache_hits_total",
+            "Reads served zero-copy from the informer cache",
+            labelnames=("kind",),
+        )
+        self.m_misses = reg.counter(
+            "cache_misses_total",
+            "Reads that fell through to the backing store",
+            labelnames=("kind",),
+        )
+        self.m_resync = reg.counter(
+            "cache_resync_total",
+            "Full re-lists of a kind from the backing store",
+        )
+        self.m_coalesced = reg.counter(
+            "watch_events_coalesced_total",
+            "Watch events superseded by a newer event for the same "
+            "object before the cache applied them",
+        )
+        self.m_staleness = reg.gauge(
+            "cache_staleness_seconds",
+            "Seconds since the kind last observed a watch event or "
+            "resync, sampled at read time",
+            labelnames=("kind",),
+        )
+        # hot-path counters are plain MONOTONIC ints (a Counter.inc per
+        # read — lock + label-key sort — would cost more than the
+        # read); they flush into the registered families lazily (at
+        # scrape time via the collector below, or flush_metrics()) by
+        # folding the delta past a watermark — readers never contend
+        # with the flush, and concurrent flushes can't double-count
+        self._hits: dict[str, int] = {}
+        self._misses: dict[str, int] = {}
+        self._flushed_hits: dict[str, int] = {}
+        self._flushed_misses: dict[str, int] = {}
+        self._flush_lock = threading.Lock()
+        self._stale_mark: dict[str, float] = {}
+        reg.register_collector(self._flush_collector)
+
+    def flush_metrics(self) -> None:
+        """Fold the hot-path int counters into the registered Prometheus
+        families (also runs automatically at scrape time)."""
+        with self._flush_lock:
+            for counts, flushed, family in (
+                (self._hits, self._flushed_hits, self.m_hits),
+                (self._misses, self._flushed_misses, self.m_misses),
+            ):
+                for kind, n in list(counts.items()):
+                    delta = n - flushed.get(kind, 0)
+                    if delta > 0:
+                        family.inc({"kind": kind}, by=delta)
+                        flushed[kind] = n
+
+    def _flush_collector(self):
+        self.flush_metrics()
+        return ()
+
+    # -- registration --------------------------------------------------------
+
+    def kinds(self) -> list[str]:
+        return list(self._kinds)
+
+    def has_kind(self, kind: str) -> bool:
+        return kind in self._kinds
+
+    def synced(self, kind: str) -> bool:
+        kc = self._kinds.get(kind)
+        return kc is not None and kc.synced
+
+    def register_indexer(self, kind: str, name: str, fn: IndexFn) -> None:
+        """Register a field indexer (controller-runtime
+        ``FieldIndexer.IndexWith`` equivalent). ``fn(obj)`` returns the
+        index keys the object files under. Registering after sync
+        rebuilds the index from the cached objects."""
+        with self._lock:
+            kc = self._kinds[kind]
+            kc.indexers[name] = fn
+            index: dict[str, dict[Key, Obj]] = {}
+            for key, obj in kc.objects.items():
+                for ik in fn(obj) or ():
+                    index.setdefault(ik, {})[key] = obj
+            kc.indexes[name] = index
+
+    def register_label_index(self, kind: str, label: str) -> str:
+        """Index a kind by the value of one label-of-interest; selector
+        lists on exactly that label become dict lookups."""
+        name = f"label:{label}"
+
+        def fn(obj: Obj, _label=label) -> list[str]:
+            v = obj_util.labels_of(obj).get(_label)
+            return [v] if v is not None else []
+
+        self.register_indexer(kind, name, fn)
+        with self._lock:
+            self._kinds[kind].label_indexes.add(label)
+        return name
+
+    def add_handler(self, kind: str, fn: Handler) -> None:
+        """Subscribe to the kind's event stream (informer event handler).
+        The current cache contents replay as ADDED first, so a handler
+        added after sync still sees every live object — the same
+        contract a fresh watch with send_initial gives."""
+        with self._lock:
+            replay = list(self._kinds[kind].objects.values())
+            self._handlers.setdefault(kind, []).append(fn)
+        for obj in replay:
+            fn("ADDED", obj)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, live: bool = True) -> None:
+        """Open one watch per kind and prime from a full list (the
+        informer's initial sync). With ``live`` a pump thread per kind
+        applies events as they arrive; without, events apply on
+        ``drain_once()`` / read-time pokes (deterministic test mode)."""
+        with self._lock:
+            opening = not self._started
+            self._started = True
+            if opening:
+                for kind in self._kinds:
+                    # watch first, then list-prime: anything written in
+                    # between arrives as a (rv-guarded) event
+                    self._watches[kind] = self.api.watch(
+                        kind, send_initial=False
+                    )
+        if opening:
+            for kind in self._kinds:
+                self.resync(kind, count=False)
+        if live:
+            with self._lock:
+                spawn = not self._threads
+                if spawn:
+                    self._threads = [
+                        threading.Thread(
+                            target=self._pump, args=(kind,), daemon=True
+                        )
+                        for kind in self._kinds
+                    ]
+            if spawn:
+                # a drain-mode cache upgrades to live when the manager
+                # later starts for real (Platform tests drain first)
+                for t in self._threads:
+                    t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for w in self._watches.values():
+            w.stop()
+
+    def wait_for_sync(self, timeout: float = 30.0) -> bool:
+        """The start/sync barrier the Manager honours before running
+        controllers. Priming is synchronous, so this only guards
+        exotic start orderings."""
+        deadline = self.now() + timeout
+        while self.now() < deadline:
+            if all(kc.synced for kc in self._kinds.values()):
+                return True
+            time.sleep(0.01)
+        return all(kc.synced for kc in self._kinds.values())
+
+    def resync(self, kind: str, count: bool = True) -> None:
+        """Re-list the kind from the backing store and rebuild the
+        mirror + indexes — heals any dropped watch event. Queued events
+        older than the listed state are ignored by the rv guard."""
+        objs = self.api.list(kind)
+        with self._lock:
+            kc = self._kinds[kind]
+            kc.objects = {}
+            kc.by_ns = {}
+            kc.indexes = {name: {} for name in kc.indexers}
+            for obj in objs:
+                self._insert(kc, self._key_of(obj), freeze(obj))
+            kc.synced = True
+            kc.last_event = self.now()
+        if count:
+            self.m_resync.inc()
+
+    # -- event application ---------------------------------------------------
+
+    @staticmethod
+    def _key_of(obj: Obj) -> Key:
+        m = obj.get("metadata", {})
+        return (m.get("namespace") or "", m.get("name", ""))
+
+    @staticmethod
+    def _rv_of(obj: Obj) -> int:
+        try:
+            return int(obj.get("metadata", {}).get("resourceVersion", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def _insert(self, kc: _KindCache, key: Key, obj: Obj) -> None:
+        kc.objects[key] = obj
+        kc.by_ns.setdefault(key[0], {})[key] = obj
+        for name, fn in kc.indexers.items():
+            index = kc.indexes.setdefault(name, {})
+            for ik in fn(obj) or ():
+                index.setdefault(ik, {})[key] = obj
+
+    def _evict(self, kc: _KindCache, key: Key) -> None:
+        old = kc.objects.pop(key, None)
+        if old is None:
+            return
+        bucket = kc.by_ns.get(key[0])
+        if bucket is not None:
+            bucket.pop(key, None)
+            if not bucket:
+                del kc.by_ns[key[0]]
+        for name, fn in kc.indexers.items():
+            index = kc.indexes.get(name, {})
+            for ik in fn(old) or ():
+                entry = index.get(ik)
+                if entry is not None:
+                    entry.pop(key, None)
+                    if not entry:
+                        del index[ik]
+
+    def _apply(self, kind: str, etype: str, obj: Obj) -> Optional[Obj]:
+        """Apply one watch event under the lock; returns the frozen
+        object when state changed (for handler dispatch — built ONCE
+        here, never re-frozen per subscriber) or None for
+        guard-rejected stale events. Caller dispatches handlers
+        OUTSIDE the lock."""
+        frozen = freeze(obj)
+        key = self._key_of(frozen)
+        rv = self._rv_of(frozen)
+        with self._lock:
+            kc = self._kinds[kind]
+            kc.last_event = self.now()
+            current = kc.objects.get(key)
+            cur_rv = self._rv_of(current) if current is not None else -1
+            tomb = kc.tombstones.get(key, -1)
+            if etype == "DELETED":
+                # record the tombstone even when there is nothing to
+                # evict: a DELETED drained ahead of its ADDED (two
+                # concurrent drainers) must still block the resurrect
+                kc.tombstones[key] = max(rv, tomb)
+                if len(kc.tombstones) > _TOMBSTONE_LIMIT:
+                    # drop the oldest half (insertion ≈ rv order)
+                    for k in list(kc.tombstones)[: _TOMBSTONE_LIMIT // 2]:
+                        del kc.tombstones[k]
+                if current is None or rv < cur_rv:
+                    return None
+                self._evict(kc, key)
+                return frozen
+            # ADDED / MODIFIED: ignore anything older than what we hold
+            # or than a deletion we already applied (out-of-order drain)
+            if rv < cur_rv or rv <= tomb:
+                return None
+            if current is not None:
+                self._evict(kc, key)
+            self._insert(kc, key, frozen)
+            return frozen
+
+    def _drain_kind(self, kind: str, budget: int = 10_000) -> bool:
+        """Pull every pending event for ``kind``, coalesce runs for the
+        same object (each event carries the full object, so only the
+        newest matters for cache state), apply, dispatch handlers."""
+        w = self._watches.get(kind)
+        if w is None or not w._q.qsize():
+            # empty-queue fast path: reads poke before every lookup, so
+            # this must cost nanoseconds, not a queue.Empty exception
+            return False
+        pending: list[tuple[str, Obj]] = []
+        for _ in range(budget):
+            item = w.try_get()
+            if item is None:
+                break
+            pending.append(item)
+        if not pending:
+            return False
+        if len(pending) > 1:
+            latest: dict[Key, int] = {}
+            for i, (_etype, obj) in enumerate(pending):
+                latest[self._key_of(obj)] = i
+            kept = [
+                ev
+                for i, ev in enumerate(pending)
+                if latest[self._key_of(ev[1])] == i
+            ]
+            if len(kept) < len(pending):
+                self.m_coalesced.inc(by=len(pending) - len(kept))
+            pending = kept
+        handlers = self._handlers.get(kind, ())
+        for etype, obj in pending:
+            frozen = self._apply(kind, etype, obj)
+            if frozen is not None:
+                for fn in handlers:
+                    fn(etype, frozen)
+        return True
+
+    def drain_once(self) -> bool:
+        """Apply all pending events across kinds (deterministic drain)."""
+        moved = False
+        for kind in self._kinds:
+            while self._drain_kind(kind):
+                moved = True
+        return moved
+
+    def poke(self, kind: str) -> None:
+        """Opportunistically apply the kind's pending events before a
+        read. Against the in-process store (whose watch enqueue is
+        synchronous) this gives read-your-writes; rv guards keep
+        concurrent pump threads order-safe."""
+        self._drain_kind(kind)
+
+    def _pump(self, kind: str) -> None:
+        w = self._watches[kind]
+        handlers_of = self._handlers
+        while not self._stop.is_set():
+            item = w.get(timeout=0.2)
+            if item is None:
+                if self._stop.is_set() or w._stopped:
+                    return
+                continue
+            etype, obj = item
+            frozen = self._apply(kind, etype, obj)
+            if frozen is not None:
+                for fn in handlers_of.get(kind, ()):
+                    fn(etype, frozen)
+            self._drain_kind(kind)
+
+    # -- reads (zero-copy) ---------------------------------------------------
+
+    def _observe_staleness(self, kc: _KindCache, kind: str) -> None:
+        if not kc.last_event:
+            return
+        # throttled: the gauge is a scrape-resolution signal; setting it
+        # (lock + label sort) on EVERY read would tax the hot path
+        now = self.now()
+        if now - self._stale_mark.get(kind, 0.0) < 0.25:
+            return
+        self._stale_mark[kind] = now
+        self.m_staleness.set(
+            max(now - kc.last_event, 0.0), labels={"kind": kind}
+        )
+
+    def get(self, kind: str, name: str, namespace: Optional[str] = None) -> Obj:
+        with self._lock:
+            kc = self._kinds[kind]
+            self._observe_staleness(kc, kind)
+            found = kc.objects.get((namespace or "", name))
+            if found is None:
+                raise NotFound(f"{kind} {namespace or ''}/{name} not found")
+            return found
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Obj] = None,
+        field_matches: Optional[dict[str, Any]] = None,
+    ) -> list[Obj]:
+        with self._lock:
+            kc = self._kinds[kind]
+            self._observe_staleness(kc, kind)
+            candidates, ns_filtered = self._candidates(
+                kc, namespace, label_selector
+            )
+            if label_selector is None and not field_matches and ns_filtered:
+                # plain namespace (or full) list: the bucket IS the
+                # answer — no per-object work at all
+                return candidates
+            out = []
+            for obj in candidates:
+                if not ns_filtered and namespace and self._key_of(obj)[0] != namespace:
+                    continue
+                if not obj_util.match_label_selector(
+                    label_selector, obj_util.labels_of(obj)
+                ):
+                    continue
+                if field_matches and any(
+                    obj_util.get_path(obj, *path.split(".")) != want
+                    for path, want in field_matches.items()
+                ):
+                    continue
+                out.append(obj)
+            return out
+
+    def _candidates(
+        self,
+        kc: _KindCache,
+        namespace: Optional[str],
+        selector: Optional[Obj],
+    ) -> tuple[list[Obj], bool]:
+        """Smallest candidate set (plus whether it is already
+        namespace-exact): a label index bucket when the selector names
+        an indexed label (equality or Exists), else the namespace
+        bucket, else everything."""
+        if selector:
+            for k, v in (selector.get("matchLabels") or {}).items():
+                if k in kc.label_indexes:
+                    return (
+                        list(
+                            kc.indexes.get(f"label:{k}", {}).get(v, {}).values()
+                        ),
+                        False,
+                    )
+            for expr in selector.get("matchExpressions") or []:
+                k = expr.get("key", "")
+                if k not in kc.label_indexes:
+                    continue
+                index = kc.indexes.get(f"label:{k}", {})
+                op = expr.get("operator", "In")
+                if op == "Exists":
+                    return (
+                        [o for bucket in index.values() for o in bucket.values()],
+                        False,
+                    )
+                if op == "In":
+                    return (
+                        [
+                            o
+                            for v in expr.get("values") or []
+                            for o in index.get(v, {}).values()
+                        ],
+                        False,
+                    )
+        if namespace:
+            return list(kc.by_ns.get(namespace, {}).values()), True
+        return list(kc.objects.values()), True
+
+    def by_index(
+        self,
+        kind: str,
+        index: str,
+        key: str,
+        namespace: Optional[str] = None,
+    ) -> list[Obj]:
+        """Field-index lookup: every cached object of ``kind`` filed
+        under ``key`` by the ``index`` indexer."""
+        with self._lock:
+            kc = self._kinds[kind]
+            self._observe_staleness(kc, kind)
+            bucket = kc.indexes.get(index, {}).get(key, {})
+            if namespace:
+                return [
+                    o for k, o in bucket.items() if k[0] == namespace
+                ]
+            return list(bucket.values())
+
+    def index_buckets(self, kind: str, index: str) -> dict[str, list[Obj]]:
+        """Every (key → objects) bucket of a field index — for passes
+        that aggregate over the whole index (the gang-bookkeeping
+        charge walks ``tpu`` buckets, whose KEYS are the precomputed
+        chip counts, so no per-pod resource parsing at read time)."""
+        with self._lock:
+            kc = self._kinds[kind]
+            self._observe_staleness(kc, kind)
+            return {
+                k: list(bucket.values())
+                for k, bucket in kc.indexes.get(index, {}).items()
+            }
+
+
+class CachedClient:
+    """APIServer-duck-typed façade: reads served from the informer
+    cache (zero-copy hits), writes and uncached kinds delegated to the
+    wrapped api. Handing this to a controller or web backend converts
+    its whole read path without touching its code."""
+
+    def __init__(self, api: Any, cache: InformerCache):
+        self.api = api
+        self.cache = cache
+        self._ready: set[str] = set()  # kinds seen synced (never unsync)
+
+    # -- reads ---------------------------------------------------------------
+
+    def _serving(self, kind: str) -> bool:
+        c = self.cache
+        if kind not in self._ready:
+            if not (c.has_kind(kind) and c.synced(kind)):
+                return False
+            self._ready.add(kind)
+        c.poke(kind)
+        return True
+
+    def get(self, kind: str, name: str, namespace: Optional[str] = None) -> Obj:
+        c = self.cache
+        if self._serving(kind):
+            try:
+                obj = c.get(kind, name, namespace)
+                c._hits[kind] = c._hits.get(kind, 0) + 1
+                return obj
+            except NotFound:
+                # fall through: read-your-writes for an object created
+                # a moment ago whose event hasn't landed, and a uniform
+                # NotFound surface for genuinely absent objects
+                pass
+        c._misses[kind] = c._misses.get(kind, 0) + 1
+        return self.api.get(kind, name, namespace)
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Obj] = None,
+        field_matches: Optional[dict[str, Any]] = None,
+    ) -> list[Obj]:
+        c = self.cache
+        if self._serving(kind):
+            c._hits[kind] = c._hits.get(kind, 0) + 1
+            return c.list(kind, namespace, label_selector, field_matches)
+        c._misses[kind] = c._misses.get(kind, 0) + 1
+        return self.api.list(
+            kind,
+            namespace=namespace,
+            label_selector=label_selector,
+            field_matches=field_matches,
+        )
+
+    def by_index(
+        self,
+        kind: str,
+        index: str,
+        key: str,
+        namespace: Optional[str] = None,
+    ) -> Optional[list[Obj]]:
+        """Indexed lookup, or None when the kind isn't cache-served yet
+        (callers fall back to a selector list)."""
+        c = self.cache
+        if self._serving(kind):
+            c._hits[kind] = c._hits.get(kind, 0) + 1
+            return c.by_index(kind, index, key, namespace)
+        return None
+
+    def index_buckets(self, kind: str, index: str) -> Optional[dict[str, list[Obj]]]:
+        """All buckets of a field index, or None when uncached."""
+        c = self.cache
+        if self._serving(kind):
+            c._hits[kind] = c._hits.get(kind, 0) + 1
+            return c.index_buckets(kind, index)
+        return None
+
+    # -- everything else (writes, watches, registry) -------------------------
+
+    def __getattr__(self, name: str):
+        return getattr(self.api, name)
+
+
+def list_by_index(
+    api: Any,
+    kind: str,
+    index: str,
+    key: str,
+    namespace: Optional[str] = None,
+    fallback_selector: Optional[Obj] = None,
+) -> list[Obj]:
+    """Index lookup against a CachedClient, degrading to a selector
+    list on a plain api (tests constructing controllers with the raw
+    store keep working)."""
+    fn = getattr(api, "by_index", None)
+    if fn is not None:
+        out = fn(kind, index, key, namespace=namespace)
+        if out is not None:
+            return out
+    return api.list(kind, namespace=namespace, label_selector=fallback_selector)
+
+
+def register_platform_indexers(cache: InformerCache) -> None:
+    """The platform's standing indexes — every converted hot path reads
+    through one of these:
+
+    - Pods by controller owner UID (``owner-uid``), by gang workload
+      label, by StatefulSet-member label, by PVC claim (``pvc``), and
+      by requested TPU chips (``tpu`` → key is the chip count as a
+      string, precomputed at write time so bookkeeping passes never
+      re-parse pod resources);
+    - StatefulSets by owner UID and by the ``notebook-name`` label;
+    - Workloads by queue (the profile namespace — quota pools are
+      per-namespace);
+    - Nodes by GKE nodepool (one pool == one physical TPU slice);
+    - Events by involved object (``"<kind>/<name>"``).
+    """
+    from odh_kubeflow_tpu.apis import pod_tpu_chips
+    from odh_kubeflow_tpu.scheduling import WORKLOAD_LABEL
+
+    def pod_tpu(obj: Obj) -> list[str]:
+        chips = int(pod_tpu_chips(obj))
+        return [str(chips)] if chips > 0 else []
+
+    def pod_pvcs(obj: Obj) -> list[str]:
+        return [
+            claim
+            for vol in obj_util.get_path(obj, "spec", "volumes", default=[]) or []
+            if (claim := obj_util.get_path(vol, "persistentVolumeClaim", "claimName"))
+        ]
+
+    def event_involved(obj: Obj) -> list[str]:
+        inv = obj.get("involvedObject") or {}
+        name = inv.get("name", "")
+        return [f"{inv.get('kind', '')}/{name}"] if name else []
+
+    def node_pool(obj: Obj) -> list[str]:
+        pool = obj_util.labels_of(obj).get("cloud.google.com/gke-nodepool")
+        return [pool] if pool else []
+
+    def workload_queue(obj: Obj) -> list[str]:
+        ns = obj_util.namespace_of(obj)
+        return [ns] if ns else []
+
+    if cache.has_kind("Pod"):
+        cache.register_indexer("Pod", "owner-uid", _owner_uids)
+        cache.register_indexer("Pod", "tpu", pod_tpu)
+        cache.register_indexer("Pod", "pvc", pod_pvcs)
+        cache.register_label_index("Pod", "statefulset")
+        cache.register_label_index("Pod", "notebook-name")
+        cache.register_label_index("Pod", WORKLOAD_LABEL)
+    if cache.has_kind("StatefulSet"):
+        cache.register_indexer("StatefulSet", "owner-uid", _owner_uids)
+        cache.register_label_index("StatefulSet", "notebook-name")
+    if cache.has_kind("Workload"):
+        cache.register_indexer("Workload", "queue", workload_queue)
+    if cache.has_kind("Node"):
+        cache.register_indexer("Node", "nodepool", node_pool)
+    if cache.has_kind("Event"):
+        cache.register_indexer("Event", "involved", event_involved)
+    if cache.has_kind("Tensorboard"):
+        cache.register_label_index("Tensorboard", "tensorboard")

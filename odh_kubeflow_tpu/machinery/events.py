@@ -90,7 +90,11 @@ class EventRecorder:
             except NotFound:
                 self._index.pop(key, None)  # pruned/expired server-side
         _, kind, obj_name, uid, reason, message, event_type = key
-        for ev in self.api.list("Event", namespace=ns):
+        from odh_kubeflow_tpu.machinery.cache import list_by_index
+
+        for ev in list_by_index(
+            self.api, "Event", "involved", f"{kind}/{obj_name}", namespace=ns
+        ):
             io = ev.get("involvedObject") or {}
             if (
                 io.get("kind") == kind
@@ -105,6 +109,8 @@ class EventRecorder:
         return None
 
     def _bump(self, event: Obj, ns: str, key: tuple) -> Obj:
+        # the event may be a shared frozen cache hit; bump a private copy
+        event = obj_util.mutable(event)
         event["count"] = int(event.get("count", 1)) + 1
         event["lastTimestamp"] = obj_util.now_rfc3339()
         try:

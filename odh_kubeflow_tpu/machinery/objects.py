@@ -21,6 +21,16 @@ _SCALARS = (str, int, float, bool, type(None))
 _native_copy = None
 _native_tried = False
 
+# instrumentation: every tree deepcopy bumps this (cheap int add under
+# the GIL). The informer cache's contract is ZERO deepcopies on cached
+# read hits; tests assert it by sampling this counter around reads.
+deepcopy_calls = 0
+
+
+def deepcopy_count() -> int:
+    """Total ``deepcopy`` invocations since import (monotonic)."""
+    return deepcopy_calls
+
 
 def _py_deepcopy(obj: Obj) -> Obj:
     t = type(obj)
@@ -41,8 +51,11 @@ def deepcopy(obj: Obj) -> Obj:
     walks the tree with direct C-API calls, with this Python recursion
     (itself ~8× over ``copy.deepcopy``'s memo bookkeeping) as the
     no-compiler fallback. Exotic leaves use ``copy.deepcopy`` on both
-    paths."""
-    global _native_copy, _native_tried
+    paths. Frozen trees (``FrozenDict``/``FrozenList``) come back as
+    plain mutable dicts/lists either way (their ``__deepcopy__`` routes
+    through ``mutable``)."""
+    global _native_copy, _native_tried, deepcopy_calls
+    deepcopy_calls += 1
     if not _native_tried:
         _native_tried = True
         try:
@@ -54,6 +67,160 @@ def deepcopy(obj: Obj) -> Obj:
     if _native_copy is not None:
         return _native_copy(obj)
     return _py_deepcopy(obj)
+
+
+# ---------------------------------------------------------------------------
+# frozen (zero-copy, read-only) object trees
+#
+# The informer cache and the store's watch fan-out hand out ONE shared
+# object per event/entry instead of a per-reader deepcopy. Safety comes
+# from deep-freezing: every container in the tree is a FrozenDict /
+# FrozenList whose mutators raise, so an aliasing bug surfaces as a
+# loud FrozenObjectError instead of silent cross-reader corruption.
+# ``mutable()`` is the copy-on-write escape hatch for the code paths
+# that legitimately edit what they read (status writers, finalizers).
+
+
+class FrozenObjectError(TypeError):
+    """Attempted mutation of a shared cached object. Take a private
+    copy with ``objects.mutable(obj)`` (or ``machinery.cache.mutable``)
+    before editing."""
+
+
+def _blocked(self, *args, **kwargs):
+    raise FrozenObjectError(
+        "cached object is read-only (shared, zero-copy); use "
+        "mutable(obj) to get a private editable copy"
+    )
+
+
+class FrozenDict(dict):
+    """A dict subclass whose mutators raise. Subclassing ``dict`` keeps
+    ``isinstance(x, dict)``, JSON serialisation, and every read path
+    working unchanged."""
+
+    __slots__ = ()
+
+    __setitem__ = _blocked
+    __delitem__ = _blocked
+    pop = _blocked
+    popitem = _blocked
+    clear = _blocked
+    update = _blocked
+    __ior__ = _blocked
+
+    def setdefault(self, key, default=None):
+        # reads through shared helpers (``meta(obj)``) use setdefault
+        # on keys that exist; only an actual insert is a mutation
+        if key in self:
+            return self[key]
+        _blocked(self)
+
+    def __deepcopy__(self, memo):
+        return mutable(self)
+
+    def __copy__(self):
+        return mutable(self)
+
+    def __reduce__(self):
+        return (dict, (mutable(self),))
+
+
+class FrozenList(list):
+    __slots__ = ()
+
+    __setitem__ = _blocked
+    __delitem__ = _blocked
+    __iadd__ = _blocked
+    __imul__ = _blocked
+    append = _blocked
+    extend = _blocked
+    insert = _blocked
+    pop = _blocked
+    remove = _blocked
+    clear = _blocked
+    sort = _blocked
+    reverse = _blocked
+
+    def __deepcopy__(self, memo):
+        return mutable(self)
+
+    def __copy__(self):
+        return mutable(self)
+
+    def __reduce__(self):
+        return (list, (mutable(self),))
+
+
+def freeze(obj):
+    """Deep-freeze a JSON-shaped tree into shared-safe read-only form.
+    Already-frozen trees return as-is (freezing is idempotent and
+    O(1) on the fast path), so one frozen copy per store event serves
+    every watcher and the cache without re-conversion."""
+    t = type(obj)
+    if t in (FrozenDict, FrozenList) or t in _SCALARS:
+        return obj
+    if isinstance(obj, dict):
+        return FrozenDict((k, freeze(v)) for k, v in obj.items())
+    if isinstance(obj, list):
+        return FrozenList(freeze(v) for v in obj)
+    return obj  # exotic immutable leaf; shared as-is
+
+
+def is_frozen(obj) -> bool:
+    return type(obj) in (FrozenDict, FrozenList)
+
+
+def _thaw(obj):
+    if isinstance(obj, dict):
+        return {k: _thaw(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_thaw(v) for v in obj]
+    return obj
+
+
+_native_thaws: Optional[bool] = None
+
+
+class _ProbeFallback(Exception):
+    pass
+
+
+class _ProbeDict(dict):
+    def __deepcopy__(self, memo):  # reached only via copy.deepcopy
+        raise _ProbeFallback
+
+
+def _native_can_thaw() -> bool:
+    """Whether the loaded native deepcopy handles dict/list subclasses
+    (newer jsontree.cpp thaws them to plain containers). A stale .so
+    bounces subclasses to copy.deepcopy — probe with a marker subclass
+    whose ``__deepcopy__`` raises, so the fallback is unmistakable."""
+    global _native_thaws
+    if _native_thaws is None:
+        deepcopy({})  # ensure the native loader ran
+        if _native_copy is None:
+            _native_thaws = False
+        else:
+            try:
+                _native_thaws = type(_native_copy(_ProbeDict())) is dict
+            except _ProbeFallback:
+                _native_thaws = False
+    return _native_thaws
+
+
+def mutable(obj):
+    """Copy-on-write escape hatch: a frozen tree comes back as a fresh,
+    fully mutable deep copy; anything else passes through UNCHANGED (a
+    plain dict from the uncached store is already the caller's private
+    copy — re-copying it would pay the tax the cache exists to kill)."""
+    global deepcopy_calls
+    if not is_frozen(obj):
+        return obj
+    deepcopy_calls += 1
+    if _native_can_thaw():
+        return _native_copy(obj)
+    return _thaw(obj)
 
 
 def meta(obj: Obj) -> Obj:

@@ -14,6 +14,35 @@ import time
 from typing import Callable
 
 
+def _wrap_cached(api):
+    """Front the remote api with the informer-backed shared cache
+    (reads become watch-fed, indexed, zero-copy; writes pass through).
+    ``INFORMER_CACHE=false`` opts out — e.g. a debug run against an
+    apiserver whose watch path is suspect."""
+    if os.environ.get("INFORMER_CACHE", "true").lower() != "true":
+        return api, None
+    from odh_kubeflow_tpu.machinery.cache import (
+        CachedClient,
+        InformerCache,
+        register_platform_indexers,
+    )
+
+    # only cache kinds the remote registry knows (CRDs were registered
+    # by api_from_env; a kind the server rejects would fail the watch)
+    from odh_kubeflow_tpu.machinery.cache import DEFAULT_CACHED_KINDS
+
+    kinds = []
+    for kind in DEFAULT_CACHED_KINDS:
+        try:
+            api.type_info(kind)
+            kinds.append(kind)
+        except Exception:  # noqa: BLE001 — unknown kind → skip
+            continue
+    cache = InformerCache(api, kinds=kinds)
+    register_platform_indexers(cache)
+    return CachedClient(api, cache), cache
+
+
 def run_controller(name: str, register: Callable) -> None:
     """``register(api, mgr)`` wires controllers into the manager.
 
@@ -26,6 +55,7 @@ def run_controller(name: str, register: Callable) -> None:
     from odh_kubeflow_tpu.machinery.client import api_from_env
 
     api = api_from_env()
+    api, cache = _wrap_cached(api)
 
     elector = None
     if os.environ.get("LEADER_ELECT", "").lower() == "true":
@@ -45,9 +75,9 @@ def run_controller(name: str, register: Callable) -> None:
 
         elector.run(on_lost=lost)
 
-    mgr = Manager(api)
+    mgr = Manager(api, cache=cache)
     register(api, mgr)
-    mgr.start()
+    mgr.start()  # includes the informer start/sync barrier
 
     # controller-runtime's --metrics-bind-address: every split-process
     # controller serves its manager registry on its own port.
@@ -76,7 +106,11 @@ def run_web(name: str, default_port: int, build: Callable) -> None:
     """``build(api)`` returns an object exposing a ``.app`` WSGI app."""
     from odh_kubeflow_tpu.machinery.client import api_from_env
 
-    backend = build(api_from_env())
+    api, cache = _wrap_cached(api_from_env())
+    if cache is not None:
+        cache.start(live=True)
+        cache.wait_for_sync()
+    backend = build(api)
     host = os.environ.get("HOST", "0.0.0.0")
     port = int(os.environ.get("PORT", str(default_port)))
     httpd = backend.app.serve(host, port)

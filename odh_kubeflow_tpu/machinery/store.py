@@ -182,6 +182,10 @@ class APIServer:
         self._lock = threading.RLock()
         self._types: dict[str, TypeInfo] = {}
         self._store: dict[str, dict[tuple[str, str], Obj]] = {}
+        # kind → namespace → {key: obj} — the same objects as _store,
+        # bucketed so namespaced lists touch only their namespace
+        # instead of scanning (and copying survivors of) the cluster
+        self._ns_buckets: dict[str, dict[str, dict[tuple[str, str], Obj]]] = {}
         self._rv = 0
         self._watches: list[Watch] = []
         self._hooks: list[_Hook] = []
@@ -196,6 +200,7 @@ class APIServer:
         with self._lock:
             self._types[kind] = TypeInfo(api_version, kind, plural, namespaced)
             self._store.setdefault(kind, {})
+            self._ns_buckets.setdefault(kind, {})
 
     def _register_builtins(self) -> None:
         for api_version, kind, plural, namespaced in BUILTIN_KINDS:
@@ -256,6 +261,18 @@ class APIServer:
         self._rv += 1
         return str(self._rv)
 
+    def _put(self, kind: str, key: tuple[str, str], obj: Obj) -> None:
+        self._store[kind][key] = obj
+        self._ns_buckets[kind].setdefault(key[0], {})[key] = obj
+
+    def _drop(self, kind: str, key: tuple[str, str]) -> None:
+        self._store[kind].pop(key, None)
+        bucket = self._ns_buckets[kind].get(key[0])
+        if bucket is not None:
+            bucket.pop(key, None)
+            if not bucket:
+                del self._ns_buckets[kind][key[0]]
+
     # -- CRUD ---------------------------------------------------------------
 
     def create(self, obj: Obj, dry_run: bool = False) -> Obj:
@@ -302,7 +319,7 @@ class APIServer:
             meta["creationTimestamp"] = obj_util.now_rfc3339()
             meta["generation"] = 1
             meta["resourceVersion"] = self._next_rv()
-            self._store[kind][key] = obj
+            self._put(kind, key, obj)
             self._notify("ADDED", obj)
             return obj_util.deepcopy(obj)
 
@@ -324,10 +341,15 @@ class APIServer:
     ) -> list[Obj]:
         info = self.type_info(kind)
         with self._lock:
+            if info.namespaced and namespace:
+                # namespace bucket: O(bucket), not O(cluster)
+                candidates = list(
+                    self._ns_buckets[kind].get(namespace, {}).values()
+                )
+            else:
+                candidates = list(self._store[kind].values())
             out = []
-            for (ns, _), stored in self._store[kind].items():
-                if info.namespaced and namespace and ns != namespace:
-                    continue
+            for stored in candidates:
                 if not obj_util.match_label_selector(
                     label_selector, obj_util.labels_of(stored)
                 ):
@@ -398,7 +420,7 @@ class APIServer:
             if _cmp_view(obj) == _cmp_view(current):
                 return obj_util.deepcopy(current)
             obj["metadata"]["resourceVersion"] = self._next_rv()
-            self._store[kind][key] = obj
+            self._put(kind, key, obj)
             self._notify("MODIFIED", obj)
             # a finalizer removal may release a pending delete
             if obj["metadata"].get("deletionTimestamp") and not obj["metadata"].get(
@@ -450,7 +472,7 @@ class APIServer:
             current["metadata"].get("namespace") if info.namespaced else None,
             current["metadata"]["name"],
         )
-        self._store[info.kind].pop(key, None)
+        self._drop(info.kind, key)
         self._notify("DELETED", current)
         self._cascade(current)
 
@@ -480,12 +502,20 @@ class APIServer:
         namespace: Optional[str] = None,
         send_initial: bool = True,
     ) -> Watch:
-        self.type_info(kind)
+        info = self.type_info(kind)
         with self._lock:
             w = Watch(self, kind, namespace)
             if send_initial:
-                for item in self.list(kind, namespace=namespace):
-                    w._enqueue(("ADDED", item))
+                # frozen shared replay: consumers of the watch stream
+                # (controller map fns, the informer cache) are readers;
+                # freezing instead of copying makes the initial sync
+                # allocation-free per additional watcher
+                if info.namespaced and namespace:
+                    items = self._ns_buckets[kind].get(namespace, {}).values()
+                else:
+                    items = self._store[kind].values()
+                for item in items:
+                    w._enqueue(("ADDED", obj_util.freeze(item)))
             self._watches.append(w)
             return w
 
@@ -497,12 +527,20 @@ class APIServer:
     def _notify(self, event_type: str, obj: Obj) -> None:
         kind = obj.get("kind", "")
         ns = obj.get("metadata", {}).get("namespace", "")
+        # ONE frozen snapshot per event, shared by every watcher: the
+        # old per-watcher deepcopy made each write O(watchers × size).
+        # freeze() builds an independent read-only tree, so later store
+        # mutations can't leak into delivered events, and readers that
+        # try to mutate get FrozenObjectError instead of corruption.
+        shared: Optional[Obj] = None
         for w in list(self._watches):
             if w.kind != kind:
                 continue
             if w.namespace and w.namespace != ns:
                 continue
-            w._enqueue((event_type, obj_util.deepcopy(obj)))
+            if shared is None:
+                shared = obj_util.freeze(obj)
+            w._enqueue((event_type, shared))
 
     # -- convenience --------------------------------------------------------
 
@@ -583,20 +621,27 @@ class APIServer:
         limit = self.EVENT_RETENTION
         with self._lock:
             info = self.type_info("Event")
-            store = self._store["Event"]
+            bucket = self._ns_buckets["Event"].get(namespace, {})
             names = [
                 # resourceVersion is the store's monotonic clock —
                 # wall-clock timestamps tie within a millisecond
                 (int(obj["metadata"]["resourceVersion"]), name)
-                for (ns, name), obj in store.items()
-                if ns == namespace
+                for (_, name), obj in bucket.items()
             ]
             if len(names) <= limit:
                 return
             names.sort()  # oldest first
             drop = names[: len(names) - limit]
             for _, name in drop:
-                store.pop(self._key(info, namespace, name), None)
+                key = self._key(info, namespace, name)
+                expired = self._store["Event"].get(key)
+                self._drop("Event", key)
+                if expired is not None:
+                    # watchers (and the informer cache) must see the
+                    # expiry, or they'd retain pruned events forever —
+                    # kube-apiserver's TTL expiry likewise ends watches
+                    # with DELETED
+                    self._notify("DELETED", expired)
             dead = {name for _, name in drop}
             self._event_index = {
                 k: v for k, v in self._event_index.items() if v not in dead

@@ -58,6 +58,46 @@ static PyObject* tree_copy(PyObject* obj) {
     Py_INCREF(obj);
     return obj;
   }
+  // dict/list SUBCLASSES (the frozen read-only wrappers the informer
+  // cache hands out, machinery/objects.py FrozenDict/FrozenList) copy
+  // into PLAIN dicts/lists: this is the fast path behind mutable(),
+  // the cache's copy-on-write escape hatch. PyDict_Next / the list
+  // item API read the concrete storage directly, so no (blocked)
+  // subclass method is ever invoked.
+  if (PyDict_Check(obj)) {
+    PyObject* out = PyDict_New();
+    if (!out) return NULL;
+    PyObject *key, *value;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(obj, &pos, &key, &value)) {
+      PyObject* cv = tree_copy(value);
+      if (!cv) {
+        Py_DECREF(out);
+        return NULL;
+      }
+      if (PyDict_SetItem(out, key, cv) < 0) {
+        Py_DECREF(cv);
+        Py_DECREF(out);
+        return NULL;
+      }
+      Py_DECREF(cv);
+    }
+    return out;
+  }
+  if (PyList_Check(obj)) {
+    Py_ssize_t n = PyList_GET_SIZE(obj);
+    PyObject* out = PyList_New(n);
+    if (!out) return NULL;
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* cv = tree_copy(PyList_GET_ITEM(obj, i));
+      if (!cv) {
+        Py_DECREF(out);
+        return NULL;
+      }
+      PyList_SET_ITEM(out, i, cv);  // steals cv
+    }
+    return out;
+  }
   return PyObject_CallFunctionObjArgs(g_copy_deepcopy, obj, NULL);
 }
 

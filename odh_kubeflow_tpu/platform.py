@@ -39,6 +39,11 @@ from odh_kubeflow_tpu.controllers.profile import ProfileController
 from odh_kubeflow_tpu.controllers.runtime import Manager
 from odh_kubeflow_tpu.controllers.tensorboard import TensorboardController
 from odh_kubeflow_tpu.machinery import httpapi
+from odh_kubeflow_tpu.machinery.cache import (
+    CachedClient,
+    InformerCache,
+    register_platform_indexers,
+)
 from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
 from odh_kubeflow_tpu.machinery.store import APIServer
 from odh_kubeflow_tpu.scheduling import register_scheduling
@@ -106,16 +111,27 @@ class Platform:
         # all scrape from the apiserver's /metrics
         self.metrics_registry = prometheus.Registry()
 
+        # the shared informer cache + indexed zero-copy client: every
+        # controller and web backend reads through it; writes and
+        # watches pass straight to the store. The webhooks and the
+        # kubelet sim stay on the raw store (they run INSIDE the write
+        # path and must see uncached truth).
+        self.cache = InformerCache(self.api, registry=self.metrics_registry)
+        register_platform_indexers(self.cache)
+        self.cached_api = CachedClient(self.api, self.cache)
+
         self.nb_config = nb_config or NotebookControllerConfig.from_env()
         culler_cfg = CullerConfig(
             cull_idle_seconds=self.nb_config.cull_idle_seconds,
             idleness_check_seconds=self.nb_config.idleness_check_seconds,
             cluster_domain=self.nb_config.cluster_domain,
         )
-        self.culler = Culler(self.api, culler_cfg)
-        self.manager = Manager(self.api, registry=self.metrics_registry)
+        self.culler = Culler(self.cached_api, culler_cfg)
+        self.manager = Manager(
+            self.api, registry=self.metrics_registry, cache=self.cache
+        )
         self.notebook_controller = NotebookController(
-            self.api,
+            self.cached_api,
             self.nb_config,
             registry=self.metrics_registry,
             culler=self.culler if self.nb_config.enable_culling else None,
@@ -125,22 +141,22 @@ class Platform:
         # controller only creates Workloads when queueing is on, and
         # without a scheduler they would pend forever
         self.scheduler = (
-            SliceScheduler(self.api, registry=self.metrics_registry)
+            SliceScheduler(self.cached_api, registry=self.metrics_registry)
             if self.nb_config.enable_queueing
             else None
         )
         if self.scheduler is not None:
             self.scheduler.register(self.manager)
-        self.profile_controller = ProfileController(self.api)
+        self.profile_controller = ProfileController(self.cached_api)
         self.profile_controller.register(self.manager)
-        self.tensorboard_controller = TensorboardController(self.api)
+        self.tensorboard_controller = TensorboardController(self.cached_api)
         self.tensorboard_controller.register(self.manager)
 
-        self.jwa = JupyterWebApp(self.api, config_path=spawner_config_path)
-        self.vwa = VolumesWebApp(self.api)
-        self.twa = TensorboardsWebApp(self.api)
-        self.kfam = KfamApp(self.api)
-        self.dashboard = DashboardApp(self.api, kfam=self.kfam.service)
+        self.jwa = JupyterWebApp(self.cached_api, config_path=spawner_config_path)
+        self.vwa = VolumesWebApp(self.cached_api)
+        self.twa = TensorboardsWebApp(self.cached_api)
+        self.kfam = KfamApp(self.cached_api)
+        self.dashboard = DashboardApp(self.cached_api, kfam=self.kfam.service)
 
         self.web = PrefixRouter(self.dashboard.app)
         self.web.mount("/jupyter", self.jwa.app)

@@ -35,7 +35,9 @@ from typing import Any, Callable, Optional
 from odh_kubeflow_tpu.apis import pod_tpu_chips
 from odh_kubeflow_tpu.controllers.runtime import Manager, Request, Result
 from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.cache import list_by_index
 from odh_kubeflow_tpu.machinery.events import EventRecorder
+from odh_kubeflow_tpu.machinery.objects import mutable
 from odh_kubeflow_tpu.machinery.store import Conflict, NotFound
 from odh_kubeflow_tpu.scheduling import (
     STATE_ADMITTED,
@@ -132,7 +134,12 @@ class SliceScheduler:
     def run_cycle(self) -> Result:
         inventory = SliceInventory.snapshot(self.api)
         quotas = QuotaSnapshot.snapshot(self.api)
-        workloads = self.api.list("Workload")
+        # global by design: admission ORDER across every queue is the
+        # cycle's whole job. mutable(): the cycle writes statuses onto
+        # these in-hand objects.
+        workloads = [
+            mutable(w) for w in self.api.list("Workload")  # uncached-ok: global admission order
+        ]
 
         admitted: list[Obj] = []
         pending: list[Obj] = []
@@ -476,10 +483,13 @@ class SliceScheduler:
         snapshot no longer charges this workload."""
         ns = obj_util.namespace_of(wl)
         name = obj_util.name_of(wl)
-        for pod in self.api.list(
+        for pod in list_by_index(
+            self.api,
             "Pod",
+            f"label:{WORKLOAD_LABEL}",
+            name,
             namespace=ns,
-            label_selector={"matchLabels": {WORKLOAD_LABEL: name}},
+            fallback_selector={"matchLabels": {WORKLOAD_LABEL: name}},
         ):
             try:
                 self.api.delete("Pod", obj_util.name_of(pod), ns)
@@ -562,22 +572,36 @@ class SliceScheduler:
         """Non-gang TPU pods charge QUOTA for their whole active life
         (ResourceQuota charges at creation — the kubelet ledger counts
         them bound or not, and admission must agree or it overshoots
-        the cap) but charge INVENTORY only once bound to a node."""
-        for pod in self.api.list("Pod"):
-            if WORKLOAD_LABEL in obj_util.labels_of(pod):
-                continue  # gang pods are charged via their Workload
-            if obj_util.get_path(pod, "status", "phase") in (
-                "Succeeded",
-                "Failed",
-            ):
-                continue
-            chips = int(pod_tpu_chips(pod))
-            if not chips:
-                continue
-            quotas.charge(obj_util.namespace_of(pod), chips)
-            node = obj_util.get_path(pod, "spec", "nodeName")
-            if node:
-                inventory.charge(node, chips)
+        the cap) but charge INVENTORY only once bound to a node.
+
+        Only pods actually requesting TPU chips matter, so the pass
+        walks the ``tpu`` field index — bucket KEY == chip count,
+        precomputed when the watch event was applied — instead of
+        scanning (and resource-parsing) every pod in the cluster;
+        without a cache it degrades to the full list it used to be."""
+        index_buckets = getattr(self.api, "index_buckets", None)
+        buckets = index_buckets("Pod", "tpu") if index_buckets else None
+        if buckets is None:
+            scan = self.api.list("Pod")  # uncached-ok: no cache to index
+            buckets = {}
+            for pod in scan:
+                chips = int(pod_tpu_chips(pod))
+                if chips:
+                    buckets.setdefault(str(chips), []).append(pod)
+        for chips_str, pods in buckets.items():
+            chips = int(chips_str)
+            for pod in pods:
+                if WORKLOAD_LABEL in obj_util.labels_of(pod):
+                    continue  # gang pods are charged via their Workload
+                if obj_util.get_path(pod, "status", "phase") in (
+                    "Succeeded",
+                    "Failed",
+                ):
+                    continue
+                quotas.charge(obj_util.namespace_of(pod), chips)
+                node = obj_util.get_path(pod, "spec", "nodeName")
+                if node:
+                    inventory.charge(node, chips)
 
     def _write_pending(
         self, wl: Obj, reason: str, message: str, position: int

@@ -295,10 +295,16 @@ class Registry:
         with self._lock:
             metrics = list(self._metrics)
             fns = list(self._collect_fns)
+        # custom collectors run FIRST (their lines still render last):
+        # some flush batched hot-path counts into registered families
+        # (informer cache hits/misses), which must land before those
+        # families render
+        collector_lines: list[str] = []
+        for fn in fns:
+            collector_lines.extend(fn())
         for m in metrics:
             lines.extend(m.collect())
-        for fn in fns:
-            lines.extend(fn())
+        lines.extend(collector_lines)
         return "\n".join(lines) + "\n"
 
 

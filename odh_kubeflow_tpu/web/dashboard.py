@@ -249,7 +249,7 @@ class DashboardApp:
             user_of(request)
             capacity: dict[str, float] = {}
             used: dict[str, float] = {}
-            for node in self.api.list("Node"):
+            for node in self.api.list("Node"):  # uncached-ok: cluster inventory
                 labels = obj_util.labels_of(node)
                 accel = labels.get("cloud.google.com/gke-tpu-accelerator")
                 if not accel:
@@ -260,7 +260,17 @@ class DashboardApp:
                     )
                 )
                 capacity[accel] = capacity.get(accel, 0) + cap
-            for pod in self.api.list("Pod"):
+            # only pods holding TPU chips matter — the ``tpu`` field
+            # index (all buckets) replaces the all-pods scan on the
+            # cached path
+            index_buckets = getattr(self.api, "index_buckets", None)
+            buckets = index_buckets("Pod", "tpu") if index_buckets else None
+            tpu_pods = (
+                [p for pods in buckets.values() for p in pods]
+                if buckets is not None
+                else self.api.list("Pod")  # uncached-ok: no cache to index
+            )
+            for pod in tpu_pods:
                 if obj_util.get_path(pod, "status", "phase") != "Running":
                     continue
                 sel = obj_util.get_path(
@@ -287,7 +297,7 @@ class DashboardApp:
                         }
                         for accel, cap in sorted(capacity.items())
                     ],
-                    "notebooks": len(self.api.list("Notebook")),
+                    "notebooks": len(self.api.list("Notebook")),  # uncached-ok: count only
                 }
             )
 

@@ -33,6 +33,7 @@ from odh_kubeflow_tpu.apis import (
     TPU_TOPOLOGY_ANNOTATION,
 )
 from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.cache import list_by_index
 from odh_kubeflow_tpu.machinery.store import APIServer, NotFound
 from odh_kubeflow_tpu.utils.tpu import TPU_TOPOLOGIES
 from odh_kubeflow_tpu.web.crud_backend import (
@@ -257,6 +258,8 @@ class JupyterWebApp(CrudBackend):
             container = obj_util.get_path(
                 nb, "spec", "template", "spec", "containers", 0, default={}
             ) or {}
+            # the notebook's own pod family via the statefulset label
+            # index — not a namespace scan filtered by name pattern
             pods = [
                 {
                     "name": obj_util.name_of(p),
@@ -267,9 +270,13 @@ class JupyterWebApp(CrudBackend):
                         p, "spec", "nodeName", default=""
                     ),
                 }
-                for p in self.api.list("Pod", namespace=namespace)
-                if _event_belongs_to_notebook(
-                    {"kind": "Pod", "name": obj_util.name_of(p)}, name
+                for p in list_by_index(
+                    self.api,
+                    "Pod",
+                    "label:statefulset",
+                    name,
+                    namespace=namespace,
+                    fallback_selector={"matchLabels": {"statefulset": name}},
                 )
             ]
             return success({
@@ -392,7 +399,7 @@ class JupyterWebApp(CrudBackend):
     def available_tpus(self) -> list[Obj]:
         """config accelerators ∩ cluster node capacity (get.py:52-73)."""
         present: dict[str, set[str]] = {}
-        for node in self.api.list("Node"):
+        for node in self.api.list("Node"):  # uncached-ok: cluster inventory
             labels = obj_util.labels_of(node)
             accel = labels.get(TPU_ACCEL_NODE_LABEL)
             capacity = obj_util.get_path(
@@ -702,12 +709,27 @@ class JupyterWebApp(CrudBackend):
     def _find_error_event(self, nb: Obj) -> Optional[str]:
         """CR events first (the controller re-emits owned STS/Pod events
         onto the Notebook), then raw namespace-event mining as fallback
-        for anything the mirror missed."""
+        for anything the mirror missed. The CR check reads the
+        ``involved`` event index when a cache serves Events — the
+        common case (a mirrored warning exists) never scans."""
         name = obj_util.name_of(nb)
+        ns = obj_util.namespace_of(nb)
+        by_index = getattr(self.api, "by_index", None)
+        if by_index is not None:
+            mirrored = by_index(
+                "Event", "involved", f"Notebook/{name}", namespace=ns
+            )
+            if mirrored is not None:
+                for event in mirrored:
+                    if (
+                        event.get("type") == "Warning"
+                        and event.get("involvedObject", {}).get("kind")
+                        == "Notebook"
+                    ):
+                        return event.get("message", event.get("reason", ""))
+                # no CR-level warning → fall through to family mining
         fallback: Optional[str] = None
-        for event in self.api.list(
-            "Event", namespace=obj_util.namespace_of(nb)
-        ):
+        for event in self.api.list("Event", namespace=ns):
             if event.get("type") != "Warning":
                 continue
             involved = event.get("involvedObject", {})

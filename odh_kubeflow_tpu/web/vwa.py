@@ -97,8 +97,14 @@ class VolumesWebApp(CrudBackend):
     def _mounting_pods(self, namespace: str, name: str) -> list:
         """The pods mounting ``name``, as rich rows (name, phase, mount
         paths) — the ONE pod scan every used-by surface derives from."""
+        from odh_kubeflow_tpu.machinery.cache import list_by_index
+
         out = []
-        for pod in self.api.list("Pod", namespace=namespace):
+        # ``pvc`` field index: only pods actually mounting the claim
+        # (namespace scan only when no cache serves Pods)
+        for pod in list_by_index(
+            self.api, "Pod", "pvc", name, namespace=namespace
+        ):
             vols = obj_util.get_path(pod, "spec", "volumes", default=[]) or []
             vol_names = {
                 v.get("name")

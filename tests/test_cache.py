@@ -1,0 +1,534 @@
+"""Informer cache tests: coherence under randomized CRUD, frozen
+(zero-copy) read semantics, field/label indexes, resync healing,
+metrics, and the tier-1 hot-path lint that keeps uncached scans from
+creeping back into controllers and web backends."""
+
+import os
+import random
+import re
+
+import pytest
+
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.cache import (
+    CachedClient,
+    FrozenObjectError,
+    InformerCache,
+    freeze,
+    is_frozen,
+    list_by_index,
+    mutable,
+    register_platform_indexers,
+)
+from odh_kubeflow_tpu.machinery.store import APIServer, NotFound
+from odh_kubeflow_tpu.utils import prometheus
+
+
+def _pod(name, ns="default", labels=None, chips=None, node=None, claims=()):
+    spec = {"containers": [{"name": name}]}
+    if chips:
+        spec["containers"][0]["resources"] = {
+            "limits": {"google.com/tpu": str(chips)},
+            "requests": {"google.com/tpu": str(chips)},
+        }
+    if node:
+        spec["nodeName"] = node
+    if claims:
+        spec["volumes"] = [
+            {"name": c, "persistentVolumeClaim": {"claimName": c}}
+            for c in claims
+        ]
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": spec,
+    }
+
+
+def _cache(api, kinds=("Pod", "StatefulSet", "Event", "ConfigMap")):
+    cache = InformerCache(api, kinds=kinds, registry=prometheus.Registry())
+    return cache
+
+
+# ---------------------------------------------------------------------------
+# frozen semantics
+
+
+def test_freeze_mutable_roundtrip_and_isolation():
+    tree = {"a": {"b": [1, {"c": "x"}]}, "n": 3}
+    frozen = freeze(tree)
+    assert is_frozen(frozen) and frozen == tree
+    with pytest.raises(FrozenObjectError):
+        frozen["a"] = 1
+    with pytest.raises(FrozenObjectError):
+        frozen["a"]["b"].append(2)
+    with pytest.raises(FrozenObjectError):
+        frozen["a"]["b"][1]["c"] = "y"
+    # setdefault on a PRESENT key is a read (meta() relies on this)
+    assert frozen.setdefault("n") == 3
+    with pytest.raises(FrozenObjectError):
+        frozen.setdefault("missing", 1)
+    thawed = mutable(frozen)
+    assert thawed == tree and type(thawed) is dict
+    thawed["a"]["b"].append(2)  # private copy, frozen untouched
+    assert len(frozen["a"]["b"]) == 2
+    # plain objects pass through mutable() unchanged (no double copy)
+    plain = {"x": 1}
+    assert mutable(plain) is plain
+
+
+def test_reader_mutation_raises_instead_of_corrupting_store():
+    """A reader that mutates a cached object must blow up loudly —
+    and the store's truth must be unaffected."""
+    api = APIServer()
+    api.create(_pod("p1", labels={"app": "x"}))
+    cache = _cache(api)
+    cache.start(live=False)
+    client = CachedClient(api, cache)
+
+    pod = client.get("Pod", "p1", "default")
+    with pytest.raises(FrozenObjectError):
+        pod["metadata"]["labels"]["app"] = "evil"
+    with pytest.raises(FrozenObjectError):
+        pod["spec"]["containers"].pop()
+    stored = api.get("Pod", "p1", "default")
+    assert stored["metadata"]["labels"] == {"app": "x"}
+    assert len(stored["spec"]["containers"]) == 1
+
+
+def test_zero_deepcopies_on_cached_read_hits():
+    api = APIServer()
+    for i in range(10):
+        api.create(_pod(f"p{i}", labels={"statefulset": "web"}))
+    cache = _cache(api)
+    register_platform_indexers(cache)
+    cache.start(live=False)
+    client = CachedClient(api, cache)
+    client.get("Pod", "p3", "default")  # prime _ready
+
+    before = obj_util.deepcopy_count()
+    for _ in range(50):
+        client.get("Pod", "p3", "default")
+        client.list("Pod", namespace="default")
+        client.list(
+            "Pod",
+            namespace="default",
+            label_selector={"matchLabels": {"statefulset": "web"}},
+        )
+        client.by_index("Pod", "label:statefulset", "web")
+    assert obj_util.deepcopy_count() == before, (
+        "cached read hits must be zero-copy"
+    )
+    # and the uncached store path DOES copy (the contrast the cache kills)
+    api.get("Pod", "p3", "default")
+    assert obj_util.deepcopy_count() > before
+
+
+# ---------------------------------------------------------------------------
+# coherence
+
+
+def _cache_state(cache, kind):
+    with cache._lock:
+        return {
+            k: (o["metadata"]["name"], o["metadata"]["resourceVersion"])
+            for k, o in cache._kinds[kind].objects.items()
+        }
+
+
+def _store_state(api, kind):
+    return {
+        (obj_util.namespace_of(o), obj_util.name_of(o)): (
+            o["metadata"]["name"],
+            o["metadata"]["resourceVersion"],
+        )
+        for o in api.list(kind)
+    }
+
+
+def test_cache_coherence_property_randomized_crud():
+    """Randomized create/update/patch/delete interleaved with informer
+    delivery always converges to exactly the store state."""
+    rng = random.Random(7)
+    api = APIServer()
+    cache = _cache(api, kinds=("ConfigMap",))
+    cache.start(live=False)
+    live: set[str] = set()
+    for step in range(400):
+        op = rng.random()
+        name = f"cm-{rng.randrange(40)}"
+        ns = f"ns-{rng.randrange(3)}"
+        key = f"{ns}/{name}"
+        try:
+            if op < 0.45 or not live:
+                api.create(
+                    {
+                        "apiVersion": "v1",
+                        "kind": "ConfigMap",
+                        "metadata": {"name": name, "namespace": ns},
+                        "data": {"v": str(step)},
+                    }
+                )
+                live.add(key)
+            elif op < 0.75:
+                api.patch("ConfigMap", name, {"data": {"v": str(step)}}, ns)
+            else:
+                api.delete("ConfigMap", name, ns)
+                live.discard(key)
+        except Exception:  # noqa: BLE001 — AlreadyExists / NotFound races
+            pass
+        if rng.random() < 0.3:  # informer applies in bursts
+            cache.drain_once()
+    cache.drain_once()
+    assert _cache_state(cache, "ConfigMap") == _store_state(api, "ConfigMap")
+
+
+def test_resync_heals_dropped_event():
+    api = APIServer()
+    cache = _cache(api, kinds=("ConfigMap",))
+    cache.start(live=False)
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "a", "namespace": "default"},
+            "data": {"v": "1"},
+        }
+    )
+    # drop the ADDED event behind the informer's back (a lossy watch)
+    assert cache._watches["ConfigMap"].try_get() is not None
+    cache.drain_once()
+    assert _cache_state(cache, "ConfigMap") != _store_state(api, "ConfigMap")
+
+    before = cache.m_resync.value()
+    cache.resync("ConfigMap")
+    assert _cache_state(cache, "ConfigMap") == _store_state(api, "ConfigMap")
+    assert cache.m_resync.value() == before + 1
+
+
+def test_rv_guard_ignores_stale_out_of_order_events():
+    api = APIServer()
+    cache = _cache(api, kinds=("ConfigMap",))
+    cache.start(live=False)
+    cm = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": "a", "namespace": "default"},
+        "data": {"v": "1"},
+    }
+    api.create(cm)
+    cache.drain_once()
+    fresh = api.get("ConfigMap", "a", "default")
+    # replay a STALE synthetic event (rv far in the past)
+    stale = obj_util.deepcopy(fresh)
+    stale["data"] = {"v": "stale"}
+    stale["metadata"]["resourceVersion"] = "0"
+    assert cache._apply("ConfigMap", "MODIFIED", stale) is None
+    assert (
+        cache.get("ConfigMap", "a", "default")["data"]["v"] == "1"
+    )
+    # a DELETED drained ahead of its ADDED leaves a tombstone that
+    # blocks the late ADDED from resurrecting the object
+    api.delete("ConfigMap", "a", "default")
+    deleted_rv = fresh["metadata"]["resourceVersion"]
+    cache.drain_once()
+    late_added = obj_util.deepcopy(fresh)
+    assert cache._apply("ConfigMap", "ADDED", late_added) is None
+    with pytest.raises(NotFound):
+        cache.get("ConfigMap", "a", "default")
+    assert int(deleted_rv) <= cache._kinds["ConfigMap"].tombstones[
+        ("default", "a")
+    ]
+
+
+# ---------------------------------------------------------------------------
+# indexes
+
+
+def test_platform_indexers_pods_sts_nodes_events():
+    api = APIServer()
+    from odh_kubeflow_tpu.apis import register_crds
+    from odh_kubeflow_tpu.scheduling import register_scheduling
+
+    register_crds(api)
+    register_scheduling(api)
+    cache = InformerCache(api, registry=prometheus.Registry())
+    register_platform_indexers(cache)
+    sts = api.create(
+        {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {
+                "name": "web",
+                "namespace": "default",
+                "labels": {"notebook-name": "web"},
+            },
+            "spec": {},
+        }
+    )
+    uid = sts["metadata"]["uid"]
+    pod = _pod(
+        "web-0",
+        labels={"statefulset": "web"},
+        chips=4,
+        node="n1",
+        claims=("data",),
+    )
+    pod["metadata"]["ownerReferences"] = [
+        {"kind": "StatefulSet", "name": "web", "uid": uid, "controller": True}
+    ]
+    api.create(pod)
+    api.create(_pod("other", ns="default"))
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Node",
+            "metadata": {
+                "name": "n1",
+                "labels": {"cloud.google.com/gke-nodepool": "pool-a"},
+            },
+        }
+    )
+    api.emit_event(sts, "Bang", "boom", event_type="Warning")
+    cache.start(live=False)
+    client = CachedClient(api, cache)
+
+    assert [
+        obj_util.name_of(p) for p in client.by_index("Pod", "owner-uid", uid)
+    ] == ["web-0"]
+    assert client.index_buckets("Pod", "tpu") == {
+        "4": client.by_index("Pod", "tpu", "4")
+    }
+    assert [
+        obj_util.name_of(p) for p in client.by_index("Pod", "pvc", "data")
+    ] == ["web-0"]
+    assert [
+        obj_util.name_of(n)
+        for n in client.by_index("Node", "nodepool", "pool-a")
+    ] == ["n1"]
+    assert [
+        obj_util.name_of(s)
+        for s in client.by_index("StatefulSet", "owner-uid", "")
+        or client.by_index("StatefulSet", "label:notebook-name", "web")
+    ] == ["web"]
+    events = client.by_index("Event", "involved", "StatefulSet/web")
+    assert len(events) == 1 and events[0]["reason"] == "Bang"
+    # selector lists route through the label index transparently
+    before = obj_util.deepcopy_count()
+    out = client.list(
+        "Pod",
+        namespace="default",
+        label_selector={"matchLabels": {"statefulset": "web"}},
+    )
+    assert [obj_util.name_of(p) for p in out] == ["web-0"]
+    assert obj_util.deepcopy_count() == before
+    # index maintenance on delete
+    api.delete("Pod", "web-0", "default")
+    cache.drain_once()
+    assert client.by_index("Pod", "owner-uid", uid) == []
+    assert client.index_buckets("Pod", "tpu") == {}
+
+
+def test_list_by_index_falls_back_without_cache():
+    api = APIServer()
+    api.create(_pod("a", labels={"statefulset": "web"}))
+    api.create(_pod("b", labels={"statefulset": "other"}))
+    out = list_by_index(
+        api,
+        "Pod",
+        "label:statefulset",
+        "web",
+        namespace="default",
+        fallback_selector={"matchLabels": {"statefulset": "web"}},
+    )
+    assert [obj_util.name_of(p) for p in out] == ["a"]
+
+
+# ---------------------------------------------------------------------------
+# CachedClient semantics + metrics
+
+
+def test_cached_client_hits_misses_and_fallthrough():
+    api = APIServer()
+    api.create(_pod("p1"))
+    cache = _cache(api, kinds=("Pod",))
+    cache.start(live=False)
+    client = CachedClient(api, cache)
+
+    assert client.get("Pod", "p1", "default")["metadata"]["name"] == "p1"
+    client.list("Pod", namespace="default")
+    # Service is NOT cached → served by the store (miss)
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": "s", "namespace": "default"},
+            "spec": {},
+        }
+    )
+    assert client.get("Service", "s", "default")["metadata"]["name"] == "s"
+    with pytest.raises(NotFound):
+        client.get("Pod", "absent", "default")
+    cache.flush_metrics()
+    assert cache.m_hits.value({"kind": "Pod"}) == 2
+    assert cache.m_misses.value({"kind": "Service"}) == 1
+    assert cache.m_misses.value({"kind": "Pod"}) == 1  # absent → fell through
+
+    # read-your-writes: a just-created object is visible immediately
+    # (poke drains the pending watch event before the lookup)
+    api.create(_pod("p2"))
+    assert client.get("Pod", "p2", "default")["metadata"]["name"] == "p2"
+    # writes delegate to the store through the same façade
+    client.delete("Pod", "p2", "default")
+    with pytest.raises(NotFound):
+        client.get("Pod", "p2", "default")
+
+
+def test_event_coalescing_counts_superseded_events():
+    api = APIServer()
+    cache = _cache(api, kinds=("ConfigMap",))
+    cache.start(live=False)
+    cm = api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "a", "namespace": "default"},
+            "data": {"v": "0"},
+        }
+    )
+    for i in range(5):
+        cm["data"] = {"v": str(i + 1)}
+        cm = api.update(cm)
+    before = cache.m_coalesced.value()
+    cache.drain_once()
+    # 6 queued events (ADDED + 5 MODIFIED) for one object → 1 applied
+    assert cache.m_coalesced.value() - before == 5
+    assert cache.get("ConfigMap", "a", "default")["data"]["v"] == "5"
+
+
+def test_event_prune_notifies_cache():
+    api = APIServer()
+    api.EVENT_RETENTION = 10
+    cache = _cache(api, kinds=("Event",))
+    cache.start(live=False)
+    cm = api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "a", "namespace": "default"},
+        }
+    )
+    for i in range(25):
+        api.emit_event(cm, f"R{i}", f"msg {i}")
+    cache.drain_once()
+    assert len(cache.list("Event", namespace="default")) == len(
+        api.list("Event", namespace="default")
+    )
+
+
+def test_cache_metric_names_pass_naming_lint():
+    registry = prometheus.Registry()
+    InformerCache(APIServer(), registry=registry)
+    assert prometheus.lint_metric_names(registry) == []
+    names = {m.name for m in registry.metrics()}
+    assert {
+        "cache_hits_total",
+        "cache_misses_total",
+        "cache_resync_total",
+        "watch_events_coalesced_total",
+        "cache_staleness_seconds",
+    } <= names
+
+
+def test_shared_frozen_event_across_watchers():
+    """_notify hands the SAME frozen object to every watcher — one
+    copy per event, not one per watcher."""
+    api = APIServer()
+    w1 = api.watch("ConfigMap")
+    w2 = api.watch("ConfigMap")
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "a", "namespace": "default"},
+        }
+    )
+    e1, e2 = w1.get(timeout=1), w2.get(timeout=1)
+    assert e1[1] is e2[1]
+    assert is_frozen(e1[1])
+    with pytest.raises(FrozenObjectError):
+        e1[1]["metadata"]["name"] = "evil"
+    w1.stop()
+    w2.stop()
+
+
+# ---------------------------------------------------------------------------
+# manager integration
+
+
+def test_manager_owns_cache_and_controllers_source_from_informer():
+    from odh_kubeflow_tpu.controllers.runtime import Manager, Result
+
+    api = APIServer()
+    cache = _cache(api, kinds=("ConfigMap",))
+    mgr = Manager(api, cache=cache)
+    seen = []
+
+    def reconcile(req):
+        seen.append((req.namespace, req.name))
+        return Result()
+
+    ctrl = mgr.new_controller("cm-test", "ConfigMap", reconcile)
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "a", "namespace": "default"},
+        }
+    )
+    mgr.drain()
+    assert ("default", "a") in seen
+    # the controller did NOT open a private watch for the cached kind
+    assert ctrl._watches == [None]
+    assert cache.synced("ConfigMap")
+
+
+# ---------------------------------------------------------------------------
+# tier-1 lint: no uncached cluster-wide scans on hot paths
+
+# kinds whose unselective cluster-wide list is always a smell in a hot
+# path (either use the namespace/selector/index forms, or mark the
+# line `# uncached-ok: <reason>` for genuinely global cold/snapshot
+# passes)
+_SCAN_KINDS = (
+    "Pod|StatefulSet|Deployment|Service|Event|Node|Notebook|"
+    "PersistentVolumeClaim|ResourceQuota|Secret"
+)
+_HOT_DIRS = ("controllers", "web", "scheduling", "webhooks")
+_BARE_LIST = re.compile(
+    r"""\.list\(\s*["'](%s)["']\s*\)""" % _SCAN_KINDS
+)
+
+
+def test_hot_paths_have_no_unindexed_cluster_scans():
+    root = os.path.join(os.path.dirname(__file__), "..", "odh_kubeflow_tpu")
+    violations = []
+    for sub in _HOT_DIRS:
+        d = os.path.join(root, sub)
+        for fname in sorted(os.listdir(d)):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(d, fname)
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    if not _BARE_LIST.search(line):
+                        continue
+                    if "uncached-ok" in line:
+                        continue
+                    violations.append(f"{sub}/{fname}:{lineno}: {line.strip()}")
+    assert violations == [], (
+        "cluster-wide list of an indexable kind on a hot path; use a "
+        "namespaced/selector/indexed read or annotate the line with "
+        "`# uncached-ok: <reason>`:\n" + "\n".join(violations)
+    )
