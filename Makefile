@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint loadtest images bench dryrun platform serve spawn-latency native kind-smoke conformance
+.PHONY: all test test-unit test-manifests lint sanitize loadtest images bench dryrun platform serve spawn-latency native kind-smoke conformance
 
 all: lint test
 
@@ -25,8 +25,21 @@ test-manifests:
 conformance:
 	$(PYTHON) -m odh_kubeflow_tpu.conformance
 
+# syntax check + graftlint (AST invariant rules: frozen-mutation,
+# uncached-list, swallowed-exception, blocking-under-lock,
+# metric-naming — see docs/GUIDE.md "Static analysis & concurrency
+# discipline"); exit-code gated
 lint:
 	$(PYTHON) -m compileall -q odh_kubeflow_tpu tests loadtest bench.py __graft_entry__.py
+	$(PYTHON) -m odh_kubeflow_tpu.analysis
+
+# the randomized property suites re-run as race probes: sanitized
+# locks record acquisition order, re-entry, and blocking-under-lock
+sanitize:
+	GRAFT_SANITIZE=1 $(PYTHON) -m pytest -q \
+	  tests/test_analysis.py \
+	  tests/test_cache.py::test_cache_coherence_property_randomized_crud \
+	  tests/test_scheduling.py::test_property_random_admit_preempt_node_loss_sequences
 
 # platform load test against the embedded apiserver + sim kubelet
 # (loadtest/start_notebooks.py; reference notebook-controller/loadtest)

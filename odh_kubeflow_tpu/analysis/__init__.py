@@ -1,0 +1,40 @@
+"""Static analysis + runtime concurrency sanitizer for the platform.
+
+Two halves, one entry point:
+
+- **graftlint** (``analysis/graftlint.py`` + ``analysis/rules.py``):
+  AST-based invariant rules — frozen-mutation, uncached-list,
+  swallowed-exception, blocking-under-lock, metric-naming — with
+  per-line suppression and file/rule allowlists. Run with
+  ``python -m odh_kubeflow_tpu.analysis`` (exit-code gated, wired
+  into ``make lint`` and CI).
+- **sanitizer** (``analysis/sanitizer.py``): the ``GRAFT_SANITIZE=1``
+  lock-wrapping layer that turns the randomized property tests into
+  race probes (lock-order inversions, non-reentrant re-entry,
+  blocking calls under store/cache locks).
+
+This module is also the platform's single lint entry point:
+``lint_registry`` re-exports the live-registry metric naming lint so
+callers need exactly one import for every lint surface.
+"""
+
+from odh_kubeflow_tpu.analysis import sanitizer  # noqa: F401
+from odh_kubeflow_tpu.analysis.graftlint import (  # noqa: F401
+    RULES,
+    Finding,
+    Rule,
+    SourceFile,
+    active_rules,
+    lint_source,
+    main,
+    register,
+    run_package,
+    run_paths,
+    run_source,
+)
+from odh_kubeflow_tpu.analysis.rules import (  # noqa: F401
+    metric_definition_sites,
+)
+from odh_kubeflow_tpu.utils.prometheus import (  # noqa: F401
+    lint_metric_names as lint_registry,
+)

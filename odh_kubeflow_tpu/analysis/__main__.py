@@ -1,0 +1,9 @@
+"""``python -m odh_kubeflow_tpu.analysis`` — run graftlint over the
+package (or given paths) and exit non-zero on findings. The CI lint
+step and ``make lint`` gate on this."""
+
+import sys
+
+from odh_kubeflow_tpu.analysis.graftlint import main
+
+sys.exit(main())

@@ -1,0 +1,569 @@
+"""The platform's graftlint rules.
+
+Each rule encodes one invariant the control plane relies on but the
+language cannot enforce. Rules are deliberately conservative: a rule
+that cries wolf gets suppressed wholesale and protects nothing, so
+every heuristic here is tuned to flag the shapes that are bugs in
+THIS codebase (see each rule's docstring for the exact contract).
+
+Add a rule by subclassing :class:`graftlint.Rule`, decorating with
+``@register``, and giving it a fixture-proven true positive in
+``tests/test_analysis.py`` — the whole-package gate keeps the tree
+clean against it from then on.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from odh_kubeflow_tpu.analysis.graftlint import (
+    Finding,
+    Rule,
+    SourceFile,
+    register,
+)
+from odh_kubeflow_tpu.utils.prometheus import metric_name_violations
+
+# kinds whose unselective cluster-wide list is always a smell on a hot
+# path (they all have namespace buckets and/or platform indexers)
+INDEXABLE_KINDS = frozenset(
+    {
+        "Pod",
+        "StatefulSet",
+        "Deployment",
+        "Service",
+        "Event",
+        "Node",
+        "Notebook",
+        "PersistentVolumeClaim",
+        "ResourceQuota",
+        "Secret",
+    }
+)
+
+# dict/list mutators that modify in place (FrozenDict/FrozenList raise
+# on every one of these)
+_MUTATORS = frozenset(
+    {
+        "append",
+        "extend",
+        "insert",
+        "pop",
+        "popitem",
+        "clear",
+        "update",
+        "remove",
+        "sort",
+        "reverse",
+        "setdefault",
+    }
+)
+
+
+def _attr_chain(node: ast.AST) -> list[str]:
+    """``self.api.get`` → ["self", "api", "get"]; empty when the
+    expression is not a plain name/attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """The base Name of a subscript/attribute access path
+    (``obj["a"]["b"]`` → "obj"), or None."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# uncached-list
+
+
+@register
+class UncachedListRule(Rule):
+    """AST-accurate replacement for the old grep scan in
+    ``tests/test_cache.py``: a cluster-wide ``.list("<Kind>")`` of an
+    indexable kind — no namespace, no selector, no field match — on a
+    hot path scans and freezes/copies the whole cluster per call. Use
+    the namespaced/selector/indexed read forms, or mark a genuinely
+    global cold/snapshot pass with ``# uncached-ok: <reason>``."""
+
+    id = "uncached-list"
+    description = (
+        "bare cluster-wide list() of an indexable kind on a hot path"
+    )
+    dirs = ("controllers", "web", "scheduling", "webhooks")
+
+    _SELECTIVE_KWARGS = ("namespace", "label_selector", "field_matches")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "list"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value in INDEXABLE_KINDS
+            ):
+                continue
+            kind = node.args[0].value
+            selective = len(node.args) > 1 or any(
+                kw.arg in self._SELECTIVE_KWARGS
+                and not (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None
+                )
+                for kw in node.keywords
+            )
+            if selective:
+                continue
+            # legacy marker continuity: `# uncached-ok: <reason>` on
+            # any line of the call keeps working
+            span = range(node.lineno, (node.end_lineno or node.lineno) + 1)
+            if any("uncached-ok" in src.line(n) for n in span):
+                continue
+            yield self.finding(
+                src,
+                node,
+                f"cluster-wide list of indexable kind {kind!r}; use a "
+                "namespaced/selector/indexed read or annotate with "
+                "`# uncached-ok: <reason>`",
+            )
+
+
+# ---------------------------------------------------------------------------
+# swallowed-exception
+
+
+@register
+class SwallowedExceptionRule(Rule):
+    """A bare ``except:`` or ``except Exception:`` whose body neither
+    re-raises nor leaves any trace (log, Event, metric, or real
+    handling) turns every failure in controllers/webhooks/scheduling/
+    machinery into silence — reconcile loops quietly stop converging.
+    Handlers that do anything observable (a call, a raise, a
+    conditional) pass; only trivially-swallowing bodies (``pass``,
+    ``continue``, ``return <constant>``) are flagged."""
+
+    id = "swallowed-exception"
+    description = "broad except handler that silently discards the error"
+    dirs = ("controllers", "webhooks", "scheduling", "machinery")
+
+    _BROAD = ("Exception", "BaseException")
+
+    def _is_broad(self, handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True
+        if isinstance(t, ast.Name):
+            return t.id in self._BROAD
+        if isinstance(t, ast.Tuple):
+            return any(
+                isinstance(e, ast.Name) and e.id in self._BROAD for e in t.elts
+            )
+        return False
+
+    def _trivial(self, body: list[ast.stmt]) -> bool:
+        for stmt in body:
+            if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+                continue
+            if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Constant
+            ):
+                continue  # docstring / ellipsis
+            if isinstance(stmt, ast.Return):
+                v = stmt.value
+                if v is None or isinstance(v, (ast.Constant, ast.Name)):
+                    continue
+                if isinstance(v, (ast.List, ast.Dict, ast.Tuple, ast.Set)) and not getattr(
+                    v, "elts", getattr(v, "keys", ())
+                ):
+                    continue  # return [] / {} / ()
+            return False
+        return True
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if self._is_broad(node) and self._trivial(node.body):
+                yield self.finding(
+                    src,
+                    node,
+                    "broad except swallows the error with no log/Event/"
+                    "metric; handle it, narrow the exception type, or "
+                    "annotate with a reason",
+                )
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock (static half; analysis/sanitizer.py is the
+# runtime half)
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    """``time.sleep``, HTTP client calls, and blocking queue/watch
+    ``get(timeout=…)`` inside a ``with <lock>:`` block stall every
+    other thread contending for that lock — the exact shape of the
+    PR 1 ``_RateLimiter`` bug. ``Condition.wait`` is exempt (it
+    releases the lock while blocked)."""
+
+    id = "blocking-under-lock"
+    description = "blocking call (sleep/HTTP/watch-get) while holding a lock"
+    files = (
+        "machinery/store.py",
+        "machinery/cache.py",
+        "machinery/client.py",
+        "controllers/runtime.py",
+        "scheduling/scheduler.py",
+        "scheduling/queue.py",
+    )
+
+    _LOCKISH = ("lock", "mutex", "_cv", "cond")
+    _WAITS = frozenset({"wait", "wait_for"})
+
+    def _is_lockish(self, expr: ast.AST) -> bool:
+        chain = _attr_chain(expr)
+        if not chain:
+            return False
+        terminal = chain[-1].lower()
+        return any(marker in terminal for marker in self._LOCKISH)
+
+    def _blocking_call(self, call: ast.Call) -> Optional[str]:
+        chain = _attr_chain(call.func)
+        if not chain:
+            return None
+        terminal = chain[-1]
+        if terminal == "sleep":
+            return "time.sleep"
+        if terminal == "urlopen":
+            return "urllib.request.urlopen"
+        if terminal in ("request", "getresponse") and "http" in " ".join(
+            c.lower() for c in chain[:-1]
+        ):
+            return "http client call"
+        if (
+            terminal == "get"
+            and len(chain) > 1  # a method, not the builtin
+            and any(kw.arg == "timeout" for kw in call.keywords)
+        ):
+            return "blocking get(timeout=…) (queue/Watch)"
+        return None
+
+    def _iter_immediate(self, node: ast.AST) -> Iterator[ast.AST]:
+        """Descendants that execute inside the critical section —
+        nested defs/lambdas run later, outside the lock, and are
+        pruned (``ast.walk`` would descend into them)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield child
+            yield from self._iter_immediate(child)
+
+    def _scan_body(
+        self, src: SourceFile, body: list[ast.stmt]
+    ) -> Iterator[Finding]:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # defined under the lock, executed later
+            for node in [stmt, *self._iter_immediate(stmt)]:
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = _attr_chain(node.func)
+                if chain and chain[-1] in self._WAITS:
+                    continue
+                what = self._blocking_call(node)
+                if what:
+                    yield self.finding(
+                        src,
+                        node,
+                        f"{what} while holding a lock; move the blocking "
+                        "call outside the critical section",
+                    )
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.With):
+                continue
+            if any(
+                self._is_lockish(item.context_expr) for item in node.items
+            ):
+                yield from self._scan_body(src, node.body)
+
+
+# ---------------------------------------------------------------------------
+# metric-naming
+
+
+def metric_definition_sites(
+    root: Optional[str] = None,
+) -> list[tuple[str, str, str, int]]:
+    """Every statically visible metric definition in the package:
+    ``(rel_path, type, name, lineno)`` for ``registry.counter/gauge/
+    histogram("literal", …)`` calls and direct ``Counter/Gauge/
+    Histogram("literal", …)`` constructions. Exposed so tests can
+    assert the scan still sees the platform's metric surface (an empty
+    scan means the detector broke, not that the tree is clean)."""
+    from odh_kubeflow_tpu.analysis.graftlint import iter_sources
+
+    out = []
+    for src in iter_sources(root):
+        for typ, name, node in _iter_metric_defs(src.tree):
+            out.append((src.rel, typ, name, node.lineno))
+    return out
+
+
+_FACTORY_METHODS = {  # registry.counter("name", …) — the common form
+    "counter": "counter",
+    "gauge": "gauge",
+    "histogram": "histogram",
+}
+_CONSTRUCTORS = {  # Counter("name", …) — only when provably prometheus's
+    "Counter": "counter",
+    "Gauge": "gauge",
+    "Histogram": "histogram",
+}
+
+
+def _prometheus_constructor_aliases(tree: ast.AST) -> dict[str, str]:
+    """Local names bound to utils.prometheus's Counter/Gauge/Histogram
+    via ``from … prometheus import`` — so ``collections.Counter("x")``
+    and other same-named classes are never mistaken for metrics."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.ImportFrom)
+            and node.module
+            and node.module.split(".")[-1] == "prometheus"
+        ):
+            for a in node.names:
+                if a.name in _CONSTRUCTORS:
+                    aliases[a.asname or a.name] = _CONSTRUCTORS[a.name]
+    return aliases
+
+
+def _iter_metric_defs(tree: ast.AST) -> Iterator[tuple[str, str, ast.Call]]:
+    ctor_aliases = _prometheus_constructor_aliases(tree)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        func = node.func
+        typ = None
+        if isinstance(func, ast.Attribute):
+            typ = _FACTORY_METHODS.get(func.attr)
+            if typ is None and func.attr in _CONSTRUCTORS:
+                # prometheus.Counter(…) / <…>.prometheus.Counter(…)
+                chain = _attr_chain(func)
+                if "prometheus" in chain[:-1]:
+                    typ = _CONSTRUCTORS[func.attr]
+        elif isinstance(func, ast.Name):
+            typ = ctor_aliases.get(func.id)
+        if typ is None:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+            continue
+        yield typ, first.value, node
+
+
+@register
+class MetricNamingRule(Rule):
+    """The registry conventions (``utils.prometheus.
+    metric_name_violations``) checked statically at every definition
+    site, so a misnamed metric fails lint before any process registers
+    it. Literal ``labelnames`` tuples are checked too."""
+
+    id = "metric-naming"
+    description = "metric definition violating Prometheus naming conventions"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for typ, name, node in _iter_metric_defs(src.tree):
+            labelnames: list[str] = []
+            for kw in node.keywords:
+                if kw.arg == "labelnames" and isinstance(
+                    kw.value, (ast.Tuple, ast.List)
+                ):
+                    labelnames = [
+                        e.value
+                        for e in kw.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    ]
+            for violation in metric_name_violations(name, typ, labelnames):
+                yield self.finding(src, node, violation)
+
+
+# ---------------------------------------------------------------------------
+# frozen-mutation
+
+
+_READ_METHODS = frozenset({"get", "list", "by_index", "index_buckets"})
+_CLIENTISH = frozenset({"api", "client", "cache", "informer", "store"})
+
+
+def _is_cache_read(call: ast.Call) -> bool:
+    """A call that returns shared frozen objects when the platform
+    runs cache-fronted: ``<…>.api/client/cache.get/list/by_index/
+    index_buckets(…)`` or the ``list_by_index`` helper."""
+    if isinstance(call.func, ast.Name):
+        return call.func.id == "list_by_index"
+    chain = _attr_chain(call.func)
+    if len(chain) < 2 or chain[-1] not in _READ_METHODS:
+        return False
+    return any(part in _CLIENTISH for part in chain[:-1])
+
+
+@register
+class FrozenMutationRule(Rule):
+    """Objects read through ``CachedClient``/the informer cache are
+    SHARED and deep-frozen; in-place mutation raises
+    ``FrozenObjectError`` at runtime (or, worse, corrupts every other
+    reader if the freeze is ever bypassed). Any subscript assignment
+    or mutating method call on a variable sourced from a cache-shaped
+    read must take a private copy first: ``obj = mutable(obj)``.
+    Scope-limited to the cache-fronted layers (controllers/web/
+    scheduling); the raw store hands out private copies."""
+
+    id = "frozen-mutation"
+    description = (
+        "in-place mutation of a cache-sourced object without mutable()"
+    )
+    dirs = ("controllers", "web", "scheduling")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(src, node)
+
+    # -- per-function sequential taint walk ---------------------------------
+
+    def _check_function(
+        self, src: SourceFile, fn: ast.FunctionDef
+    ) -> Iterator[Finding]:
+        tainted: set[str] = set()
+        yield from self._walk(src, fn.body, tainted)
+
+    def _walk(
+        self, src: SourceFile, body: list[ast.stmt], tainted: set[str]
+    ) -> Iterator[Finding]:
+        for stmt in body:
+            yield from self._handle_stmt(src, stmt, tainted)
+
+    def _handle_stmt(
+        self, src: SourceFile, stmt: ast.stmt, tainted: set[str]
+    ) -> Iterator[Finding]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested function: fresh scope
+            yield from self._check_function(src, stmt)
+            return
+        yield from self._mutations_in(src, stmt, tainted)
+        if isinstance(stmt, ast.Assign):
+            for target in stmt.targets:
+                self._assign(target, stmt.value, tainted)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign(stmt.target, stmt.value, tainted)
+        elif isinstance(stmt, ast.For):
+            if isinstance(stmt.target, ast.Name):
+                if isinstance(
+                    stmt.iter, ast.Call
+                ) and _is_cache_read(stmt.iter):
+                    tainted.add(stmt.target.id)
+                elif (
+                    isinstance(stmt.iter, ast.Name)
+                    and stmt.iter.id in tainted
+                ):
+                    # iterating a tainted list: elements share the taint
+                    tainted.add(stmt.target.id)
+                else:
+                    tainted.discard(stmt.target.id)
+            yield from self._walk(src, stmt.body, tainted)
+            yield from self._walk(src, stmt.orelse, tainted)
+            return
+        # recurse into compound statements with the same scope
+        for attr in ("body", "orelse", "finalbody", "handlers"):
+            sub = getattr(stmt, attr, None)
+            if not sub:
+                continue
+            if attr == "handlers":
+                for h in sub:
+                    yield from self._walk(src, h.body, tainted)
+            else:
+                yield from self._walk(src, sub, tainted)
+
+    def _assign(
+        self, target: ast.AST, value: ast.AST, tainted: set[str]
+    ) -> None:
+        if not isinstance(target, ast.Name):
+            return
+        if isinstance(value, ast.Call):
+            if _is_cache_read(value):
+                tainted.add(target.id)
+                return
+            chain = _attr_chain(value.func)
+            if chain and chain[-1] in ("mutable", "deepcopy"):
+                tainted.discard(target.id)
+                return
+            tainted.discard(target.id)
+            return
+        if isinstance(value, ast.Name) and value.id in tainted:
+            tainted.add(target.id)  # alias keeps the taint
+            return
+        tainted.discard(target.id)
+
+    def _mutations_in(
+        self, src: SourceFile, stmt: ast.stmt, tainted: set[str]
+    ) -> Iterator[Finding]:
+        if not tainted:
+            return
+        targets: list[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = [
+                t
+                for t in stmt.targets
+                if isinstance(t, (ast.Subscript, ast.Attribute))
+            ]
+        elif isinstance(stmt, ast.AugAssign) and isinstance(
+            stmt.target, (ast.Subscript, ast.Attribute, ast.Name)
+        ):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = [
+                t for t in stmt.targets if isinstance(t, ast.Subscript)
+            ]
+        for t in targets:
+            root = _root_name(t)
+            if root in tainted:
+                yield self.finding(
+                    src,
+                    stmt,
+                    f"in-place write to cache-sourced object {root!r} "
+                    "(shared, frozen); take a private copy first: "
+                    f"{root} = mutable({root})",
+                )
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if isinstance(call.func, ast.Attribute) and (
+                call.func.attr in _MUTATORS
+            ):
+                root = _root_name(call.func.value)
+                if root in tainted:
+                    yield self.finding(
+                        src,
+                        stmt,
+                        f".{call.func.attr}() on cache-sourced object "
+                        f"{root!r} (shared, frozen); take a private copy "
+                        f"first: {root} = mutable({root})",
+                    )

@@ -24,6 +24,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from odh_kubeflow_tpu.analysis import sanitizer as _sanitizer
 from odh_kubeflow_tpu.machinery import objects as obj_util
 from odh_kubeflow_tpu.machinery.store import APIServer, Watch
 from odh_kubeflow_tpu.utils import prometheus, tracing
@@ -109,7 +110,10 @@ class _RateLimiter:
         self.base = base
         self.cap = cap
         self.failures: dict[Request, int] = {}
-        self._lock = threading.Lock()
+        # the PR 1 fix moved the backoff sleep OUT of this critical
+        # section; the sanitizer's blocking-under-lock probe guards the
+        # invariant at runtime (tests/test_analysis.py)
+        self._lock = _sanitizer.new_lock("controller.ratelimiter")
 
     def when(self, req: Request) -> float:
         with self._lock:
@@ -172,7 +176,7 @@ class Controller:
         # live under _cv with the queue itself
         self._enqueued_at: dict[Request, float] = {}
         self._req_trace: dict[Request, str] = {}
-        self._lock = threading.Lock()
+        self._lock = _sanitizer.new_lock(f"workqueue.{name}")
         self._cv = threading.Condition(self._lock)
         self._limiter = _RateLimiter()
         self._stop = threading.Event()

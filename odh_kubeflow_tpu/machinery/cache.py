@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Optional
 
+from odh_kubeflow_tpu.analysis import sanitizer as _sanitizer
 from odh_kubeflow_tpu.machinery import objects as obj_util
 from odh_kubeflow_tpu.machinery.objects import (  # noqa: F401 — public API
     FrozenDict,
@@ -134,7 +135,7 @@ class InformerCache:
     ):
         self.api = api
         self.now = time_fn
-        self._lock = threading.RLock()
+        self._lock = _sanitizer.new_rlock("informer.cache")
         self._kinds: dict[str, _KindCache] = {k: _KindCache() for k in kinds}
         self._handlers: dict[str, list[Handler]] = {}
         self._watches: dict[str, Watch] = {}
@@ -178,7 +179,7 @@ class InformerCache:
         self._misses: dict[str, int] = {}
         self._flushed_hits: dict[str, int] = {}
         self._flushed_misses: dict[str, int] = {}
-        self._flush_lock = threading.Lock()
+        self._flush_lock = _sanitizer.new_lock("informer.metrics-flush")
         self._stale_mark: dict[str, float] = {}
         reg.register_collector(self._flush_collector)
 

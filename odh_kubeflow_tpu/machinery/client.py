@@ -13,6 +13,7 @@ process (or via the AdmissionReview webhook deployment).
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import socket
@@ -25,6 +26,7 @@ import urllib.request
 from collections import OrderedDict
 from typing import Any, Optional
 
+from odh_kubeflow_tpu.analysis import sanitizer as _sanitizer
 from odh_kubeflow_tpu.machinery import objects as obj_util
 from odh_kubeflow_tpu.utils import tracing
 from odh_kubeflow_tpu.machinery.store import (
@@ -107,7 +109,7 @@ class RemoteAPIServer:
         self._refill_t = time.monotonic()
         self._types: dict[str, TypeInfo] = {}
         self._watches: list[Watch] = []
-        self._lock = threading.RLock()
+        self._lock = _sanitizer.new_rlock("remote-client")
         # LRU-bounded: long-running controllers emit events with dynamic
         # detail; the dedupe cache must not grow with them
         self._event_index: "OrderedDict[tuple, str]" = OrderedDict()
@@ -230,6 +232,9 @@ class RemoteAPIServer:
         req = urllib.request.Request(
             url, data=data, method=method, headers=self._headers(),
         )
+        # an HTTP round-trip must never run while holding a store/cache
+        # lock (sanitizer probe; no-op when GRAFT_SANITIZE is off)
+        _sanitizer.note_blocking(f"http {method} {path}")
         try:
             with urllib.request.urlopen(
                 req, timeout=self.timeout, context=self._ssl_ctx
@@ -241,8 +246,13 @@ class RemoteAPIServer:
                 status = json.loads(e.read().decode())
                 message = status.get("message", message)
                 reason = status.get("reason", "")
-            except Exception:  # noqa: BLE001
-                pass
+            except (
+                OSError,
+                ValueError,
+                AttributeError,
+                http.client.HTTPException,  # e.g. IncompleteRead mid-body
+            ):
+                pass  # non-Status error body; the HTTPError text stands
             # the structured Status.reason disambiguates the two 409s
             klass = {
                 "AlreadyExists": AlreadyExists,

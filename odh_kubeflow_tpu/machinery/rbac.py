@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from odh_kubeflow_tpu.machinery.store import APIServer
+from odh_kubeflow_tpu.machinery.store import APIServer, NotFound
 
 
 def _rule_matches(
@@ -64,7 +64,8 @@ class RBACEvaluator:
                 role = self.api.get("ClusterRole", name)
             else:
                 role = self.api.get("Role", name, binding_ns)
-        except Exception:
+        except NotFound:
+            # a binding to a deleted role grants nothing (k8s behaviour)
             return []
         return role.get("rules") or []
 

@@ -31,12 +31,14 @@ def _wrap_cached(api):
     # by api_from_env; a kind the server rejects would fail the watch)
     from odh_kubeflow_tpu.machinery.cache import DEFAULT_CACHED_KINDS
 
+    from odh_kubeflow_tpu.machinery.store import NotFound
+
     kinds = []
     for kind in DEFAULT_CACHED_KINDS:
         try:
             api.type_info(kind)
             kinds.append(kind)
-        except Exception:  # noqa: BLE001 — unknown kind → skip
+        except NotFound:  # kind not registered with this server → skip
             continue
     cache = InformerCache(api, kinds=kinds)
     register_platform_indexers(cache)

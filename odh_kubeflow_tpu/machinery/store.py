@@ -28,6 +28,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, Optional
 
+from odh_kubeflow_tpu.analysis import sanitizer as _sanitizer
 from odh_kubeflow_tpu.machinery import objects as obj_util
 from odh_kubeflow_tpu.utils import tracing
 
@@ -161,6 +162,11 @@ class Watch:
             yield item
 
     def get(self, timeout: Optional[float] = None) -> Optional[tuple[str, Obj]]:
+        if timeout is None or timeout > 0:
+            # a blocking wait on the event queue must never run while
+            # holding a store/cache lock (sanitizer probe; no-op when
+            # GRAFT_SANITIZE is off)
+            _sanitizer.note_blocking("Watch.get")
         try:
             item = self._q.get(timeout=timeout)
         except queue.Empty:
@@ -179,7 +185,7 @@ class Watch:
 
 class APIServer:
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = _sanitizer.new_rlock("apiserver.store")
         self._types: dict[str, TypeInfo] = {}
         self._store: dict[str, dict[tuple[str, str], Obj]] = {}
         # kind → namespace → {key: obj} — the same objects as _store,

@@ -315,32 +315,45 @@ default_registry = Registry()
 # naming lint (tier-1 guard: new metrics can't drift from conventions)
 
 
-def lint_metric_names(registry: Registry) -> list[str]:
-    """Prometheus naming conventions, enforced in CI:
+def metric_name_violations(
+    name: str, typ: str, labelnames: Sequence[str] = ()
+) -> list[str]:
+    """Prometheus naming conventions for ONE metric family:
     - names are ``[a-z_][a-z0-9_]*`` (no uppercase, no leading digit);
     - counters end in ``_total``;
     - histograms record durations and end in ``_seconds``;
     - nothing but counters claims the ``_total`` suffix.
-    Returns human-readable violations (empty == clean)."""
+    Shared by the live-registry lint below and graftlint's static
+    ``metric-naming`` rule (analysis/rules.py), so the conventions
+    cannot drift between the two checkers."""
     import re
 
     violations = []
+    if not re.fullmatch(r"[a-z_][a-z0-9_]*", name):
+        violations.append(
+            f"{name}: must match [a-z_][a-z0-9_]* (lowercase only)"
+        )
+    if typ == "counter" and not name.endswith("_total"):
+        violations.append(f"{name}: counter names must end in _total")
+    if typ != "counter" and name.endswith("_total"):
+        violations.append(f"{name}: _total suffix is reserved for counters")
+    if typ == "histogram" and not name.endswith("_seconds"):
+        violations.append(f"{name}: duration histograms must end in _seconds")
+    for ln in labelnames:
+        if not re.fullmatch(r"[a-z_][a-z0-9_]*", ln):
+            violations.append(f"{name}: label {ln!r} must be lowercase")
+    return violations
+
+
+def lint_metric_names(registry: Registry) -> list[str]:
+    """Naming conventions over a LIVE registry (what a process actually
+    registered), complementing the static definition-site rule.
+    Returns human-readable violations (empty == clean)."""
+    violations = []
     for m in registry.metrics():
-        if not re.fullmatch(r"[a-z_][a-z0-9_]*", m.name):
-            violations.append(
-                f"{m.name}: must match [a-z_][a-z0-9_]* (lowercase only)"
-            )
-        if m.type == "counter" and not m.name.endswith("_total"):
-            violations.append(f"{m.name}: counter names must end in _total")
-        if m.type != "counter" and m.name.endswith("_total"):
-            violations.append(f"{m.name}: _total suffix is reserved for counters")
-        if m.type == "histogram" and not m.name.endswith("_seconds"):
-            violations.append(
-                f"{m.name}: duration histograms must end in _seconds"
-            )
-        for ln in m.labelnames:
-            if not re.fullmatch(r"[a-z_][a-z0-9_]*", ln):
-                violations.append(f"{m.name}: label {ln!r} must be lowercase")
+        violations.extend(
+            metric_name_violations(m.name, m.type, m.labelnames)
+        )
     return violations
 
 

@@ -1,0 +1,554 @@
+"""graftlint (AST invariant rules) + runtime concurrency sanitizer.
+
+Every rule gets a fixture-proven true positive, a suppressed variant,
+and a clean variant; the whole-package run is the tier-1 gate that
+keeps the tree lint-clean. The sanitizer half proves lock-order
+inversion / re-entry / blocking-under-lock detection on deliberate
+violations — including the regression guard for the PR 1
+``_RateLimiter`` sleep-outside-the-lock fix."""
+
+import threading
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.analysis import (
+    active_rules,
+    lint_source,
+    main,
+    metric_definition_sites,
+    run_package,
+    sanitizer,
+)
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# framework: registry, suppression, allowlists, CLI
+
+
+def test_rule_catalog_has_the_platform_rules():
+    ids = {r.id for r in active_rules()}
+    assert {
+        "frozen-mutation",
+        "uncached-list",
+        "swallowed-exception",
+        "blocking-under-lock",
+        "metric-naming",
+    } <= ids
+    assert len(ids) >= 5
+
+
+def test_rule_allowlist_rejects_unknown_rule():
+    with pytest.raises(KeyError):
+        active_rules(["no-such-rule"])
+
+
+def test_line_suppression_and_disable_all():
+    src = 'def f(api):\n    return api.list("Pod")  # graftlint: disable=uncached-list cold path\n'
+    assert lint_source(src, "controllers/x.py") == []
+    src = 'def f(api):\n    return api.list("Pod")  # graftlint: disable=all everything\n'
+    assert lint_source(src, "controllers/x.py") == []
+    # a different rule's marker does NOT suppress
+    src = 'def f(api):\n    return api.list("Pod")  # graftlint: disable=metric-naming\n'
+    assert rule_ids(lint_source(src, "controllers/x.py")) == ["uncached-list"]
+
+
+def test_file_level_suppression():
+    src = (
+        "# graftlint: disable-file=uncached-list generated fixture\n"
+        'def f(api):\n    return api.list("Pod")\n'
+        'def g(api):\n    return api.list("Node")\n'
+    )
+    assert lint_source(src, "controllers/x.py") == []
+
+
+def test_multiline_statement_suppression_any_line_of_span():
+    src = (
+        "def f(api):\n"
+        "    return api.list(\n"
+        '        "Pod",\n'
+        "    )  # graftlint: disable=uncached-list marker on closing paren\n"
+    )
+    assert lint_source(src, "controllers/x.py") == []
+
+
+def test_dir_allowlist_scopes_rules():
+    src = 'def f(api):\n    return api.list("Pod")\n'
+    # models/ is not a hot-path section for uncached-list
+    assert lint_source(src, "models/x.py", ["uncached-list"]) == []
+    assert rule_ids(lint_source(src, "web/x.py", ["uncached-list"])) == [
+        "uncached-list"
+    ]
+
+
+def test_linting_a_package_subdirectory_keeps_sections(tmp_path, monkeypatch):
+    """`python -m …analysis odh_kubeflow_tpu/controllers` must apply
+    dir-scoped rules exactly as a whole-package run would — re-rooting
+    the relative paths at the subdirectory would silently skip them."""
+    from odh_kubeflow_tpu.analysis import graftlint
+
+    pkg = tmp_path / "pkg"
+    (pkg / "controllers").mkdir(parents=True)
+    (pkg / "controllers" / "bad.py").write_text(
+        'def f(api):\n    return api.list("Pod")\n'
+    )
+    monkeypatch.setattr(graftlint, "package_root", lambda: str(pkg))
+    by_dir = graftlint.run_paths([str(pkg / "controllers")], ["uncached-list"])
+    by_file = graftlint.run_paths(
+        [str(pkg / "controllers" / "bad.py")], ["uncached-list"]
+    )
+    assert rule_ids(by_dir) == ["uncached-list"]
+    assert [f.path for f in by_dir] == [f.path for f in by_file] == [
+        "controllers/bad.py"
+    ]
+
+
+def test_cli_exit_codes_and_rule_listing(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text('m = registry.counter("bad_name", "no _total suffix")\n')
+    assert main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "metric-naming" in out and "bad.py:1" in out
+    clean = tmp_path / "clean.py"
+    clean.write_text('m = registry.counter("good_total", "fine")\n')
+    assert main([str(clean)]) == 0
+    assert main(["--list-rules"]) == 0
+    assert "uncached-list" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# uncached-list
+
+
+def test_uncached_list_true_positive():
+    src = 'def f(api):\n    return api.list("StatefulSet")\n'
+    fs = lint_source(src, "controllers/x.py", ["uncached-list"])
+    assert rule_ids(fs) == ["uncached-list"] and fs[0].line == 2
+
+
+def test_uncached_list_legacy_marker_keeps_working():
+    src = 'def f(api):\n    return api.list("Node")  # uncached-ok: inventory snapshot\n'
+    assert lint_source(src, "scheduling/x.py", ["uncached-list"]) == []
+
+
+def test_uncached_list_clean_variants():
+    src = (
+        "def f(api, ns, sel):\n"
+        '    api.list("Pod", namespace=ns)\n'
+        '    api.list("Pod", label_selector=sel)\n'
+        '    api.list("Pod", ns)\n'
+        '    api.list("Lease")\n'  # not an indexable kind
+        "    api.list(kind)\n"  # dynamic kind: out of static reach
+    )
+    assert lint_source(src, "web/x.py", ["uncached-list"]) == []
+
+
+def test_uncached_list_explicit_none_namespace_still_flagged():
+    src = 'def f(api):\n    return api.list("Pod", namespace=None)\n'
+    assert rule_ids(lint_source(src, "web/x.py", ["uncached-list"])) == [
+        "uncached-list"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# swallowed-exception
+
+
+def test_swallowed_exception_true_positives():
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "def g():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except:\n"
+        "        return []\n"
+    )
+    fs = lint_source(src, "machinery/x.py", ["swallowed-exception"])
+    assert rule_ids(fs) == ["swallowed-exception"] * 2
+    assert [f.line for f in fs] == [4, 9]
+
+
+def test_swallowed_exception_suppressed():
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:  # graftlint: disable=swallowed-exception sim must keep ticking\n"
+        "        pass\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["swallowed-exception"]) == []
+
+
+def test_swallowed_exception_clean_variants():
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except NotFound:\n"  # narrow type: fine
+        "        pass\n"
+        "def g():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        log.exception('boom')\n"  # observable handling
+        "def h():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        metrics.inc()\n"
+        "        raise\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["swallowed-exception"]) == []
+
+
+def test_swallowed_exception_out_of_scope_dirs():
+    src = "def f():\n    try:\n        work()\n    except Exception:\n        pass\n"
+    assert lint_source(src, "models/x.py", ["swallowed-exception"]) == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock (static)
+
+
+def test_blocking_under_lock_true_positives():
+    src = (
+        "import time\n"
+        "def f(self):\n"
+        "    with self._lock:\n"
+        "        time.sleep(0.1)\n"
+        "def g(self):\n"
+        "    with self._lock:\n"
+        "        item = self._q.get(timeout=1.0)\n"
+        "def h(self):\n"
+        "    with self._lock:\n"
+        "        urllib.request.urlopen(req)\n"
+    )
+    fs = lint_source(src, "machinery/store.py", ["blocking-under-lock"])
+    assert rule_ids(fs) == ["blocking-under-lock"] * 3
+
+
+def test_blocking_under_lock_clean_variants():
+    src = (
+        "import time\n"
+        "def f(self):\n"
+        "    with self._cv:\n"
+        "        self._cv.wait(timeout=0.1)\n"  # releases while blocked
+        "    time.sleep(0.1)\n"  # outside the lock
+        "def g(self):\n"
+        "    with self._lock:\n"
+        "        x = d.get('key')\n"  # dict get: no timeout kw
+        "    with open('f') as fh:\n"
+        "        time.sleep(0)\n"  # not a lock context
+        "def h(self):\n"
+        "    with self._lock:\n"
+        "        def later():\n"
+        "            time.sleep(1)\n"  # deferred, runs outside
+        "        return later\n"
+    )
+    assert lint_source(src, "machinery/cache.py", ["blocking-under-lock"]) == []
+
+
+def test_blocking_under_lock_scoped_to_concurrency_files():
+    src = "import time\ndef f(self):\n    with self._lock:\n        time.sleep(1)\n"
+    assert lint_source(src, "web/x.py", ["blocking-under-lock"]) == []
+    assert rule_ids(
+        lint_source(src, "controllers/runtime.py", ["blocking-under-lock"])
+    ) == ["blocking-under-lock"]
+
+
+# ---------------------------------------------------------------------------
+# metric-naming
+
+
+def test_metric_naming_true_positives():
+    src = (
+        'a = registry.counter("requests_count", "missing total suffix")\n'
+        'b = registry.histogram("latency_ms", "wrong unit suffix")\n'
+        'c = registry.gauge("depth_total", "gauge stealing _total")\n'
+        'd = registry.counter("x_total", "bad label", labelnames=("Kind",))\n'
+    )
+    fs = lint_source(src, "utils/x.py", ["metric-naming"])
+    assert len(fs) == 4 and set(rule_ids(fs)) == {"metric-naming"}
+
+
+def test_metric_naming_direct_constructors_checked():
+    src = 'from odh_kubeflow_tpu.utils.prometheus import Counter\nc = Counter("Nope", "x")\n'
+    assert len(lint_source(src, "models/x.py", ["metric-naming"])) == 2
+    src = 'from odh_kubeflow_tpu.utils import prometheus\nc = prometheus.Counter("Nope", "x")\n'
+    assert len(lint_source(src, "models/x.py", ["metric-naming"])) == 2
+
+
+def test_metric_naming_ignores_unrelated_counters():
+    # collections.Counter (or any same-named class not from
+    # utils.prometheus) must never be mistaken for a metric
+    src = (
+        "from collections import Counter\n"
+        'c = Counter("hello")\n'
+        'h = Histogram("raw")\n'  # undefined/foreign name: not provably ours
+    )
+    assert lint_source(src, "models/x.py", ["metric-naming"]) == []
+
+
+def test_metric_naming_suppressed_and_clean():
+    src = 'a = registry.counter("legacy_count", "grandfathered")  # graftlint: disable=metric-naming legacy dashboard\n'
+    assert lint_source(src, "utils/x.py", ["metric-naming"]) == []
+    src = (
+        'a = registry.counter("reconcile_total", "ok", labelnames=("result",))\n'
+        'b = registry.histogram("reconcile_time_seconds", "ok")\n'
+        'c = registry.gauge("workqueue_depth", "ok")\n'
+    )
+    assert lint_source(src, "utils/x.py", ["metric-naming"]) == []
+
+
+def test_metric_definition_scan_sees_platform_surface():
+    # an empty scan means the detector broke, not that the tree is clean
+    defs = metric_definition_sites()
+    assert len(defs) >= 10
+    assert any(name == "workqueue_depth" for _, _, name, _ in defs)
+
+
+# ---------------------------------------------------------------------------
+# frozen-mutation
+
+
+def test_frozen_mutation_subscript_write():
+    src = (
+        "def f(self):\n"
+        '    nb = self.api.get("Notebook", "n", "ns")\n'
+        '    nb["status"] = {"phase": "running"}\n'
+    )
+    fs = lint_source(src, "controllers/x.py", ["frozen-mutation"])
+    assert rule_ids(fs) == ["frozen-mutation"] and fs[0].line == 3
+
+
+def test_frozen_mutation_mutating_method_and_loop_elements():
+    src = (
+        "def f(self, ns):\n"
+        '    for pod in self.api.list("Pod", namespace=ns):\n'
+        '        pod["metadata"]["labels"].update({"x": "1"})\n'
+        "def g(client):\n"
+        '    pods = client.by_index("Pod", "owner-uid", "u")\n'
+        "    for p in pods:\n"
+        '        p["spec"]["nodeName"] = "n1"\n'
+    )
+    fs = lint_source(src, "scheduling/x.py", ["frozen-mutation"])
+    assert rule_ids(fs) == ["frozen-mutation"] * 2
+
+
+def test_frozen_mutation_mutable_cleanses():
+    src = (
+        "def f(self):\n"
+        '    nb = self.api.get("Notebook", "n", "ns")\n'
+        "    nb = mutable(nb)\n"
+        '    nb["status"] = {}\n'
+        "def g(self, ns):\n"
+        '    for w in self.api.list("Workload", namespace=ns):\n'
+        "        wl = mutable(w)\n"
+        '        wl["status"] = {}\n'
+    )
+    assert lint_source(src, "scheduling/x.py", ["frozen-mutation"]) == []
+
+
+def test_frozen_mutation_plain_objects_not_flagged():
+    src = (
+        "def f(self):\n"
+        "    obj = build_notebook()\n"
+        '    obj["status"] = {}\n'
+        "    d = {}\n"
+        '    d["k"] = 1\n'
+    )
+    assert lint_source(src, "controllers/x.py", ["frozen-mutation"]) == []
+
+
+def test_frozen_mutation_suppressed():
+    src = (
+        "def f(self):\n"
+        '    nb = self.api.get("Notebook", "n", "ns")\n'
+        '    nb["status"] = {}  # graftlint: disable=frozen-mutation raw-store path only\n'
+    )
+    assert lint_source(src, "controllers/x.py", ["frozen-mutation"]) == []
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 whole-package gate
+
+
+def test_package_tree_is_lint_clean():
+    findings = run_package()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer
+
+
+@pytest.fixture
+def san():
+    was_enabled = sanitizer.enabled()
+    sanitizer.enable()
+    sanitizer.reset()
+    yield sanitizer
+    sanitizer.reset()
+    if not was_enabled:
+        sanitizer.disable()
+
+
+def test_lock_order_inversion_detected(san):
+    a, b = san.new_lock("A"), san.new_lock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    reports = san.reports()
+    assert len(reports) == 1 and "lock-order inversion" in reports[0]
+    assert "'A'" in reports[0] and "'B'" in reports[0]
+
+
+def test_consistent_order_is_clean(san):
+    a, b = san.new_lock("A"), san.new_lock("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert san.reports() == []
+
+
+def test_transitive_inversion_detected(san):
+    a, b, c = san.new_lock("A"), san.new_lock("B"), san.new_lock("C")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with a:  # closes the A→B→C cycle
+            pass
+    assert any("lock-order inversion" in r for r in san.reports())
+
+
+def test_nonreentrant_reentry_raises_instead_of_deadlocking(san):
+    lock = san.new_lock("L")
+    with lock:
+        with pytest.raises(san.SanitizerError):
+            lock.acquire()
+    assert any("re-entry" in r for r in san.reports())
+
+
+def test_rlock_reentry_is_legal(san):
+    lock = san.new_rlock("R")
+    with lock:
+        with lock:
+            pass
+    assert san.reports() == []
+
+
+def test_distinct_instances_sharing_a_name_are_not_reentry(san):
+    """Re-entry is per lock INSTANCE; every _RateLimiter (etc.) shares
+    a factory name, and nesting two different instances is legal."""
+    from odh_kubeflow_tpu.controllers.runtime import _RateLimiter
+
+    l1, l2 = _RateLimiter(), _RateLimiter()
+    with l1._lock:
+        with l2._lock:
+            pass
+    assert san.reports() == []
+
+
+def test_sleep_under_lock_reported(san):
+    with san.new_lock("S"):
+        time.sleep(0)
+    reports = san.reports()
+    assert len(reports) == 1 and "blocking-under-lock" in reports[0]
+    time.sleep(0)  # outside: clean
+    assert len(san.reports()) == 1
+
+
+def test_watch_get_under_store_lock_reported(san):
+    from odh_kubeflow_tpu.machinery.store import APIServer
+
+    api = APIServer()  # constructed under the sanitizer → sanitized lock
+    assert isinstance(api._lock, san.SanitizedLock)
+    w = api.watch("Pod", send_initial=False)
+    with api._lock:
+        w.get(timeout=0.01)
+    assert any("Watch.get" in r for r in san.reports())
+    # the normal (unlocked) pump path is clean
+    san.reset()
+    w.get(timeout=0.01)
+    assert san.reports() == []
+    w.stop()
+
+
+def test_condition_wait_with_sanitized_lock_is_clean(san):
+    lock = san.new_lock("cv-lock")
+    cv = threading.Condition(lock)
+    with cv:
+        cv.wait(timeout=0.01)  # releases the lock while blocked
+    assert san.reports() == []
+
+
+def test_rate_limiter_regression_guard(san):
+    """The sanitizer's blocking-under-lock probe doubles as the
+    regression guard for the PR 1 ``_RateLimiter`` fix: backoff
+    computation happens under its lock, the sleep/delay never does."""
+    from odh_kubeflow_tpu.controllers.runtime import (
+        Controller,
+        Result,
+        _RateLimiter,
+    )
+    from odh_kubeflow_tpu.machinery.store import APIServer
+
+    api = APIServer()
+    clock = [0.0]
+    calls = {"n": 0}
+
+    def flaky(req):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return Result()
+
+    ctrl = Controller("probe", api, flaky, "ConfigMap", time_fn=lambda: clock[0])
+    assert isinstance(ctrl._limiter._lock, san.SanitizedLock)
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "a", "namespace": "default"},
+        }
+    )
+    for _ in range(6):  # drain through the backoff retries
+        ctrl.drain_once()
+        clock[0] += 1.0
+    assert calls["n"] == 3  # failed twice, then converged
+    assert not any("ratelimiter" in r for r in san.reports()), san.reports()
+
+    # the OLD bug shape — sleeping inside the limiter's critical
+    # section — is exactly what the probe catches:
+    limiter = _RateLimiter()
+    with limiter._lock:
+        time.sleep(0)
+    assert any(
+        "blocking-under-lock" in r and "ratelimiter" in r
+        for r in san.reports()
+    )
+
+
+def test_factories_return_raw_primitives_when_disabled():
+    if sanitizer.enabled():  # pragma: no cover — GRAFT_SANITIZE=1 run
+        pytest.skip("sanitizer armed via environment")
+    lock = sanitizer.new_lock("x")
+    rlock = sanitizer.new_rlock("y")
+    assert type(lock) is type(threading.Lock())
+    assert type(rlock) is type(threading.RLock())

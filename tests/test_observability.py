@@ -7,7 +7,6 @@ drift from Prometheus conventions)."""
 import io
 import json
 import logging
-import pathlib
 import re
 import urllib.request
 
@@ -20,10 +19,7 @@ from odh_kubeflow_tpu.machinery.client import RemoteAPIServer
 from odh_kubeflow_tpu.machinery.events import EventRecorder
 from odh_kubeflow_tpu.machinery.store import APIServer
 from odh_kubeflow_tpu.utils import tracing
-from odh_kubeflow_tpu.utils.prometheus import (
-    Registry,
-    lint_metric_names,
-)
+from odh_kubeflow_tpu.utils.prometheus import Registry
 
 
 def _notebook(name="nb1", ns="default"):
@@ -461,44 +457,29 @@ def test_notebook_lifecycle_events(monkeypatch):
 
 # ---------------------------------------------------------------------------
 # metrics naming lint (tier-1: conventions can't drift)
-
-_METRIC_CALL = re.compile(
-    r"\.(counter|gauge|histogram)\(\s*\n?\s*['\"]([A-Za-z0-9_:]+)['\"]"
-)
-
-
-def _source_metric_names():
-    root = pathlib.Path(__file__).resolve().parent.parent / "odh_kubeflow_tpu"
-    out = []
-    for path in root.rglob("*.py"):
-        text = path.read_text()
-        for m in _METRIC_CALL.finditer(text):
-            out.append((path.relative_to(root), m.group(1), m.group(2)))
-    return out
+#
+# The old regex-based source scan migrated into graftlint's
+# AST-accurate `metric-naming` rule; both the static definition-site
+# check and the live-registry check route through the unified
+# analysis entry point (python -m odh_kubeflow_tpu.analysis).
 
 
 def test_metric_names_follow_prometheus_conventions():
-    names = _source_metric_names()
+    from odh_kubeflow_tpu.analysis import (
+        metric_definition_sites,
+        run_package,
+    )
+
     # the platform declares a real metric surface; an empty scan means
-    # the regex broke, not that we're clean
-    assert len(names) >= 10
-    violations = []
-    for path, typ, name in names:
-        if not re.fullmatch(r"[a-z_][a-z0-9_]*", name):
-            violations.append(f"{path}: {name}: lowercase [a-z0-9_] only")
-        if typ == "counter" and not name.endswith("_total"):
-            violations.append(f"{path}: {name}: counters must end in _total")
-        if typ != "counter" and name.endswith("_total"):
-            violations.append(f"{path}: {name}: _total is for counters only")
-        if typ == "histogram" and not name.endswith("_seconds"):
-            violations.append(
-                f"{path}: {name}: duration histograms must end in _seconds"
-            )
-    assert not violations, "\n".join(violations)
+    # the detector broke, not that we're clean
+    assert len(metric_definition_sites()) >= 10
+    violations = run_package(select=["metric-naming"])
+    assert violations == [], "\n".join(f.render() for f in violations)
 
 
 def test_live_platform_registry_passes_lint():
+    from odh_kubeflow_tpu.analysis import lint_registry
     from odh_kubeflow_tpu.platform import Platform
 
     platform = Platform()
-    assert lint_metric_names(platform.metrics_registry) == []
+    assert lint_registry(platform.metrics_registry) == []
