@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint sanitize loadtest images bench dryrun platform serve spawn-latency native kind-smoke conformance
+.PHONY: all test test-unit test-manifests lint sanitize chaos loadtest images bench dryrun platform serve spawn-latency native kind-smoke conformance
 
 all: lint test
 
@@ -32,6 +32,14 @@ conformance:
 lint:
 	$(PYTHON) -m compileall -q odh_kubeflow_tpu tests loadtest bench.py __graft_entry__.py
 	$(PYTHON) -m odh_kubeflow_tpu.analysis
+
+# seeded chaos suite: resilience property tests under injected
+# conflicts, 429s, 5xx, watch-stream drops, and resourceVersion expiry
+# (GRAFT_CHAOS seeds every schedule — reproducible CI runs), with the
+# concurrency sanitizer armed so recovery paths are race-probed too
+chaos:
+	GRAFT_CHAOS=1 GRAFT_SANITIZE=1 $(PYTHON) -m pytest -q \
+	  tests/test_chaos.py tests/test_leader.py
 
 # the randomized property suites re-run as race probes: sanitized
 # locks record acquisition order, re-entry, and blocking-under-lock

@@ -305,6 +305,151 @@ class BlockingUnderLockRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# retry-without-backoff
+
+
+@register
+class RetryWithoutBackoffRule(Rule):
+    """A hand-rolled retry loop — ``while True`` or fixed-count
+    ``for … in range(n)`` around an API call, swallowing the error to
+    go around again with a constant sleep (or none) — synchronises
+    every failing client into a thundering herd against the recovering
+    server. Route retries through ``machinery.backoff`` (jittered
+    exponential delays, capped attempts, Retry-After honoured). A loop
+    that references the backoff helper, sleeps a *computed* delay, or
+    whose except handler exits the loop (return/raise/break) is not a
+    retry loop and passes."""
+
+    id = "retry-without-backoff"
+    description = (
+        "bare retry loop around API calls without the shared backoff "
+        "helper"
+    )
+    dirs = ("machinery", "controllers")
+
+    _API_TERMINALS = frozenset(
+        {
+            "create",
+            "update",
+            "update_status",
+            "patch",
+            "delete",
+            "list",
+            "watch",
+            "urlopen",
+            "_request",
+            "_do_request",
+            "_call",
+            "_query",
+            "emit_event",
+            "create_or_get",
+        }
+    )
+    _BACKOFFISH_CALLS = frozenset({"retry", "next_delay", "delays"})
+
+    def _is_retry_loop_header(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.While):
+            return isinstance(node.test, ast.Constant) and bool(
+                node.test.value
+            )
+        if isinstance(node, ast.For):
+            it = node.iter
+            return (
+                isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Name)
+                and it.func.id == "range"
+            )
+        return False
+
+    def _iter_live(self, node: ast.AST) -> Iterator[ast.AST]:
+        """Descendants executing inside the loop iteration — nested
+        defs/lambdas run later and are pruned."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield child
+            yield from self._iter_live(child)
+
+    def _uses_backoff(self, loop: ast.AST) -> bool:
+        for node in [loop, *self._iter_live(loop)]:
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if chain and (
+                    chain[-1] in self._BACKOFFISH_CALLS
+                    or any("backoff" in c.lower() for c in chain)
+                ):
+                    return True
+            if isinstance(node, ast.Name) and "backoff" in node.id.lower():
+                return True
+            if isinstance(node, ast.Attribute) and (
+                "backoff" in node.attr.lower()
+            ):
+                return True
+        return False
+
+    def _handler_retries(self, handler: ast.ExceptHandler) -> bool:
+        """Whether the handler lets the loop go around again: a body
+        ending in return/raise/break exits instead of retrying."""
+        if not handler.body:
+            return True
+        last = handler.body[-1]
+        return not isinstance(last, (ast.Return, ast.Raise, ast.Break))
+
+    def _api_retry_try(self, loop: ast.AST) -> Optional[ast.Try]:
+        for node in self._iter_live(loop):
+            if not isinstance(node, ast.Try):
+                continue
+            in_try = [
+                n
+                for stmt in node.body
+                for n in [stmt, *self._iter_live(stmt)]
+            ]
+            calls_api = any(
+                isinstance(n, ast.Call)
+                and (chain := _attr_chain(n.func))
+                and chain[-1] in self._API_TERMINALS
+                and len(chain) > 1
+                for n in in_try
+            )
+            if calls_api and any(
+                self._handler_retries(h) for h in node.handlers
+            ):
+                return node
+        return None
+
+    def _sleeps_constant_or_nothing(self, loop: ast.AST) -> bool:
+        for node in self._iter_live(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain or chain[-1] != "sleep":
+                continue
+            if not all(isinstance(a, ast.Constant) for a in node.args):
+                return False  # computed delay: some pacing policy exists
+        return True  # constant sleeps and no sleep at all both flag
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if not self._is_retry_loop_header(node):
+                continue
+            if self._uses_backoff(node):
+                continue
+            if self._api_retry_try(node) is None:
+                continue
+            if not self._sleeps_constant_or_nothing(node):
+                continue
+            yield self.finding(
+                src,
+                node,
+                "retry loop around an API call with constant (or no) "
+                "sleep; use machinery.backoff.retry()/next_delay() for "
+                "jittered, capped retries",
+            )
+
+
+# ---------------------------------------------------------------------------
 # metric-naming
 
 

@@ -33,6 +33,7 @@ frozen copy per store event now serves the cache AND every controller.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Callable, Iterable, Optional
@@ -47,8 +48,10 @@ from odh_kubeflow_tpu.machinery.objects import (  # noqa: F401 — public API
     is_frozen,
     mutable,
 )
-from odh_kubeflow_tpu.machinery.store import NotFound, Watch
+from odh_kubeflow_tpu.machinery.store import APIError, NotFound, Watch
 from odh_kubeflow_tpu.utils import prometheus
+
+log = logging.getLogger("machinery.cache")
 
 Obj = dict[str, Any]
 Key = tuple[str, str]  # (namespace, name); "" for cluster-scoped
@@ -104,6 +107,8 @@ class _KindCache:
         "synced",
         "tombstones",
         "last_event",
+        "degraded",
+        "retry_at",
     )
 
     def __init__(self):
@@ -115,6 +120,10 @@ class _KindCache:
         self.synced = False
         self.tombstones: dict[Key, int] = {}
         self.last_event = 0.0
+        # degraded = the watch stream is down and a relist hasn't
+        # succeeded yet; reads keep serving last-known-good state
+        self.degraded = False
+        self.retry_at = 0.0  # earliest next reestablish attempt
 
 
 class InformerCache:
@@ -137,11 +146,21 @@ class InformerCache:
         self.now = time_fn
         self._lock = _sanitizer.new_rlock("informer.cache")
         self._kinds: dict[str, _KindCache] = {k: _KindCache() for k in kinds}
+        # per-kind heal mutex: stream-loss recovery can be triggered by
+        # the pump thread AND read-path pokes at once; only one may
+        # swap the watch + relist (plain Lock, taken non-blocking — a
+        # loser returns immediately instead of stacking up)
+        self._heal_locks: dict[str, threading.Lock] = {
+            k: threading.Lock() for k in self._kinds
+        }
         self._handlers: dict[str, list[Handler]] = {}
         self._watches: dict[str, Watch] = {}
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
         self._started = False
+        # live = pump threads own stream healing; in drain mode (tests,
+        # pre-start platforms) the read path heals instead
+        self._live = False
 
         reg = registry or prometheus.default_registry
         self.m_hits = reg.counter(
@@ -158,6 +177,14 @@ class InformerCache:
             "cache_resync_total",
             "Full re-lists of a kind from the backing store",
         )
+        self.m_relists = reg.counter(
+            "cache_relists_total",
+            "Relists forced by watch-stream loss or resourceVersion "
+            "expiry (the degraded-mode healing path)",
+        )
+        # floor between reestablish attempts while the backend stays
+        # down, so degraded reads don't hammer it (tests set 0)
+        self.reestablish_backoff = 0.5
         self.m_coalesced = reg.counter(
             "watch_events_coalesced_total",
             "Watch events superseded by a newer event for the same "
@@ -212,6 +239,18 @@ class InformerCache:
     def synced(self, kind: str) -> bool:
         kc = self._kinds.get(kind)
         return kc is not None and kc.synced
+
+    def degraded(self, kind: str) -> bool:
+        """True while the kind's watch stream is down and unhealed —
+        reads still serve, but from last-known-good state (the staleness
+        gauge quantifies how old). Consumers surface this as the
+        ``degraded: true`` marker on listings."""
+        kc = self._kinds.get(kind)
+        return kc is not None and kc.degraded
+
+    def any_degraded(self) -> bool:
+        with self._lock:
+            return any(kc.degraded for kc in self._kinds.values())
 
     def register_indexer(self, kind: str, name: str, fn: IndexFn) -> None:
         """Register a field indexer (controller-runtime
@@ -270,8 +309,28 @@ class InformerCache:
                         kind, send_initial=False
                     )
         if opening:
+            from odh_kubeflow_tpu.machinery import backoff
+
+            def transient(e: BaseException) -> bool:
+                # 4xx (Denied/NotFound/Invalid) is a configuration
+                # error — surface it immediately, don't mask it as a
+                # flaky backend
+                if isinstance(e, APIError):
+                    return e.code >= 500 or e.code == 429
+                return isinstance(e, OSError)
+
             for kind in self._kinds:
-                self.resync(kind, count=False)
+                # the initial prime must survive a flaky apiserver
+                # (transient 429/5xx/network): capped jittered retries,
+                # then fail loudly — starting without ANY state would
+                # serve wrong empty listings, worse than not starting
+                backoff.retry(
+                    lambda k=kind: self.resync(k, count=False),
+                    retryable=transient,
+                    attempts=5,
+                    base=0.02,
+                    cap=0.5,
+                )
         if live:
             with self._lock:
                 spawn = not self._threads
@@ -285,6 +344,7 @@ class InformerCache:
             if spawn:
                 # a drain-mode cache upgrades to live when the manager
                 # later starts for real (Platform tests drain first)
+                self._live = True
                 for t in self._threads:
                     t.start()
 
@@ -304,11 +364,10 @@ class InformerCache:
             time.sleep(0.01)
         return all(kc.synced for kc in self._kinds.values())
 
-    def resync(self, kind: str, count: bool = True) -> None:
-        """Re-list the kind from the backing store and rebuild the
-        mirror + indexes — heals any dropped watch event. Queued events
-        older than the listed state are ignored by the rv guard."""
-        objs = self.api.list(kind)
+    def _rebuild(self, kind: str, objs: list[Obj]) -> None:
+        """Replace the kind's mirror + indexes with a listed snapshot
+        (shared by resync and stream-loss healing). Queued events older
+        than the snapshot are ignored afterwards by the rv guard."""
         with self._lock:
             kc = self._kinds[kind]
             kc.objects = {}
@@ -318,8 +377,78 @@ class InformerCache:
                 self._insert(kc, self._key_of(obj), freeze(obj))
             kc.synced = True
             kc.last_event = self.now()
+            kc.degraded = False
+            kc.retry_at = 0.0
+
+    def resync(self, kind: str, count: bool = True) -> None:
+        """Re-list the kind from the backing store and rebuild the
+        mirror + indexes — heals any dropped watch event."""
+        self._rebuild(kind, self.api.list(kind))
         if count:
             self.m_resync.inc()
+
+    def _degrade(self, kind: str, why: str, e: Exception) -> bool:
+        log.warning(
+            "informer %s: %s (%s); serving last-known-good degraded",
+            kind, why, e,
+        )
+        with self._lock:
+            kc = self._kinds[kind]
+            kc.degraded = True
+            kc.retry_at = self.now() + self.reestablish_backoff
+        return False
+
+    def _reestablish(self, kind: str) -> bool:
+        """Heal a dead watch stream: open a fresh watch, then full
+        relist (watch-first-then-list, same ordering as ``start()``, so
+        nothing written in between is missed). A relist — not an rv
+        resume — because deletions during the outage would otherwise
+        survive in the mirror forever. The old stream is only torn down
+        AFTER the new one is up, so a failed attempt changes nothing
+        and the next read retries (past the backoff floor). Failure
+        leaves the kind degraded; reads keep serving last-known-good."""
+        kc = self._kinds[kind]
+        if self.now() < kc.retry_at:
+            return False
+        if not self._heal_locks[kind].acquire(blocking=False):
+            return False  # another thread is already healing this kind
+        try:
+            current = self._watches.get(kind)
+            if (
+                current is not None
+                and not current.ended
+                and not current._stopped
+                and not kc.degraded
+            ):
+                return False  # the previous lock holder already healed
+            try:
+                w = self.api.watch(kind, send_initial=False)
+            except Exception as e:  # noqa: BLE001 — Expired/APIError/OSError
+                return self._degrade(kind, "watch re-open failed", e)
+            try:
+                objs = self.api.list(kind)
+            except Exception as e:  # noqa: BLE001 — backend still flapping
+                try:
+                    w.stop()
+                except (APIError, OSError, RuntimeError):
+                    pass  # best-effort teardown of the half-opened stream
+                return self._degrade(kind, "relist after stream loss failed", e)
+            with self._lock:
+                old = self._watches.get(kind)
+                self._watches[kind] = w
+            self._rebuild(kind, objs)
+            if old is not None and old is not w and not old._stopped:
+                try:
+                    old.stop()
+                except (APIError, OSError, RuntimeError):
+                    pass  # the stream is already dead; nothing to release
+            self.m_relists.inc()
+            log.warning(
+                "informer %s: watch re-established after relist", kind
+            )
+            return True
+        finally:
+            self._heal_locks[kind].release()
 
     # -- event application ---------------------------------------------------
 
@@ -398,15 +527,31 @@ class InformerCache:
             self._insert(kc, key, frozen)
             return frozen
 
+    def _heal_on_read(self, w: Watch, kind: str) -> bool:
+        """Drain-mode healing: a stream that DIED (ended, not stopped
+        by us) or a kind still marked degraded relists here. With live
+        pumps running, healing is the pump thread's job — a read must
+        serve last-known-good instantly, not block a request behind
+        watch/list timeouts against a sick backend."""
+        if (
+            not self._live
+            and not self._stop.is_set()
+            and ((w.ended and not w._stopped) or self._kinds[kind].degraded)
+        ):
+            return self._reestablish(kind)
+        return False
+
     def _drain_kind(self, kind: str, budget: int = 10_000) -> bool:
         """Pull every pending event for ``kind``, coalesce runs for the
         same object (each event carries the full object, so only the
         newest matters for cache state), apply, dispatch handlers."""
         w = self._watches.get(kind)
-        if w is None or not w._q.qsize():
+        if w is None:
+            return False
+        if not w._q.qsize():
             # empty-queue fast path: reads poke before every lookup, so
             # this must cost nanoseconds, not a queue.Empty exception
-            return False
+            return self._heal_on_read(w, kind)
         pending: list[tuple[str, Obj]] = []
         for _ in range(budget):
             item = w.try_get()
@@ -414,7 +559,8 @@ class InformerCache:
                 break
             pending.append(item)
         if not pending:
-            return False
+            # the nonzero qsize was the dead stream's None sentinel
+            return self._heal_on_read(w, kind)
         if len(pending) > 1:
             latest: dict[Key, int] = {}
             for i, (_etype, obj) in enumerate(pending):
@@ -451,13 +597,28 @@ class InformerCache:
         self._drain_kind(kind)
 
     def _pump(self, kind: str) -> None:
-        w = self._watches[kind]
         handlers_of = self._handlers
         while not self._stop.is_set():
+            # refetch per iteration: _reestablish swaps the watch out
+            # from under us after a stream loss
+            w = self._watches.get(kind)
+            if w is None:
+                return
             item = w.get(timeout=0.2)
             if item is None:
-                if self._stop.is_set() or w._stopped:
+                if self._stop.is_set():
                     return
+                if w._stopped:
+                    if self._watches.get(kind) is not w:
+                        continue  # swapped out by a heal — refetch
+                    return  # our registered watch was stopped: shutdown
+                if w.ended:
+                    # the stream died (dropped connection, 410, chaos):
+                    # mark degraded, heal via fresh watch + relist, and
+                    # keep serving last-known-good state meanwhile
+                    self._kinds[kind].degraded = True
+                    if not self._reestablish(kind):
+                        time.sleep(self.reestablish_backoff or 0.05)
                 continue
             etype, obj = item
             frozen = self._apply(kind, etype, obj)

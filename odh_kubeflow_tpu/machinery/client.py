@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import logging
 import os
 import socket
 import ssl
@@ -27,20 +28,24 @@ from collections import OrderedDict
 from typing import Any, Optional
 
 from odh_kubeflow_tpu.analysis import sanitizer as _sanitizer
-from odh_kubeflow_tpu.machinery import objects as obj_util
-from odh_kubeflow_tpu.utils import tracing
+from odh_kubeflow_tpu.machinery import backoff, objects as obj_util
+from odh_kubeflow_tpu.utils import prometheus, tracing
 from odh_kubeflow_tpu.machinery.store import (
     AlreadyExists,
     APIError,
     BadRequest,
     Conflict,
     Denied,
+    Expired,
     Invalid,
     NotFound,
+    TooManyRequests,
     TypeInfo,
     Unauthorized,
     Watch,
 )
+
+log = logging.getLogger("machinery.client")
 
 Obj = dict[str, Any]
 
@@ -49,10 +54,31 @@ _ERR_BY_CODE = {
     401: Unauthorized,
     404: NotFound,
     409: Conflict,
+    410: Expired,
     422: Invalid,
     403: Denied,
+    429: TooManyRequests,
+}
+_REASON_TO_ERR = {
+    "AlreadyExists": AlreadyExists,
+    "BadRequest": BadRequest,
+    "Conflict": Conflict,
+    "NotFound": NotFound,
+    "Invalid": Invalid,
+    "Denied": Denied,
+    "Unauthorized": Unauthorized,
+    "Expired": Expired,
+    "TooManyRequests": TooManyRequests,
 }
 _EVENT_INDEX_MAX = 4096
+
+# Retry policy (the verb × error table in docs/GUIDE.md): a 429 was
+# never executed server-side, so every verb retries it after the
+# Retry-After wait; 5xx and network errors retry only verbs that are
+# safe to repeat when the first attempt MAY have been executed — reads.
+# Mutations surface immediately (their callers already run level-
+# triggered reconcile loops / optimistic-concurrency retries).
+_IDEMPOTENT_VERBS = frozenset({"GET"})
 
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -80,9 +106,29 @@ class RemoteAPIServer:
         client_cert_file: Optional[str] = None,
         client_key_file: Optional[str] = None,
         insecure_skip_tls_verify: bool = False,
+        retries: int = 4,
+        retry_base: float = 0.05,
+        retry_cap: float = 2.0,
+        registry: Optional[prometheus.Registry] = None,
     ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # shared backoff policy (machinery.backoff): `retries` total
+        # attempts, exponential + decorrelated jitter between them
+        self.retries = max(int(retries), 1)
+        self.retry_base = retry_base
+        self.retry_cap = retry_cap
+        self._sleep = time.sleep  # injectable for tests
+        reg = registry or prometheus.default_registry
+        self._m_retries = reg.counter(
+            "client_retries_total",
+            "API requests retried by the client, by verb and reason",
+            labelnames=("verb", "reason"),
+        )
+        self._m_watch_reestablished = reg.counter(
+            "watch_reestablished_total",
+            "Watch streams re-established after a dropped connection",
+        )
         self._token = token
         self._token_file = token_file
         self._token_file_mtime: Optional[float] = None
@@ -223,7 +269,49 @@ class RemoteAPIServer:
                 headers["tracestate"] = "odh=controller"
         return headers
 
+    def _retry_reason(self, method: str, e: Exception) -> Optional[str]:
+        """Whether (and why) this failure is retryable for this verb —
+        the policy table in docs/GUIDE.md. None = surface it now."""
+        if isinstance(e, TooManyRequests):
+            return "429"  # not executed server-side: all verbs retry
+        if isinstance(e, APIError):
+            if e.code >= 500 and method in _IDEMPOTENT_VERBS:
+                return "5xx"
+            return None
+        if isinstance(e, (OSError, http.client.HTTPException)):
+            # connection refused/reset/timeout: the request MAY have
+            # executed — only reads are safe to repeat
+            if method in _IDEMPOTENT_VERBS:
+                return "network"
+        return None
+
     def _request(
+        self, method: str, path: str, body: Optional[Obj] = None, query: str = ""
+    ) -> Obj:
+        """One API call through the shared retry helper
+        (``machinery.backoff``): capped attempts, exponential +
+        decorrelated jitter, Retry-After honoured, and the verb × error
+        policy of ``_retry_reason`` as the retryable predicate."""
+
+        def on_retry(e: BaseException, attempt: int, delay: float) -> None:
+            reason = self._retry_reason(method, e) or "?"
+            self._m_retries.inc({"verb": method, "reason": reason})
+            log.warning(
+                "%s %s failed (%s); retry %d/%d in %.3fs",
+                method, path, reason, attempt + 1, self.retries, delay,
+            )
+
+        return backoff.retry(
+            lambda: self._do_request(method, path, body, query),
+            retryable=lambda e: self._retry_reason(method, e) is not None,
+            attempts=self.retries,
+            base=self.retry_base,
+            cap=self.retry_cap,
+            sleep_fn=self._sleep,
+            on_retry=on_retry,
+        )
+
+    def _do_request(
         self, method: str, path: str, body: Optional[Obj] = None, query: str = ""
     ) -> Obj:
         self._throttle()
@@ -254,15 +342,13 @@ class RemoteAPIServer:
             ):
                 pass  # non-Status error body; the HTTPError text stands
             # the structured Status.reason disambiguates the two 409s
-            klass = {
-                "AlreadyExists": AlreadyExists,
-                "BadRequest": BadRequest,
-                "Conflict": Conflict,
-                "NotFound": NotFound,
-                "Invalid": Invalid,
-                "Denied": Denied,
-                "Unauthorized": Unauthorized,
-            }.get(reason) or _ERR_BY_CODE.get(e.code, APIError)
+            klass = _REASON_TO_ERR.get(reason) or _ERR_BY_CODE.get(
+                e.code, APIError
+            )
+            if klass is TooManyRequests:
+                raise TooManyRequests(
+                    message, retry_after=_retry_after_of(e)
+                ) from None
             raise klass(message) from None
 
     # -- CRUD (APIServer duck type) -----------------------------------------
@@ -341,49 +427,193 @@ class RemoteAPIServer:
         kind: str,
         namespace: Optional[str] = None,
         send_initial: bool = True,
+        resource_version: Optional[str] = None,
     ) -> Watch:
+        """Watch with automatic stream recovery: a dropped connection
+        logs a warning and reconnects, resuming from the last-seen
+        resourceVersion (no events lost, no duplicate replay). A 410
+        Expired resume — the server compacted our resume point — ends
+        the Watch with ``ended=True`` / ``error=Expired`` so the
+        consumer relists (the informer cache does exactly that); other
+        4xx responses surface the mapped error the same way, as does a
+        stream that drops before ANY event arrived on a no-initial-dump
+        watch (no resume point exists, so reconnecting would silently
+        skip the gap — with send_initial the reconnect replays the full
+        state instead, which rv-guarded consumers dedupe). Before this
+        pump reconnected, a broken stream left consumers blocked on a
+        dead Watch forever."""
         p = self._path(kind, namespace, None, require_ns=False)
-        url = (
-            self.base_url
-            + p
-            + f"?watch=true&sendInitialEvents={'true' if send_initial else 'false'}"
-        )
         w = Watch(self, kind, namespace)
+        # first-connect handshake: consumers rely on watch-then-list
+        # ordering (open the stream, then list; anything written in
+        # between arrives as an event). The embedded store registers
+        # the watch synchronously; over HTTP we must not return before
+        # the stream is actually open server-side, or a list issued
+        # right after could race past events into a silent gap.
+        connected = threading.Event()
+
+        def _url(initial: bool, rv: Optional[str]) -> str:
+            q = f"?watch=true&sendInitialEvents={'true' if initial else 'false'}"
+            if rv is not None:
+                q += f"&resourceVersion={urllib.parse.quote(str(rv), safe='')}"
+            return self.base_url + p + q
 
         def pump():
-            resp = None
             try:
-                # no read timeout: heartbeats arrive every 15s; a dead
-                # server surfaces as a connection error ending the pump
-                resp = urllib.request.urlopen(  # noqa: S310
-                    urllib.request.Request(url, headers=self._headers()),
-                    context=self._ssl_ctx,
-                )
-                w._resp = resp
-                for line in resp:
-                    if w._stopped:
-                        break
-                    try:
-                        evt = json.loads(line.decode())
-                    except ValueError:
-                        continue
-                    if evt.get("type") in ("HEARTBEAT", None):
-                        continue
-                    w._enqueue((evt["type"], evt["object"]))
-            except (OSError, ValueError):
-                pass
+                _pump_loop()
+            except Exception as e:  # noqa: BLE001 — never die silently
+                if not w._stopped:
+                    w.error = e
+                    log.warning(
+                        "watch %s: pump crashed (%s: %s); consumer must "
+                        "relist", kind, type(e).__name__, e,
+                    )
             finally:
-                # the pump owns the close: closing from another thread
-                # would block on the buffered-reader lock held by the
-                # in-flight readline until the next heartbeat
-                if resp is not None:
-                    try:
-                        resp.close()
-                    except OSError:
-                        pass
+                # the sentinel AND the ended flag are guaranteed no
+                # matter how the pump exits — a dead watch must never
+                # look alive (the pre-PR bug this module fixes)
+                if not w._stopped:
+                    w.ended = True
+                connected.set()  # release a waiting opener either way
                 w._q.put(None)
 
+        def _pump_loop():
+            rv = resource_version
+            delay: Optional[float] = None
+            floor: Optional[float] = None  # Retry-After from a 429
+            connected_once = False
+            while not w._stopped:
+                resp = None
+                try:
+                    # no read timeout: heartbeats arrive every 15s; a
+                    # dead server surfaces as a connection error and we
+                    # reconnect below
+                    resp = urllib.request.urlopen(  # noqa: S310
+                        urllib.request.Request(
+                            # resuming: replay from rv, never a second
+                            # full initial dump
+                            _url(send_initial and rv is None, rv),
+                            headers=self._headers(),
+                        ),
+                        context=self._ssl_ctx,
+                    )
+                    w._resp = resp
+                    connected.set()
+                    if connected_once:
+                        self._m_watch_reestablished.inc()
+                        log.warning(
+                            "watch %s: stream re-established (resume rv=%s)",
+                            kind, rv,
+                        )
+                    connected_once = True
+                    delay = None  # healthy stream resets the backoff
+                    for line in resp:
+                        if w._stopped:
+                            break
+                        try:
+                            evt = json.loads(line.decode())
+                        except ValueError:
+                            continue
+                        if (
+                            not isinstance(evt, dict)
+                            or evt.get("type") in ("HEARTBEAT", None)
+                        ):
+                            continue
+                        obj = evt.get("object")
+                        if not isinstance(obj, dict):
+                            # unknown framing (a Status doc, a future
+                            # BOOKMARK): skip, don't kill the pump
+                            continue
+                        new_rv = obj.get("metadata", {}).get("resourceVersion")
+                        if new_rv is not None:
+                            rv = new_rv
+                        w._enqueue((evt["type"], obj))
+                    if w._stopped:
+                        break
+                    log.warning(
+                        "watch %s: stream ended; reconnecting from rv=%s",
+                        kind, rv,
+                    )
+                except urllib.error.HTTPError as e:
+                    retry_after = _retry_after_of(e) if e.code == 429 else None
+                    try:
+                        e.read()
+                    except (OSError, ValueError):
+                        pass
+                    if 400 <= e.code < 500 and e.code != 429:
+                        # includes 410: our resume point was compacted —
+                        # the consumer must relist; other 4xx (authn/
+                        # authz/bad request) won't heal by retrying
+                        # either. 429 is NOT here: shed load was never
+                        # executed, so the reconnect below retries it
+                        # after the Retry-After wait (the verb × error
+                        # policy table).
+                        klass = _ERR_BY_CODE.get(e.code, APIError)
+                        w.error = klass(
+                            f"watch {kind}: HTTP {e.code} (resume rv={rv})"
+                        )
+                        w.ended = True
+                        log.warning(
+                            "watch %s: HTTP %d at rv=%s; stream dead "
+                            "(%s) — consumer must relist/reauth",
+                            kind, e.code, rv, klass.__name__,
+                        )
+                        return  # pump()'s finally delivers the sentinel
+                    if retry_after:
+                        floor = retry_after
+                    log.warning(
+                        "watch %s: HTTP %d; reconnecting from rv=%s",
+                        kind, e.code, rv,
+                    )
+                except (OSError, ValueError, http.client.HTTPException):
+                    if not w._stopped:
+                        log.warning(
+                            "watch %s: stream broke; reconnecting from rv=%s",
+                            kind, rv,
+                        )
+                finally:
+                    # the pump owns the close: closing from another
+                    # thread would block on the buffered-reader lock
+                    # held by the in-flight readline until the next
+                    # heartbeat
+                    if resp is not None:
+                        try:
+                            resp.close()
+                        except OSError:
+                            pass
+                if w._stopped:
+                    break
+                if rv is None and not send_initial and connected_once:
+                    # a stream that OPENED and then dropped before any
+                    # event leaves a gap no resume point covers — a
+                    # reconnect would silently skip everything written
+                    # during it. Surface instead — the consumer (the
+                    # informer cache) relists. A connect that was
+                    # REJECTED outright (429 shed, refused) opened no
+                    # stream, so nothing was missed: retry below.
+                    w.error = APIError(
+                        f"watch {kind}: stream lost before any event; "
+                        "no resume point — relist required"
+                    )
+                    w.ended = True
+                    log.warning(
+                        "watch %s: stream lost before any event; "
+                        "consumer must relist", kind,
+                    )
+                    break
+                delay = backoff.next_delay(
+                    delay, base=self.retry_base, cap=self.retry_cap
+                )
+                if floor:
+                    delay, floor = max(delay, floor), None
+                self._sleep(delay)
+
         threading.Thread(target=pump, daemon=True).start()
+        # bounded wait (best effort): a down server keeps the pump in
+        # its reconnect loop — proceed after the timeout, no worse than
+        # the old return-immediately behaviour
+        _sanitizer.note_blocking(f"watch connect {kind}")
+        connected.wait(timeout=min(5.0, self.timeout))
         with self._lock:
             self._watches.append(w)
         return w
@@ -471,6 +701,15 @@ class RemoteAPIServer:
             while len(self._event_index) > _EVENT_INDEX_MAX:
                 self._event_index.popitem(last=False)
         return created
+
+
+def _retry_after_of(e: urllib.error.HTTPError) -> float:
+    """The Retry-After header as seconds (delay-seconds form only —
+    the HTTP-date form is overkill for an apiserver hint), default 1s."""
+    try:
+        return float(e.headers.get("Retry-After", "1"))
+    except (AttributeError, TypeError, ValueError):
+        return 1.0
 
 
 def _selector_to_string(selector: Obj) -> str:

@@ -26,10 +26,13 @@ import datetime
 import hashlib
 import hmac
 import json
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Any, Callable, Optional
+
+from odh_kubeflow_tpu.machinery import backoff
 
 Obj = dict[str, Any]
 
@@ -81,7 +84,10 @@ def modify_policy_bindings(policy: Obj, role: str, member: str, add: bool) -> Ob
 
 class GcpIamClient:
     """Workload-identity binding via the IAM API's get/setIamPolicy
-    pair, with etag conflict retry (status 409, per the API contract)."""
+    pair, with etag conflict retry (status 409, per the API contract)
+    paced by the shared backoff helper (``machinery.backoff``) —
+    jittered delays, capped attempts — instead of a private
+    fixed-count loop."""
 
     def __init__(
         self,
@@ -89,11 +95,13 @@ class GcpIamClient:
         http_fn: Optional[HttpFn] = None,
         endpoint: str = "https://iam.googleapis.com/v1",
         max_retries: int = 3,
+        sleep_fn: Callable[[float], None] = time.sleep,
     ):
         self.token_fn = token_fn or (lambda: "")
         self.http = http_fn or _default_http
         self.endpoint = endpoint.rstrip("/")
         self.max_retries = max_retries
+        self._sleep = sleep_fn
 
     def _call(self, method: str, path: str, body: Optional[Obj] = None) -> Obj:
         headers = {"Content-Type": "application/json"}
@@ -114,19 +122,28 @@ class GcpIamClient:
 
     def _modify(self, gcp_sa: str, member: str, add: bool) -> None:
         resource = f"/projects/-/serviceAccounts/{gcp_sa}"
-        for attempt in range(self.max_retries):
+
+        def read_modify_write() -> None:
             policy = self._call("POST", f"{resource}:getIamPolicy")
             updated = modify_policy_bindings(
                 policy, WORKLOAD_IDENTITY_ROLE, member, add
             )
-            try:
-                self._call("POST", f"{resource}:setIamPolicy", {"policy": updated})
-                return
-            except _EtagConflict:
-                if attempt == self.max_retries - 1:
-                    raise GcpIamError(
-                        f"setIamPolicy on {gcp_sa}: etag conflict persisted"
-                    )
+            self._call("POST", f"{resource}:setIamPolicy", {"policy": updated})
+
+        try:
+            backoff.retry(
+                read_modify_write,
+                retryable=(_EtagConflict,),
+                attempts=self.max_retries,
+                base=0.02,
+                cap=0.5,
+                sleep_fn=self._sleep,
+            )
+        except _EtagConflict:
+            raise GcpIamError(
+                f"setIamPolicy on {gcp_sa}: etag conflict persisted "
+                f"after {self.max_retries} attempts"
+            ) from None
 
     # plugin-facing callable contract: (gcp_sa, member, action)
     def __call__(self, gcp_sa: str, member: str, action: str) -> None:

@@ -27,6 +27,8 @@ same HTTP codes kube-apiserver uses (404/409/409/422/403).
 from __future__ import annotations
 
 import json
+import math
+import os
 import threading
 from typing import Any, Iterator, Optional
 from urllib.parse import parse_qs
@@ -43,8 +45,10 @@ from odh_kubeflow_tpu.machinery.store import (
     BadRequest,
     Conflict,
     Denied,
+    Expired,
     Invalid,
     NotFound,
+    TooManyRequests,
 )
 
 Obj = dict[str, Any]
@@ -56,9 +60,53 @@ _STATUS = {
     Invalid: 422,
     Denied: 403,
     BadRequest: 400,
+    Expired: 410,
+    TooManyRequests: 429,
 }
 
 WATCH_HEARTBEAT_SECONDS = 15.0
+
+# APF-lite default: per-client concurrent (non-watch) request cap.
+# kube-apiserver's Priority & Fairness rejects excess work with 429 +
+# Retry-After instead of queueing it unboundedly; so do we. 0 disables.
+DEFAULT_INFLIGHT_LIMIT = int(os.environ.get("APF_INFLIGHT_LIMIT", "256"))
+INFLIGHT_RETRY_AFTER_SECONDS = 1.0
+
+
+class InflightLimiter:
+    """Per-client inflight counter (APF-lite). ``try_acquire`` admits
+    up to ``limit`` concurrent requests per client identity and sheds
+    the rest — the caller turns a False into a 429 with Retry-After.
+    Watches are exempt (long-running, same as kube's APF)."""
+
+    def __init__(self, limit: int, retry_after: float = INFLIGHT_RETRY_AFTER_SECONDS):
+        self.limit = limit
+        self.retry_after = retry_after
+        self._lock = threading.Lock()
+        self._inflight: dict[str, int] = {}
+
+    def try_acquire(self, client: str) -> bool:
+        with self._lock:
+            n = self._inflight.get(client, 0)
+            if n >= self.limit:
+                return False
+            self._inflight[client] = n + 1
+            return True
+
+    def release(self, client: str) -> None:
+        with self._lock:
+            n = self._inflight.get(client, 0) - 1
+            if n <= 0:
+                self._inflight.pop(client, None)
+            else:
+                self._inflight[client] = n
+
+
+def _retry_after_header(seconds: float) -> tuple[str, str]:
+    """RFC 9110 delta-seconds is an INTEGER: a float ("1.0") reads as
+    absent to conformant clients (client-go, urllib3), defeating the
+    backpressure. Round up so the hint never undershoots."""
+    return ("Retry-After", str(max(1, math.ceil(seconds))))
 
 
 def _err_status(e: APIError) -> int:
@@ -144,12 +192,15 @@ class RestAPI:
         server: APIServer,
         authenticator: Optional[Any] = None,  # environ -> username | None
         metrics_registry: Optional[Registry] = None,
+        inflight_limit: Optional[int] = None,
     ):
         self.server = server
         self.authenticator = authenticator
         # served at /metrics when given (anonymous, like the health
         # probes — the controller-runtime metrics-listener posture)
         self.metrics_registry = metrics_registry
+        limit = DEFAULT_INFLIGHT_LIMIT if inflight_limit is None else inflight_limit
+        self.limiter = InflightLimiter(limit) if limit > 0 else None
 
     # -- helpers ------------------------------------------------------------
 
@@ -157,20 +208,23 @@ class RestAPI:
         return self.server.kind_for_plural(plural)
 
     @staticmethod
-    def _json(status: int, body: Obj, start_response) -> list[bytes]:
+    def _json(
+        status: int, body: Obj, start_response, headers=()
+    ) -> list[bytes]:
         payload = json.dumps(body).encode()
         start_response(
             f"{status} {'OK' if status < 400 else 'Error'}",
             [
                 ("Content-Type", "application/json"),
                 ("Content-Length", str(len(payload))),
+                *headers,
             ],
         )
         return [payload]
 
     @staticmethod
     def _error(
-        status: int, message: str, start_response, reason: str = ""
+        status: int, message: str, start_response, reason: str = "", headers=()
     ) -> list[bytes]:
         return RestAPI._json(
             status,
@@ -185,13 +239,18 @@ class RestAPI:
                 "code": status,
             },
             start_response,
+            headers=headers,
         )
 
-    def _watch_stream(
-        self, kind: str, namespace: Optional[str], send_initial: bool
-    ) -> Iterator[bytes]:
-        w = self.server.watch(kind, namespace=namespace, send_initial=send_initial)
+    def _watch_stream(self, w) -> Iterator[bytes]:
         try:
+            # immediate greeting: wsgiref only flushes status+headers
+            # with the first body bytes, and the client's watch opener
+            # blocks in urlopen until they arrive. The watch is already
+            # registered, so greeting NOW (instead of at the first
+            # event/15s heartbeat) is what makes the client's
+            # watch-then-list ordering guarantee real over HTTP.
+            yield b'{"type":"HEARTBEAT"}\n'
             while True:
                 item = w.get(timeout=WATCH_HEARTBEAT_SECONDS)
                 if item is None:
@@ -288,14 +347,49 @@ class RestAPI:
         except NotFound as e:
             return self._error(404, str(e), start_response)
 
+        # APF-lite admission: cap concurrent non-watch requests per
+        # client identity, shedding excess with 429 + Retry-After
+        # instead of queueing unboundedly in the thread pool. Watches
+        # are exempt (long-running, kube's APF posture) — but ONLY what
+        # _dispatch actually serves as a watch (collection GETs);
+        # ?watch=true on a named resource is an ordinary read and must
+        # not buy its way past the limiter.
+        is_watch = (
+            method == "GET"
+            and route.name is None
+            and qs.get("watch", ["false"])[0] in ("true", "1")
+        )
+        client = None
+        if self.limiter is not None and not is_watch:
+            client = environ.get("odh.authenticated.user") or environ.get(
+                "REMOTE_ADDR", "anonymous"
+            )
+            if not self.limiter.try_acquire(client):
+                return self._error(
+                    429,
+                    f"too many in-flight requests for client {client!r}",
+                    start_response,
+                    reason="TooManyRequests",
+                    headers=[_retry_after_header(self.limiter.retry_after)],
+                )
         try:
             return self._dispatch(kind, route, method, qs, environ, start_response)
         except APIError as e:
+            headers = []
+            if isinstance(e, TooManyRequests):
+                headers.append(_retry_after_header(e.retry_after))
             return self._error(
-                _err_status(e), str(e), start_response, reason=type(e).__name__
+                _err_status(e),
+                str(e),
+                start_response,
+                reason=type(e).__name__,
+                headers=headers,
             )
         except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
             return self._error(500, f"{type(e).__name__}: {e}", start_response)
+        finally:
+            if client is not None:
+                self.limiter.release(client)
 
     def _subject_access_review(self, environ, start_response):
         """kube's SAR endpoint: the auth-proxy sidecar (and any other
@@ -341,11 +435,22 @@ class RestAPI:
         if method == "GET" and name is None:
             if qs.get("watch", ["false"])[0] in ("true", "1"):
                 send_initial = qs.get("sendInitialEvents", ["true"])[0] != "false"
+                rv = qs.get("resourceVersion", [None])[0]
+                # the watch opens BEFORE streaming starts so a 410
+                # Expired resume surfaces as a proper Status response
+                # (raised here → the APIError handler), not a broken
+                # stream
+                w = self.server.watch(
+                    kind,
+                    namespace=ns,
+                    send_initial=send_initial,
+                    resource_version=rv,
+                )
                 start_response(
                     "200 OK",
                     [("Content-Type", "application/json"), ("X-Stream", "watch")],
                 )
-                return self._watch_stream(kind, ns, send_initial)
+                return self._watch_stream(w)
             selector = None
             if "labelSelector" in qs:
                 selector = obj_util.parse_selector_string(qs["labelSelector"][0])
@@ -423,6 +528,7 @@ def serve(
     ssl_context: Optional[Any] = None,
     authenticator: Optional[Any] = None,
     metrics_registry: Optional[Registry] = None,
+    inflight_limit: Optional[int] = None,
 ) -> tuple[threading.Thread, int, Any]:
     """Serve the REST façade on a daemon thread; returns (thread,
     bound_port, httpd). ``httpd.shutdown()`` stops it.
@@ -433,7 +539,10 @@ def serve(
     requests with 401 except on health probes; ``metrics_registry``
     exposes Prometheus text exposition at ``/metrics``."""
     app = RestAPI(
-        server, authenticator=authenticator, metrics_registry=metrics_registry
+        server,
+        authenticator=authenticator,
+        metrics_registry=metrics_registry,
+        inflight_limit=inflight_limit,
     )
     httpd = make_server(
         host, port, app, server_class=_ThreadingServer, handler_class=_QuietHandler

@@ -55,8 +55,11 @@ def run_controller(name: str, register: Callable) -> None:
     reconciling without it)."""
     from odh_kubeflow_tpu.controllers.runtime import Manager
     from odh_kubeflow_tpu.machinery.client import api_from_env
+    from odh_kubeflow_tpu.machinery.faults import maybe_wrap
 
-    api = api_from_env()
+    # GRAFT_CHAOS=<seed>: deterministic fault injection on the API path
+    # (chaos soak runs); unset = the raw client, zero overhead
+    api = maybe_wrap(api_from_env())
     api, cache = _wrap_cached(api)
 
     elector = None
@@ -107,8 +110,9 @@ def run_controller(name: str, register: Callable) -> None:
 def run_web(name: str, default_port: int, build: Callable) -> None:
     """``build(api)`` returns an object exposing a ``.app`` WSGI app."""
     from odh_kubeflow_tpu.machinery.client import api_from_env
+    from odh_kubeflow_tpu.machinery.faults import maybe_wrap
 
-    api, cache = _wrap_cached(api_from_env())
+    api, cache = _wrap_cached(maybe_wrap(api_from_env()))
     if cache is not None:
         cache.start(live=True)
         cache.wait_for_sync()

@@ -25,6 +25,7 @@ from __future__ import annotations
 import queue
 import threading
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, Optional
 
@@ -73,6 +74,28 @@ class Unauthorized(APIError):
     the authz 403)."""
 
     code = 401
+
+
+class TooManyRequests(APIError):
+    """Server-side load shedding (kube's APF 429). Carries the
+    Retry-After hint clients must honour before retrying — unlike the
+    other errors, a 429 means the request was never executed, so every
+    verb is safe to retry after the wait."""
+
+    code = 429
+
+    def __init__(self, message: str = "", retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+class Expired(APIError):
+    """HTTP 410 Gone: the requested resourceVersion has been compacted
+    out of the watch cache. A watch cannot resume from it — the client
+    must relist and watch from the fresh state (exactly
+    kube-apiserver's ``status.reason: Expired`` contract)."""
+
+    code = 410
 
 
 @dataclass
@@ -133,7 +156,15 @@ BUILTIN_KINDS: list[tuple[str, str, str, bool]] = [
 
 
 class Watch:
-    """Iterator over (event_type, obj) with a bounded drain queue."""
+    """Iterator over (event_type, obj) with a bounded drain queue.
+
+    ``ended``/``error`` are the stream-health surface: a pump that dies
+    (dropped connection it could not resume, 410 Expired, injected
+    chaos) sets ``ended = True`` (and ``error`` when there is one)
+    before enqueueing the ``None`` sentinel, so consumers can tell
+    "the stream broke — relist" apart from "I asked it to stop"
+    (``_stopped``). The embedded in-process watch never ends on its
+    own."""
 
     def __init__(self, server: "APIServer", kind: str, namespace: Optional[str]):
         self._q: "queue.Queue[Optional[tuple[str, Obj]]]" = queue.Queue()
@@ -141,6 +172,8 @@ class Watch:
         self.kind = kind
         self.namespace = namespace
         self._stopped = False
+        self.ended = False
+        self.error: Optional[Exception] = None
 
     def _enqueue(self, event: tuple[str, Obj]) -> None:
         if not self._stopped:
@@ -184,6 +217,12 @@ class Watch:
 
 
 class APIServer:
+    # retained watch-cache window (events, not seconds): a watch may
+    # resume from any resourceVersion still inside it; older resumes
+    # get 410 Expired, same as kube-apiserver's compacted etcd window.
+    # Class attr so chaos tests shrink it to force expiry.
+    WATCH_CACHE_SIZE = 2048
+
     def __init__(self):
         self._lock = _sanitizer.new_rlock("apiserver.store")
         self._types: dict[str, TypeInfo] = {}
@@ -196,6 +235,14 @@ class APIServer:
         self._watches: list[Watch] = []
         self._hooks: list[_Hook] = []
         self._event_index: dict[tuple, str] = {}
+        # bounded watch cache: (rv, kind, namespace, etype, frozen obj)
+        # — the resume window behind watch(resource_version=…)
+        self._event_log: deque[tuple[int, str, str, str, Obj]] = deque()
+        # highest rv dropped from the log; resuming BELOW it is Expired
+        # (a gap we can no longer fill) — resuming exactly at it is
+        # fine: that client saw the newest dropped event and everything
+        # after it is still retained
+        self._compacted_rv = 0
         self._register_builtins()
 
     # -- type registry ------------------------------------------------------
@@ -479,6 +526,11 @@ class APIServer:
             current["metadata"]["name"],
         )
         self._drop(info.kind, key)
+        # a deletion is a new cluster state: stamp a FRESH rv (kube
+        # does the same) so the watch cache orders it after the last
+        # modification — a resume from the final modified rv must
+        # deliver the DELETED event, not silently skip it
+        current["metadata"]["resourceVersion"] = self._next_rv()
         self._notify("DELETED", current)
         self._cascade(current)
 
@@ -507,11 +559,35 @@ class APIServer:
         kind: str,
         namespace: Optional[str] = None,
         send_initial: bool = True,
+        resource_version: Optional[str] = None,
     ) -> Watch:
+        """Open a watch stream. ``resource_version`` resumes from a
+        previously observed rv: events after it replay from the watch
+        cache, then the stream goes live — no initial ADDED dump. A
+        resume point older than the retained window raises
+        :class:`Expired` (410); the caller must relist."""
         info = self.type_info(kind)
         with self._lock:
             w = Watch(self, kind, namespace)
-            if send_initial:
+            if resource_version is not None:
+                try:
+                    rv = int(resource_version)
+                except (TypeError, ValueError):
+                    raise Invalid(
+                        f"resourceVersion {resource_version!r} is not numeric"
+                    ) from None
+                if rv < self._compacted_rv:
+                    raise Expired(
+                        f"resourceVersion {rv} is too old (oldest resumable "
+                        f"is {self._compacted_rv})"
+                    )
+                for erv, ekind, ens, etype, obj in self._event_log:
+                    if erv <= rv or ekind != kind:
+                        continue
+                    if namespace and ens != namespace:
+                        continue
+                    w._enqueue((etype, obj))
+            elif send_initial:
                 # frozen shared replay: consumers of the watch stream
                 # (controller map fns, the informer cache) are readers;
                 # freezing instead of copying makes the initial sync
@@ -532,20 +608,29 @@ class APIServer:
 
     def _notify(self, event_type: str, obj: Obj) -> None:
         kind = obj.get("kind", "")
-        ns = obj.get("metadata", {}).get("namespace", "")
-        # ONE frozen snapshot per event, shared by every watcher: the
-        # old per-watcher deepcopy made each write O(watchers × size).
-        # freeze() builds an independent read-only tree, so later store
-        # mutations can't leak into delivered events, and readers that
-        # try to mutate get FrozenObjectError instead of corruption.
-        shared: Optional[Obj] = None
+        meta = obj.get("metadata", {})
+        ns = meta.get("namespace", "")
+        # ONE frozen snapshot per event, shared by every watcher AND the
+        # watch cache: the old per-watcher deepcopy made each write
+        # O(watchers × size). freeze() builds an independent read-only
+        # tree, so later store mutations can't leak into delivered
+        # events, and readers that try to mutate get FrozenObjectError
+        # instead of corruption.
+        shared = obj_util.freeze(obj)
+        try:
+            rv = int(meta.get("resourceVersion", self._rv))
+        except (TypeError, ValueError):
+            rv = self._rv
+        self._event_log.append((rv, kind, ns, event_type, shared))
+        while len(self._event_log) > self.WATCH_CACHE_SIZE:
+            self._compacted_rv = max(
+                self._compacted_rv, self._event_log.popleft()[0]
+            )
         for w in list(self._watches):
             if w.kind != kind:
                 continue
             if w.namespace and w.namespace != ns:
                 continue
-            if shared is None:
-                shared = obj_util.freeze(obj)
             w._enqueue((event_type, shared))
 
     # -- convenience --------------------------------------------------------
@@ -646,7 +731,8 @@ class APIServer:
                     # watchers (and the informer cache) must see the
                     # expiry, or they'd retain pruned events forever —
                     # kube-apiserver's TTL expiry likewise ends watches
-                    # with DELETED
+                    # with DELETED (fresh rv, same as _remove)
+                    expired["metadata"]["resourceVersion"] = self._next_rv()
                     self._notify("DELETED", expired)
             dead = {name for _, name in drop}
             self._event_index = {

@@ -9,11 +9,16 @@ success/error envelopes, liveness probes (probes.py).
 
 from __future__ import annotations
 
+import http.client
+import logging
 import os
-from typing import Any, Optional
+import threading
+from typing import Any, Callable, Optional
 
 from odh_kubeflow_tpu.machinery.rbac import RBACEvaluator
 from odh_kubeflow_tpu.machinery.store import APIServer, APIError, NotFound
+
+log = logging.getLogger("crud-backend")
 from odh_kubeflow_tpu.web.microweb import (
     App,
     HTTPError,
@@ -52,6 +57,21 @@ def success(extra: Optional[dict] = None, status: int = 200) -> Response:
     return Response(body, status)
 
 
+# connection-level failures the remote client classifies as network
+# outages and may re-raise after its retries (BadStatusLine,
+# IncompleteRead are HTTPException, NOT OSError)
+_OUTAGE_ERRORS = (APIError, OSError, http.client.HTTPException)
+
+
+def _is_outage(e: Exception) -> bool:
+    """A backend failure that degraded-mode serving should mask:
+    server errors, load shedding, and network loss. Client errors
+    (403/404/422…) are real answers and must surface."""
+    if isinstance(e, APIError):
+        return e.code >= 500 or e.code == 429
+    return isinstance(e, (OSError, http.client.HTTPException))
+
+
 def failure(log: str, status: int = 400) -> Response:
     return Response({"success": False, "status": status, "log": log}, status)
 
@@ -79,6 +99,12 @@ class CrudBackend:
             static_dir=static_dir or default_static,
             static_mounts=mounts,
         )
+        # last-known-good listings for degraded-mode serving: when the
+        # backend is unreachable, list endpoints answer from here with
+        # a `degraded: true` marker instead of 500ing (NotebookOS's
+        # mask-transient-infrastructure-failures posture)
+        self._lkg: dict[Any, list] = {}
+        self._lkg_lock = threading.Lock()
         install_csrf(self.app)
         self._install_probes()
         self._install_errors()
@@ -115,6 +141,54 @@ class CrudBackend:
                 + (f" in namespace {namespace}" if namespace else ""),
             )
         return user
+
+    # -- degraded-mode serving ---------------------------------------------
+
+    def backend_degraded(self, *kinds: str) -> bool:
+        """Whether the informer cache behind ``self.api`` (when there
+        is one) is serving any of ``kinds`` degraded — watch stream
+        down, state last-known-good."""
+        cache = getattr(self.api, "cache", None)
+        return cache is not None and any(
+            cache.has_kind(k) and cache.degraded(k) for k in kinds
+        )
+
+    def serve_listing(
+        self,
+        key: Any,
+        build: Callable[[], list],
+        kinds: tuple[str, ...] = (),
+    ) -> tuple[list, bool]:
+        """Build a listing's rows, remembering them as last-known-good;
+        when the backend is unreachable (5xx/429/network), serve the
+        remembered rows — possibly empty — with ``degraded=True``
+        instead of failing the request. ``kinds`` lets an informer
+        cache's own degraded state mark even successful (stale) reads."""
+        try:
+            rows = build()
+        except _OUTAGE_ERRORS as e:
+            if not _is_outage(e):
+                raise
+            log.warning(
+                "listing %s: backend unreachable (%s: %s); serving "
+                "last-known-good", key, type(e).__name__, e,
+            )
+            with self._lkg_lock:
+                return list(self._lkg.get(key, [])), True
+        # checked AFTER build: the informer pokes (and discovers a dead
+        # stream) during the reads build() performs
+        degraded = self.backend_degraded(*kinds)
+        with self._lkg_lock:
+            self._lkg[key] = list(rows)
+        return rows, degraded
+
+    def listing_body(
+        self, field: str, rows: list, degraded: bool
+    ) -> dict[str, Any]:
+        body: dict[str, Any] = {field: rows}
+        if degraded:
+            body["degraded"] = True
+        return body
 
     # -- shared status/event treatment (reference:
     # crud-web-apps/common/backend/.../status.py — every app derives

@@ -222,11 +222,15 @@ class JupyterWebApp(CrudBackend):
         @app.route("/api/namespaces/<namespace>/notebooks")
         def list_notebooks(request, namespace):
             self.authorize(request, "list", "notebooks", namespace, "kubeflow.org")
-            notebooks = [
-                self.notebook_row(nb)
-                for nb in self.api.list("Notebook", namespace=namespace)
-            ]
-            return success({"notebooks": notebooks})
+            rows, degraded = self.serve_listing(
+                ("notebooks", namespace),
+                lambda: [
+                    self.notebook_row(nb)
+                    for nb in self.api.list("Notebook", namespace=namespace)
+                ],
+                kinds=("Notebook",),
+            )
+            return success(self.listing_body("notebooks", rows, degraded))
 
         @app.route("/api/namespaces/<namespace>/notebooks", methods=["POST"])
         def post_notebook(request, namespace):
@@ -374,8 +378,14 @@ class JupyterWebApp(CrudBackend):
         @app.route("/api/namespaces/<namespace>/pvcs")
         def list_pvcs(request, namespace):
             self.authorize(request, "list", "persistentvolumeclaims", namespace)
-            pvcs = self.api.list("PersistentVolumeClaim", namespace=namespace)
-            return success({"pvcs": pvcs})
+            rows, degraded = self.serve_listing(
+                ("pvcs", namespace),
+                lambda: self.api.list(
+                    "PersistentVolumeClaim", namespace=namespace
+                ),
+                kinds=("PersistentVolumeClaim",),
+            )
+            return success(self.listing_body("pvcs", rows, degraded))
 
         @app.route("/api/namespaces/<namespace>/poddefaults")
         def list_poddefaults(request, namespace):

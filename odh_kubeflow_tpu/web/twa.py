@@ -30,11 +30,15 @@ class TensorboardsWebApp(CrudBackend):
             self.authorize(
                 request, "list", "tensorboards", namespace, "tensorboard.kubeflow.org"
             )
-            rows = [
-                self.tensorboard_row(tb)
-                for tb in self.api.list("Tensorboard", namespace=namespace)
-            ]
-            return success({"tensorboards": rows})
+            rows, degraded = self.serve_listing(
+                ("tensorboards", namespace),
+                lambda: [
+                    self.tensorboard_row(tb)
+                    for tb in self.api.list("Tensorboard", namespace=namespace)
+                ],
+                kinds=("Tensorboard",),
+            )
+            return success(self.listing_body("tensorboards", rows, degraded))
 
         @app.route("/api/namespaces/<namespace>/tensorboards", methods=["POST"])
         def post_tb(request, namespace):

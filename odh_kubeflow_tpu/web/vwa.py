@@ -25,13 +25,17 @@ class VolumesWebApp(CrudBackend):
         @app.route("/api/namespaces/<namespace>/pvcs")
         def list_pvcs(request, namespace):
             self.authorize(request, "list", "persistentvolumeclaims", namespace)
-            rows = [
-                self.pvc_row(pvc)
-                for pvc in self.api.list(
-                    "PersistentVolumeClaim", namespace=namespace
-                )
-            ]
-            return success({"pvcs": rows})
+            rows, degraded = self.serve_listing(
+                ("pvcs", namespace),
+                lambda: [
+                    self.pvc_row(pvc)
+                    for pvc in self.api.list(
+                        "PersistentVolumeClaim", namespace=namespace
+                    )
+                ],
+                kinds=("PersistentVolumeClaim", "Pod"),
+            )
+            return success(self.listing_body("pvcs", rows, degraded))
 
         @app.route("/api/namespaces/<namespace>/pvcs", methods=["POST"])
         def post_pvc(request, namespace):

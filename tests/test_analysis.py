@@ -38,6 +38,7 @@ def test_rule_catalog_has_the_platform_rules():
         "swallowed-exception",
         "blocking-under-lock",
         "metric-naming",
+        "retry-without-backoff",
     } <= ids
     assert len(ids) >= 5
 
@@ -375,6 +376,109 @@ def test_frozen_mutation_suppressed():
         '    nb["status"] = {}  # graftlint: disable=frozen-mutation raw-store path only\n'
     )
     assert lint_source(src, "controllers/x.py", ["frozen-mutation"]) == []
+
+
+# ---------------------------------------------------------------------------
+# retry-without-backoff
+
+
+def test_retry_without_backoff_fixed_count_loop_flagged():
+    # the exact shape cloudiam's etag retry had before it moved onto
+    # machinery.backoff: for-range around an API call, no pacing
+    src = (
+        "def ensure(api, obj):\n"
+        "    for attempt in range(3):\n"
+        "        try:\n"
+        "            return api.create(obj)\n"
+        "        except Exception:\n"
+        "            if attempt == 2:\n"
+        "                raise\n"
+    )
+    assert rule_ids(
+        lint_source(src, "machinery/x.py", ["retry-without-backoff"])
+    ) == ["retry-without-backoff"]
+
+
+def test_retry_without_backoff_while_true_constant_sleep_flagged():
+    src = (
+        "import time\n"
+        "def ensure(api, obj):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return api.update(obj)\n"
+        "        except Exception:\n"
+        "            time.sleep(0.1)\n"
+    )
+    assert rule_ids(
+        lint_source(src, "controllers/x.py", ["retry-without-backoff"])
+    ) == ["retry-without-backoff"]
+
+
+def test_retry_without_backoff_clean_variants():
+    # routed through the shared helper (call chain names backoff)
+    src = (
+        "from odh_kubeflow_tpu.machinery import backoff\n"
+        "def ensure(api, obj):\n"
+        "    return backoff.retry(lambda: api.create(obj), attempts=3)\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["retry-without-backoff"]) == []
+    # computed (non-constant) sleep = some pacing policy exists
+    src = (
+        "import time\n"
+        "def ensure(api, obj, delay):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return api.update(obj)\n"
+        "        except Exception:\n"
+        "            delay = min(delay * 2, 5.0)\n"
+        "            time.sleep(delay)\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["retry-without-backoff"]) == []
+    # inline backoff state (next_delay) in the loop
+    src = (
+        "from odh_kubeflow_tpu.machinery import backoff\n"
+        "def pump(api, kind):\n"
+        "    delay = None\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return api.watch(kind)\n"
+        "        except Exception:\n"
+        "            delay = backoff.next_delay(delay)\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["retry-without-backoff"]) == []
+    # a handler that EXITS the loop is not a retry loop
+    src = (
+        "def drain(q):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return q.get(timeout=1)\n"
+        "        except Exception:\n"
+        "            return None\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["retry-without-backoff"]) == []
+    # out-of-scope dirs are not checked (web retries are HTTP-level)
+    src = (
+        "def ensure(api, obj):\n"
+        "    for _ in range(3):\n"
+        "        try:\n"
+        "            return api.create(obj)\n"
+        "        except Exception:\n"
+        "            pass\n"
+    )
+    assert lint_source(src, "web/x.py", ["retry-without-backoff"]) == []
+
+
+def test_retry_without_backoff_suppressed_with_reason():
+    src = (
+        "def ensure(api, obj):\n"
+        "    for _ in range(3):  # graftlint: disable=retry-without-backoff "
+        "bounded dev-only helper\n"
+        "        try:\n"
+        "            return api.create(obj)\n"
+        "        except Exception:\n"
+        "            pass\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["retry-without-backoff"]) == []
 
 
 # ---------------------------------------------------------------------------

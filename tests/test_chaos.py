@@ -1,0 +1,918 @@
+"""Chaos suite: the control plane under a misbehaving API path.
+
+Seeded fault schedules (``machinery.faults.FaultInjector``) inject
+transient conflicts, 429s with Retry-After, 5xx, watch-stream drops,
+and resourceVersion expiry, and these tests assert the resilience
+machinery actually masks them: the shared backoff helper paces retries,
+the remote client retries idempotent verbs and reconnects watches
+resuming from the last-seen rv, the store/httpapi speak real 410/429
+semantics, the informer cache heals via relist and serves last-known-
+good state while degraded, the scheduler's admit/preempt invariants
+survive, and the web apps answer listings with ``degraded: true``
+instead of 500s.
+
+``GRAFT_CHAOS=<seed>`` re-seeds every schedule (CI pins it to 1 for
+reproducible runs); unset, the suite uses its own fixed seed. Under
+``GRAFT_SANITIZE=1`` the randomized sequences double as race probes —
+zero sanitizer reports allowed.
+"""
+
+import json
+import logging
+import random
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from odh_kubeflow_tpu.machinery import backoff
+from odh_kubeflow_tpu.machinery.cache import (
+    CachedClient,
+    InformerCache,
+    register_platform_indexers,
+)
+from odh_kubeflow_tpu.machinery.client import RemoteAPIServer
+from odh_kubeflow_tpu.machinery.faults import (
+    FaultInjector,
+    FaultSchedule,
+    chaos_seed,
+)
+from odh_kubeflow_tpu.machinery.httpapi import serve
+from odh_kubeflow_tpu.machinery.store import (
+    APIError,
+    APIServer,
+    Conflict,
+    Expired,
+    NotFound,
+    TooManyRequests,
+)
+from odh_kubeflow_tpu.utils import prometheus
+
+SEED = chaos_seed() or 20260803
+
+
+def _cm(name, ns="default", v="0"):
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": ns},
+        "data": {"v": v},
+    }
+
+
+def _no_sleep(_s):
+    pass
+
+
+def _injector(api, schedule=None, seed=SEED, registry=None):
+    return FaultInjector(
+        api,
+        seed=seed,
+        schedule=schedule if schedule is not None else FaultSchedule.none(),
+        registry=registry or prometheus.Registry(),
+        sleep_fn=_no_sleep,
+    )
+
+
+# ---------------------------------------------------------------------------
+# backoff helper
+
+
+def test_backoff_delays_are_jittered_bounded_and_capped():
+    rng = random.Random(3)
+    ds = list(backoff.delays(10, base=0.05, cap=0.4, rng=rng))
+    assert len(ds) == 9
+    assert all(0.05 <= d <= 0.4 for d in ds)
+    assert max(ds) > 0.05  # it actually grows
+    # deterministic under a fixed rng seed (reproducible chaos runs)
+    assert ds == list(backoff.delays(10, base=0.05, cap=0.4, rng=random.Random(3)))
+
+
+def test_backoff_retry_caps_attempts_and_honours_retry_after():
+    sleeps, calls = [], {"n": 0}
+
+    def always_shed():
+        calls["n"] += 1
+        raise TooManyRequests("shed", retry_after=0.25)
+
+    with pytest.raises(TooManyRequests):
+        backoff.retry(
+            always_shed,
+            retryable=(TooManyRequests,),
+            attempts=3,
+            base=0.01,
+            cap=0.1,
+            rng=random.Random(2),
+            sleep_fn=sleeps.append,
+        )
+    assert calls["n"] == 3
+    # Retry-After floors every delay, even above the cap
+    assert len(sleeps) == 2 and all(s >= 0.25 for s in sleeps)
+
+
+def test_backoff_retry_propagates_non_retryable_immediately():
+    calls = {"n": 0}
+
+    def conflict():
+        calls["n"] += 1
+        raise Conflict("real contention")
+
+    with pytest.raises(Conflict):
+        backoff.retry(
+            conflict,
+            retryable=(TooManyRequests,),
+            attempts=5,
+            sleep_fn=_no_sleep,
+        )
+    assert calls["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fault injector
+
+
+def test_fault_injector_is_deterministic_per_seed():
+    def run(seed):
+        api = APIServer()
+        inj = _injector(
+            api,
+            FaultSchedule(
+                conflict=0.3, too_many_requests=0.3, server_error=0.2
+            ),
+            seed=seed,
+        )
+        out = []
+        for i in range(80):
+            try:
+                inj.create(_cm(f"c{i}"))
+                out.append("ok")
+            except APIError as e:
+                out.append(type(e).__name__)
+        return out
+
+    assert run(7) == run(7)
+    assert run(7) != run(8)
+    assert "Conflict" in run(7) and "TooManyRequests" in run(7)
+
+
+def test_fault_metrics_pass_naming_lint():
+    registry = prometheus.Registry()
+    _injector(APIServer(), registry=registry)
+    InformerCache(APIServer(), registry=registry)
+    RemoteAPIServer("http://127.0.0.1:1", registry=registry)
+    assert prometheus.lint_metric_names(registry) == []
+    names = {m.name for m in registry.metrics()}
+    assert {
+        "faults_injected_total",
+        "client_retries_total",
+        "watch_reestablished_total",
+        "cache_relists_total",
+    } <= names
+
+
+# ---------------------------------------------------------------------------
+# store: watch resume + 410 semantics
+
+
+def test_store_watch_resumes_from_resource_version():
+    api = APIServer()
+    first = api.create(_cm("a0"))
+    api.create(_cm("a1"))
+    api.create(_cm("a2"))
+    w = api.watch(
+        "ConfigMap", resource_version=first["metadata"]["resourceVersion"]
+    )
+    # replay: only events AFTER the resume point, no initial dump
+    names = []
+    while True:
+        item = w.try_get()
+        if item is None:
+            break
+        names.append(item[1]["metadata"]["name"])
+    assert names == ["a1", "a2"]
+    # and the stream is live after the replay
+    api.create(_cm("a3"))
+    etype, obj = w.get(timeout=1)
+    assert (etype, obj["metadata"]["name"]) == ("ADDED", "a3")
+    w.stop()
+
+
+def test_store_watch_resume_delivers_deletions_with_fresh_rv():
+    """A deletion is a new cluster state: it must carry a FRESH rv so a
+    resume from the object's final modified rv still delivers the
+    DELETED event (stale-rv deletions would be silently skipped by the
+    `erv <= rv` resume filter — ghost objects forever)."""
+    api = APIServer()
+    a = api.create(_cm("a"))
+    rv = a["metadata"]["resourceVersion"]
+    api.delete("ConfigMap", "a", "default")
+    w = api.watch("ConfigMap", resource_version=rv)
+    item = w.try_get()
+    assert item is not None and item[0] == "DELETED"
+    assert item[1]["metadata"]["name"] == "a"
+    assert int(item[1]["metadata"]["resourceVersion"]) > int(rv)
+    w.stop()
+
+
+def test_store_watch_from_compacted_rv_raises_expired():
+    api = APIServer()
+    api.WATCH_CACHE_SIZE = 5
+    for i in range(12):
+        api.create(_cm(f"b{i}"))
+    with pytest.raises(Expired):
+        api.watch("ConfigMap", resource_version="1")
+    # inside the retained window still resumes fine
+    recent = api.get("ConfigMap", "b10", "default")
+    w = api.watch(
+        "ConfigMap", resource_version=recent["metadata"]["resourceVersion"]
+    )
+    item = w.try_get()
+    assert item is not None and item[1]["metadata"]["name"] == "b11"
+    w.stop()
+
+
+# ---------------------------------------------------------------------------
+# httpapi: 410 / 429 mapping, Retry-After, APF-lite inflight limiter
+
+
+def test_httpapi_maps_expired_watch_to_410_status():
+    api = APIServer()
+    api.WATCH_CACHE_SIZE = 4
+    for i in range(10):
+        api.create(_cm(f"c{i}"))
+    _t, port, httpd = serve(api)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v1/configmaps"
+            "?watch=true&resourceVersion=1"
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 410
+        status = json.loads(ei.value.read().decode())
+        assert status["reason"] == "Expired"
+    finally:
+        httpd.shutdown()
+
+
+def test_httpapi_inflight_limiter_sheds_with_429_and_retry_after():
+    api = APIServer()
+    gate, entered = threading.Event(), threading.Event()
+
+    def slow_hook(_req):
+        entered.set()
+        gate.wait(5)
+        return None
+
+    api.register_admission_hook(["ConfigMap"], slow_hook, mutating=True)
+    _t, port, httpd = serve(api, inflight_limit=1)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        results = {}
+
+        def create():
+            req = urllib.request.Request(
+                base + "/api/v1/namespaces/default/configmaps",
+                data=json.dumps(_cm("slow")).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                results["create"] = r.status
+
+        t = threading.Thread(target=create, daemon=True)
+        t.start()
+        assert entered.wait(5)
+        # the one slot is held: the next request is shed, not queued
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                base + "/api/v1/namespaces/default/configmaps", timeout=5
+            )
+        assert ei.value.code == 429
+        assert float(ei.value.headers["Retry-After"]) > 0
+        assert json.loads(ei.value.read().decode())["reason"] == (
+            "TooManyRequests"
+        )
+        # the typed client surfaces it as TooManyRequests w/ retry_after
+        client = RemoteAPIServer(base, retries=1)
+        with pytest.raises(TooManyRequests) as ce:
+            client.list("ConfigMap")
+        assert ce.value.retry_after > 0
+        gate.set()
+        t.join(5)
+        assert results["create"] == 201  # the admitted request finished
+        # slot released: reads flow again
+        with urllib.request.urlopen(
+            base + "/api/v1/namespaces/default/configmaps", timeout=5
+        ) as r:
+            assert r.status == 200
+    finally:
+        gate.set()
+        httpd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# client: retry policy (verb × error), watch reconnect/resume, 410
+
+
+def test_client_retry_policy_and_metrics():
+    registry = prometheus.Registry()
+    c = RemoteAPIServer(
+        "http://127.0.0.1:1",
+        registry=registry,
+        retries=3,
+        retry_base=0.001,
+        retry_cap=0.002,
+    )
+    sleeps = []
+    c._sleep = sleeps.append
+    calls = {"n": 0}
+
+    # GET retried through transient 5xx
+    def flaky(method, path, body=None, query=""):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise APIError("injected 503")
+        return {"items": []}
+
+    c._do_request = flaky
+    assert c.list("Pod") == []
+    assert calls["n"] == 3
+    assert c._m_retries.value({"verb": "GET", "reason": "5xx"}) == 2
+
+    # mutations do NOT retry ambiguous errors (5xx/network)
+    calls["n"] = 0
+
+    def always_5xx(method, path, body=None, query=""):
+        calls["n"] += 1
+        raise APIError("boom")
+
+    c._do_request = always_5xx
+    with pytest.raises(APIError):
+        c.update({"kind": "Pod", "metadata": {"name": "x", "namespace": "d"}})
+    assert calls["n"] == 1
+    calls["n"] = 0
+
+    def refused(method, path, body=None, query=""):
+        calls["n"] += 1
+        raise ConnectionRefusedError("no route")
+
+    c._do_request = refused
+    with pytest.raises(OSError):
+        c.delete("Pod", "x", "d")
+    assert calls["n"] == 1
+
+    # 429 retries EVERY verb (never executed server-side), honouring
+    # Retry-After as the delay floor
+    calls["n"] = 0
+    sleeps.clear()
+
+    def shed_once(method, path, body=None, query=""):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise TooManyRequests("shed", retry_after=0.05)
+        return {"kind": "Pod", "metadata": {"name": "x", "namespace": "d"}}
+
+    c._do_request = shed_once
+    c.update({"kind": "Pod", "metadata": {"name": "x", "namespace": "d"}})
+    assert calls["n"] == 2
+    assert sleeps and sleeps[0] >= 0.05
+    assert c._m_retries.value({"verb": "PUT", "reason": "429"}) == 1
+
+
+def test_client_watch_reconnects_resuming_from_last_rv(caplog):
+    """Satellite regression: a dropped HTTP stream used to end the pump
+    silently, leaving consumers blocked on a dead Watch forever. Now it
+    warns and reconnects, resuming from the last-seen rv — later events
+    arrive, earlier ones do not replay."""
+    caplog.set_level(logging.WARNING, logger="machinery.client")
+    api = APIServer()
+    registry = prometheus.Registry()
+    _t, port, httpd = serve(api)
+    client = RemoteAPIServer(
+        f"http://127.0.0.1:{port}",
+        registry=registry,
+        retry_base=0.01,
+        retry_cap=0.05,
+    )
+    try:
+        api.create(_cm("a"))
+        w = client.watch("ConfigMap")
+        etype, obj = w.get(timeout=5)
+        assert (etype, obj["metadata"]["name"]) == ("ADDED", "a")
+        # sever the live stream out from under the pump (same socket
+        # surgery Watch.stop uses), simulating a dropped connection
+        sock = w._resp.fp.raw._sock  # noqa: SLF001
+        sock.shutdown(socket.SHUT_RDWR)
+        api.create(_cm("b"))
+        etype2, obj2 = w.get(timeout=5)
+        assert (etype2, obj2["metadata"]["name"]) == ("ADDED", "b")
+        assert not w.ended
+        assert client._m_watch_reestablished.value() >= 1
+        assert any(
+            "reconnect" in r.getMessage() or "re-established" in r.getMessage()
+            for r in caplog.records
+        )
+        w.stop()
+    finally:
+        httpd.shutdown()
+
+
+def test_client_watch_surfaces_expired_with_warning(caplog):
+    caplog.set_level(logging.WARNING, logger="machinery.client")
+    api = APIServer()
+    api.WATCH_CACHE_SIZE = 4
+    for i in range(10):
+        api.create(_cm(f"e{i}"))
+    _t, port, httpd = serve(api)
+    try:
+        client = RemoteAPIServer(f"http://127.0.0.1:{port}")
+        w = client.watch("ConfigMap", resource_version="1")
+        assert w.get(timeout=5) is None  # sentinel: stream is dead
+        assert w.ended and isinstance(w.error, Expired)
+        assert any("410" in r.getMessage() for r in caplog.records)
+    finally:
+        httpd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# informer cache: degraded-mode serving + relist healing
+
+
+def _cache_state(cache, kind):
+    with cache._lock:
+        return {
+            k: (o["metadata"]["name"], o["metadata"]["resourceVersion"])
+            for k, o in cache._kinds[kind].objects.items()
+        }
+
+
+def _store_state(api, kind):
+    return {
+        (
+            o["metadata"].get("namespace", ""),
+            o["metadata"]["name"],
+        ): (o["metadata"]["name"], o["metadata"]["resourceVersion"])
+        for o in api.list(kind)
+    }
+
+
+def test_cache_serves_last_known_good_while_degraded_then_heals():
+    api = APIServer()
+    registry = prometheus.Registry()
+    inj = _injector(api, registry=registry)
+    cache = InformerCache(inj, kinds=("ConfigMap",), registry=registry)
+    cache.reestablish_backoff = 0.0
+    cache.start(live=False)
+    api.create(_cm("a"))
+    cache.drain_once()
+    assert _cache_state(cache, "ConfigMap") == _store_state(api, "ConfigMap")
+
+    # partition: the watch stream drops and every API call errors
+    inj.set_offline(True)
+    api.create(_cm("b"))  # lands in the store behind the partition
+    cache.drain_once()  # sees the dead stream, fails to heal
+    assert cache.degraded("ConfigMap")
+    # reads still serve last-known-good state, zero exceptions
+    assert cache.get("ConfigMap", "a", "default")["data"]["v"] == "0"
+    with pytest.raises(NotFound):
+        cache.get("ConfigMap", "b", "default")
+
+    # heal: fresh watch + full relist brings in everything missed
+    inj.set_offline(False)
+    cache.drain_once()
+    assert not cache.degraded("ConfigMap")
+    assert cache.get("ConfigMap", "b", "default")
+    assert _cache_state(cache, "ConfigMap") == _store_state(api, "ConfigMap")
+    assert registry.metrics() and cache.m_relists.value() >= 1
+
+
+def test_cache_coherence_property_under_chaos():
+    """The PR 3 randomized cache-coherence property, re-run with a
+    seeded fault schedule on the whole API path — the randomized CRUD
+    and the informer both go through the injector, so writes fail
+    transiently, relists hit 429s/5xx, and live watch streams drop
+    mid-sequence. The mirror must converge to exactly the store state
+    once the weather clears, with recovery visible in the relist
+    counter and zero sanitizer reports."""
+    from odh_kubeflow_tpu.analysis import sanitizer
+
+    reports_before = len(sanitizer.reports())
+    rng = random.Random(SEED)
+    api = APIServer()
+    registry = prometheus.Registry()
+    inj = _injector(
+        api,
+        FaultSchedule(
+            conflict=0.03,
+            too_many_requests=0.05,
+            server_error=0.05,
+            watch_drop=0.05,
+        ),
+        registry=registry,
+    )
+    cache = InformerCache(inj, kinds=("ConfigMap",), registry=registry)
+    cache.reestablish_backoff = 0.0
+    cache.start(live=False)
+    live: set[str] = set()
+    for step in range(400):
+        op = rng.random()
+        name = f"cm-{rng.randrange(40)}"
+        ns = f"ns-{rng.randrange(3)}"
+        key = f"{ns}/{name}"
+        try:
+            if op < 0.45 or not live:
+                inj.create(_cm(name, ns=ns, v=str(step)))
+                live.add(key)
+            elif op < 0.75:
+                inj.patch("ConfigMap", name, {"data": {"v": str(step)}}, ns)
+            else:
+                inj.delete("ConfigMap", name, ns)
+                live.discard(key)
+        except (APIError, KeyError):
+            pass  # AlreadyExists/NotFound races AND injected faults
+        if rng.random() < 0.3:
+            cache.drain_once()
+    # the weather clears; the cache must converge to the store
+    inj.set_schedule(FaultSchedule.none())
+    for _ in range(6):
+        cache.drain_once()
+    assert _cache_state(cache, "ConfigMap") == _store_state(api, "ConfigMap")
+    assert not cache.degraded("ConfigMap")
+    inj_total = sum(
+        inj.m_faults.value({"kind": k})
+        for k in ("conflict", "too_many_requests", "server_error", "watch_drop")
+    )
+    assert inj_total > 0, "the schedule injected nothing — dead test"
+    assert cache.m_relists.value() >= 1, "no watch drop healed — dead test"
+    if sanitizer.enabled():
+        assert sanitizer.reports()[reports_before:] == []
+
+
+# ---------------------------------------------------------------------------
+# scheduler: admit/preempt property under chaos
+
+
+def test_scheduler_property_under_chaos_no_lost_workloads():
+    """The PR 2 randomized admit/preempt sequence with a seeded fault
+    schedule between the controllers and the store (the kubelet sim and
+    the assertions read the raw truth). Reconcile errors surface into
+    the runtime's backoff requeue; once faults stop, every surviving
+    notebook must have its Workload (none lost), gangs must be whole,
+    priority order must hold, and quota must not be oversubscribed."""
+    from odh_kubeflow_tpu.analysis import sanitizer
+    from odh_kubeflow_tpu.apis import (
+        TPU_ACCELERATOR_ANNOTATION,
+        TPU_TOPOLOGY_ANNOTATION,
+        register_crds,
+    )
+    from odh_kubeflow_tpu.controllers.notebook import (
+        NotebookController,
+        NotebookControllerConfig,
+    )
+    from odh_kubeflow_tpu.controllers.runtime import Manager
+    from odh_kubeflow_tpu.machinery import objects as obj_util
+    from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
+    from odh_kubeflow_tpu.scheduling import (
+        PRIORITY_CLASS_ANNOTATION,
+        WORKLOAD_LABEL,
+        register_scheduling,
+    )
+    from odh_kubeflow_tpu.scheduling.scheduler import SliceScheduler
+
+    reports_before = len(sanitizer.reports())
+    rng = random.Random(SEED)
+    api = APIServer()
+    register_crds(api)
+    register_scheduling(api)
+    cluster = FakeCluster(api)
+    registry = prometheus.Registry()
+    inj = _injector(
+        api,
+        FaultSchedule(
+            conflict=0.05,
+            too_many_requests=0.04,
+            server_error=0.03,
+            watch_drop=0.02,
+        ),
+        registry=registry,
+    )
+    # the platform shape: controllers read through the Manager-owned
+    # informer cache (which heals dropped streams), write through the
+    # faulty path
+    kinds = (
+        "Notebook",
+        "Workload",
+        "Pod",
+        "StatefulSet",
+        "Service",
+        "Node",
+        "ResourceQuota",
+        "Event",
+        "PriorityClass",
+    )
+    cache = InformerCache(inj, kinds=kinds, registry=registry)
+    cache.reestablish_backoff = 0.0
+    register_platform_indexers(cache)
+    client = CachedClient(inj, cache)
+    mgr = Manager(client, cache=cache)
+    NotebookController(
+        client, NotebookControllerConfig(enable_queueing=True), registry=registry
+    ).register(mgr)
+    SliceScheduler(client, registry=registry).register(mgr)
+    for pcname, value in (("tpu-interactive", 1000), ("tpu-batch", -100)):
+        api.create(
+            {
+                "apiVersion": "scheduling.k8s.io/v1",
+                "kind": "PriorityClass",
+                "metadata": {"name": pcname},
+                "value": value,
+                "globalDefault": False,
+            }
+        )
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "ResourceQuota",
+            "metadata": {"name": "kf-resource-quota", "namespace": "team-a"},
+            "spec": {"hard": {"requests.google.com/tpu": "16"}},
+        }
+    )
+    for pool in ("pa", "pb", "pc"):
+        cluster.add_tpu_node_pool(
+            pool, "tpu-v5p-slice", "2x2x2", num_hosts=2, chips_per_host=4
+        )
+
+    def notebook(name, pclass):
+        ann = {
+            TPU_ACCELERATOR_ANNOTATION: "tpu-v5p-slice",
+            TPU_TOPOLOGY_ANNOTATION: "2x2x2",
+        }
+        if pclass:
+            ann[PRIORITY_CLASS_ANNOTATION] = pclass
+        return {
+            "apiVersion": "kubeflow.org/v1beta1",
+            "kind": "Notebook",
+            "metadata": {"name": name, "namespace": "team-a", "annotations": ann},
+            "spec": {
+                "template": {
+                    "spec": {"containers": [{"name": name, "image": "jax"}]}
+                }
+            },
+        }
+
+    def quiesce(rounds=3):
+        for _ in range(rounds):
+            cluster.step()
+            try:
+                mgr.drain()
+            except RuntimeError:
+                # under active chaos a round may not quiesce; the
+                # converged end state is what the invariants gate
+                pass
+            time.sleep(0.02)  # lets backoff-delayed requeues come due
+
+    live: dict[str, None] = {}
+    counter = 0
+    classes = [None, "tpu-batch", "tpu-interactive"]
+    for _ in range(25):
+        op = rng.choice(["create", "create", "create", "delete"])
+        if op == "create" and len(live) < 5:
+            counter += 1
+            name = f"nb{counter}"
+            api.create(notebook(name, rng.choice(classes)))
+            live[name] = None
+        elif op == "delete" and live:
+            name = rng.choice(sorted(live))
+            del live[name]
+            api.delete("Notebook", name, "team-a")
+        quiesce(rounds=2)
+
+    # weather clears → everything must converge
+    inj.set_schedule(FaultSchedule.none())
+    for _ in range(8):
+        quiesce(rounds=2)
+
+    workloads = api.list("Workload")
+    by_name = {obj_util.name_of(w): w for w in workloads}
+    # no lost workloads: every surviving notebook kept (or regained) its
+    # Workload; no orphan Workload survived its notebook
+    assert set(by_name) == set(live), (
+        f"workloads {sorted(by_name)} != live notebooks {sorted(live)}"
+    )
+    admitted_chips = 0
+    for name, wl in by_name.items():
+        hosts = wl["spec"]["hosts"]
+        bound = [
+            p
+            for p in api.list(
+                "Pod",
+                namespace="team-a",
+                label_selector={"matchLabels": {WORKLOAD_LABEL: name}},
+            )
+            if obj_util.get_path(p, "spec", "nodeName")
+            and obj_util.get_path(p, "status", "phase")
+            not in ("Succeeded", "Failed")
+        ]
+        state = wl.get("status", {}).get("state", "")
+        if state == "Admitted":
+            admitted_chips += wl["spec"]["chips"]
+            assert len(bound) in (0, hosts), (
+                f"partial gang on {name}: {len(bound)}/{hosts}"
+            )
+        else:
+            assert len(bound) == 0, f"pending {name} has bound pods"
+    assert admitted_chips <= 16, "quota oversubscribed"
+    pending = [
+        w for w in workloads if w.get("status", {}).get("state") != "Admitted"
+    ]
+    admitted = [
+        w for w in workloads if w.get("status", {}).get("state") == "Admitted"
+    ]
+    for p in pending:
+        for a in admitted:
+            assert a["spec"]["priority"] >= p["spec"]["priority"], (
+                "priority inversion after recovery"
+            )
+    assert inj.m_faults.value({"kind": "conflict"}) > 0
+    if sanitizer.enabled():
+        assert sanitizer.reports()[reports_before:] == []
+
+
+# ---------------------------------------------------------------------------
+# web apps: degraded listings, never 500
+
+
+def test_serve_listing_last_known_good_without_cache():
+    from odh_kubeflow_tpu.apis import register_crds
+    from odh_kubeflow_tpu.web.jwa import JupyterWebApp
+
+    api = APIServer()
+    register_crds(api)
+    inj = _injector(api)
+    jwa = JupyterWebApp(inj)
+    api.create(
+        {
+            "apiVersion": "kubeflow.org/v1beta1",
+            "kind": "Notebook",
+            "metadata": {"name": "nb", "namespace": "team-a"},
+            "spec": {"template": {"spec": {"containers": [{"name": "nb"}]}}},
+        }
+    )
+    build = lambda: [  # noqa: E731
+        jwa.notebook_row(nb)
+        for nb in jwa.api.list("Notebook", namespace="team-a")
+    ]
+    rows, degraded = jwa.serve_listing(("notebooks", "team-a"), build)
+    assert [r["name"] for r in rows] == ["nb"] and not degraded
+
+    inj.set_offline(True)
+    rows2, degraded2 = jwa.serve_listing(("notebooks", "team-a"), build)
+    assert rows2 == rows and degraded2
+    # a listing that never succeeded answers empty + degraded, not 500
+    rows3, degraded3 = jwa.serve_listing(
+        ("pvcs", "team-a"),
+        lambda: jwa.api.list("PersistentVolumeClaim", namespace="team-a"),
+    )
+    assert rows3 == [] and degraded3
+    # …while REAL client errors still surface
+    inj.set_offline(False)
+    with pytest.raises(NotFound):
+        jwa.serve_listing(
+            ("bad", "team-a"),
+            lambda: jwa.api.list("NoSuchKind", namespace="team-a"),
+        )
+
+
+@pytest.fixture
+def degraded_web_env(monkeypatch):
+    """JWA/VWA/TWA over CachedClient(FaultInjector(store)) behind real
+    HTTP, with RBAC served from the cache so authz survives outages."""
+    from odh_kubeflow_tpu.apis import register_crds
+    from odh_kubeflow_tpu.scheduling import register_scheduling
+    from odh_kubeflow_tpu.web import crud_backend
+    from odh_kubeflow_tpu.web.jwa import JupyterWebApp
+    from odh_kubeflow_tpu.web.twa import TensorboardsWebApp
+    from odh_kubeflow_tpu.web.vwa import VolumesWebApp
+
+    monkeypatch.setattr(crud_backend, "DEV_MODE", True)
+    api = APIServer()
+    register_crds(api)
+    register_scheduling(api)
+    registry = prometheus.Registry()
+    inj = _injector(api, registry=registry)
+    cache = InformerCache(
+        inj,
+        kinds=(
+            "Notebook",
+            "Tensorboard",
+            "PersistentVolumeClaim",
+            "Pod",
+            "StatefulSet",
+            "Workload",
+            "Event",
+            "Node",
+            "ResourceQuota",
+        ),
+        registry=registry,
+    )
+    register_platform_indexers(cache)
+    cache.reestablish_backoff = 0.0
+    cache.start(live=False)
+    client = CachedClient(inj, cache)
+    servers = []
+
+    def up(app_obj):
+        httpd = app_obj.app.serve("127.0.0.1", 0)
+        servers.append(httpd)
+        return f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    env = {
+        "api": api,
+        "inj": inj,
+        "cache": cache,
+        "jwa": up(JupyterWebApp(client)),
+        "vwa": up(VolumesWebApp(client)),
+        "twa": up(TensorboardsWebApp(client)),
+    }
+    yield env
+    for httpd in servers:
+        httpd.shutdown()
+
+
+def _get_json(base, path):
+    req = urllib.request.Request(
+        base + path, headers={"kubeflow-userid": "alice@example.com"}
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def test_web_listings_degrade_instead_of_500(degraded_web_env):
+    env = degraded_web_env
+    api, inj, cache = env["api"], env["inj"], env["cache"]
+    api.create(
+        {
+            "apiVersion": "kubeflow.org/v1beta1",
+            "kind": "Notebook",
+            "metadata": {"name": "nb1", "namespace": "team-a"},
+            "spec": {"template": {"spec": {"containers": [{"name": "nb1"}]}}},
+        }
+    )
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": {"name": "vol1", "namespace": "team-a"},
+            "spec": {"resources": {"requests": {"storage": "1Gi"}}},
+        }
+    )
+    api.create(
+        {
+            "apiVersion": "tensorboard.kubeflow.org/v1alpha1",
+            "kind": "Tensorboard",
+            "metadata": {"name": "tb1", "namespace": "team-a"},
+            "spec": {"logspath": "pvc://vol1/logs"},
+        }
+    )
+    paths = {
+        "jwa": "/api/namespaces/team-a/notebooks",
+        "vwa": "/api/namespaces/team-a/pvcs",
+        "twa": "/api/namespaces/team-a/tensorboards",
+    }
+    fields = {"jwa": "notebooks", "vwa": "pvcs", "twa": "tensorboards"}
+    healthy = {}
+    for app, path in paths.items():
+        status, body = _get_json(env[app], path)
+        assert status == 200 and not body.get("degraded")
+        healthy[app] = body[fields[app]]
+        assert len(healthy[app]) == 1
+
+    # partition the backend: listings must keep answering 200 with the
+    # last-known-good rows and a degraded marker — never a 500
+    inj.set_offline(True)
+    api.create(  # lands behind the partition; visible after healing
+        {
+            "apiVersion": "kubeflow.org/v1beta1",
+            "kind": "Notebook",
+            "metadata": {"name": "nb2", "namespace": "team-a"},
+            "spec": {"template": {"spec": {"containers": [{"name": "nb2"}]}}},
+        }
+    )
+    for app, path in paths.items():
+        status, body = _get_json(env[app], path)
+        assert status == 200, f"{app} failed during outage"
+        assert body.get("degraded") is True
+        assert [r["name"] for r in body[fields[app]]] == [
+            r["name"] for r in healthy[app]
+        ]
+
+    # heal: the informer relists, the marker clears, nb2 appears
+    inj.set_offline(False)
+    status, body = _get_json(env["jwa"], paths["jwa"])
+    assert status == 200 and not body.get("degraded")
+    assert sorted(r["name"] for r in body["notebooks"]) == ["nb1", "nb2"]
+    assert cache.m_relists.value() >= 1

@@ -82,6 +82,83 @@ def test_renew_loop_detects_loss():
     a._stop.set()
 
 
+def test_leader_failover_under_injected_faults():
+    """Chaos failover: the holder loses API connectivity mid-renew
+    (FaultInjector outage), so it stops renewing and must stand down —
+    while the standby, whose path is healthy, takes the lease over once
+    it expires. On rejoin the old holder observes the foreign holder
+    and cannot steal the live lease back."""
+    from odh_kubeflow_tpu.machinery.faults import FaultInjector, FaultSchedule
+    from odh_kubeflow_tpu.utils import prometheus
+
+    api = APIServer()
+    inj = FaultInjector(
+        api,
+        seed=5,
+        schedule=FaultSchedule.none(),
+        registry=prometheus.Registry(),
+        sleep_fn=lambda s: None,
+    )
+    # ≥ 1s: the Lease spec carries whole leaseDurationSeconds (kube's
+    # MicroTime granularity is for renew stamps, not the duration)
+    lease_duration = 1.0
+    holder = LeaderElector(
+        inj,
+        "notebook-controller-leader",
+        namespace="default",
+        identity="holder",
+        lease_duration=lease_duration,
+        renew_period=0.05,
+        retry_period=0.02,
+    )
+    standby = LeaderElector(
+        api,
+        "notebook-controller-leader",
+        namespace="default",
+        identity="standby",
+        lease_duration=lease_duration,
+        renew_period=0.05,
+        retry_period=0.02,
+    )
+    assert holder.try_acquire()
+    lost = []
+    holder.run(on_lost=lambda: lost.append(time.monotonic()))
+
+    # the holder's API path partitions mid-renew
+    t0 = time.monotonic()
+    inj.set_offline(True)
+    # the standby takes over once the un-renewed lease expires — within
+    # lease_duration (plus polling slack), not unboundedly later
+    deadline = t0 + 10 * lease_duration
+    took_over = False
+    while time.monotonic() < deadline:
+        if standby.try_acquire():
+            took_over = True
+            break
+        time.sleep(0.02)
+    took = time.monotonic() - t0
+    assert took_over, "standby never acquired the expired lease"
+    assert took >= lease_duration * 0.5, "standby stole a live lease"
+    assert took < 4 * lease_duration, "takeover exceeded the lease window"
+    lease = api.get("Lease", "notebook-controller-leader", "default")
+    assert lease["spec"]["holderIdentity"] == "standby"
+
+    # the old holder stands down: its renew loop fires on_lost (blown
+    # renew deadline during the outage, or the foreign holder on
+    # rejoin) — either way it must exit instead of reconciling on
+    inj.set_offline(False)
+    stop_at = time.monotonic() + 5
+    while not lost and time.monotonic() < stop_at:
+        time.sleep(0.02)
+    assert lost, "old holder kept running without the lease"
+    # and it cannot steal the standby's LIVE lease back (re-stamp the
+    # standby's renewTime first — its renew loop isn't running in this
+    # test, and an expired lease would legitimately be stealable)
+    assert standby.try_acquire() is True
+    assert holder.try_acquire() is False
+    holder._stop.set()
+
+
 def test_client_qps_throttle_paces_requests():
     """Token bucket: burst passes instantly, then ~qps/s."""
     from odh_kubeflow_tpu.machinery.client import RemoteAPIServer
