@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint sanitize chaos loadtest images bench dryrun platform serve spawn-latency native kind-smoke conformance
+.PHONY: all test test-unit test-manifests lint sanitize chaos loadtest images bench dryrun platform serve spawn-latency suspend-bench native kind-smoke conformance
 
 all: lint test
 
@@ -39,7 +39,8 @@ lint:
 # concurrency sanitizer armed so recovery paths are race-probed too
 chaos:
 	GRAFT_CHAOS=1 GRAFT_SANITIZE=1 $(PYTHON) -m pytest -q \
-	  tests/test_chaos.py tests/test_leader.py
+	  tests/test_chaos.py tests/test_leader.py \
+	  tests/test_sessions.py::test_property_random_suspend_resume_under_chaos
 
 # the randomized property suites re-run as race probes: sanitized
 # locks record acquisition order, re-entry, and blocking-under-lock
@@ -47,7 +48,8 @@ sanitize:
 	GRAFT_SANITIZE=1 $(PYTHON) -m pytest -q \
 	  tests/test_analysis.py \
 	  tests/test_cache.py::test_cache_coherence_property_randomized_crud \
-	  tests/test_scheduling.py::test_property_random_admit_preempt_node_loss_sequences
+	  tests/test_scheduling.py::test_property_random_admit_preempt_node_loss_sequences \
+	  tests/test_sessions.py::test_property_random_suspend_resume_oversubscribed
 
 # platform load test against the embedded apiserver + sim kubelet
 # (loadtest/start_notebooks.py; reference notebook-controller/loadtest)
@@ -56,6 +58,12 @@ loadtest:
 
 spawn-latency:
 	$(PYTHON) -m loadtest.spawn_latency --record
+
+# suspend → reopen → ready warm-resume gate (sessions/ subsystem): the
+# cold platform spawn vs the checkpoint-backed resume, state verified
+# bit-identical; runs on the sim kubelet, no accelerator needed
+suspend-bench:
+	$(PYTHON) -m loadtest.spawn_latency --suspend-only
 
 # C++ host-side components (input-pipeline packer); lazy-built on first
 # import too — this target just front-loads the compile

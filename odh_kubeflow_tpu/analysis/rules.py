@@ -101,7 +101,7 @@ class UncachedListRule(Rule):
     description = (
         "bare cluster-wide list() of an indexable kind on a hot path"
     )
-    dirs = ("controllers", "web", "scheduling", "webhooks")
+    dirs = ("controllers", "web", "scheduling", "webhooks", "sessions")
 
     _SELECTIVE_KWARGS = ("namespace", "label_selector", "field_matches")
 
@@ -156,8 +156,10 @@ class SwallowedExceptionRule(Rule):
     ``continue``, ``return <constant>``) are flagged."""
 
     id = "swallowed-exception"
+    # (sessions/ included: a swallowed snapshot failure silently loses
+    # a user's kernel)
     description = "broad except handler that silently discards the error"
-    dirs = ("controllers", "webhooks", "scheduling", "machinery")
+    dirs = ("controllers", "webhooks", "scheduling", "machinery", "sessions")
 
     _BROAD = ("Exception", "BaseException")
 
@@ -228,6 +230,10 @@ class BlockingUnderLockRule(Rule):
         "controllers/runtime.py",
         "scheduling/scheduler.py",
         "scheduling/queue.py",
+        # checkpoint IO (snapshot HTTP hooks, orbax writes) must never
+        # run under store/cache locks — suspend would stall every reader
+        "sessions/manager.py",
+        "sessions/checkpoint.py",
     )
 
     _LOCKISH = ("lock", "mutex", "_cv", "cond")
@@ -586,7 +592,7 @@ class FrozenMutationRule(Rule):
     description = (
         "in-place mutation of a cache-sourced object without mutable()"
     )
-    dirs = ("controllers", "web", "scheduling")
+    dirs = ("controllers", "web", "scheduling", "sessions")
 
     def check(self, src: SourceFile) -> Iterator[Finding]:
         for node in ast.walk(src.tree):

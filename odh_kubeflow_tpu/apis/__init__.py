@@ -31,6 +31,16 @@ LAST_ACTIVITY_CHECK_ANNOTATION = (
     "notebooks.kubeflow.org/last_activity_check_timestamp"
 )
 
+# suspend-to-checkpoint contract (sessions/ subsystem, NotebookOS-style,
+# arXiv 2503.20591): SUSPENDED_AT alongside STOP_ANNOTATION means
+# "suspended, resumable" — the session manager checkpoints kernel state
+# before the slice is released, and JWA offers resume instead of a cold
+# start. RESUME_REQUESTED stamps when the user reopened, feeding the
+# warm-resume latency histogram.
+SUSPENDED_AT_ANNOTATION = "notebooks.kubeflow.org/suspended-at"
+SUSPEND_REASON_ANNOTATION = "notebooks.kubeflow.org/suspend-reason"
+RESUME_REQUESTED_ANNOTATION = "notebooks.kubeflow.org/resume-requested-at"
+
 # TPU scheduling contract (replaces the reference's nvidia.com/gpu path,
 # BASELINE.json north star)
 TPU_RESOURCE = "google.com/tpu"
@@ -38,6 +48,20 @@ TPU_ACCELERATOR_ANNOTATION = "notebooks.kubeflow.org/tpu-accelerator"
 TPU_TOPOLOGY_ANNOTATION = "notebooks.kubeflow.org/tpu-topology"
 TPU_ACCEL_NODE_LABEL = "cloud.google.com/gke-tpu-accelerator"
 TPU_TOPO_NODE_LABEL = "cloud.google.com/gke-tpu-topology"
+
+
+def notebook_agent_url(
+    notebook, cluster_domain: str = "cluster.local", port: int = 8890
+) -> str:
+    """Base URL of the in-pod agent sidecar family behind the notebook
+    Service (tpu-activity probe, session snapshot/restore hooks) — ONE
+    addressing convention, shared by the culler and the session
+    manager so the two can't drift."""
+    from odh_kubeflow_tpu.machinery import objects as obj_util
+
+    name = obj_util.name_of(notebook)
+    ns = obj_util.namespace_of(notebook)
+    return f"http://{name}.{ns}.svc.{cluster_domain}:{port}"
 
 
 def pod_spec_tpu_chips(pod_spec) -> float:
@@ -86,8 +110,19 @@ def install_default_cluster_roles(api: APIServer) -> None:
     """The kubeflow-admin/edit/view ClusterRoles every profile
     RoleBinding references (the reference ships these via manifests;
     kfam maps its role names onto them, bindings.go:39-46). Idempotent."""
-    kf_groups = ["kubeflow.org", "tensorboard.kubeflow.org"]
-    kf_resources = ["notebooks", "poddefaults", "tensorboards", "profiles"]
+    kf_groups = [
+        "kubeflow.org",
+        "tensorboard.kubeflow.org",
+        # sessions/: users see their own suspend/resume checkpoints
+        "sessions.kubeflow.org",
+    ]
+    kf_resources = [
+        "notebooks",
+        "poddefaults",
+        "tensorboards",
+        "profiles",
+        "sessioncheckpoints",
+    ]
     core_resources = [
         "persistentvolumeclaims",
         "pods",

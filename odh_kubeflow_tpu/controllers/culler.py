@@ -31,6 +31,8 @@ from odh_kubeflow_tpu.apis import (
     LAST_ACTIVITY_ANNOTATION,
     LAST_ACTIVITY_CHECK_ANNOTATION,
     STOP_ANNOTATION,
+    SUSPEND_REASON_ANNOTATION,
+    SUSPENDED_AT_ANNOTATION,
     TPU_ACCELERATOR_ANNOTATION,
 )
 from odh_kubeflow_tpu.controllers.runtime import Result
@@ -63,6 +65,11 @@ class CullerConfig:
     # port the in-image tpu-activity-agent listens on (exposed by the
     # notebook Service for TPU notebooks; images/*/tpu-activity-agent)
     tpu_agent_port: int = 8890
+    # suspend-to-checkpoint instead of a plain stop: the cull stamps
+    # ``suspended-at`` alongside ``kubeflow-resource-stopped`` so the
+    # session manager snapshots kernel state before the slice is
+    # released, and JWA shows "suspended, resumable" — not "stopped"
+    suspend_on_cull: bool = False
 
 
 class Culler:
@@ -102,11 +109,15 @@ class Culler:
         )
 
     def _default_tpu_url(self, notebook: Obj) -> str:
-        name = obj_util.name_of(notebook)
-        ns = obj_util.namespace_of(notebook)
+        from odh_kubeflow_tpu.apis import notebook_agent_url
+
         return (
-            f"http://{name}.{ns}.svc.{self.config.cluster_domain}:"
-            f"{self.config.tpu_agent_port}/api/tpu/activity"
+            notebook_agent_url(
+                notebook,
+                self.config.cluster_domain,
+                self.config.tpu_agent_port,
+            )
+            + "/api/tpu/activity"
         )
 
     # -- probes -------------------------------------------------------------
@@ -219,11 +230,28 @@ class Culler:
                 self.m_last_cull.set(now)
             # a re-cull of the same notebook (restarted, idled again)
             # bumps the Event count instead of stacking duplicates
-            self.recorder.normal(
-                notebook,
-                "Culled",
-                "Notebook idle beyond threshold; scaling to zero",
-            )
+            if self.config.suspend_on_cull:
+                # suspended, not stopped: a DISTINCT event + the
+                # suspended-at stamp let JWA (and users) tell
+                # "resumable with warm state" apart from a plain stop
+                obj_util.set_annotation(
+                    notebook, SUSPENDED_AT_ANNOTATION, _fmt_time(now)
+                )
+                obj_util.set_annotation(
+                    notebook, SUSPEND_REASON_ANNOTATION, "cull"
+                )
+                self.recorder.normal(
+                    notebook,
+                    "Suspended",
+                    "Notebook idle beyond threshold; suspending session "
+                    "to checkpoint and releasing the slice",
+                )
+            else:
+                self.recorder.normal(
+                    notebook,
+                    "Culled",
+                    "Notebook idle beyond threshold; scaling to zero",
+                )
         self._patch_annotations(notebook)
         return Result(requeue_after=period)
 

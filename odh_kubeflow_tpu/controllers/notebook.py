@@ -78,6 +78,13 @@ class NotebookControllerConfig:
     # gang admission through the TPU slice scheduler: TPU notebooks get
     # a Workload + admission-gated pods instead of racing the quota
     enable_queueing: bool = False
+    # suspend-to-checkpoint sessions (sessions/ subsystem): culls become
+    # suspends, the scale-down waits for the kernel snapshot, and
+    # suspended notebooks resume warm instead of starting cold
+    enable_sessions: bool = False
+    # wedge-breaker: a suspend whose snapshot never lands within this
+    # window degrades to a plain stop (losing state beats leaking chips)
+    suspend_grace_seconds: float = 600.0
 
     @staticmethod
     def from_env() -> "NotebookControllerConfig":
@@ -97,6 +104,10 @@ class NotebookControllerConfig:
             idleness_check_seconds=float(env.get("IDLENESS_CHECK_PERIOD", "1"))
             * 60.0,
             enable_queueing=flag("ENABLE_TPU_QUEUEING", "true"),
+            enable_sessions=flag("ENABLE_SESSION_SUSPEND", "true"),
+            suspend_grace_seconds=float(
+                env.get("SESSION_SUSPEND_GRACE_SECONDS", "600")
+            ),
         )
 
 
@@ -198,8 +209,19 @@ class NotebookController:
         ctrl.owns("StatefulSet").owns("Service")
         ctrl.watches("Pod", self._map_pod, predicate=self._pod_predicate)
         ctrl.watches("Event", self._map_event)
+        if self.config.enable_sessions:
+            # the checkpoint turning durable is what releases the held
+            # scale-down — the suspend completes on this watch
+            ctrl.watches("SessionCheckpoint", self._map_checkpoint)
         if self.config.use_istio:
             ctrl.owns("VirtualService")
+
+    @staticmethod
+    def _map_checkpoint(_etype: str, ckpt: Obj) -> list[Request]:
+        name = obj_util.get_path(
+            ckpt, "spec", "notebook", default=obj_util.name_of(ckpt)
+        )
+        return [Request(obj_util.namespace_of(ckpt), name)] if name else []
 
     def _pod_predicate(self, _etype: str, pod: Obj) -> bool:
         return "notebook-name" in obj_util.labels_of(pod)
@@ -316,7 +338,20 @@ class NotebookController:
             self._set_condition(notebook, "TPURequestInvalid", str(e))
             return Result()
 
-        sts = self.generate_statefulset(notebook, tpu)
+        # suspend hold: a requested suspend keeps the pods (and the
+        # Workload reservation) alive until the kernel snapshot is
+        # durable — only then does the scale-down release the slice
+        suspend_hold = False
+        if self.config.enable_sessions:
+            from odh_kubeflow_tpu import sessions
+
+            suspend_hold = sessions.suspend_pending(
+                self.api,
+                notebook,
+                grace_seconds=self.config.suspend_grace_seconds,
+            )
+
+        sts = self.generate_statefulset(notebook, tpu, suspend_hold=suspend_hold)
         try:
             _, created = reconcilehelper.reconcile_object(
                 self.api, sts, owner=notebook
@@ -476,7 +511,12 @@ class NotebookController:
     def _notebook_prefix(self, notebook: Obj) -> str:
         return f"/notebook/{obj_util.namespace_of(notebook)}/{obj_util.name_of(notebook)}"
 
-    def generate_statefulset(self, notebook: Obj, tpu: Optional[TpuRequest]) -> Obj:
+    def generate_statefulset(
+        self,
+        notebook: Obj,
+        tpu: Optional[TpuRequest],
+        suspend_hold: bool = False,
+    ) -> Obj:
         name = obj_util.name_of(notebook)
         ns = obj_util.namespace_of(notebook)
         template = obj_util.deepcopy(
@@ -509,7 +549,10 @@ class NotebookController:
                 "fsGroup", DEFAULT_FSGROUP
             )
 
-        stopped = STOP_ANNOTATION in obj_util.annotations_of(notebook)
+        stopped = (
+            STOP_ANNOTATION in obj_util.annotations_of(notebook)
+            and not suspend_hold
+        )
         replicas = 0 if stopped else 1
 
         if tpu is not None:
@@ -702,6 +745,11 @@ class NotebookController:
             "conditions": [],
             "containerState": {},
         }
+        # the session manager's suspend/resume phase is its field, not
+        # this mirror's — preserve it across the rebuild
+        phase = obj_util.get_path(notebook, "status", "phase", default="")
+        if phase:
+            status["phase"] = phase
         # controller-owned conditions survive the pod-mirror rebuild
         for cond in (
             obj_util.get_path(notebook, "status", "conditions", default=[]) or []
@@ -788,10 +836,13 @@ def main() -> None:
     def register(api, mgr):
         from odh_kubeflow_tpu.controllers.culler import Culler, CullerConfig
         from odh_kubeflow_tpu.scheduling import register_scheduling
+        from odh_kubeflow_tpu.sessions import register_sessions
 
         cfg = NotebookControllerConfig.from_env()
         if cfg.enable_queueing:
             register_scheduling(api)  # the remote client needs the kind
+        if cfg.enable_sessions:
+            register_sessions(api)
         culler = None
         if cfg.enable_culling:
             culler = Culler(
@@ -800,6 +851,7 @@ def main() -> None:
                     cull_idle_seconds=cfg.cull_idle_seconds,
                     idleness_check_seconds=cfg.idleness_check_seconds,
                     cluster_domain=cfg.cluster_domain,
+                    suspend_on_cull=cfg.enable_sessions,
                 ),
             )
         # the controller's own counters must live on the registry the
@@ -807,6 +859,15 @@ def main() -> None:
         NotebookController(
             api, cfg, registry=mgr.metrics_registry, culler=culler
         ).register(mgr)
+        if cfg.enable_sessions:
+            from odh_kubeflow_tpu.sessions.manager import (
+                SessionConfig,
+                SessionManager,
+            )
+
+            SessionManager(
+                api, SessionConfig.from_env(), registry=mgr.metrics_registry
+            ).register(mgr)
 
     run_controller("notebook-controller", register)
 

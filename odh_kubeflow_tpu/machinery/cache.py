@@ -81,12 +81,24 @@ DEFAULT_CACHED_KINDS: tuple[str, ...] = (
     "PriorityClass",
     "Notebook",
     "Workload",
+    "SessionCheckpoint",
     "Profile",
     "Tensorboard",
     "PodDefault",
 )
 
 _TOMBSTONE_LIMIT = 4096
+
+
+def _kind_registered(api: Any, kind: str) -> bool:
+    type_info = getattr(api, "type_info", None)
+    if type_info is None:
+        return True  # duck api without a registry — let the watch decide
+    try:
+        type_info(kind)
+    except NotFound:
+        return False
+    return True
 
 
 def _owner_uids(obj: Obj) -> list[str]:
@@ -144,6 +156,12 @@ class InformerCache:
     ):
         self.api = api
         self.now = time_fn
+        if kinds is DEFAULT_CACHED_KINDS:
+            # the implicit platform set adapts to what's registered
+            # (optional subsystems like sessions/ may be absent); an
+            # EXPLICIT kind list stays strict — a typo there is a
+            # configuration error the failing watch should surface
+            kinds = [k for k in kinds if _kind_registered(api, k)]
         self._lock = _sanitizer.new_rlock("informer.cache")
         self._kinds: dict[str, _KindCache] = {k: _KindCache() for k in kinds}
         # per-kind heal mutex: stream-loss recovery can be triggered by

@@ -43,13 +43,76 @@ def _is_gated_unbound(pod: Obj) -> bool:
     )
 
 
+class SimSessionRuntime:
+    """The kubelet sim's checkpoint/restore container hooks (the
+    sessions/ subsystem's runtime interface). "Container memory" —
+    kernel state — is keyed by pod UID and lives exactly as long as the
+    pod does: a deleted or Failed pod loses its unsnapshotted state,
+    which is precisely why checkpoint-then-preempt beats a hard kill.
+
+    Tests (and the sim's notebook "kernels") write state with
+    ``write_state``; the SessionManager's suspend path calls
+    ``snapshot`` while the pod is still Running, and its resume path
+    calls ``restore`` into the fresh pod."""
+
+    def __init__(self) -> None:
+        self._memory: dict[str, Obj] = {}  # pod uid → kernel state
+
+    @staticmethod
+    def _uid(pod: Obj) -> str:
+        return obj_util.meta(pod).get("uid", "")
+
+    def write_state(self, pod: Obj, state: Obj) -> None:
+        self._memory[self._uid(pod)] = obj_util.deepcopy(state)
+
+    def read_state(self, pod: Obj) -> Optional[Obj]:
+        state = self._memory.get(self._uid(pod))
+        return obj_util.deepcopy(state) if state is not None else None
+
+    # -- the hooks the SessionManager drives --------------------------------
+
+    def snapshot(self, notebook: Obj, pod: Obj) -> Optional[Obj]:
+        # a live container that never wrote memory has a valid, EMPTY
+        # kernel state — None is reserved for "hook unreachable" (the
+        # manager retries that inside the suspend grace window)
+        return obj_util.deepcopy(self._memory.get(self._uid(pod), {}))
+
+    def restore(self, notebook: Obj, pod: Obj, state: Obj) -> bool:
+        self._memory[self._uid(pod)] = obj_util.deepcopy(state or {})
+        return True
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def drop(self, pod: Obj) -> None:
+        self._memory.pop(self._uid(pod), None)
+
+    def prune(self, live_uids: set[str]) -> None:
+        for uid in list(self._memory):
+            if uid not in live_uids:
+                del self._memory[uid]
+
+
 class FakeCluster:
     def __init__(self, api: APIServer):
         self.api = api
         self._ip_counter = itertools.count(2)
+        # checkpoint/restore container hooks (sessions/ subsystem)
+        self.session_runtime = SimSessionRuntime()
         # per-step() scheduler ledger: used-TPU-by-node, built once per
         # pass and updated as pods bind (None outside a step)
         self._sched_used: Optional[dict[str, float]] = None
+
+    # -- session-state helpers (tests drive these as "the kernel") ----------
+
+    def set_session_state(self, namespace: str, notebook: str, state: Obj) -> None:
+        """Write kernel state into notebook's pod-0 container memory —
+        what a user's running kernel does between our observations."""
+        pod = self.api.get("Pod", f"{notebook}-0", namespace)
+        self.session_runtime.write_state(pod, state)
+
+    def get_session_state(self, namespace: str, notebook: str) -> Optional[Obj]:
+        pod = self.api.get("Pod", f"{notebook}-0", namespace)
+        return self.session_runtime.read_state(pod)
 
     # -- nodes --------------------------------------------------------------
 
@@ -116,6 +179,9 @@ class FakeCluster:
                 continue
             if obj_util.get_path(pod, "status", "phase") in ("Succeeded", "Failed"):
                 continue
+            # container memory dies with the host — unsnapshotted
+            # kernel state on a preempted node is gone
+            self.session_runtime.drop(pod)
             pod.setdefault("status", {})
             pod["status"]["phase"] = "Failed"
             pod["status"]["reason"] = "Preempted"
@@ -618,3 +684,11 @@ class FakeCluster:
         finally:
             self._sched_used = None
         self._mirror_quota_status()
+        # container memory lives and dies with its pod: GC kernel state
+        # for pods that no longer exist (scale-down, eviction, delete)
+        self.session_runtime.prune(
+            {
+                obj_util.meta(p).get("uid", "")
+                for p in self.api.list("Pod")
+            }
+        )

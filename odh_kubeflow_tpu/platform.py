@@ -48,6 +48,8 @@ from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
 from odh_kubeflow_tpu.machinery.store import APIServer
 from odh_kubeflow_tpu.scheduling import register_scheduling
 from odh_kubeflow_tpu.scheduling.scheduler import SliceScheduler
+from odh_kubeflow_tpu.sessions import register_sessions
+from odh_kubeflow_tpu.sessions.manager import SessionConfig, SessionManager
 from odh_kubeflow_tpu.utils import prometheus
 from odh_kubeflow_tpu.web.dashboard import DashboardApp
 from odh_kubeflow_tpu.web.jwa import JupyterWebApp
@@ -102,6 +104,7 @@ class Platform:
         self.api = APIServer()
         register_crds(self.api)
         register_scheduling(self.api)
+        register_sessions(self.api)
         install_default_cluster_roles(self.api)
         PodDefaultWebhook(self.api).register()
         NotebookWebhook(self.api).register()
@@ -125,11 +128,17 @@ class Platform:
             cull_idle_seconds=self.nb_config.cull_idle_seconds,
             idleness_check_seconds=self.nb_config.idleness_check_seconds,
             cluster_domain=self.nb_config.cluster_domain,
+            # with sessions on, culls suspend-to-checkpoint instead of
+            # stopping cold — the idle slice frees, the kernel survives
+            suspend_on_cull=self.nb_config.enable_sessions,
         )
         self.culler = Culler(self.cached_api, culler_cfg)
         self.manager = Manager(
             self.api, registry=self.metrics_registry, cache=self.cache
         )
+        # the sim cluster is built before the controllers so its
+        # checkpoint/restore container hooks can back the SessionManager
+        self.cluster = FakeCluster(self.api) if sim else None
         self.notebook_controller = NotebookController(
             self.cached_api,
             self.nb_config,
@@ -137,11 +146,31 @@ class Platform:
             culler=self.culler if self.nb_config.enable_culling else None,
         )
         self.notebook_controller.register(self.manager)
+        # suspend-to-checkpoint sessions (sessions/): snapshots kernels
+        # on cull/preempt, restores on resume, and gives the scheduler
+        # its checkpoint-then-preempt hooks
+        self.session_manager = None
+        if self.nb_config.enable_sessions:
+            self.session_manager = SessionManager(
+                self.cached_api,
+                SessionConfig.from_env(),
+                registry=self.metrics_registry,
+                runtime=(
+                    self.cluster.session_runtime
+                    if self.cluster is not None
+                    else None
+                ),
+            )
+            self.session_manager.register(self.manager)
         # gang admission for TPU slices (scheduling/): the notebook
         # controller only creates Workloads when queueing is on, and
         # without a scheduler they would pend forever
         self.scheduler = (
-            SliceScheduler(self.cached_api, registry=self.metrics_registry)
+            SliceScheduler(
+                self.cached_api,
+                registry=self.metrics_registry,
+                suspender=self.session_manager,
+            )
             if self.nb_config.enable_queueing
             else None
         )
@@ -164,7 +193,6 @@ class Platform:
         self.web.mount("/tensorboards", self.twa.app)
         self.web.mount("/kfam", self.kfam.app, strip=False)
 
-        self.cluster = FakeCluster(self.api) if sim else None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._api_httpd = None

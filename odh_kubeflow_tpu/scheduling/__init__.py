@@ -45,6 +45,16 @@ WORKLOAD_LABEL = f"{GROUP}/workload"
 # Notebook annotation selecting a PriorityClass (scheduling.k8s.io/v1)
 PRIORITY_CLASS_ANNOTATION = "notebooks.kubeflow.org/priority-class"
 
+# ResourceQuota annotation enabling chip oversubscription for a quota
+# pool (sessions/ subsystem, NotebookOS-style): committed sessions
+# (running + suspended-to-checkpoint) may hold up to hard × factor
+# chips — only the RUNNING ones occupy physical inventory; suspended
+# sessions hold a checkpoint, not a slice. Without the annotation (or
+# at factor 1) the legacy quota semantics hold unchanged: suspended
+# sessions are as invisible to admission as stopped notebooks, and no
+# committed-session cap applies.
+OVERSUBSCRIPTION_FACTOR_ANNOTATION = f"{GROUP}/oversubscription-factor"
+
 # Workload status states
 STATE_PENDING = "Pending"
 STATE_ADMITTED = "Admitted"

@@ -170,14 +170,34 @@ class QuotaSnapshot:
     """Per-namespace TPU chip caps + charged usage. The cap is the
     tightest hard value across the namespace's quotas that name a TPU
     key (the same rule the admission controller applies); namespaces
-    with no TPU-capped quota are unlimited."""
+    with no TPU-capped quota are unlimited.
+
+    **Oversubscription** (sessions/ subsystem): the quota object may
+    carry ``OVERSUBSCRIPTION_FACTOR_ANNOTATION``. ``hard`` still bounds
+    the chips ACTIVE workloads hold; ``hard × factor`` bounds the chips
+    COMMITTED to sessions overall — active workloads plus
+    suspended-to-checkpoint sessions (which hold a checkpoint, not a
+    slice). That is what lets a pool admit more sessions than physical
+    inventory while suspend/resume time-shares the real chips."""
 
     def __init__(self) -> None:
         self.hard: dict[str, int] = {}
         self.used: dict[str, int] = {}
+        # oversubscription factor per namespace (absent → 1.0)
+        self.factor: dict[str, float] = {}
+        # chips held by suspended/resuming sessions (SessionCheckpoints)
+        self.suspended: dict[str, int] = {}
+        # (namespace, workload-name) keys whose session is already
+        # committed (suspended or mid-resume) — their re-admission must
+        # not re-charge the session cap
+        self.session_keys: set[tuple[str, str]] = set()
 
     @classmethod
     def snapshot(cls, api: Any) -> "QuotaSnapshot":
+        from odh_kubeflow_tpu.scheduling import (
+            OVERSUBSCRIPTION_FACTOR_ANNOTATION,
+        )
+
         snap = cls()
         for quota in api.list("ResourceQuota"):  # uncached-ok: cluster quota snapshot
             ns = obj_util.namespace_of(quota)
@@ -187,7 +207,34 @@ class QuotaSnapshot:
                     cap = int(obj_util.parse_quantity(hard[key]))
                     if ns not in snap.hard or cap < snap.hard[ns]:
                         snap.hard[ns] = cap
+                        try:
+                            snap.factor[ns] = max(
+                                float(
+                                    obj_util.annotations_of(quota).get(
+                                        OVERSUBSCRIPTION_FACTOR_ANNOTATION,
+                                        "1",
+                                    )
+                                ),
+                                1.0,
+                            )
+                        except ValueError:
+                            snap.factor[ns] = 1.0
                     break
+        # the one committed-session definition (shared with JWA and the
+        # dashboard): Suspended/Resuming checkpoints whose Workload is
+        # not currently Admitted — an admitted gang's chips live in the
+        # active charge and must not be double-booked
+        from odh_kubeflow_tpu.sessions import (
+            checkpoint_chips,
+            committed_checkpoints,
+        )
+
+        for ck in committed_checkpoints(api):
+            ns = obj_util.namespace_of(ck)
+            snap.suspended[ns] = snap.suspended.get(ns, 0) + checkpoint_chips(
+                ck
+            )
+            snap.session_keys.add((ns, obj_util.name_of(ck)))
         return snap
 
     def cap(self, namespace: str) -> Optional[int]:
@@ -208,6 +255,39 @@ class QuotaSnapshot:
 
     def release(self, namespace: str, chips: int) -> None:
         self.charge(namespace, -chips)
+
+    # -- oversubscription (session cap) --------------------------------------
+
+    def session_cap(self, namespace: str) -> Optional[int]:
+        """``hard × factor`` — the committed-session ceiling, or None
+        when the namespace is unlimited."""
+        cap = self.hard.get(namespace)
+        if cap is None:
+            return None
+        return int(cap * self.factor.get(namespace, 1.0))
+
+    def committed(self, namespace: str) -> int:
+        """Chips committed to sessions: active workload charges plus
+        suspended/resuming checkpoints."""
+        return self.used.get(namespace, 0) + self.suspended.get(namespace, 0)
+
+    def fits_sessions(self, namespace: str, name: str, chips: int) -> bool:
+        """Whether admitting ``chips`` more keeps the pool inside its
+        committed-session ceiling. Only pools that opted into
+        oversubscription (factor > 1) are session-capped — without the
+        annotation the legacy quota semantics hold unchanged (suspended
+        sessions are as invisible to admission as stopped ones). A
+        workload whose session is already committed (a suspended
+        notebook resuming) is exempt — it is re-claiming chips the pool
+        already granted."""
+        if self.factor.get(namespace, 1.0) <= 1.0:
+            return True
+        cap = self.session_cap(namespace)
+        if cap is None:
+            return True
+        if (namespace, name) in self.session_keys:
+            return True
+        return self.committed(namespace) + chips <= cap
 
 
 # ---------------------------------------------------------------------------

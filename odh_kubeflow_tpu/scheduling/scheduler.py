@@ -70,9 +70,14 @@ class SliceScheduler:
         api: Any,
         registry: Optional[prometheus.Registry] = None,
         time_fn: Callable[[], float] = time.time,
+        suspender: Optional[Any] = None,
     ):
         self.api = api
         self.now = time_fn
+        # checkpoint-then-preempt hooks (sessions.manager.SessionManager
+        # duck: is_suspendable / suspend_in_flight / request_suspend).
+        # None → every preemption is a hard kill, as before.
+        self.suspender = suspender
         self.recorder = EventRecorder(api, COMPONENT)
         reg = registry or prometheus.default_registry
         self.m_pending = reg.gauge(
@@ -112,6 +117,10 @@ class SliceScheduler:
         ctrl.watches("Node", self._map_cycle)
         ctrl.watches("ResourceQuota", self._map_cycle)
         ctrl.watches("Pod", self._map_cycle, predicate=self._pod_is_relevant)
+        if self.suspender is not None:
+            # a checkpoint turning Suspended frees committed capacity;
+            # a workload waiting on AwaitingSuspend admits on this watch
+            ctrl.watches("SessionCheckpoint", self._map_cycle)
 
     @staticmethod
     def _pod_is_relevant(_etype: str, pod: Obj) -> bool:
@@ -291,18 +300,41 @@ class SliceScheduler:
                 "for the same pool",
             )
 
+        session_ok = quotas.fits_sessions(ns, obj_util.name_of(wl), chips)
         quota_ok = quotas.fits(ns, chips)
         fit = (
             inventory.fit(accel, topo, hosts, chips_per_host)
-            if quota_ok
+            if quota_ok and session_ok
             else None
         )
-        if not quota_ok or fit is None:
+        suspends_pending = 0
+        if not session_ok or not quota_ok or fit is None:
             victims = self._plan_preemption(
                 wl, inventory, quotas, admitted
             )
             if victims is not None:
+                hard: list[Obj] = []
+                soft: list[Obj] = []
+                in_flight: list[Obj] = []
                 for victim in victims:
+                    if self.suspender is None:
+                        hard.append(victim)
+                    elif self.suspender.suspend_in_flight(victim):
+                        # its snapshot is being taken NOW — killing the
+                        # pods here would destroy the very state the
+                        # suspend exists to save; its release is coming
+                        in_flight.append(victim)
+                    elif session_ok and self.suspender.is_suspendable(
+                        victim
+                    ):
+                        soft.append(victim)
+                    else:
+                        # hard kill — including when the SESSION CAP is
+                        # the blocker: a suspended victim still counts
+                        # as committed, only eviction (victim requeues
+                        # Pending, holding no checkpoint) frees the cap
+                        hard.append(victim)
+                for victim in hard:
                     self._evict(
                         victim,
                         reason="Preempted",
@@ -310,13 +342,68 @@ class SliceScheduler:
                             f"preempted by higher-priority workload "
                             f"{ns}/{obj_util.name_of(wl)}"
                         ),
-                        metric_reason="priority",
+                        metric_reason="evict",
                     )
                     admitted.remove(victim)
+                # checkpoint-then-preempt: suspendable victims keep
+                # their pods until the snapshot is durable — re-charge
+                # their trial release and wait for the suspend to free
+                # the reservation for real
+                for victim in soft + in_flight:
+                    inventory.charge_workload(victim)
+                    quotas.charge(
+                        obj_util.namespace_of(victim),
+                        wlutil.chips_of(victim),
+                    )
+                suspends_pending += len(in_flight)
+                for victim in soft:
+                    if self.suspender.request_suspend(
+                        victim,
+                        f"preempted by higher-priority workload "
+                        f"{ns}/{obj_util.name_of(wl)}; suspending "
+                        "session to checkpoint",
+                    ):
+                        # only a request that actually landed counts as
+                        # a pending release — a failed stamp must fall
+                        # through to the real quota/fit verdict
+                        self.m_preemptions.inc({"reason": "suspend"})
+                        suspends_pending += 1
+                session_ok = quotas.fits_sessions(
+                    ns, obj_util.name_of(wl), chips
+                )
                 quota_ok = quotas.fits(ns, chips)
                 fit = inventory.fit(accel, topo, hosts, chips_per_host)
 
+        # oversubscription reclaim: still starved with no hard-kill
+        # plan — ask idle suspendable sessions (equal priority allowed;
+        # this is the NotebookOS density move) to yield via checkpoint.
+        # Skipped when the preemption plan above already has releases
+        # in flight (the reclaim would recount those victims) and when
+        # the session cap is the blocker (suspends don't lower it).
+        if (
+            session_ok
+            and (not quota_ok or fit is None)
+            and self.suspender is not None
+            and not suspends_pending
+        ):
+            suspends_pending = self._plan_suspend_reclaim(
+                wl, inventory, quotas, admitted
+            )
+
+        if not session_ok:
+            cap = quotas.session_cap(ns)
+            self.m_attempts.inc({"result": "session_cap"})
+            return (
+                "SessionCapExhausted",
+                f"session cap reached in {ns}: running+suspended "
+                f"sessions hold {quotas.committed(ns)} chip(s), cap "
+                f"{cap} (hard {quotas.cap(ns)} × oversubscription "
+                f"factor {quotas.factor.get(ns, 1.0):g}); delete or "
+                "resume-and-stop a session, or raise the factor",
+            )
         if not quota_ok:
+            if suspends_pending:
+                return self._awaiting_suspend(suspends_pending)
             cap = quotas.cap(ns)
             used = quotas.used.get(ns, 0)
             self.m_attempts.inc({"result": "quota_exhausted"})
@@ -326,6 +413,8 @@ class SliceScheduler:
                 f"used {used}, hard {cap}, need {chips}",
             )
         if fit is None:
+            if suspends_pending:
+                return self._awaiting_suspend(suspends_pending)
             self.m_attempts.inc({"result": "unschedulable"})
             if not inventory.capacity_exists(accel, topo):
                 return (
@@ -342,6 +431,14 @@ class SliceScheduler:
         pool, nodes = fit
         self._admit(wl, pool, nodes, inventory, quotas)
         return None
+
+    def _awaiting_suspend(self, count: int) -> tuple[str, str]:
+        self.m_attempts.inc({"result": "awaiting_suspend"})
+        return (
+            "AwaitingSuspend",
+            f"waiting for {count} session(s) to suspend to checkpoint "
+            "and release their slice reservation",
+        )
 
     def _admit(
         self,
@@ -397,7 +494,9 @@ class SliceScheduler:
         None (in which case all trial releases are rolled back).
         Victims: strictly lower priority, contending on quota (same
         namespace) or capacity (assigned pool matches the selector);
-        cheapest first — lowest priority, then youngest admission."""
+        cheapest first — lowest priority, then suspendable (their state
+        survives as a checkpoint — a hard kill loses real work) before
+        hard-kill victims, then youngest admission."""
         ns = obj_util.namespace_of(wl)
         spec = wl.get("spec") or {}
         accel = spec.get("acceleratorType", "")
@@ -413,8 +512,16 @@ class SliceScheduler:
             pool = inventory.pools.get(pool_name)
             return pool is not None and pool.matches(accel, topo)
 
-        # cheapest victims first: lowest priority, then the most
-        # recently admitted (loses the least running work)
+        # cheapest victims first: lowest priority, then — at equal
+        # priority — suspendable (or already-suspending) sessions ahead
+        # of hard-kill victims, then the most recently admitted (loses
+        # the least running work)
+        def yields_via_checkpoint(v: Obj) -> bool:
+            return self.suspender is not None and (
+                self.suspender.suspend_in_flight(v)
+                or self.suspender.is_suspendable(v)
+            )
+
         candidates = sorted(
             (
                 v
@@ -423,6 +530,7 @@ class SliceScheduler:
             ),
             key=lambda v: (
                 wlutil.priority_of(v),
+                0 if yields_via_checkpoint(v) else 1,
                 -obj_util.parse_rfc3339(
                     obj_util.get_path(v, "status", "admittedAt", default="")
                 ),
@@ -448,6 +556,9 @@ class SliceScheduler:
         def admits() -> bool:
             return bool(
                 quotas.fits(ns, wlutil.chips_of(wl))
+                and quotas.fits_sessions(
+                    ns, obj_util.name_of(wl), wlutil.chips_of(wl)
+                )
                 and inventory.fit(accel, topo, hosts, chips_per_host)
             )
 
@@ -472,6 +583,124 @@ class SliceScheduler:
             else:
                 release(victim)
         return chosen
+
+    def _plan_suspend_reclaim(
+        self,
+        wl: Obj,
+        inventory: SliceInventory,
+        quotas: QuotaSnapshot,
+        admitted: list[Obj],
+    ) -> int:
+        """Checkpoint-then-preempt for an overcommitted pool: when
+        ``wl`` is starved and strict-priority preemption found nothing,
+        ask IDLE suspendable sessions (equal or lower priority — the
+        NotebookOS density move) to yield their slice via a durable
+        snapshot. Nothing is evicted here: suspends are requested, the
+        releases land asynchronously, and the caller reports
+        ``AwaitingSuspend``. Returns the number of pending releases
+        (new requests + suspends already in flight); every trial
+        release is rolled back before returning."""
+        ns = obj_util.namespace_of(wl)
+        spec = wl.get("spec") or {}
+        accel = spec.get("acceleratorType", "")
+        topo = spec.get("topology", "")
+        hosts = wlutil.hosts_of(wl)
+        chips_per_host = wlutil.chips_per_host_of(wl)
+        my_priority = wlutil.priority_of(wl)
+
+        def contends(victim: Obj) -> bool:
+            if obj_util.namespace_of(victim) == ns and quotas.cap(ns) is not None:
+                return True
+            pool_name = obj_util.get_path(
+                victim, "status", "assignment", "pool", default=""
+            )
+            pool = inventory.pools.get(pool_name)
+            return pool is not None and pool.matches(accel, topo)
+
+        def release(victim: Obj) -> None:
+            inventory.release_workload(victim)
+            quotas.release(
+                obj_util.namespace_of(victim), wlutil.chips_of(victim)
+            )
+
+        def charge(victim: Obj) -> None:
+            inventory.charge_workload(victim)
+            quotas.charge(
+                obj_util.namespace_of(victim), wlutil.chips_of(victim)
+            )
+
+        def admits() -> bool:
+            return bool(
+                quotas.fits(ns, wlutil.chips_of(wl))
+                and quotas.fits_sessions(
+                    ns, obj_util.name_of(wl), wlutil.chips_of(wl)
+                )
+                and inventory.fit(accel, topo, hosts, chips_per_host)
+            )
+
+        # releases already on their way (snapshots being taken now)
+        in_flight = [
+            v
+            for v in admitted
+            if contends(v) and self.suspender.suspend_in_flight(v)
+        ]
+        for v in in_flight:
+            release(v)
+        try:
+            if admits():
+                return len(in_flight)
+            candidates = sorted(
+                (
+                    v
+                    for v in admitted
+                    if v not in in_flight
+                    and wlutil.priority_of(v) <= my_priority
+                    and contends(v)
+                    and self.suspender.is_suspendable(v, require_idle=True)
+                ),
+                key=lambda v: (
+                    wlutil.priority_of(v),
+                    -obj_util.parse_rfc3339(
+                        obj_util.get_path(
+                            v, "status", "admittedAt", default=""
+                        )
+                    ),
+                ),
+            )
+            chosen: list[Obj] = []
+            for victim in candidates:
+                release(victim)
+                chosen.append(victim)
+                if admits():
+                    break
+            else:
+                for victim in chosen:
+                    charge(victim)
+                return len(in_flight)
+            # prune greedy extras — every suspend is real user latency
+            for victim in list(chosen):
+                charge(victim)
+                if admits():
+                    chosen.remove(victim)
+                else:
+                    release(victim)
+            requested = 0
+            for victim in chosen:
+                if self.suspender.request_suspend(
+                    victim,
+                    f"idle session yielding its slice to "
+                    f"{ns}/{obj_util.name_of(wl)} (pool overcommitted); "
+                    "suspending to checkpoint",
+                ):
+                    self.m_preemptions.inc({"reason": "suspend"})
+                    requested += 1
+            for victim in chosen:
+                charge(victim)
+            # only requests that landed are pending releases
+            return requested + len(in_flight)
+        finally:
+            for v in in_flight:
+                charge(v)
 
     # -- eviction -----------------------------------------------------------
 
@@ -693,12 +922,31 @@ class SliceScheduler:
 def main() -> None:
     """Split-process entrypoint (manifests/notebook-controller): attach
     to $KUBE_API_URL and run admission cycles forever."""
+    import os
+
     from odh_kubeflow_tpu.machinery.runner import run_controller
     from odh_kubeflow_tpu.scheduling import register_scheduling
 
     def register(api, mgr):
         register_scheduling(api)
-        SliceScheduler(api, registry=mgr.metrics_registry).register(mgr)
+        suspender = None
+        if os.environ.get("ENABLE_SESSION_SUSPEND", "true").lower() == "true":
+            # the hooks only read/patch through the api — the actual
+            # snapshot work runs in the notebook-controller process's
+            # SessionManager
+            from odh_kubeflow_tpu.sessions import register_sessions
+            from odh_kubeflow_tpu.sessions.manager import (
+                SessionConfig,
+                SessionManager,
+            )
+
+            register_sessions(api)
+            suspender = SessionManager(
+                api, SessionConfig.from_env(), registry=mgr.metrics_registry
+            )
+        SliceScheduler(
+            api, registry=mgr.metrics_registry, suspender=suspender
+        ).register(mgr)
 
     run_controller("tpu-scheduler", register)
 

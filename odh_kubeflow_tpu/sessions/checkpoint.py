@@ -1,0 +1,211 @@
+"""The durable byte store behind SessionCheckpoints, keyed by notebook
+UID.
+
+Session state is an opaque JSON-able tree (kernel variables, execution
+counters — whatever the in-pod snapshot hook hands over). It is
+canonically serialized once, digested (sha256 — the bit-identity
+receipt the resume path and the property tests verify), and written
+through ``train.checkpoint.CheckpointManager`` — the same orbax-backed
+manager training state uses, so session snapshots inherit its
+async-capable IO, ``max_to_keep`` GC, and fsspec path support (PVC
+paths and ``gs://`` buckets alike). Where orbax/jax is unavailable the
+store degrades to plain JSON files with the same layout and receipts.
+
+Checkpoint IO is blocking filesystem/network work: it must NEVER run
+under store/cache locks (graftlint's blocking-under-lock scope covers
+this package; the SessionManager only calls the store from reconcile
+bodies, which hold none).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from typing import Any, Optional
+
+Obj = dict[str, Any]
+
+_META = "session-meta.json"
+
+
+def _canonical(state: Obj) -> bytes:
+    return json.dumps(
+        state, sort_keys=True, separators=(",", ":")
+    ).encode()
+
+
+class SessionCheckpointStore:
+    """``save(uid, state) → receipt`` / ``load(uid) → (state, digest)``
+    / ``delete(uid)``. One subdirectory per notebook UID; re-suspends
+    write monotonically increasing steps and old steps are GC'd."""
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        backend: str = "auto",
+        max_to_keep: int = 2,
+    ):
+        self.root = root
+        self.max_to_keep = max_to_keep
+        # "auto" resolves lazily at first IO — constructing the store
+        # (e.g. at Platform boot) must not pay the jax/orbax import
+        self._backend = backend
+        self._managers: dict[str, Any] = {}
+
+    @property
+    def backend(self) -> str:
+        if self._backend == "auto":
+            try:
+                from odh_kubeflow_tpu.train.checkpoint import (  # noqa: F401
+                    CheckpointManager,
+                )
+
+                self._backend = "orbax"
+            except Exception:  # jax/orbax not importable → file fallback
+                self._backend = "json"
+        return self._backend
+
+    # -- paths / metadata ----------------------------------------------------
+
+    def _dir(self, uid: str) -> str:
+        return os.path.join(self.root, uid)
+
+    def _meta_path(self, uid: str) -> str:
+        return os.path.join(self._dir(uid), _META)
+
+    def _read_meta(self, uid: str) -> Optional[Obj]:
+        try:
+            with open(self._meta_path(uid)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write_meta(self, uid: str, meta: Obj) -> None:
+        os.makedirs(self._dir(uid), exist_ok=True)
+        tmp = self._meta_path(uid) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, self._meta_path(uid))
+
+    # -- orbax backend -------------------------------------------------------
+
+    def _manager(self, uid: str):
+        mngr = self._managers.get(uid)
+        if mngr is None:
+            from odh_kubeflow_tpu.train.checkpoint import CheckpointManager
+
+            mngr = self._managers[uid] = CheckpointManager(
+                os.path.join(self._dir(uid), "orbax"),
+                max_to_keep=self.max_to_keep,
+                # synchronous: the suspend path needs the snapshot
+                # durable before the pods are torn down
+                async_save=False,
+            )
+        return mngr
+
+    # -- API -----------------------------------------------------------------
+
+    def save(self, uid: str, state: Obj) -> Obj:
+        """Persist ``state`` for ``uid``; returns the receipt
+        ``{"step", "digest", "sizeBytes"}`` the SessionCheckpoint
+        status records."""
+        payload = _canonical(state)
+        digest = hashlib.sha256(payload).hexdigest()
+        prev = self._read_meta(uid)
+        step = (int(prev["step"]) + 1) if prev else 0
+        if self.backend == "orbax":
+            import jax.numpy as jnp
+            import numpy as np
+
+            arr = jnp.asarray(np.frombuffer(payload, np.uint8))
+            mngr = self._manager(uid)
+            mngr.save(step, {"session": arr}, force=True)
+            mngr.wait_until_finished()
+        else:
+            os.makedirs(self._dir(uid), exist_ok=True)
+            with open(self._step_path(uid, step), "wb") as f:
+                f.write(payload)
+            for old in self._json_steps(uid)[: -self.max_to_keep]:
+                try:
+                    os.remove(self._step_path(uid, old))
+                except OSError:
+                    pass
+        meta = {"step": step, "digest": digest, "sizeBytes": len(payload)}
+        self._write_meta(uid, meta)
+        return dict(meta)
+
+    def load(self, uid: str) -> Optional[tuple[Obj, str]]:
+        """The latest state for ``uid`` plus the digest of the bytes
+        actually read back (callers compare it against the saved
+        receipt — the bit-identity check), or None when nothing is
+        stored."""
+        meta = self._read_meta(uid)
+        if meta is None:
+            return None
+        step = int(meta["step"])
+        if self.backend == "orbax":
+            import jax
+            import numpy as np
+
+            mngr = self._manager(uid)
+            like = {
+                "session": jax.ShapeDtypeStruct(
+                    (int(meta["sizeBytes"]),),
+                    np.uint8,
+                    sharding=jax.sharding.SingleDeviceSharding(
+                        jax.devices()[0]
+                    ),
+                )
+            }
+            restored = mngr.restore(like, step=step)
+            payload = bytes(np.asarray(restored["session"]))
+        else:
+            try:
+                with open(self._step_path(uid, step), "rb") as f:
+                    payload = f.read()
+            except OSError:
+                return None
+        digest = hashlib.sha256(payload).hexdigest()
+        return json.loads(payload.decode()), digest
+
+    def exists(self, uid: str) -> bool:
+        return self._read_meta(uid) is not None
+
+    def delete(self, uid: str) -> None:
+        mngr = self._managers.pop(uid, None)
+        if mngr is not None:
+            try:
+                mngr.close()
+            except Exception:  # graftlint: disable=swallowed-exception best-effort close before rmtree
+                pass
+        shutil.rmtree(self._dir(uid), ignore_errors=True)
+
+    def close(self) -> None:
+        for uid in list(self._managers):
+            mngr = self._managers.pop(uid)
+            try:
+                mngr.close()
+            except Exception:  # graftlint: disable=swallowed-exception shutdown must not raise
+                pass
+
+    # -- json backend helpers ------------------------------------------------
+
+    def _step_path(self, uid: str, step: int) -> str:
+        return os.path.join(self._dir(uid), f"state-{step:08d}.json")
+
+    def _json_steps(self, uid: str) -> list[int]:
+        try:
+            names = os.listdir(self._dir(uid))
+        except OSError:
+            return []
+        steps = []
+        for n in names:
+            if n.startswith("state-") and n.endswith(".json"):
+                try:
+                    steps.append(int(n[len("state-"):-len(".json")]))
+                except ValueError:
+                    pass
+        return sorted(steps)

@@ -13,7 +13,7 @@ from typing import Any, Optional
 
 from odh_kubeflow_tpu.controllers.kfam import KfamService
 from odh_kubeflow_tpu.machinery import objects as obj_util
-from odh_kubeflow_tpu.machinery.store import APIServer, AlreadyExists
+from odh_kubeflow_tpu.machinery.store import AlreadyExists, APIServer
 from odh_kubeflow_tpu.utils import prometheus
 from odh_kubeflow_tpu.web.crud_backend import (
     failure,
@@ -287,6 +287,30 @@ class DashboardApp:
                             c, "resources", "limits", "google.com/tpu", default=0
                         )
                     )
+            # suspended sessions hold committed chips without occupying
+            # inventory — the occupancy panel shows both axes so an
+            # oversubscribed pool (committed > capacity) is visible;
+            # the ledger definition is shared with JWA and admission
+            from odh_kubeflow_tpu.sessions import (
+                checkpoint_chips,
+                committed_checkpoints,
+            )
+
+            suspended_chips: dict[str, float] = {}
+            suspended_count = 0
+            for ck in committed_checkpoints(self.api):
+                if (
+                    obj_util.get_path(ck, "status", "phase")
+                    == "Suspended"
+                ):
+                    suspended_count += 1
+                accel = obj_util.get_path(
+                    ck, "spec", "acceleratorType", default=""
+                )
+                if accel:
+                    suspended_chips[accel] = suspended_chips.get(
+                        accel, 0
+                    ) + float(checkpoint_chips(ck))
             return success(
                 {
                     "tpu": [
@@ -294,10 +318,14 @@ class DashboardApp:
                             "accelerator": accel,
                             "capacityChips": cap,
                             "usedChips": used.get(accel, 0),
+                            "suspendedChips": suspended_chips.get(accel, 0),
+                            "committedChips": used.get(accel, 0)
+                            + suspended_chips.get(accel, 0),
                         }
                         for accel, cap in sorted(capacity.items())
                     ],
                     "notebooks": len(self.api.list("Notebook")),  # uncached-ok: count only
+                    "suspendedSessions": suspended_count,
                 }
             )
 

@@ -26,7 +26,10 @@ from typing import Any, Optional
 import yaml
 
 from odh_kubeflow_tpu.apis import (
+    RESUME_REQUESTED_ANNOTATION,
     STOP_ANNOTATION,
+    SUSPEND_REASON_ANNOTATION,
+    SUSPENDED_AT_ANNOTATION,
     TPU_ACCEL_NODE_LABEL,
     TPU_ACCELERATOR_ANNOTATION,
     TPU_RESOURCE,
@@ -35,6 +38,7 @@ from odh_kubeflow_tpu.apis import (
 from odh_kubeflow_tpu.machinery import objects as obj_util
 from odh_kubeflow_tpu.machinery.cache import list_by_index
 from odh_kubeflow_tpu.machinery.store import APIServer, NotFound
+from odh_kubeflow_tpu.scheduling import OVERSUBSCRIPTION_FACTOR_ANNOTATION
 from odh_kubeflow_tpu.utils.tpu import TPU_TOPOLOGIES
 from odh_kubeflow_tpu.web.crud_backend import (
     CrudBackend,
@@ -164,6 +168,13 @@ class JupyterWebApp(CrudBackend):
         self.config_path = config_path
         self._config_mtime: Optional[float] = None
         self._config = copy.deepcopy(DEFAULT_CONFIG)
+        # without the sessions subsystem a suspend request would stamp
+        # annotations nobody serves — the UI must not promise warm
+        # state that can never exist
+        self.sessions_enabled = (
+            os.environ.get("ENABLE_SESSION_SUSPEND", "true").lower()
+            == "true"
+        )
         self._register_routes()
 
     # -- config (live reload per request, utils.py:22-53) --------------------
@@ -353,17 +364,54 @@ class JupyterWebApp(CrudBackend):
             stopped = body.get("stopped")
             if stopped is None:
                 return failure("body must set 'stopped': true|false", 400)
-            patch = {
-                "metadata": {
-                    "annotations": {
-                        STOP_ANNOTATION: (
-                            obj_util.now_rfc3339() if stopped else None
-                        )
-                    }
-                }
-            }
-            self.api.patch("Notebook", name, patch, namespace)
+            now = obj_util.now_rfc3339()
+            if stopped:
+                annotations: Obj = {STOP_ANNOTATION: now}
+                if body.get("suspend") and self.sessions_enabled:
+                    # user-requested suspend: keep the kernel as a
+                    # checkpoint instead of a cold stop. Idempotent —
+                    # a duplicate suspend must NOT open a new epoch
+                    # (that would resurrect the pods and overwrite the
+                    # durable checkpoint with a fresh kernel's nothing)
+                    nb = self.api.get("Notebook", name, namespace)
+                    if SUSPENDED_AT_ANNOTATION not in (
+                        obj_util.annotations_of(nb)
+                    ):
+                        annotations[SUSPENDED_AT_ANNOTATION] = now
+                        annotations[SUSPEND_REASON_ANNOTATION] = "user"
+            else:
+                annotations, _ = self._resume_annotations(
+                    namespace, name, now
+                )
+            self.api.patch(
+                "Notebook", name, {"metadata": {"annotations": annotations}},
+                namespace,
+            )
             return success()
+
+        @app.route(
+            "/api/namespaces/<namespace>/notebooks/<name>/resume",
+            methods=["POST"],
+        )
+        def resume_notebook(request, namespace, name):
+            """Explicit resume API (the spawner's CONNECT on a
+            suspended row): clear the stop/suspend contract so the
+            Workload re-enqueues, and stamp resume-requested-at — the
+            session manager's warm-resume histogram measures from this
+            instant to state-restored-in-pod."""
+            self.authorize(
+                request, "update", "notebooks", namespace, "kubeflow.org"
+            )
+            annotations, warm = self._resume_annotations(
+                namespace, name, obj_util.now_rfc3339()
+            )
+            self.api.patch(
+                "Notebook",
+                name,
+                {"metadata": {"annotations": annotations}},
+                namespace,
+            )
+            return success({"resume": "warm" if warm else "cold"})
 
         @app.route(
             "/api/namespaces/<namespace>/notebooks/<name>", methods=["DELETE"]
@@ -403,6 +451,29 @@ class JupyterWebApp(CrudBackend):
                 for pd in self.api.list("PodDefault", namespace=namespace)
             ]
             return success({"poddefaults": pds})
+
+    def _resume_annotations(
+        self, namespace: str, name: str, now: str
+    ) -> tuple[Obj, bool]:
+        """The start/resume merge-patch plus whether the resume is
+        warm (one read decides both): clears the stop/suspend contract;
+        a notebook that was suspended (not plain-stopped) additionally
+        gets resume-requested-at so the warm-resume latency is measured
+        from the user's click."""
+        warm = False
+        try:
+            nb = self.api.get("Notebook", name, namespace)
+            warm = SUSPENDED_AT_ANNOTATION in obj_util.annotations_of(nb)
+        except NotFound:
+            pass
+        annotations: Obj = {
+            STOP_ANNOTATION: None,
+            SUSPENDED_AT_ANNOTATION: None,
+            SUSPEND_REASON_ANNOTATION: None,
+        }
+        if warm:
+            annotations[RESUME_REQUESTED_ANNOTATION] = now
+        return annotations, warm
 
     # -- TPU inventory -------------------------------------------------------
 
@@ -453,12 +524,53 @@ class JupyterWebApp(CrudBackend):
                 used = obj_util.get_path(
                     quota, "status", "used", key, default="0"
                 )
-                return {
+                row = {
                     "resource": key,
                     "hard": str(hard),
                     "used": str(used),
                 }
+                factor = obj_util.annotations_of(quota).get(
+                    OVERSUBSCRIPTION_FACTOR_ANNOTATION
+                )
+                try:
+                    factor_f = float(factor) if factor else 1.0
+                except ValueError:
+                    factor_f = 1.0
+                if factor_f > 1.0:
+                    # oversubscribed pool: surface the committed-session
+                    # view next to the physical one so the spawner can
+                    # say "4 of 8 chips running, 12 of 16 committed"
+                    suspended = self._suspended_chips(namespace)
+                    cap = int(
+                        obj_util.parse_quantity(hard) * factor_f
+                    )
+                    row.update(
+                        {
+                            "oversubscriptionFactor": f"{factor_f:g}",
+                            "sessionCap": str(cap),
+                            "committed": str(
+                                int(obj_util.parse_quantity(used))
+                                + suspended
+                            ),
+                            "suspended": str(suspended),
+                        }
+                    )
+                return row
         return None
+
+    def _suspended_chips(self, namespace: str) -> int:
+        """Chips held by suspended/resuming sessions in the namespace —
+        committed to the pool but not occupying physical inventory
+        (the same ledger definition admission uses)."""
+        from odh_kubeflow_tpu.sessions import (
+            checkpoint_chips,
+            committed_checkpoints,
+        )
+
+        return sum(
+            checkpoint_chips(ck)
+            for ck in committed_checkpoints(self.api, namespace=namespace)
+        )
 
     def _workload_of(self, nb: Obj) -> Optional[Obj]:
         try:
@@ -685,13 +797,40 @@ class JupyterWebApp(CrudBackend):
         }
 
     def notebook_status(self, nb: Obj) -> Obj:
-        """stopped/terminating/waiting/running + error-event mining."""
+        """stopped/suspended/resuming/terminating/waiting/running +
+        error-event mining. Suspended is NOT stopped: the session
+        survives as a checkpoint and resumes warm — the UI offers
+        "resume", not "start over"."""
         ann = obj_util.annotations_of(nb)
         if obj_util.meta(nb).get("deletionTimestamp"):
             return {"phase": "terminating", "message": "Deleting this notebook"}
+        session_phase = obj_util.get_path(nb, "status", "phase", default="")
         if STOP_ANNOTATION in ann:
+            if SUSPENDED_AT_ANNOTATION in ann:
+                if session_phase == "Suspending":
+                    return {
+                        "phase": "suspending",
+                        "message": (
+                            "Checkpointing session state before "
+                            "releasing the slice"
+                        ),
+                    }
+                return {
+                    "phase": "suspended",
+                    "message": (
+                        "Session suspended to checkpoint; resume to "
+                        "restore it warm"
+                    ),
+                }
             return {"phase": "stopped", "message": "No Pods are currently running"}
         ready = obj_util.get_path(nb, "status", "readyReplicas", default=0)
+        if session_phase == "Resuming":
+            # pods may already be Running, but ready waits for the
+            # state restore — the whole point of a warm resume
+            return {
+                "phase": "resuming",
+                "message": "Restoring session state from checkpoint",
+            }
         if ready and ready > 0:
             return {"phase": "ready", "message": "Running"}
         wl = self._workload_of(nb)

@@ -34,16 +34,27 @@ def test_all_yaml_parses():
 def test_crds_match_code_registrations():
     from odh_kubeflow_tpu.apis import register_crds
     from odh_kubeflow_tpu.machinery.store import APIServer
+    from odh_kubeflow_tpu.scheduling import register_scheduling
+    from odh_kubeflow_tpu.sessions import register_sessions
 
     api = APIServer()
     register_crds(api)
+    register_scheduling(api)
+    register_sessions(api)
 
     crds = {
         d["metadata"]["name"]: d
         for _, d in _all_docs()
         if d.get("kind") == "CustomResourceDefinition"
     }
-    expected = {"Notebook", "Profile", "Tensorboard", "PodDefault"}
+    expected = {
+        "Notebook",
+        "Profile",
+        "Tensorboard",
+        "PodDefault",
+        "Workload",
+        "SessionCheckpoint",
+    }
     for kind in expected:
         info = api.type_info(kind)
         group = info.api_version.split("/")[0]
