@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint sanitize chaos loadtest images bench dryrun platform serve spawn-latency suspend-bench native kind-smoke conformance
+.PHONY: all test test-unit test-manifests lint sanitize chaos loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
 
 all: lint test
 
@@ -65,11 +65,22 @@ spawn-latency:
 suspend-bench:
 	$(PYTHON) -m loadtest.spawn_latency --suspend-only
 
-# C++ host-side components (input-pipeline packer); lazy-built on first
-# import too — this target just front-loads the compile
+# web-tier concurrency axis of the control-plane bench: thread-per-
+# request + stdlib json baseline vs event loop + native serializer +
+# bytes cache, over real sockets (gates >=10x concurrent req/s and no
+# serial p99 regression; see docs/GUIDE.md "Async web tier")
+webbench:
+	$(PYTHON) loadtest/control_plane_bench.py
+
+# C++ host-side components (input-pipeline packer + jsontree
+# deepcopy/dumps); lazy-built on first import too — this target just
+# front-loads the compiles
 native:
 	$(PYTHON) -c "from odh_kubeflow_tpu import native; so = native.build(force=True); \
 	  import sys; print(so) if so else sys.exit('no C++ compiler found')"
+	$(PYTHON) -c "from odh_kubeflow_tpu import native; import sys; \
+	  ok = native.jsontree_deepcopy() and native.jsontree_dumps(); \
+	  print('jsontree: deepcopy+dumps built') if ok else sys.exit('jsontree build failed')"
 
 images:
 	$(MAKE) -C images build

@@ -20,6 +20,15 @@ reconcile throughput and ≥2x JWA list p95, with the cached passes'
 deepcopy counts recorded (reads on the cached path are zero-copy; the
 residual copies are the reconciler's own ``mutable()`` working copies).
 
+The **web-tier concurrency axis** (``--skip-web-tier`` to omit)
+measures the REST façade over real sockets two ways: the legacy
+thread-per-request server with per-request ``json.dumps`` (the pre-PR
+posture: ``event_loop=False, fast_serialize=False``, serializer pinned
+to the stdlib) vs the asyncio event loop with the native serializer +
+per-(kind, rv) bytes cache. Serial latency (p50/p95/p99, one client)
+gates "no p99 regression"; ``--clients`` concurrent closed-loop
+clients hammering namespace lists gate ≥10x requests/s per replica.
+
 Run: ``python loadtest/control_plane_bench.py [--notebooks 500]``
 """
 
@@ -29,6 +38,7 @@ import argparse
 import io
 import json
 import os
+import socket
 import statistics
 import sys
 import time
@@ -52,6 +62,7 @@ from odh_kubeflow_tpu.machinery.cache import (
     InformerCache,
     register_platform_indexers,
 )
+from odh_kubeflow_tpu.machinery import httpapi, serialize
 from odh_kubeflow_tpu.machinery.store import APIServer
 from odh_kubeflow_tpu.scheduling import register_scheduling
 from odh_kubeflow_tpu.scheduling.scheduler import SliceScheduler
@@ -263,12 +274,296 @@ def bench_jwa(jwa, namespaces: list[str], rounds: int) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# web-tier concurrency axis (thread-per-request vs event loop, over
+# real sockets)
+
+
+def _http_get(port: int, path: str) -> bytes:
+    """One request over a fresh connection (``Connection: close`` so
+    both servers use the one-shot lifecycle — the serial-latency
+    comparison holds connection setup constant), raw bytes back — no
+    client-side JSON parse polluting the server measurement."""
+    with socket.create_connection(("127.0.0.1", port), timeout=30) as s:
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.sendall(
+            f"GET {path} HTTP/1.1\r\nHost: bench\r\n"
+            "Connection: close\r\n\r\n".encode()
+        )
+        chunks = []
+        while True:
+            chunk = s.recv(1 << 16)
+            if not chunk:
+                break
+            chunks.append(chunk)
+    data = b"".join(chunks)
+    status = data.split(b"\r\n", 1)[0]
+    assert b"200" in status, status
+    return data
+
+
+class _Session:
+    """Connection-reusing HTTP client: keeps the connection when the
+    server offers keep-alive (the event loop does), transparently
+    reconnects per request when it doesn't (wsgiref closes after every
+    response) — so each tier is measured with the connection lifecycle
+    it actually provides to clients.
+
+    Parsing is deliberately minimal (bulk ``recv`` + ``partition``, no
+    per-line reads): the client must be cheap enough that the SERVER is
+    the measured bottleneck — on a small box a per-line-parsing client
+    saturates the CPU before a fast server does, and the concurrency
+    axis degenerates into measuring the harness."""
+
+    def __init__(self, port: int):
+        self.port = port
+        self._sock = None
+        self._buf = b""
+        self._reqs: dict[str, bytes] = {}
+
+    def _connect(self):
+        self._sock = socket.create_connection(
+            ("127.0.0.1", self.port), timeout=30
+        )
+        # small request/response ping-pong on a persistent connection:
+        # Nagle + delayed-ACK would add ~40ms stalls per exchange
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._buf = b""
+
+    def close(self):
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def get(self, path: str, _retries: int = 3) -> bytes:
+        req = self._reqs.get(path)
+        if req is None:
+            req = f"GET {path} HTTP/1.1\r\nHost: bench\r\n\r\n".encode()
+            self._reqs[path] = req
+        if self._sock is None:
+            self._connect()
+        self._sock.sendall(req)
+        recv = self._sock.recv
+        buf = self._buf
+        while b"\r\n\r\n" not in buf:
+            chunk = recv(1 << 16)
+            if not chunk:  # server closed the idle connection: retry on
+                # a fresh one, bounded so a shedding/dying server
+                # surfaces as a real error rather than a recursion blowup
+                self.close()
+                if _retries <= 0:
+                    raise ConnectionError(f"server keeps closing: {path}")
+                self._connect()
+                return self.get(path, _retries - 1)
+            buf += chunk
+        head, _, buf = buf.partition(b"\r\n\r\n")
+        lower = head.lower()
+        assert b"200" in head[:16], head[:64]
+        length = 0
+        i = lower.find(b"content-length:")
+        if i >= 0:
+            # the header may be the head's LAST line (wsgiref emits app
+            # headers after its own), with no trailing \r to find
+            end = lower.find(b"\r", i)
+            length = int(lower[i + 15: end if end >= 0 else len(lower)])
+        while len(buf) < length:
+            chunk = recv(1 << 16)
+            if not chunk:
+                break
+            buf += chunk
+        body, self._buf = buf[:length], buf[length:]
+        keep = lower.startswith(b"http/1.1") and (
+            b"connection: close" not in lower
+        )
+        if not keep:
+            self.close()
+        return body
+
+
+def _percentiles(samples: list[float]) -> dict:
+    samples = sorted(samples)
+    return {
+        "requests": len(samples),
+        "p50_ms": round(statistics.median(samples), 3),
+        "p95_ms": round(samples[int(len(samples) * 0.95) - 1], 3),
+        "p99_ms": round(samples[int(len(samples) * 0.99) - 1], 3),
+    }
+
+
+def bench_serial_interleaved(
+    ports: list[int], paths: list[str], rounds: int
+) -> list[dict]:
+    """Serial latency for several servers measured ALTERNATELY, one
+    request each per path per round — a host-level stall (scheduler
+    steal, noisy neighbour) lands on every tier instead of biasing
+    whichever happened to own that wall-clock window."""
+    samples: list[list[float]] = [[] for _ in ports]
+    for _ in range(rounds):
+        for path in paths:
+            for i, port in enumerate(ports):
+                t0 = time.perf_counter()
+                _http_get(port, path)
+                samples[i].append((time.perf_counter() - t0) * 1000.0)
+    return [_percentiles(s) for s in samples]
+
+
+def _concurrent_worker(
+    port: int,
+    paths: list[str],
+    per_client: int,
+    idx: int,
+    barrier,
+    err_q,
+) -> None:
+    my_paths = paths[idx % len(paths):] + paths[: idx % len(paths)]
+    session = _Session(port)
+    barrier.wait()
+    try:
+        for i in range(per_client):
+            session.get(my_paths[i % len(my_paths)])
+    except Exception as e:  # noqa: BLE001 — surfaced to the gate
+        err_q.put(repr(e))
+    finally:
+        session.close()
+
+
+def bench_concurrent_http(
+    port: int, paths: list[str], clients: int, per_client: int
+) -> dict:
+    """``clients`` closed-loop workers, ``per_client`` list requests
+    each; requests/s is the replica-throughput headline. Workers are
+    PROCESSES: in-process client threads would share the server's GIL
+    and measure their own parsing, not the replica's throughput."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("fork")
+    barrier = ctx.Barrier(clients + 1)
+    err_q = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=_concurrent_worker,
+            args=(port, paths, per_client, i, barrier, err_q),
+            daemon=True,
+        )
+        for i in range(clients)
+    ]
+    for p in procs:
+        p.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for p in procs:
+        p.join()
+    elapsed = time.perf_counter() - t0
+    if not err_q.empty():
+        raise RuntimeError(f"concurrent client failed: {err_q.get()}")
+    total = clients * per_client
+    return {
+        "clients": clients,
+        "requests": total,
+        "elapsed_s": round(elapsed, 3),
+        "requests_per_s": round(total / elapsed, 1),
+    }
+
+
+def bench_web_tier(
+    api: APIServer,
+    namespaces: list[str],
+    client_counts: list[int],
+    per_client: int,
+    sweep_reps: int = 2,
+) -> dict:
+    """Thread-per-request + stdlib json (the pre-PR posture) vs event
+    loop + native serializer + bytes cache, same store, same paths.
+
+    Both servers run AT ONCE and every measurement alternates between
+    them — serial samples one-for-one, concurrent windows adjacently
+    per client count, the whole sweep repeated ``sweep_reps`` times
+    with each tier keeping its best window. Host-level noise (CPU
+    steal, scheduler stalls — multi-ms on shared boxes) thus lands on
+    both tiers instead of deciding the ratio by which tier owned the
+    bad wall-clock window. The baseline app uses the stdlib encoder by
+    construction (``fast_serialize=False`` routes every response
+    through plain ``json.dumps`` and disables the bytes cache), so no
+    global engine pinning is needed while both serve."""
+    paths = [f"/api/v1/namespaces/{ns}/notebooks" for ns in namespaces]
+
+    _, _, base_srv = httpapi.serve(
+        api, port=0, event_loop=False, fast_serialize=False
+    )
+    base_port = base_srv.server_address[1]
+    _, loop_port, loop_srv = httpapi.serve(api, port=0, event_loop=True)
+    try:
+        bench_serial_interleaved([base_port, loop_port], paths, 1)  # warmup
+        baseline_serial, loop_serial = bench_serial_interleaved(
+            [base_port, loop_port], paths, 25
+        )
+        base_runs: list[dict] = []
+        loop_runs: list[dict] = []
+        for _ in range(sweep_reps):
+            for count in client_counts:
+                base_runs.append(
+                    bench_concurrent_http(base_port, paths, count, per_client)
+                )
+                loop_runs.append(
+                    bench_concurrent_http(loop_port, paths, count, per_client)
+                )
+    finally:
+        base_srv.shutdown()
+        loop_srv.shutdown()
+
+    baseline_conc = {
+        "runs": base_runs,
+        "best": max(base_runs, key=lambda r: r["requests_per_s"]),
+    }
+    loop_conc = {
+        "runs": loop_runs,
+        "best": max(loop_runs, key=lambda r: r["requests_per_s"]),
+    }
+    return {
+        "serialize_engine": serialize.engine(),
+        "thread_baseline": {
+            "serial": baseline_serial,
+            "concurrent": baseline_conc,
+        },
+        "event_loop": {"serial": loop_serial, "concurrent": loop_conc},
+        "speedup_concurrent": round(
+            loop_conc["best"]["requests_per_s"]
+            / baseline_conc["best"]["requests_per_s"],
+            2,
+        ),
+        "speedup_serial_p50": round(
+            baseline_serial["p50_ms"] / loop_serial["p50_ms"], 2
+        ),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--notebooks", type=int, default=500)
     parser.add_argument("--namespaces", type=int, default=4)
     parser.add_argument("--reconcile-passes", type=int, default=3)
     parser.add_argument("--jwa-rounds", type=int, default=25)
+    parser.add_argument(
+        "--clients",
+        default="4,8,16,32",
+        help="comma-separated closed-loop client counts to sweep",
+    )
+    # long enough that worker-process startup/straggler noise is
+    # amortised out of the elapsed window (short bursts under-read
+    # the event loop by 30%+)
+    parser.add_argument("--requests-per-client", type=int, default=100)
+    parser.add_argument(
+        "--sweep-reps",
+        type=int,
+        default=2,
+        help="repetitions of the alternating concurrent sweep "
+        "(per-tier best across all windows)",
+    )
+    parser.add_argument(
+        "--skip-web-tier",
+        action="store_true",
+        help="omit the socket-level web-tier concurrency axis",
+    )
     parser.add_argument("--out", default="BENCH_control_plane.json")
     args = parser.parse_args()
 
@@ -355,6 +650,16 @@ def main() -> None:
             uncached_jwa["p95_ms"] / cached_jwa["p95_ms"], 2
         ),
     }
+    if not args.skip_web_tier:
+        client_counts = [int(c) for c in str(args.clients).split(",") if c]
+        results["web_tier"] = bench_web_tier(
+            api,
+            namespaces,
+            client_counts,
+            args.requests_per_client,
+            sweep_reps=args.sweep_reps,
+        )
+
     cache.flush_metrics()
     results["cache_metrics"] = {
         "hits": {
@@ -378,6 +683,16 @@ def main() -> None:
         f"\nreconcile speedup: {gate_reconcile}x (gate >= 3x) | "
         f"JWA list p95 speedup: {gate_jwa}x (gate >= 2x)"
     )
+    if "web_tier" in results:
+        wt = results["web_tier"]
+        print(
+            f"web tier concurrent: {wt['speedup_concurrent']}x "
+            f"({wt['thread_baseline']['concurrent']['best']['requests_per_s']} -> "
+            f"{wt['event_loop']['concurrent']['best']['requests_per_s']} req/s, "
+            f"gate >= 10x) | serial p99 "
+            f"{wt['thread_baseline']['serial']['p99_ms']} -> "
+            f"{wt['event_loop']['serial']['p99_ms']} ms (gate: no regression)"
+        )
 
 
 if __name__ == "__main__":

@@ -456,6 +456,88 @@ class RetryWithoutBackoffRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# hot-path-json-dumps
+
+
+@register
+class HotPathJsonDumpsRule(Rule):
+    """Every JSON response the web/API tier emits must flow through
+    ``machinery.serialize.dumps`` (C-speed, byte-identical to
+    ``json.dumps``) or the serialized-bytes cache — a direct
+    ``json.dumps`` on a serving path silently reverts that response to
+    an interpreter tree walk per hit, exactly the cost the native
+    serializer removed. Scope is the serving tiers (``web/``,
+    ``machinery/``); ``machinery/serialize.py`` itself is exempt (it
+    IS the fallback). Genuinely cold or outbound sites (client request
+    bodies, cloud-API payloads, bench baselines) are marked
+    ``# dumps-ok: <reason>`` on any line of the call."""
+
+    id = "hot-path-json-dumps"
+    description = (
+        "direct json.dumps on a web/machinery serving path (bypasses "
+        "the native serializer)"
+    )
+    dirs = ("web", "machinery")
+
+    _EXEMPT_FILES = frozenset({"machinery/serialize.py"})
+
+    @staticmethod
+    def _json_module_names(tree: ast.AST) -> frozenset[str]:
+        """Local names bound to the ``json`` module (``import json``,
+        ``import json as _json``) — so a same-named ``dumps`` method on
+        some other object is never mistaken for the stdlib encoder."""
+        names = {"json"}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "json":
+                        names.add(a.asname or a.name)
+        return frozenset(names)
+
+    @staticmethod
+    def _bare_dumps_names(tree: ast.AST) -> frozenset[str]:
+        """Local names bound to ``json.dumps`` via
+        ``from json import dumps [as …]``."""
+        names = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "json":
+                for a in node.names:
+                    if a.name == "dumps":
+                        names.add(a.asname or a.name)
+        return frozenset(names)
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if src.rel in self._EXEMPT_FILES:
+            return
+        json_names = self._json_module_names(src.tree)
+        bare_names = self._bare_dumps_names(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            hit = (
+                isinstance(func, ast.Attribute)
+                and func.attr == "dumps"
+                and isinstance(func.value, ast.Name)
+                and func.value.id in json_names
+            ) or (
+                isinstance(func, ast.Name) and func.id in bare_names
+            )
+            if not hit:
+                continue
+            span = range(node.lineno, (node.end_lineno or node.lineno) + 1)
+            if any("dumps-ok" in src.line(n) for n in span):
+                continue
+            yield self.finding(
+                src,
+                node,
+                "direct json.dumps on a serving path; route through "
+                "machinery.serialize.dumps (or the serialized-bytes "
+                "cache), or annotate with `# dumps-ok: <reason>`",
+            )
+
+
+# ---------------------------------------------------------------------------
 # metric-naming
 
 

@@ -36,10 +36,12 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Iterable, Optional
 
 from odh_kubeflow_tpu.analysis import sanitizer as _sanitizer
 from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery import serialize
 from odh_kubeflow_tpu.machinery.objects import (  # noqa: F401 — public API
     FrozenDict,
     FrozenList,
@@ -121,6 +123,7 @@ class _KindCache:
         "last_event",
         "degraded",
         "retry_at",
+        "version",
     )
 
     def __init__(self):
@@ -136,6 +139,12 @@ class _KindCache:
         # succeeded yet; reads keep serving last-known-good state
         self.degraded = False
         self.retry_at = 0.0  # earliest next reestablish attempt
+        # monotonic mutation counter for THIS mirror's visible state —
+        # bumped on every insert/evict/rebuild, so consumers can key
+        # memoized derivations (listing memo, bytes caches) on exactly
+        # what the cache serves rather than the store's rv (which may
+        # be ahead of an unapplied event)
+        self.version = 0
 
 
 class InformerCache:
@@ -266,6 +275,16 @@ class InformerCache:
         kc = self._kinds.get(kind)
         return kc is not None and kc.degraded
 
+    def mirror_version(self, kind: str) -> int:
+        """Monotonic counter of THIS mirror's visible mutations for
+        ``kind`` (0 before any apply). Unlike the store's rv, it moves
+        exactly when a read of this cache could observe different
+        state, so memoized derivations (the web tier's listing memo)
+        key on it: equal versions ⇒ byte-identical list output."""
+        with self._lock:
+            kc = self._kinds.get(kind)
+            return 0 if kc is None else kc.version
+
     def any_degraded(self) -> bool:
         with self._lock:
             return any(kc.degraded for kc in self._kinds.values())
@@ -388,6 +407,9 @@ class InformerCache:
         than the snapshot are ignored afterwards by the rv guard."""
         with self._lock:
             kc = self._kinds[kind]
+            # own bump: an empty snapshot inserts nothing, yet evicts
+            # everything — the version must still move
+            kc.version += 1
             kc.objects = {}
             kc.by_ns = {}
             kc.indexes = {name: {} for name in kc.indexers}
@@ -483,6 +505,7 @@ class InformerCache:
             return 0
 
     def _insert(self, kc: _KindCache, key: Key, obj: Obj) -> None:
+        kc.version += 1
         kc.objects[key] = obj
         kc.by_ns.setdefault(key[0], {})[key] = obj
         for name, fn in kc.indexers.items():
@@ -494,6 +517,7 @@ class InformerCache:
         old = kc.objects.pop(key, None)
         if old is None:
             return
+        kc.version += 1
         bucket = kc.by_ns.get(key[0])
         if bucket is not None:
             bucket.pop(key, None)
@@ -778,6 +802,129 @@ class InformerCache:
             }
 
 
+class SerializedBytesCache:
+    """Bounded LRU of serialized response bytes keyed by on-the-wire
+    identity: ``(kind, namespace, name, resourceVersion)``.
+
+    The apiserver's object contents are immutable per resourceVersion
+    (every change stamps a fresh rv — deletions included), so the key
+    IS the content hash: nothing ever needs explicit invalidation, a
+    changed object simply serializes under its new rv while the stale
+    entry ages out of the LRU. One instance per serving tier (RestAPI)
+    — rv counters are per-store, so a process-global cache could alias
+    objects across the independent stores tests create.
+
+    Two views share the underlying object bytes:
+
+    - ``obj_bytes(obj)``: the object itself (single GETs, write
+      responses, and the items of a composed list — a cached namespace
+      list is a memcpy-join of these on a hit, zero serialization);
+    - ``event_bytes(etype, obj)``: the full watch wire line
+      ``{"type": ..., "object": ...}\\n``, composed from ``obj_bytes``
+      and cached per event type — every subscriber of the same event
+      fans out the SAME bytes object, so an event is serialized exactly
+      once no matter how many watchers are connected.
+
+    Objects without kind/name/resourceVersion (Status docs, synthetic
+    bodies) bypass the cache and serialize directly.
+    """
+
+    def __init__(self, capacity: int = 8192):
+        self.capacity = capacity
+        self._lock = _sanitizer.new_lock("serialized-bytes-cache")
+        self._data: "OrderedDict[tuple, bytes]" = OrderedDict()
+        # plain monotonic ints (same posture as the informer's hot-path
+        # counters): a lock+label Counter.inc per response would cost
+        # more than the serialization it saves
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _key(obj: Obj, variant: str = "") -> Optional[tuple]:
+        m = obj.get("metadata")
+        if not isinstance(m, dict):
+            return None
+        rv = m.get("resourceVersion")
+        name = m.get("name")
+        if not rv or not name:
+            return None
+        return (
+            variant,
+            obj.get("kind", ""),
+            m.get("namespace") or "",
+            name,
+            rv,
+        )
+
+    def _get(self, key: tuple) -> Optional[bytes]:
+        with self._lock:
+            data = self._data.get(key)
+            if data is not None:
+                self._data.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+            return data
+
+    def _put(self, key: tuple, data: bytes) -> None:
+        with self._lock:
+            self._data[key] = data
+            self._data.move_to_end(key)
+            while len(self._data) > self.capacity:
+                self._data.popitem(last=False)
+
+    def obj_bytes(self, obj: Obj) -> bytes:
+        key = self._key(obj)
+        if key is None:
+            return serialize.dumps(obj)
+        data = self._get(key)
+        if data is None:
+            data = serialize.dumps(obj)  # outside the lock
+            self._put(key, data)
+        return data
+
+    def event_bytes(self, etype: str, obj: Obj) -> bytes:
+        key = self._key(obj, variant=etype)
+        if key is None:
+            return (
+                b'{"type": ' + serialize.dumps(etype)
+                + b', "object": ' + serialize.dumps(obj) + b"}\n"
+            )
+        data = self._get(key)
+        if data is None:
+            # composed, not re-serialized: the object bytes are shared
+            # with obj_bytes consumers (list items, single GETs)
+            data = (
+                b'{"type": "' + etype.encode() + b'", "object": '
+                + self.obj_bytes(obj) + b"}\n"
+            )
+            self._put(key, data)
+        return data
+
+    def list_bytes(self, kind: str, items: Iterable[Obj]) -> bytes:
+        """The full ``{kind}List`` response payload, byte-identical to
+        ``json.dumps({"kind": f"{kind}List", "apiVersion": "v1",
+        "items": [...]})``, composed from per-object cached bytes."""
+        inner = b", ".join(self.obj_bytes(o) for o in items)
+        return (
+            b'{"kind": "' + kind.encode() + b'List", "apiVersion": "v1", '
+            b'"items": [' + inner + b"]}"
+        )
+
+    # whole-list payloads, keyed by the store's per-kind mutation
+    # version (``APIServer.kind_version``): between bumps a kind's list
+    # output is immutable, so a repeat list request serves the SAME
+    # bytes without touching the store — no per-object deepcopy, no
+    # selector walk, no serialization. This is what makes a cached
+    # namespace list "one C call end-to-end" on a hit.
+
+    def list_payload(self, key: tuple) -> Optional[bytes]:
+        return self._get(("LIST",) + key)
+
+    def store_list_payload(self, key: tuple, payload: bytes) -> None:
+        self._put(("LIST",) + key, payload)
+
+
 class CachedClient:
     """APIServer-duck-typed façade: reads served from the informer
     cache (zero-copy hits), writes and uncached kinds delegated to the
@@ -856,6 +1003,23 @@ class CachedClient:
             c._hits[kind] = c._hits.get(kind, 0) + 1
             return c.index_buckets(kind, index)
         return None
+
+    def listing_versions(self, kinds: tuple[str, ...]) -> Optional[tuple]:
+        """Mirror versions for a listing's whole read set, or None when
+        any kind is still store-served (unsynced, unregistered) — a
+        memo key must cover every kind the rows derive from, and store
+        reads have no cheap version to key on. ``_serving`` pokes each
+        kind first, so pending events are applied (and counted) before
+        the version is read: read-your-writes holds for the memo
+        exactly as it does for the reads themselves."""
+        if not kinds:
+            return None
+        versions = []
+        for kind in kinds:
+            if not self._serving(kind):
+                return None
+            versions.append(self.cache.mirror_version(kind))
+        return tuple(versions)
 
     # -- everything else (writes, watches, registry) -------------------------
 

@@ -316,7 +316,12 @@ class RemoteAPIServer:
     ) -> Obj:
         self._throttle()
         url = self.base_url + path + (f"?{query}" if query else "")
-        data = json.dumps(body).encode() if body is not None else None
+        # outbound request body (write path, not a serving response)
+        data = (
+            json.dumps(body).encode()  # dumps-ok: outbound request body
+            if body is not None
+            else None
+        )
         req = urllib.request.Request(
             url, data=data, method=method, headers=self._headers(),
         )
@@ -476,6 +481,7 @@ class RemoteAPIServer:
                     w.ended = True
                 connected.set()  # release a waiting opener either way
                 w._q.put(None)
+                w._wake()  # event-loop consumers parked on set_notify
 
         def _pump_loop():
             rv = resource_version

@@ -112,7 +112,8 @@ class GcpIamClient:
             method,
             f"{self.endpoint}{path}",
             headers,
-            json.dumps(body).encode() if body is not None else None,
+            # outbound cloud-API request body, not a serving path
+            json.dumps(body).encode() if body is not None else None,  # dumps-ok: outbound
         )
         if status == 409:
             raise _EtagConflict()
@@ -342,7 +343,8 @@ class AwsIamClient:
             {
                 "Action": "UpdateAssumeRolePolicy",
                 "RoleName": self._role_name(role_arn),
-                "PolicyDocument": json.dumps(doc),
+                # outbound cloud-API payload, not a serving path
+                "PolicyDocument": json.dumps(doc),  # dumps-ok: outbound
             }
         )
 

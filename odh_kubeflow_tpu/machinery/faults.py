@@ -174,6 +174,7 @@ class FaultInjector:
         except (AttributeError, OSError):
             pass  # duck-typed server without watch bookkeeping
         w._q.put(None)
+        w._wake()  # event-loop consumers parked on set_notify
         self._forget_watch(w)
         self._count("watch_drop")
 

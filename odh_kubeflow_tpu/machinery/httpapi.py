@@ -30,12 +30,19 @@ import json
 import math
 import os
 import threading
-from typing import Any, Iterator, Optional
+from typing import Any, Optional
 from urllib.parse import parse_qs
 from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
 from socketserver import ThreadingMixIn
 
 from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery import serialize
+from odh_kubeflow_tpu.machinery.cache import SerializedBytesCache
+from odh_kubeflow_tpu.machinery.eventloop import (
+    EventLoopServer,
+    WatchBody,
+    event_loop_enabled,
+)
 from odh_kubeflow_tpu.utils import tracing
 from odh_kubeflow_tpu.utils.prometheus import Registry
 from odh_kubeflow_tpu.machinery.store import (
@@ -193,6 +200,7 @@ class RestAPI:
         authenticator: Optional[Any] = None,  # environ -> username | None
         metrics_registry: Optional[Registry] = None,
         inflight_limit: Optional[int] = None,
+        fast_serialize: bool = True,
     ):
         self.server = server
         self.authenticator = authenticator
@@ -201,17 +209,42 @@ class RestAPI:
         self.metrics_registry = metrics_registry
         limit = DEFAULT_INFLIGHT_LIMIT if inflight_limit is None else inflight_limit
         self.limiter = InflightLimiter(limit) if limit > 0 else None
+        # per-(kind, rv) serialized-bytes cache: list responses compose
+        # from per-object bytes and watch events serialize ONCE for all
+        # subscribers. fast_serialize=False is the bench's pre-PR
+        # baseline (plain json.dumps per response, no byte reuse).
+        self.fast_serialize = fast_serialize
+        self.bytes_cache = SerializedBytesCache() if fast_serialize else None
 
     # -- helpers ------------------------------------------------------------
 
     def _resolve_kind(self, plural: str) -> str:
         return self.server.kind_for_plural(plural)
 
-    @staticmethod
     def _json(
-        status: int, body: Obj, start_response, headers=()
+        self, status: int, body: Obj, start_response, headers=()
     ) -> list[bytes]:
-        payload = json.dumps(body).encode()
+        if self.fast_serialize:
+            payload = serialize.dumps(body)
+        else:
+            payload = json.dumps(body).encode()  # dumps-ok: legacy baseline
+        return self._raw(status, payload, start_response, headers)
+
+    def _object(
+        self, status: int, obj: Obj, start_response, headers=()
+    ) -> list[bytes]:
+        """Single-object response through the bytes cache — a GET of an
+        unchanged object (same rv) is a cache hit, and the bytes are
+        shared with the list/watch views of the same rv."""
+        if self.bytes_cache is not None:
+            payload = self.bytes_cache.obj_bytes(obj)
+            return self._raw(status, payload, start_response, headers)
+        return self._json(status, obj, start_response, headers)
+
+    @staticmethod
+    def _raw(
+        status: int, payload: bytes, start_response, headers=()
+    ) -> list[bytes]:
         start_response(
             f"{status} {'OK' if status < 400 else 'Error'}",
             [
@@ -222,11 +255,10 @@ class RestAPI:
         )
         return [payload]
 
-    @staticmethod
     def _error(
-        status: int, message: str, start_response, reason: str = "", headers=()
+        self, status: int, message: str, start_response, reason: str = "", headers=()
     ) -> list[bytes]:
-        return RestAPI._json(
+        return self._json(
             status,
             {
                 "kind": "Status",
@@ -242,26 +274,28 @@ class RestAPI:
             headers=headers,
         )
 
-    def _watch_stream(self, w) -> Iterator[bytes]:
-        try:
-            # immediate greeting: wsgiref only flushes status+headers
-            # with the first body bytes, and the client's watch opener
-            # blocks in urlopen until they arrive. The watch is already
-            # registered, so greeting NOW (instead of at the first
-            # event/15s heartbeat) is what makes the client's
-            # watch-then-list ordering guarantee real over HTTP.
-            yield b'{"type":"HEARTBEAT"}\n'
-            while True:
-                item = w.get(timeout=WATCH_HEARTBEAT_SECONDS)
-                if item is None:
-                    # queue timeout → heartbeat; a dead client raises on
-                    # the write and the finally stops the watch
-                    yield b'{"type":"HEARTBEAT"}\n'
-                    continue
+    def _watch_stream(self, w) -> WatchBody:
+        """Wrap a store Watch for streaming. The event-loop server
+        pumps the returned body on the loop (no thread pinned); plain
+        WSGI consumers iterate it (one blocking thread, the old
+        behaviour). Framing goes through the serialized-bytes cache:
+        the same event fans the SAME bytes to every subscriber, so one
+        store write costs one serialization no matter how many watch
+        streams are connected."""
+        if self.bytes_cache is not None:
+            frame = lambda item: self.bytes_cache.event_bytes(*item)  # noqa: E731
+        else:
+
+            def frame(item):
                 etype, obj = item
-                yield json.dumps({"type": etype, "object": obj}).encode() + b"\n"
-        finally:
-            w.stop()
+                return (
+                    json.dumps(  # dumps-ok: legacy baseline (fast_serialize=False)
+                        {"type": etype, "object": obj}
+                    ).encode()
+                    + b"\n"
+                )
+
+        return WatchBody(w, frame, heartbeat=WATCH_HEARTBEAT_SECONDS)
 
     # -- WSGI ---------------------------------------------------------------
 
@@ -317,7 +351,7 @@ class RestAPI:
                     ],
                 )
                 return [
-                    json.dumps(
+                    serialize.dumps(
                         {
                             "kind": "Status",
                             "status": "Failure",
@@ -325,7 +359,7 @@ class RestAPI:
                             "reason": "Unauthorized",
                             "code": 401,
                         }
-                    ).encode()
+                    )
                 ]
             environ["odh.authenticated.user"] = user
         if path == "/version":
@@ -454,7 +488,36 @@ class RestAPI:
             selector = None
             if "labelSelector" in qs:
                 selector = obj_util.parse_selector_string(qs["labelSelector"][0])
+            ver_fn = getattr(self.server, "kind_version", None)
+            if self.bytes_cache is not None and ver_fn is not None:
+                # whole-payload hit path: the version is read BEFORE
+                # the list, so a racing writer can only make a cached
+                # snapshot NEWER than its key — never stale — and its
+                # bump moves every later request to a fresh key
+                lkey = (
+                    kind,
+                    ns or "",
+                    qs.get("labelSelector", [""])[0],
+                    ver_fn(kind),
+                )
+                payload = self.bytes_cache.list_payload(lkey)
+                if payload is None:
+                    items = self.server.list(
+                        kind, namespace=ns, label_selector=selector
+                    )
+                    payload = self.bytes_cache.list_bytes(kind, items)
+                    self.bytes_cache.store_list_payload(lkey, payload)
+                return self._raw(200, payload, start_response)
             items = self.server.list(kind, namespace=ns, label_selector=selector)
+            if self.bytes_cache is not None:
+                # composed from per-object cached bytes: a repeat list
+                # of unchanged objects (same rvs) serializes NOTHING —
+                # the hot cached-namespace-list path is a memcpy join
+                return self._raw(
+                    200,
+                    self.bytes_cache.list_bytes(kind, items),
+                    start_response,
+                )
             return self._json(
                 200,
                 {"kind": f"{kind}List", "apiVersion": "v1", "items": items},
@@ -462,7 +525,9 @@ class RestAPI:
             )
 
         if method == "GET":
-            return self._json(200, self.server.get(kind, name, ns), start_response)
+            return self._object(
+                200, self.server.get(kind, name, ns), start_response
+            )
 
         if method == "POST" and name is None:
             obj = self._read_body(environ)
@@ -470,7 +535,14 @@ class RestAPI:
             if ns and not obj.setdefault("metadata", {}).get("namespace"):
                 obj["metadata"]["namespace"] = ns
             dry = qs.get("dryRun", [""])[0] == "All"
-            return self._json(201, self.server.create(obj, dry_run=dry), start_response)
+            created = self.server.create(obj, dry_run=dry)
+            if dry:
+                # NOT through the bytes cache: a dry-run echo carries
+                # whatever resourceVersion the client sent, and caching
+                # bytes under a forged (name, rv) would poison later
+                # reads of the real object at that rv
+                return self._json(201, created, start_response)
+            return self._object(201, created, start_response)
 
         if method == "PUT" and name is not None:
             obj = self._read_body(environ)
@@ -486,8 +558,10 @@ class RestAPI:
                     f"does not match URL ({ns}/{name})"
                 )
             if route.subresource == "status":
-                return self._json(200, self.server.update_status(obj), start_response)
-            return self._json(200, self.server.update(obj), start_response)
+                return self._object(
+                    200, self.server.update_status(obj), start_response
+                )
+            return self._object(200, self.server.update(obj), start_response)
 
         if method == "PATCH" and name is not None:
             patch = self._read_body(environ)
@@ -499,7 +573,7 @@ class RestAPI:
                     "patch may not change metadata.name/namespace "
                     f"({pmeta.get('namespace')}/{pmeta.get('name')} vs URL {ns}/{name})"
                 )
-            return self._json(
+            return self._object(
                 200, self.server.patch(kind, name, patch, ns), start_response
             )
 
@@ -529,9 +603,20 @@ def serve(
     authenticator: Optional[Any] = None,
     metrics_registry: Optional[Registry] = None,
     inflight_limit: Optional[int] = None,
+    event_loop: Optional[bool] = None,
+    workers: Optional[int] = None,
+    fast_serialize: bool = True,
 ) -> tuple[threading.Thread, int, Any]:
-    """Serve the REST façade on a daemon thread; returns (thread,
-    bound_port, httpd). ``httpd.shutdown()`` stops it.
+    """Serve the REST façade; returns (thread, bound_port, httpd).
+    ``httpd.shutdown()`` stops it.
+
+    Serving defaults to the asyncio event loop
+    (``machinery/eventloop.py``): all connections and watch streams
+    multiplex on one loop thread (a watch no longer pins a thread for
+    its lifetime) and handler bodies run in a small worker pool.
+    ``event_loop=False`` / ``WEB_EVENT_LOOP=false`` keeps the legacy
+    thread-per-request server; ``fast_serialize=False`` additionally
+    disables the native serializer + bytes cache (the bench baseline).
 
     ``ssl_context`` (an ``ssl.SSLContext``) serves HTTPS — the posture
     a real kube-apiserver always has; ``authenticator`` (see
@@ -543,7 +628,15 @@ def serve(
         authenticator=authenticator,
         metrics_registry=metrics_registry,
         inflight_limit=inflight_limit,
+        fast_serialize=fast_serialize,
     )
+    if event_loop is None:
+        event_loop = event_loop_enabled()
+    if event_loop:
+        srv = EventLoopServer(
+            app, host=host, port=port, ssl_context=ssl_context, workers=workers
+        )
+        return srv._thread, srv.server_address[1], srv
     httpd = make_server(
         host, port, app, server_class=_ThreadingServer, handler_class=_QuietHandler
     )

@@ -1,0 +1,91 @@
+"""C-speed JSON serialization for the web/API tier.
+
+``dumps(obj)`` is byte-identical to ``json.dumps(obj).encode()`` —
+that's the contract every consumer (microweb responses, the REST
+façade, watch-event framing) relies on, and tests/test_webtier.py
+proves it across fixtures, a randomized tree property, and with the
+native extension absent. The native path
+(``native/jsontree.cpp::dumps``) walks the tree with direct C-API
+calls — including the ``FrozenDict``/``FrozenList`` subclasses the
+informer cache hands out — and falls back to the stdlib for anything
+it cannot prove it serializes identically, so parity holds by
+construction.
+
+Engine resolution mirrors ``objects.deepcopy``: lazy first-use probe,
+pure-Python fallback when no compiler/extension is available.
+``set_engine("python")`` pins the stdlib path (the bench's baseline
+and the fallback-parity tests); ``set_engine(None)`` restores the
+automatic probe. ``dumps_count()`` is the serialize-once
+instrumentation: the watch fan-out contract (each event serialized
+exactly once regardless of subscriber count) is asserted by sampling
+it, the same way ``deepcopy_count()`` guards zero-copy reads.
+"""
+
+from __future__ import annotations
+
+import json as _json
+from typing import Any, Optional
+
+# instrumentation: every tree serialization bumps this (cheap int add
+# under the GIL); the serialized-bytes cache's hit path never calls
+# dumps, so tests assert fan-out/caching contracts by sampling it
+dumps_calls = 0
+
+_native_dumps = None
+_native_tried = False
+_forced_engine: Optional[str] = None  # None = auto, "python", "native"
+
+
+def _py_dumps(obj: Any) -> bytes:
+    return _json.dumps(obj).encode()
+
+
+def _resolve():
+    global _native_dumps, _native_tried
+    if not _native_tried:
+        _native_tried = True
+        try:
+            from odh_kubeflow_tpu import native
+
+            _native_dumps = native.jsontree_dumps()
+        except Exception:  # noqa: BLE001 — any native failure → Python
+            _native_dumps = None
+    return _native_dumps
+
+
+def set_engine(name: Optional[str]) -> None:
+    """Pin the serialization engine: ``"python"`` (stdlib json),
+    ``"native"`` (raise if the extension is unavailable), or ``None``
+    to restore the automatic probe. Benches pin the baseline with
+    this; tests pin "python" for the fallback-parity run."""
+    global _forced_engine
+    if name not in (None, "python", "native"):
+        raise ValueError(f"unknown serialize engine {name!r}")
+    if name == "native" and _resolve() is None:
+        raise RuntimeError("native serializer unavailable (no C++ compiler)")
+    _forced_engine = name
+
+
+def engine() -> str:
+    """The engine ``dumps`` resolves to right now."""
+    if _forced_engine is not None:
+        return _forced_engine
+    return "native" if _resolve() is not None else "python"
+
+
+def dumps(obj: Any) -> bytes:
+    """``json.dumps(obj).encode()`` with exact byte parity, at C speed
+    when the native extension is available."""
+    global dumps_calls
+    dumps_calls += 1
+    if _forced_engine == "python":
+        return _py_dumps(obj)
+    fn = _resolve()
+    if fn is not None:
+        return fn(obj)
+    return _py_dumps(obj)
+
+
+def dumps_count() -> int:
+    """Total ``dumps`` invocations since import (monotonic)."""
+    return dumps_calls

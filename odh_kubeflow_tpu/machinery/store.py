@@ -174,14 +174,37 @@ class Watch:
         self._stopped = False
         self.ended = False
         self.error: Optional[Exception] = None
+        self._notify_cb: Optional[Callable[[], None]] = None
+
+    def set_notify(self, fn: Optional[Callable[[], None]]) -> None:
+        """Register a wake callback fired (from the enqueuing thread)
+        whenever an event or the end/stop sentinel lands. This is the
+        event-loop server's multiplexing hook: instead of pinning a
+        thread per watch on a blocking ``get``, the async pump parks on
+        an ``asyncio.Event`` the callback sets via
+        ``call_soon_threadsafe``. Fired once on registration so events
+        already queued are never missed."""
+        self._notify_cb = fn
+        if fn is not None:
+            self._wake()
+
+    def _wake(self) -> None:
+        cb = self._notify_cb
+        if cb is not None:
+            try:
+                cb()
+            except RuntimeError:
+                pass  # the consumer's event loop is shutting down
 
     def _enqueue(self, event: tuple[str, Obj]) -> None:
         if not self._stopped:
             self._q.put(event)
+            self._wake()
 
     def stop(self) -> None:
         self._stopped = True
         self._q.put(None)
+        self._wake()
         self._server._remove_watch(self)
 
     def events(self, timeout: Optional[float] = None) -> Iterator[tuple[str, Obj]]:
@@ -232,6 +255,9 @@ class APIServer:
         # instead of scanning (and copying survivors of) the cluster
         self._ns_buckets: dict[str, dict[str, dict[tuple[str, str], Obj]]] = {}
         self._rv = 0
+        # kind → rv of its last mutation (see kind_version): the
+        # serving tier's whole-list-payload cache key
+        self._kind_rv: dict[str, int] = {}
         self._watches: list[Watch] = []
         self._hooks: list[_Hook] = []
         self._event_index: dict[tuple, str] = {}
@@ -606,10 +632,22 @@ class APIServer:
             if w in self._watches:
                 self._watches.remove(w)
 
+    def kind_version(self, kind: str) -> int:
+        """The resourceVersion of the last mutation that touched
+        ``kind`` (0 if never touched). This is the serving tier's
+        list-payload cache key: per-kind list output is immutable
+        between bumps, so ``(kind, namespace, selector,
+        kind_version)`` identifies a whole serialized list response —
+        a repeat list is served from bytes without touching the store
+        at all."""
+        with self._lock:
+            return self._kind_rv.get(kind, 0)
+
     def _notify(self, event_type: str, obj: Obj) -> None:
         kind = obj.get("kind", "")
         meta = obj.get("metadata", {})
         ns = meta.get("namespace", "")
+        self._kind_rv[kind] = self._rv
         # ONE frozen snapshot per event, shared by every watcher AND the
         # watch cache: the old per-watcher deepcopy made each write
         # O(watchers × size). freeze() builds an independent read-only

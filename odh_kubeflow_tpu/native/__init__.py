@@ -33,7 +33,7 @@ _JT_SO = os.path.join(_DIR, "_odhkf_jsontree.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
-_jt_fn = None
+_jt_mod = None
 _jt_tried = False
 
 
@@ -114,17 +114,15 @@ def available() -> bool:
     return _load() is not None
 
 
-def jsontree_deepcopy():
-    """The C deepcopy for JSON-shaped trees (machinery/objects.py's
-    hot path), or None when it can't build/load. Lazy-built and cached
-    like the packer; parity with the Python fallback is contract-tested
-    in tests/test_native.py."""
-    global _jt_fn, _jt_tried
+def _jsontree_module():
+    """The lazily built+loaded jsontree extension module, or None.
+    One compile+load serves both entry points (deepcopy and dumps)."""
+    global _jt_mod, _jt_tried
     if _jt_tried:
-        return _jt_fn
+        return _jt_mod
     with _lock:
         if _jt_tried:
-            return _jt_fn
+            return _jt_mod
         try:
             import sysconfig
 
@@ -142,11 +140,47 @@ def jsontree_deepcopy():
                 spec = spec_from_loader("_odhkf_jsontree", loader)
                 mod = module_from_spec(spec)
                 loader.exec_module(mod)
-                _jt_fn = mod.deepcopy
+                _jt_mod = mod
         except (OSError, subprocess.CalledProcessError, ImportError):
-            _jt_fn = None
+            _jt_mod = None
         _jt_tried = True
-    return _jt_fn
+    return _jt_mod
+
+
+def jsontree_deepcopy():
+    """The C deepcopy for JSON-shaped trees (machinery/objects.py's
+    hot path), or None when it can't build/load. Lazy-built and cached
+    like the packer; parity with the Python fallback is contract-tested
+    in tests/test_native.py."""
+    mod = _jsontree_module()
+    return None if mod is None else mod.deepcopy
+
+
+def jsontree_dumps():
+    """The C serializer for JSON-shaped trees (the web/API tier's hot
+    response path; machinery/serialize.py fronts it), or None when it
+    can't build/load. The returned callable has EXACT ``json.dumps(obj)
+    .encode()`` parity: the extension raises its ``Fallback`` exception
+    for any input it cannot prove it serializes identically (non-str
+    dict keys, exotic leaves) and this wrapper re-serializes with the
+    stdlib — so behaviour, output bytes, and error messages all match.
+    Capability-probed: a stale prebuilt .so without the ``dumps`` entry
+    point degrades to None (callers use the pure-Python path)."""
+    mod = _jsontree_module()
+    if mod is None or not hasattr(mod, "dumps") or not hasattr(mod, "Fallback"):
+        return None  # stale .so from before the dumps entry point
+    import json as _json
+
+    c_dumps = mod.dumps
+    fallback = mod.Fallback
+
+    def dumps(obj):
+        try:
+            return c_dumps(obj)
+        except fallback:
+            return _json.dumps(obj).encode()
+
+    return dumps
 
 
 def _i32p(a: np.ndarray):

@@ -238,6 +238,12 @@ class Platform:
 
 
 def _serve_wsgi(app, host: str, port: int) -> tuple[threading.Thread, int, Any]:
+    from odh_kubeflow_tpu.machinery import eventloop
+
+    if eventloop.event_loop_enabled():
+        srv = eventloop.serve_wsgi(app, host, port)
+        return srv._thread, srv.server_address[1], srv
+
     from wsgiref.simple_server import make_server
 
     httpd = make_server(

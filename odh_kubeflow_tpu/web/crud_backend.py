@@ -105,6 +105,14 @@ class CrudBackend:
         # mask-transient-infrastructure-failures posture)
         self._lkg: dict[Any, list] = {}
         self._lkg_lock = threading.Lock()
+        # listing memo: rows keyed by the mirror versions of every kind
+        # they derive from — a repeat listing with an unchanged cache
+        # skips row building entirely (the web-tier hot path becomes
+        # memo lookup + serialization, which the bytes cache also skips
+        # on a hit). Only populated when the api can version the whole
+        # read set (CachedClient.listing_versions); store-served apps
+        # rebuild every time, exactly as before.
+        self._listing_memo: dict[Any, tuple[tuple, list]] = {}
         install_csrf(self.app)
         self._install_probes()
         self._install_errors()
@@ -163,7 +171,21 @@ class CrudBackend:
         when the backend is unreachable (5xx/429/network), serve the
         remembered rows — possibly empty — with ``degraded=True``
         instead of failing the request. ``kinds`` lets an informer
-        cache's own degraded state mark even successful (stale) reads."""
+        cache's own degraded state mark even successful (stale) reads.
+
+        ``kinds`` must name EVERY kind the rows derive from: it doubles
+        as the listing-memo key (rows are reused while all those mirror
+        versions hold still), so a kind missing from it would serve
+        stale rows after that kind changed."""
+        versions_fn = getattr(self.api, "listing_versions", None)
+        versions = versions_fn(kinds) if versions_fn is not None else None
+        if versions is not None:
+            # versions read BEFORE build: a write landing mid-build can
+            # only make the memoized rows NEWER than their key — the
+            # next request misses and rebuilds, never serves stale
+            memo = self._listing_memo.get(key)
+            if memo is not None and memo[0] == versions:
+                return list(memo[1]), self.backend_degraded(*kinds)
         try:
             rows = build()
         except _OUTAGE_ERRORS as e:
@@ -180,6 +202,8 @@ class CrudBackend:
         degraded = self.backend_degraded(*kinds)
         with self._lkg_lock:
             self._lkg[key] = list(rows)
+            if versions is not None:
+                self._listing_memo[key] = (versions, list(rows))
         return rows, degraded
 
     def listing_body(

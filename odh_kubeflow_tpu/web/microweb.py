@@ -10,6 +10,7 @@ the exact surface the CRUD backends need.
 
 from __future__ import annotations
 
+import http
 import json
 import mimetypes
 import os
@@ -21,6 +22,8 @@ from http.cookies import SimpleCookie
 from typing import Any, Callable, Optional
 from wsgiref.simple_server import WSGIServer, make_server
 from socketserver import ThreadingMixIn
+
+from odh_kubeflow_tpu.machinery import serialize
 
 
 class HTTPError(Exception):
@@ -89,7 +92,10 @@ class Response:
         self.status = status
         self.headers = dict(headers or {})
         if isinstance(body, (dict, list)):
-            self.body = json.dumps(body).encode()
+            # C-speed serialization with json.dumps byte parity — the
+            # frozen zero-copy trees the informer cache hands out go
+            # straight to bytes without an interpreter tree walk
+            self.body = serialize.dumps(body)
             self.headers.setdefault("Content-Type", "application/json")
         elif isinstance(body, str):
             self.body = body.encode()
@@ -111,8 +117,23 @@ _STATUS_TEXT = {
     200: "OK", 201: "Created", 204: "No Content", 301: "Moved Permanently",
     302: "Found", 400: "Bad Request", 401: "Unauthorized", 403: "Forbidden",
     404: "Not Found", 405: "Method Not Allowed", 409: "Conflict",
-    422: "Unprocessable Entity", 500: "Internal Server Error",
+    410: "Gone", 422: "Unprocessable Entity", 429: "Too Many Requests",
+    500: "Internal Server Error", 503: "Service Unavailable",
 }
+
+
+def _status_text(status: int) -> str:
+    """Reason phrase for a status code. Codes outside the common table
+    fall back to the stdlib registry — an unknown code must not emit a
+    status line with an empty reason phrase (the 410/429/503 responses
+    the chaos-hardened paths send did exactly that before)."""
+    text = _STATUS_TEXT.get(status)
+    if text is None:
+        try:
+            text = http.HTTPStatus(status).phrase
+        except ValueError:
+            text = "Unknown"
+    return text
 
 
 class Blueprint:
@@ -276,17 +297,41 @@ class App:
                 response = Response(
                     {"success": False, "status": 500, "log": str(e)}, 500
                 )
-        status_line = f"{response.status} {_STATUS_TEXT.get(response.status, '')}"
+        status_line = f"{response.status} {_status_text(response.status)}"
         start_response(status_line, list(response.headers.items()))
         return [response.body]
 
     # -- serving ------------------------------------------------------------
 
-    def serve(self, host: str = "127.0.0.1", port: int = 0, ssl_context=None):
+    def serve(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        ssl_context=None,
+        event_loop: Optional[bool] = None,
+        workers: Optional[int] = None,
+    ):
         """Start a daemon-thread server. ``ssl_context`` (an
         ``ssl.SSLContext``) upgrades it to HTTPS — the admission webhook
         serves AdmissionReview this way, since a real kube-apiserver
-        only calls webhooks over TLS."""
+        only calls webhooks over TLS.
+
+        Serving defaults to the asyncio event-loop front end
+        (``machinery/eventloop.py``): connections multiplex on one loop
+        thread and handler bodies run in a small worker pool instead of
+        a thread per request. ``event_loop=False`` (or
+        ``WEB_EVENT_LOOP=false``) keeps the legacy thread-per-request
+        server — the bench's baseline and an operational escape hatch.
+        Both return an object with ``server_address`` and
+        ``shutdown()``."""
+        from odh_kubeflow_tpu.machinery import eventloop
+
+        if event_loop is None:
+            event_loop = eventloop.event_loop_enabled()
+        if event_loop:
+            return eventloop.serve_wsgi(
+                self, host, port, ssl_context=ssl_context, workers=workers
+            )
 
         class ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
             daemon_threads = True

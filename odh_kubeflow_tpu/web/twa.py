@@ -36,7 +36,7 @@ class TensorboardsWebApp(CrudBackend):
                     self.tensorboard_row(tb)
                     for tb in self.api.list("Tensorboard", namespace=namespace)
                 ],
-                kinds=("Tensorboard",),
+                kinds=("Tensorboard", "Event"),
             )
             return success(self.listing_body("tensorboards", rows, degraded))
 

@@ -33,7 +33,7 @@ class VolumesWebApp(CrudBackend):
                         "PersistentVolumeClaim", namespace=namespace
                     )
                 ],
-                kinds=("PersistentVolumeClaim", "Pod"),
+                kinds=("PersistentVolumeClaim", "Pod", "Event"),
             )
             return success(self.listing_body("pvcs", rows, degraded))
 

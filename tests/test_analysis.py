@@ -39,6 +39,7 @@ def test_rule_catalog_has_the_platform_rules():
         "blocking-under-lock",
         "metric-naming",
         "retry-without-backoff",
+        "hot-path-json-dumps",
     } <= ids
     assert len(ids) >= 5
 
@@ -153,6 +154,66 @@ def test_uncached_list_explicit_none_namespace_still_flagged():
     assert rule_ids(lint_source(src, "web/x.py", ["uncached-list"])) == [
         "uncached-list"
     ]
+
+
+# ---------------------------------------------------------------------------
+# hot-path-json-dumps
+
+
+def test_hot_path_json_dumps_true_positive():
+    src = "import json\ndef handler(obj):\n    return json.dumps(obj).encode()\n"
+    fs = lint_source(src, "web/x.py", ["hot-path-json-dumps"])
+    assert rule_ids(fs) == ["hot-path-json-dumps"] and fs[0].line == 3
+
+
+def test_hot_path_json_dumps_sees_aliases():
+    src = "import json as _json\ndef f(o):\n    return _json.dumps(o)\n"
+    assert rule_ids(
+        lint_source(src, "machinery/x.py", ["hot-path-json-dumps"])
+    ) == ["hot-path-json-dumps"]
+    src = "from json import dumps\ndef f(o):\n    return dumps(o)\n"
+    assert rule_ids(
+        lint_source(src, "web/x.py", ["hot-path-json-dumps"])
+    ) == ["hot-path-json-dumps"]
+
+
+def test_hot_path_json_dumps_marker_suppresses():
+    src = (
+        "import json\n"
+        "def f(o):\n"
+        "    return json.dumps(o)  # dumps-ok: bench baseline\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["hot-path-json-dumps"]) == []
+    # marker on any line of a multi-line call
+    src = (
+        "import json\n"
+        "def f(o):\n"
+        "    return json.dumps(\n"
+        "        o  # dumps-ok: cold path\n"
+        "    )\n"
+    )
+    assert lint_source(src, "web/x.py", ["hot-path-json-dumps"]) == []
+
+
+def test_hot_path_json_dumps_clean_variants():
+    src = (
+        "from odh_kubeflow_tpu.machinery import serialize\n"
+        "def f(o, yaml):\n"
+        "    payload = serialize.dumps(o)\n"  # the sanctioned path
+        "    other = yaml.dumps(o)\n"  # some other module's dumps
+        "    return payload + other\n"
+    )
+    assert lint_source(src, "web/x.py", ["hot-path-json-dumps"]) == []
+
+
+def test_hot_path_json_dumps_scope():
+    src = "import json\ndef f(o):\n    return json.dumps(o)\n"
+    # only the serving tiers are in scope; the serializer itself is exempt
+    assert lint_source(src, "train/x.py", ["hot-path-json-dumps"]) == []
+    assert (
+        lint_source(src, "machinery/serialize.py", ["hot-path-json-dumps"])
+        == []
+    )
 
 
 # ---------------------------------------------------------------------------

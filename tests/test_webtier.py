@@ -1,0 +1,733 @@
+"""Async zero-copy web tier: native ``dumps`` parity, the
+serialized-bytes cache, event-loop serving, and the watch fan-out
+serialize-once contract.
+
+The parity suite is the contract every consumer of
+``machinery.serialize.dumps`` relies on — byte-identical output to
+``json.dumps(obj).encode()`` — proven on hand-picked fixtures (unicode
+escapes, float/int repr, Frozen containers, fallback leaves), on a
+randomized-tree property, and with the native engine pinned off (the
+``.so``-absent posture every fallback deployment runs in).
+"""
+
+import json
+import math
+import random
+import socket
+import string
+import threading
+
+import pytest
+
+from odh_kubeflow_tpu.apis import register_crds
+from odh_kubeflow_tpu.machinery import httpapi, serialize
+from odh_kubeflow_tpu.machinery.cache import SerializedBytesCache
+from odh_kubeflow_tpu.machinery.eventloop import event_loop_enabled
+from odh_kubeflow_tpu.machinery.objects import freeze
+from odh_kubeflow_tpu.machinery.store import APIServer
+from odh_kubeflow_tpu.web import microweb
+
+
+def _native_available() -> bool:
+    from odh_kubeflow_tpu import native
+
+    return native.jsontree_dumps() is not None
+
+
+ENGINES = ["python"] + (["native"] if _native_available() else [])
+
+
+@pytest.fixture(params=ENGINES)
+def engine(request):
+    """Run the test under each serialization engine; ``python`` is the
+    fallback-parity run (the exact code path a host without a C++
+    compiler, or with a stale pre-dumps ``.so``, serves with)."""
+    serialize.set_engine(request.param)
+    yield request.param
+    serialize.set_engine(None)
+
+
+# ---------------------------------------------------------------------------
+# dumps parity
+
+
+PARITY_FIXTURES = [
+    None,
+    True,
+    False,
+    0,
+    -17,
+    10**40,  # arbitrary-precision int
+    1.5,
+    -0.0,
+    0.1,
+    1e16,
+    1e-5,
+    1e300,
+    math.pi,
+    math.inf,
+    -math.inf,
+    math.nan,
+    "",
+    "plain ascii",
+    'quotes " and \\ backslash',
+    "controls \x00\x01\x1f\x7f and \b\t\n\f\r",
+    "héllo wörld",
+    "   line separators",
+    "astral 😀 🧪 \U0010ffff",
+    "\ud800 lone surrogate",
+    [],
+    {},
+    [1, "two", 3.0, None, True],
+    (1, 2, "tuple encodes as array"),
+    {"nested": {"deep": [{"er": [{"still": "parity"}]}]}},
+    {
+        "kind": "Notebook",
+        "apiVersion": "kubeflow.org/v1beta1",
+        "metadata": {
+            "name": "nb-0",
+            "namespace": "team-a",
+            "resourceVersion": "41",
+            "labels": {"app": "nb-0"},
+            "annotations": {"notebooks.kubeflow.org/last-activity": "now"},
+        },
+        "spec": {"template": {"spec": {"containers": [{"image": "j:x"}]}}},
+        "status": {"readyReplicas": 1, "conditions": []},
+    },
+    # fallback leaves: json.dumps coerces non-str keys; the native
+    # encoder hands these back and the wrapper must match exactly
+    {1: "int key"},
+    {None: "none key", True: "bool key"},
+    {3.5: "float key"},
+]
+
+
+def test_dumps_parity_fixtures(engine):
+    for obj in PARITY_FIXTURES:
+        assert serialize.dumps(obj) == json.dumps(obj).encode(), (
+            engine,
+            obj,
+        )
+
+
+def test_dumps_parity_frozen_containers(engine):
+    """The informer cache hands out FrozenDict/FrozenList subclasses;
+    they must serialize identically to their plain equivalents."""
+    plain = {
+        "metadata": {"name": "x", "resourceVersion": "7", "n": [1, 2, 3]},
+        "spec": {"replicas": 2, "flags": [True, None, 1.25]},
+    }
+    frozen = freeze(plain)
+    want = json.dumps(plain).encode()
+    assert serialize.dumps(frozen) == want
+    assert serialize.dumps(plain) == want
+
+
+def test_dumps_unserializable_raises_like_json(engine):
+    for bad in ({"k": b"bytes"}, {"k": {1, 2}}, {"k": object()}):
+        with pytest.raises(TypeError) as native_err:
+            serialize.dumps(bad)
+        with pytest.raises(TypeError) as json_err:
+            json.dumps(bad)
+        assert str(native_err.value) == str(json_err.value)
+
+
+def _random_tree(rng: random.Random, depth: int = 0):
+    roll = rng.random()
+    if depth >= 4 or roll < 0.45:
+        leaf = rng.randrange(8)
+        if leaf == 0:
+            return rng.choice([None, True, False])
+        if leaf == 1:
+            return rng.randrange(-(10**12), 10**12)
+        if leaf == 2:
+            return rng.choice(
+                [rng.uniform(-1e6, 1e6), rng.random() * 10**rng.randrange(-20, 20)]
+            )
+        if leaf == 3:
+            return rng.choice([math.inf, -math.inf, math.nan, -0.0, 0.0])
+        alphabet = (
+            string.ascii_letters
+            + string.digits
+            + '"\\\b\t\n\f\r/ '
+            + "éüß "
+            + "😀\U0001f9ea"
+            + "\x00\x1f\x7f"
+        )
+        return "".join(
+            rng.choice(alphabet) for _ in range(rng.randrange(0, 24))
+        )
+    if roll < 0.75:
+        return {
+            "k%d" % i: _random_tree(rng, depth + 1)
+            for i in range(rng.randrange(0, 6))
+        }
+    return [_random_tree(rng, depth + 1) for _ in range(rng.randrange(0, 6))]
+
+
+def test_dumps_parity_randomized_property(engine):
+    rng = random.Random(1234)
+    for trial in range(200):
+        tree = _random_tree(rng)
+        want = json.dumps(tree)
+        got = serialize.dumps(tree)
+        assert got == want.encode(), (engine, trial, want)
+
+
+def test_engine_resolution_surface():
+    assert serialize.engine() in ("python", "native")
+    serialize.set_engine("python")
+    try:
+        assert serialize.engine() == "python"
+        before = serialize.dumps_count()
+        serialize.dumps({"a": 1})
+        assert serialize.dumps_count() == before + 1
+    finally:
+        serialize.set_engine(None)
+    with pytest.raises(ValueError):
+        serialize.set_engine("rust")
+
+
+# ---------------------------------------------------------------------------
+# serialized-bytes cache
+
+
+def _obj(name="nb", ns="team-a", rv="3", kind="Notebook"):
+    return {
+        "kind": kind,
+        "apiVersion": "v1",
+        "metadata": {"name": name, "namespace": ns, "resourceVersion": rv},
+        "spec": {"x": 1},
+    }
+
+
+def test_bytes_cache_hit_skips_serialization():
+    c = SerializedBytesCache()
+    o = _obj()
+    first = c.obj_bytes(o)
+    assert first == json.dumps(o).encode()
+    before = serialize.dumps_count()
+    again = c.obj_bytes(o)
+    assert again is first  # the SAME bytes object, not a re-encode
+    assert serialize.dumps_count() == before
+    assert c.hits == 1 and c.misses == 1
+
+
+def test_bytes_cache_rv_change_is_a_miss():
+    c = SerializedBytesCache()
+    c.obj_bytes(_obj(rv="3"))
+    newer = _obj(rv="4")
+    newer["spec"]["x"] = 2
+    assert c.obj_bytes(newer) == json.dumps(newer).encode()
+    assert c.misses == 2
+
+
+def test_bytes_cache_unidentified_objects_bypass():
+    c = SerializedBytesCache()
+    status = {"kind": "Status", "status": "Failure", "code": 404}
+    assert c.obj_bytes(status) == json.dumps(status).encode()
+    assert c.hits == 0 and c.misses == 0  # never entered the cache
+
+
+def test_bytes_cache_event_bytes_compose_from_object_bytes():
+    c = SerializedBytesCache()
+    o = _obj()
+    line = c.event_bytes("ADDED", o)
+    assert line == json.dumps({"type": "ADDED", "object": o}).encode() + b"\n"
+    # same event again: hit, same bytes object
+    assert c.event_bytes("ADDED", o) is line
+    # a different event type of the same rv reuses the object bytes:
+    # composing MODIFIED costs zero serializations
+    before = serialize.dumps_count()
+    mod = c.event_bytes("MODIFIED", o)
+    assert serialize.dumps_count() == before
+    assert mod == json.dumps({"type": "MODIFIED", "object": o}).encode() + b"\n"
+
+
+def test_bytes_cache_list_compose_parity():
+    c = SerializedBytesCache()
+    items = [_obj(name=f"nb-{i}", rv=str(i)) for i in range(5)]
+    got = c.list_bytes("Notebook", items)
+    want = json.dumps(
+        {"kind": "NotebookList", "apiVersion": "v1", "items": items}
+    ).encode()
+    assert got == want
+    # repeat list of unchanged objects serializes nothing
+    before = serialize.dumps_count()
+    assert c.list_bytes("Notebook", items) == want
+    assert serialize.dumps_count() == before
+
+
+def test_bytes_cache_lru_bound():
+    c = SerializedBytesCache(capacity=2)
+    for i in range(5):
+        c.obj_bytes(_obj(name=f"nb-{i}", rv=str(i)))
+    assert len(c._data) == 2
+
+
+# ---------------------------------------------------------------------------
+# microweb: status text + event-loop serving
+
+
+def test_status_text_covers_shed_and_chaos_codes():
+    assert microweb._status_text(410) == "Gone"
+    assert microweb._status_text(429) == "Too Many Requests"
+    assert microweb._status_text(503) == "Service Unavailable"
+    assert microweb._status_text(200) == "OK"
+    # stdlib-registry fallback for codes outside the common table
+    assert microweb._status_text(418) == "I'm a Teapot"
+    assert microweb._status_text(599) == "Unknown"
+
+
+def test_app_emits_reason_phrase_for_shed_statuses():
+    app = microweb.App("t")
+
+    @app.route("/shed")
+    def shed(req):
+        raise microweb.HTTPError(429, "slow down")
+
+    captured = {}
+
+    def start_response(status, headers):
+        captured["status"] = status
+
+    body = app(
+        {"REQUEST_METHOD": "GET", "PATH_INFO": "/shed", "QUERY_STRING": ""},
+        start_response,
+    )
+    assert captured["status"] == "429 Too Many Requests"
+    assert json.loads(b"".join(body))["status"] == 429
+
+
+def _get(port, path):
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+        s.sendall(
+            f"GET {path} HTTP/1.1\r\nHost: t\r\nConnection: close\r\n\r\n".encode()
+        )
+        data = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                return data
+            data += chunk
+
+
+def test_event_loop_serves_microweb_app():
+    app = microweb.App("t")
+
+    @app.route("/ping")
+    def ping(req):
+        return {"pong": True, "n": 3}
+
+    server = app.serve(event_loop=True)
+    try:
+        assert type(server).__name__ == "EventLoopServer"
+        raw = _get(server.server_port, "/ping")
+        head, _, body = raw.partition(b"\r\n\r\n")
+        assert head.startswith(b"HTTP/1.1 200 OK")
+        assert json.loads(body) == {"pong": True, "n": 3}
+    finally:
+        server.shutdown()
+
+
+def test_thread_server_fallback_still_serves():
+    app = microweb.App("t")
+
+    @app.route("/ping")
+    def ping(req):
+        return {"pong": True}
+
+    server = app.serve(event_loop=False)
+    try:
+        raw = _get(server.server_address[1], "/ping")
+        assert b'{"pong": true}' in raw
+    finally:
+        server.shutdown()
+
+
+def test_event_loop_env_opt_out(monkeypatch):
+    monkeypatch.setenv("WEB_EVENT_LOOP", "false")
+    assert not event_loop_enabled()
+    monkeypatch.delenv("WEB_EVENT_LOOP")
+    assert event_loop_enabled()
+
+
+# ---------------------------------------------------------------------------
+# httpapi over the event loop: watch fan-out + thread accounting
+
+
+@pytest.fixture()
+def api_served():
+    server = APIServer()
+    register_crds(server)
+    _, port, httpd = httpapi.serve(server, port=0, event_loop=True)
+    yield server, port, httpd
+    httpd.shutdown()
+
+
+def _nb(name, ns="team-a"):
+    return {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "template": {"spec": {"containers": [{"name": name, "image": "j"}]}}
+        },
+    }
+
+
+def _open_watch(port, path="/api/v1/namespaces/team-a/notebooks?watch=true"):
+    """Raw-socket watch stream (no client pump thread, so server-side
+    thread accounting stays observable). Returns (socket, reader) with
+    headers + the greeting heartbeat consumed."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=15)
+    s.sendall(f"GET {path} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
+    f = s.makefile("rb")
+    status = f.readline()
+    assert b"200" in status
+    while True:
+        line = f.readline()
+        if line in (b"\r\n", b"\n"):
+            break
+    greeting = f.readline()
+    assert b"HEARTBEAT" in greeting
+    return s, f
+
+
+def test_watch_fanout_serializes_each_event_exactly_once(api_served):
+    server, port, _ = api_served
+    streams = [_open_watch(port) for _ in range(5)]
+    try:
+        before = serialize.dumps_count()
+        server.create(_nb("fanout-nb"))
+        lines = [f.readline() for _, f in streams]
+        # every subscriber got the event, framed identically
+        assert all(line == lines[0] for line in lines)
+        event = json.loads(lines[0])
+        assert event["type"] == "ADDED"
+        assert event["object"]["metadata"]["name"] == "fanout-nb"
+        # ONE serialization total for 5 subscribers: the event framing
+        # composes from the shared per-(kind, rv) object bytes
+        assert serialize.dumps_count() - before == 1
+    finally:
+        for s, f in streams:
+            f.close()
+            s.close()
+
+
+def test_watches_do_not_consume_a_thread_each(api_served):
+    server, port, _ = api_served
+    baseline = threading.active_count()
+    n = 25
+    streams = [_open_watch(port) for _ in range(n)]
+    try:
+        grown = threading.active_count() - baseline
+        # thread-per-request serving would add ~n threads here; the
+        # event loop multiplexes every stream, so growth is bounded by
+        # the fixed worker pool regardless of subscriber count
+        assert grown < n // 2, grown
+        # and the streams are all live, not parked corpses
+        server.create(_nb("alive-nb"))
+        for _, f in streams:
+            assert b"alive-nb" in f.readline()
+    finally:
+        for s, f in streams:
+            f.close()
+            s.close()
+
+
+def test_event_loop_persistent_connections(api_served):
+    """Three requests over ONE connection: the event loop keeps it
+    alive (an idle connection is a registered fd, not a parked
+    thread), framing each response with Content-Length."""
+    server, port, _ = api_served
+    server.create(_nb("ka-nb"))
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    f = s.makefile("rb")
+    try:
+        for _ in range(3):
+            s.sendall(
+                b"GET /api/v1/namespaces/team-a/notebooks/ka-nb HTTP/1.1\r\n"
+                b"Host: t\r\n\r\n"
+            )
+            status = f.readline()
+            assert b"200" in status
+            headers = {}
+            while True:
+                line = f.readline()
+                if line in (b"\r\n", b"\n"):
+                    break
+                k, _, v = line.partition(b":")
+                headers[k.strip().lower()] = v.strip()
+            assert headers[b"connection"] == b"keep-alive"
+            body = f.read(int(headers[b"content-length"]))
+            assert json.loads(body)["metadata"]["name"] == "ka-nb"
+    finally:
+        f.close()
+        s.close()
+
+
+def test_serial_requests_event_loop_parity(api_served):
+    """The same CRUD surface byte-for-byte through the event loop:
+    create → get → list responses are plain json.dumps-parity
+    documents (the wire contract PR-3/PR-5 clients rely on)."""
+    server, port, _ = api_served
+    server.create(_nb("p1"))
+    raw = _get(port, "/api/v1/namespaces/team-a/notebooks/p1")
+    head, _, body = raw.partition(b"\r\n\r\n")
+    assert head.startswith(b"HTTP/1.1 200")
+    got = json.loads(body)
+    assert got["metadata"]["name"] == "p1"
+    assert body == json.dumps(server.get("Notebook", "p1", "team-a")).encode()
+
+    raw = _get(port, "/api/v1/namespaces/team-a/notebooks")
+    _, _, body = raw.partition(b"\r\n\r\n")
+    want = json.dumps(
+        {
+            "kind": "NotebookList",
+            "apiVersion": "v1",
+            "items": server.list("Notebook", namespace="team-a"),
+        }
+    ).encode()
+    assert body == want
+
+
+# ---------------------------------------------------------------------------
+# listing memo (CrudBackend.serve_listing over a versioned cache)
+
+
+def _jwa_on_cache():
+    from odh_kubeflow_tpu.machinery.cache import CachedClient, InformerCache
+    from odh_kubeflow_tpu.utils import prometheus
+    from odh_kubeflow_tpu.web.jwa import JupyterWebApp
+
+    from odh_kubeflow_tpu.scheduling import register_scheduling
+
+    api = APIServer()
+    register_crds(api)
+    register_scheduling(api)
+    _grant_admin(api)
+    cache = InformerCache(
+        api,
+        kinds=("Notebook", "Workload", "Event"),
+        registry=prometheus.Registry(),
+    )
+    cache.start(live=False)
+    jwa = JupyterWebApp(CachedClient(api, cache))
+    return api, jwa
+
+
+def _grant_admin(api):
+    from odh_kubeflow_tpu.apis import install_default_cluster_roles
+
+    install_default_cluster_roles(api)
+    api.create(
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": "webtier-admin"},
+            "subjects": [{"kind": "User", "name": "web@test"}],
+            "roleRef": {"kind": "ClusterRole", "name": "kubeflow-admin"},
+        }
+    )
+
+
+def _list_rows(jwa, ns="team-a"):
+    state = {}
+
+    def start_response(status, headers, exc_info=None):
+        state["status"] = status
+
+    body = b"".join(
+        jwa.app(
+            {
+                "REQUEST_METHOD": "GET",
+                "PATH_INFO": f"/api/namespaces/{ns}/notebooks",
+                "QUERY_STRING": "",
+                "HTTP_KUBEFLOW_USERID": "web@test",
+            },
+            start_response,
+        )
+    )
+    assert state["status"].startswith("200"), state
+    return json.loads(body)["notebooks"]
+
+
+def test_listing_memo_skips_rebuild_until_a_kind_changes(monkeypatch):
+    """Repeat listings with an unchanged cache serve memoized rows
+    (zero row builds); any write to a kind in the listing's read set
+    invalidates, and the fresh rows are visible immediately
+    (read-your-writes through the poke in listing_versions)."""
+    from odh_kubeflow_tpu.web.jwa import JupyterWebApp
+
+    api, jwa = _jwa_on_cache()
+    api.create(_nb("memo-a"))
+    builds = {"n": 0}
+    real_row = JupyterWebApp.notebook_row
+
+    def counting_row(self, nb, events=None):
+        builds["n"] += 1
+        return real_row(self, nb, events=events)
+
+    monkeypatch.setattr(JupyterWebApp, "notebook_row", counting_row)
+    rows = _list_rows(jwa)
+    assert [r["name"] for r in rows] == ["memo-a"]
+    assert builds["n"] == 1
+    # repeat: memo hit, no row rebuilt
+    assert [r["name"] for r in _list_rows(jwa)] == ["memo-a"]
+    assert builds["n"] == 1
+    # a write to a read-set kind invalidates and is visible at once
+    api.create(_nb("memo-b"))
+    assert sorted(r["name"] for r in _list_rows(jwa)) == ["memo-a", "memo-b"]
+    assert builds["n"] == 3
+    # and an Event write (read set, not listed kind) invalidates too
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"name": "ev-1", "namespace": "team-a"},
+            "type": "Warning",
+            "reason": "FailedCreate",
+            "message": "boom",
+            "involvedObject": {"kind": "Notebook", "name": "memo-a"},
+        }
+    )
+    _list_rows(jwa)
+    assert builds["n"] == 5
+
+
+def test_listing_memo_disabled_without_a_versioned_cache():
+    """A store-backed app (no CachedClient) rebuilds every listing —
+    the memo never serves rows it cannot version."""
+    from odh_kubeflow_tpu.web.jwa import JupyterWebApp
+
+    api = APIServer()
+    register_crds(api)
+    _grant_admin(api)
+    jwa = JupyterWebApp(api)
+    api.create(_nb("plain-a"))
+    assert [r["name"] for r in _list_rows(jwa)] == ["plain-a"]
+    api.create(_nb("plain-b"))
+    assert sorted(r["name"] for r in _list_rows(jwa)) == [
+        "plain-a",
+        "plain-b",
+    ]
+
+
+def test_event_loop_rejects_oversized_bodies(api_served):
+    """A Content-Length beyond WEB_MAX_BODY_BYTES is refused with 413
+    BEFORE any body bytes buffer on the loop (routing/auth never runs,
+    memory never grows)."""
+    from odh_kubeflow_tpu.machinery import eventloop
+
+    _, port, _ = api_served
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        s.sendall(
+            b"POST /api/v1/namespaces/team-a/notebooks HTTP/1.1\r\n"
+            b"Host: t\r\nContent-Length: "
+            + str(eventloop.MAX_BODY_BYTES + 1).encode()
+            + b"\r\n\r\n"
+        )
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            data += chunk
+        assert data.startswith(b"HTTP/1.1 413"), data[:64]
+    finally:
+        s.close()
+
+
+def test_event_loop_rejects_chunked_transfer_encoding(api_served):
+    """Chunked framing is refused with 501+close — parsing the chunk
+    stream as pipelined requests would be a request-smuggling vector on
+    an authenticated keep-alive connection."""
+    _, port, _ = api_served
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        s.sendall(
+            b"POST /api/v1/namespaces/team-a/notebooks HTTP/1.1\r\n"
+            b"Host: t\r\nTransfer-Encoding: chunked\r\n\r\n"
+            b"5\r\nhello\r\n0\r\n\r\n"
+        )
+        data = b""
+        while True:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            data += chunk
+        assert data.startswith(b"HTTP/1.1 501"), data[:64]
+    finally:
+        s.close()
+
+
+def test_event_loop_half_close_still_delivers_pooled_response(api_served):
+    """FIN after the request, then read — a legal HTTP pattern: the
+    response (here a pooled create, first hit on the route so EWMA is
+    unseen) must still arrive; side effects must not be silently
+    dropped with the 201."""
+    server, port, _ = api_served
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        body = json.dumps(_nb("halfclose-nb")).encode()
+        s.sendall(
+            b"POST /api/v1/namespaces/team-a/notebooks HTTP/1.1\r\n"
+            b"Host: t\r\nContent-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n"
+            + body
+        )
+        s.shutdown(socket.SHUT_WR)
+        data = b""
+        while True:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            data += chunk
+        assert data.startswith(b"HTTP/1.1 201"), data[:64]
+        assert server.get("Notebook", "halfclose-nb", "team-a")
+    finally:
+        s.close()
+
+
+def test_event_loop_rejects_bad_content_length(api_served):
+    """Duplicate or non-numeric Content-Length is 400+close — coercing
+    it to 0 would reframe the unread body as the next pipelined
+    request (desync)."""
+    _, port, _ = api_served
+    for cl_headers in (
+        b"Content-Length: 10\r\nContent-Length: 0\r\n",
+        b"Content-Length: 1e2\r\n",
+        b"Content-Length: -5\r\n",
+    ):
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        try:
+            s.sendall(
+                b"POST /api/v1/namespaces/team-a/notebooks HTTP/1.1\r\n"
+                b"Host: t\r\n" + cl_headers + b"\r\nXXXXXXXXXX"
+            )
+            data = b""
+            while True:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                data += chunk
+            assert data.startswith(b"HTTP/1.1 400"), (cl_headers, data[:64])
+        finally:
+            s.close()
+
+
+def test_watch_body_close_stops_watch():
+    """wsgiref calls result.close() on disconnect; the Watch must
+    deregister then, not at GC time (thread-fallback parity with the
+    old generator's finally)."""
+    from odh_kubeflow_tpu.machinery.eventloop import WatchBody
+
+    server = APIServer()
+    register_crds(server)
+    w = server.watch("Notebook", namespace="team-a")
+    wb = WatchBody(w, frame=lambda item: b"", heartbeat=0.01)
+    assert w in server._watches
+    wb.close()
+    assert w not in server._watches
