@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint sanitize chaos loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
+.PHONY: all test test-unit test-manifests lint sanitize chaos durability loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
 
 all: lint test
 
@@ -41,6 +41,22 @@ chaos:
 	GRAFT_CHAOS=1 GRAFT_SANITIZE=1 $(PYTHON) -m pytest -q \
 	  tests/test_chaos.py tests/test_leader.py \
 	  tests/test_sessions.py::test_property_random_suspend_resume_under_chaos
+
+# crash/failover drills (docs/GUIDE.md "Durability & failover"): WAL
+# kill-point sweep (process death at every commit point), disk-fault
+# schedules (torn write / failed fsync / short read), fencing-token
+# regression, and the sharded-manager failover drill — all under the
+# sanitizer and a seeded chaos schedule — then the recovery axis of
+# the control-plane bench (cold-recovery time + failover p99; writes
+# to a scratch copy so the committed BENCH numbers change only when
+# refreshed deliberately)
+durability:
+	GRAFT_SANITIZE=1 GRAFT_CHAOS=7 $(PYTHON) -m pytest -q \
+	  tests/test_durability.py tests/test_leader.py
+	cp BENCH_control_plane.json /tmp/durability_bench.json
+	$(PYTHON) loadtest/control_plane_bench.py --recovery-only \
+	  --recovery-counts 500,2000 --failover-reps 6 \
+	  --out /tmp/durability_bench.json
 
 # the randomized property suites re-run as race probes: sanitized
 # locks record acquisition order, re-entry, and blocking-under-lock

@@ -537,6 +537,153 @@ def bench_web_tier(
     }
 
 
+def bench_recovery(
+    object_counts: list[int], failover_reps: int = 8
+) -> dict:
+    """The durability axis (docs/GUIDE.md "Durability & failover"):
+
+    - **cold recovery**: build N objects through a fsync-per-write WAL,
+      snapshot, write a ~10% WAL tail, then measure
+      ``APIServer.recover`` wall time (snapshot load + tail replay) —
+      the apiserver's restart-to-serving cost at fleet size;
+    - **WAL write overhead**: µs per acked mutation with the log
+      attached (the ack-after-fsync tax the store pays for
+      crash-safety);
+    - **failover**: two live sharded manager replicas; kill the one
+      owning a namespace, create an object there, and time kill →
+      the survivor's first reconcile write. p50/p99 over reps gates
+      handover inside the lease window.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from odh_kubeflow_tpu.controllers.runtime import Manager
+    from odh_kubeflow_tpu.machinery.leader import ShardMembership
+    from odh_kubeflow_tpu.machinery.wal import WriteAheadLog
+
+    cold = []
+    for n in object_counts:
+        d = tempfile.mkdtemp(prefix="walbench-")
+        try:
+            wal = WriteAheadLog(d)
+            api = APIServer(wal=wal, snapshot_interval=0)  # manual cut
+            register_crds(api)
+            t0 = time.perf_counter()
+            for i in range(n):
+                api.create(
+                    {
+                        "kind": "Notebook",
+                        "metadata": {
+                            "name": f"nb{i}",
+                            "namespace": f"team{i % 8}",
+                        },
+                        "spec": {
+                            "template": {
+                                "spec": {"containers": [{"name": "nb"}]}
+                            }
+                        },
+                    }
+                )
+            wal_write_s = time.perf_counter() - t0
+            api.snapshot_now()
+            tail = max(n // 10, 1)
+            for i in range(tail):  # post-snapshot WAL tail to replay
+                nb = api.get("Notebook", f"nb{i}", f"team{i % 8}")
+                nb["spec"]["touched"] = i
+                api.update(nb)
+            wal.close()
+            t0 = time.perf_counter()
+            rec = APIServer.recover(WriteAheadLog(d))
+            recover_s = time.perf_counter() - t0
+            count = len(rec.list("Notebook"))
+            assert count == n, f"recovered {count} of {n} objects"
+            cold.append(
+                {
+                    "objects": n,
+                    "wal_tail_records": tail,
+                    "cold_recovery_ms": round(recover_s * 1000.0, 1),
+                    "recovery_objects_per_s": round(n / recover_s, 1),
+                    "wal_append_us_per_write": round(
+                        wal_write_s / n * 1e6, 1
+                    ),
+                }
+            )
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    # ---- failover-to-first-reconcile --------------------------------------
+    lease = 1.0  # whole seconds: the Lease spec field is an int
+    samples = []
+    for rep in range(failover_reps):
+        api = APIServer()
+        api.register_kind("kubeflow.org/v1", "Widget", "widgets")
+        m1 = ShardMembership(
+            api, "bench", identity="r1", namespace="default",
+            lease_duration=lease, renew_period=0.04, retry_period=0.02,
+        )
+        m2 = ShardMembership(
+            api, "bench", identity="r2", namespace="default",
+            lease_duration=lease, renew_period=0.04, retry_period=0.02,
+        )
+        m1.join()
+        m2.join()
+        written = threading.Event()
+
+        def reconcile(req, api=api, written=written):
+            obj = api.get("Widget", req.name, req.namespace)
+            if not (obj.get("status") or {}).get("writer"):
+                obj.setdefault("status", {})["writer"] = "r2"
+                api.update_status(obj)
+                written.set()
+            return None
+
+        mgr2 = Manager(api, shard=m2)
+        mgr2.new_controller("bench", "Widget", reconcile)
+        m2.run(on_lost=lambda: None)
+        mgr2.start()
+        try:
+            victim_ns = next(
+                ns
+                for ns in (f"ns{i}-{rep}" for i in range(64))
+                if m1.owns(ns)
+            )
+            # r1 dies; an object lands in its namespace mid-outage
+            t0 = time.monotonic()
+            m1._stop.set()
+            api.create(
+                {"kind": "Widget",
+                 "metadata": {"name": "w", "namespace": victim_ns},
+                 "spec": {"v": rep}}
+            )
+            ok = written.wait(timeout=20 * lease)
+            took = time.monotonic() - t0
+            assert ok, "survivor never reconciled the dead shard"
+            samples.append(took)
+        finally:
+            mgr2.stop()
+            m1._stop.set()
+            m2._stop.set()
+    samples_ms = sorted(s * 1000.0 for s in samples)
+
+    def pct(p):
+        return round(
+            samples_ms[min(int(p * len(samples_ms)), len(samples_ms) - 1)], 1
+        )
+
+    return {
+        "cold_recovery": cold,
+        "failover": {
+            "lease_duration_s": lease,
+            "reps": failover_reps,
+            "p50_ms": pct(0.50),
+            "p99_ms": pct(0.99),
+            "max_ms": round(samples_ms[-1], 1),
+            "lease_windows_p99": round(pct(0.99) / (lease * 1000.0), 2),
+        },
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--notebooks", type=int, default=500)
@@ -564,8 +711,55 @@ def main() -> None:
         action="store_true",
         help="omit the socket-level web-tier concurrency axis",
     )
+    parser.add_argument(
+        "--recovery",
+        action="store_true",
+        help="include the durability axis (cold-recovery time vs "
+        "object count + failover-to-first-reconcile)",
+    )
+    parser.add_argument(
+        "--recovery-only",
+        action="store_true",
+        help="run ONLY the durability axis and merge it into --out "
+        "(existing entries untouched) — the `make durability` path",
+    )
+    parser.add_argument(
+        "--recovery-counts",
+        default="1000,5000",
+        help="comma-separated object counts for the cold-recovery axis",
+    )
+    parser.add_argument(
+        "--failover-reps",
+        type=int,
+        default=8,
+        help="failover drill repetitions (p50/p99 over these)",
+    )
     parser.add_argument("--out", default="BENCH_control_plane.json")
     args = parser.parse_args()
+
+    if args.recovery_only:
+        counts = [int(c) for c in str(args.recovery_counts).split(",") if c]
+        recovery = bench_recovery(counts, failover_reps=args.failover_reps)
+        merged: dict = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                merged = json.load(f)
+        merged["recovery"] = recovery
+        with open(args.out, "w") as f:
+            json.dump(merged, f, indent=2)
+        print(json.dumps({"recovery": recovery}, indent=2))
+        fo = recovery["failover"]
+        print(
+            f"\ncold recovery: "
+            + ", ".join(
+                f"{c['objects']} objs in {c['cold_recovery_ms']}ms"
+                for c in recovery["cold_recovery"]
+            )
+            + f" | failover p99 {fo['p99_ms']}ms "
+            f"({fo['lease_windows_p99']} lease windows; gate: within "
+            "the lease window + detection slack)"
+        )
+        return
 
     api = build_cluster(args.notebooks, args.namespaces)
     cfg = NotebookControllerConfig(enable_queueing=False)
@@ -658,6 +852,12 @@ def main() -> None:
             client_counts,
             args.requests_per_client,
             sweep_reps=args.sweep_reps,
+        )
+
+    if args.recovery:
+        counts = [int(c) for c in str(args.recovery_counts).split(",") if c]
+        results["recovery"] = bench_recovery(
+            counts, failover_reps=args.failover_reps
         )
 
     cache.flush_metrics()

@@ -456,6 +456,131 @@ class RetryWithoutBackoffRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# unfenced-write
+
+
+@register
+class UnfencedWriteRule(Rule):
+    """A module that participates in leader election or shard
+    membership (imports ``machinery.leader`` / constructs a
+    ``LeaderElector``/``ShardMembership``) is a controller-path
+    writer, and every store write it issues must carry its lease
+    epoch: either lexically inside ``with <elector>.fence():`` /
+    ``with fenced(...):``, or through a receiver whose name marks it
+    fenced (``fenced_api.update(...)``). A raw write in such a module
+    is exactly the leader-election TOCTOU the store's fencing-token
+    check closes — a deposed holder completing an in-flight write
+    after losing the lease. Components that get their fence from the
+    Manager (``fence_fn``) never import leader machinery and are out
+    of scope, same scope discipline as ``retry-without-backoff``.
+    Genuinely epoch-free writes (boot-time registration, test
+    scaffolding) are annotated ``# unfenced-ok: <reason>``."""
+
+    id = "unfenced-write"
+    description = (
+        "store write in a leader-electing module outside a fencing "
+        "context"
+    )
+    dirs = ("controllers", "machinery", "scheduling", "sessions", "web")
+
+    # the fencing helpers themselves (and the runner, which only wires
+    # electors into the Manager) are the mechanism, not consumers
+    _EXEMPT_FILES = frozenset({"machinery/leader.py"})
+
+    _WRITE_TERMINALS = frozenset(
+        {
+            "create",
+            "update",
+            "update_status",
+            "patch",
+            "delete",
+            "emit_event",
+            "create_or_get",
+        }
+    )
+    _WRITERISH = frozenset({"api", "client", "store"})
+    _LEADER_NAMES = ("LeaderElector", "ShardMembership")
+
+    def _module_uses_leader(self, tree: ast.AST) -> bool:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod.endswith("machinery.leader") or mod == "leader":
+                    return True
+            elif isinstance(node, ast.Import):
+                if any(
+                    a.name.endswith("machinery.leader") for a in node.names
+                ):
+                    return True
+            elif isinstance(node, ast.Name) and node.id in self._LEADER_NAMES:
+                return True
+        return False
+
+    def _is_fence_ctx(self, expr: ast.AST) -> bool:
+        """``<elector>.fence()`` / ``fenced(...)`` / ``leader.fenced(…)``
+        — any call whose terminal name is fence-ish."""
+        if not isinstance(expr, ast.Call):
+            return False
+        chain = _attr_chain(expr.func)
+        return bool(chain) and chain[-1] in ("fence", "fenced")
+
+    def _is_raw_write(self, call: ast.Call) -> bool:
+        chain = _attr_chain(call.func)
+        if len(chain) < 2 or chain[-1] not in self._WRITE_TERMINALS:
+            return False
+        receiver = chain[:-1]
+        if any("fenced" in part.lower() for part in receiver):
+            return False  # a fence-carrying handle
+        return any(part in self._WRITERISH for part in receiver)
+
+    def _visit(
+        self, src: SourceFile, node: ast.AST, fenced: bool
+    ) -> Iterator[Finding]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a function body starts unfenced: the fence is a dynamic
+            # contextvar, and a def's call site is unknown lexically
+            for child in node.body:
+                yield from self._visit(src, child, False)
+            return
+        if isinstance(node, ast.Lambda):
+            # conservatively skipped: `retry(lambda: api.update(x))`
+            # inside a fence block runs while the fence is installed
+            return
+        if isinstance(node, ast.With):
+            inner = fenced or any(
+                self._is_fence_ctx(item.context_expr) for item in node.items
+            )
+            for child in node.body:
+                yield from self._visit(src, child, inner)
+            return
+        if (
+            not fenced
+            and isinstance(node, ast.Call)
+            and self._is_raw_write(node)
+        ):
+            span = range(node.lineno, (node.end_lineno or node.lineno) + 1)
+            if not any("unfenced-ok" in src.line(i) for i in span):
+                yield self.finding(
+                    src,
+                    node,
+                    "store write in a leader-electing module without "
+                    "a fencing context; wrap in `with elector.fence():`"
+                    " (or route through a fenced handle), or annotate "
+                    "with `# unfenced-ok: <reason>`",
+                )
+        for child in ast.iter_child_nodes(node):
+            yield from self._visit(src, child, fenced)
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if src.rel in self._EXEMPT_FILES:
+            return
+        if not self._module_uses_leader(src.tree):
+            return
+        for child in src.tree.body:
+            yield from self._visit(src, child, False)
+
+
+# ---------------------------------------------------------------------------
 # hot-path-json-dumps
 
 

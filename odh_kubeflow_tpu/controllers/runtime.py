@@ -18,6 +18,7 @@ operator on sigs.k8s.io/controller-runtime; SURVEY.md §1 L2):
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 import time
@@ -137,12 +138,23 @@ class Controller:
         workers: int = 1,
         metrics: Optional[RuntimeMetrics] = None,
         informer: Optional[Any] = None,
+        fence_fn: Optional[Callable[[], Any]] = None,
+        shard: Optional[Any] = None,
     ):
         self.name = name
         self.api = api
         self.reconcile = reconcile
         self.for_kind = for_kind
         self.time_fn = time_fn
+        # fence_fn() returns a context manager installing the replica's
+        # current lease epoch around the reconcile body, so every store
+        # write it issues is fencing-token checked (a deposed replica's
+        # in-flight writes are rejected, not applied). None = unfenced.
+        self.fence_fn = fence_fn
+        # shard (machinery.leader.ShardMembership): when set, this
+        # replica only reconciles namespaces it owns under the current
+        # membership — two replicas never reconcile the same object.
+        self.shard = shard
         # shared informer cache (Manager-owned): kinds it serves feed
         # this controller through event handlers — one frozen copy per
         # store event for ALL controllers — instead of a private watch
@@ -276,6 +288,17 @@ class Controller:
     def _process(self, req: Request) -> None:
         with self._cv:
             trace_id = self._req_trace.pop(req, None)
+        if self.shard is not None and not self.shard.owns(req.namespace):
+            # not ours under the current membership: the owning replica
+            # sees the same watch events and reconciles it. Checked at
+            # process time (not enqueue) so a reshard between the two
+            # re-routes instead of dropping.
+            self.metrics.reconcile_total.inc(
+                {"controller": self.name, "result": "sharded_out"}
+            )
+            self._done(req)
+            self._limiter.forget(req)
+            return
         key = f"{req.namespace}/{req.name}"
         start = self.time_fn()
         with tracing.span(
@@ -285,7 +308,9 @@ class Controller:
             reconcile_key=key,
         ):
             try:
-                result = self.reconcile(req) or Result()
+                fence = self.fence_fn() if self.fence_fn else contextlib.nullcontext()
+                with fence:
+                    result = self.reconcile(req) or Result()
             except Exception:
                 elapsed = self.time_fn() - start
                 self._m_reconcile_time.observe(elapsed)
@@ -435,6 +460,8 @@ class Manager:
         time_fn: Callable[[], float] = time.monotonic,
         registry: Optional[prometheus.Registry] = None,
         cache: Optional[Any] = None,
+        elector: Optional[Any] = None,
+        shard: Optional[Any] = None,
     ):
         self.api = api
         self.time_fn = time_fn
@@ -449,6 +476,46 @@ class Manager:
         # any controller runs, pumped first on every drain round
         self.cache = cache
         self._cache_started = False
+        # leader elector (machinery.leader.LeaderElector) and/or shard
+        # membership (ShardMembership): reconciles run inside the
+        # replica's fence so deposed-epoch writes are rejected by the
+        # store, and — with a shard — only owned namespaces reconcile
+        self.elector = elector
+        self.shard = shard
+        if shard is not None and hasattr(shard, "add_on_change"):
+            shard.add_on_change(self._reshard_resync)
+
+    def _reshard_resync(self, old: list[str], new: list[str]) -> None:
+        """Membership changed: re-enqueue every primary object so keys
+        in namespaces this replica NEWLY owns get reconciled. A peer
+        that expired left no watch event behind; without this resync
+        its slice would sit unreconciled until the next organic event.
+        Keys still owned elsewhere are filtered at process time."""
+        log.info(
+            "shard membership changed %s -> %s; resyncing %d controllers",
+            old,
+            new,
+            len(self.controllers),
+        )
+        for c in self.controllers:
+            try:
+                objs = self.api.list(c.for_kind)
+            except Exception:  # noqa: BLE001 — API blip; next change retries
+                log.exception("reshard resync list %s failed", c.for_kind)
+                continue
+            for obj in objs:
+                c.enqueue(
+                    Request(
+                        obj_util.namespace_of(obj), obj_util.name_of(obj)
+                    )
+                )
+
+    def _fence_fn(self) -> Optional[Callable[[], Any]]:
+        if self.shard is not None:
+            return self.shard.fence
+        if self.elector is not None:
+            return self.elector.fence
+        return None
 
     def new_controller(
         self,
@@ -470,6 +537,8 @@ class Manager:
             workers=workers,
             metrics=self._runtime_metrics,
             informer=self.cache,
+            fence_fn=self._fence_fn(),
+            shard=self.shard,
         )
         self.controllers.append(ctrl)
         return ctrl

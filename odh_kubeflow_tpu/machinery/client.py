@@ -37,7 +37,9 @@ from odh_kubeflow_tpu.machinery.store import (
     Conflict,
     Denied,
     Expired,
+    FencedOut,
     Invalid,
+    current_fence as store_fence,
     NotFound,
     TooManyRequests,
     TypeInfo,
@@ -68,6 +70,7 @@ _REASON_TO_ERR = {
     "Denied": Denied,
     "Unauthorized": Unauthorized,
     "Expired": Expired,
+    "FencedOut": FencedOut,
     "TooManyRequests": TooManyRequests,
 }
 _EVENT_INDEX_MAX = 4096
@@ -267,6 +270,13 @@ class RemoteAPIServer:
                 # so the remote store skips trace-stamping children,
                 # same as the embedded path
                 headers["tracestate"] = "odh=controller"
+        # propagate the calling context's fencing token so the remote
+        # store validates this write against the lease epoch exactly
+        # like the embedded path (X-Fencing-Token: ns/lease/token)
+        fence = store_fence()
+        if fence is not None:
+            ns, lease, token = fence
+            headers["X-Fencing-Token"] = f"{ns}/{lease}/{token}"
         return headers
 
     def _retry_reason(self, method: str, e: Exception) -> Optional[str]:

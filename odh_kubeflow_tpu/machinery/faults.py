@@ -45,11 +45,157 @@ from odh_kubeflow_tpu.machinery.store import (
     TooManyRequests,
     Watch,
 )
+from odh_kubeflow_tpu.machinery.wal import CrashPoint, FileIO
 from odh_kubeflow_tpu.utils import prometheus
 
 Obj = dict[str, Any]
 
 CHAOS_ENV = "GRAFT_CHAOS"
+
+
+# ---------------------------------------------------------------------------
+# disk faults (the WAL's IO layer)
+
+
+@dataclass
+class DiskFaultSchedule:
+    """Per-IO fault probabilities for the WAL's :class:`FileIO`
+    surface, drawn from a seeded rng in a fixed order (same replay
+    contract as :class:`FaultSchedule`)."""
+
+    torn_write: float = 0.0  # write a random prefix, then die
+    fsync_fail: float = 0.0  # fsync raises OSError (write never acked)
+    short_read: float = 0.0  # read returns a truncated prefix once
+    slow_disk: float = 0.0  # added latency before the IO
+    slow_seconds: float = 0.002
+
+    @classmethod
+    def default(cls) -> "DiskFaultSchedule":
+        return cls(torn_write=0.02, fsync_fail=0.02, short_read=0.05, slow_disk=0.05)
+
+    @classmethod
+    def none(cls) -> "DiskFaultSchedule":
+        return cls()
+
+
+class FaultyFileIO(FileIO):
+    """WAL IO layer with seeded disk faults. A torn write raises
+    :class:`~odh_kubeflow_tpu.machinery.wal.CrashPoint` after flushing
+    a random prefix (the classic power-cut shape recovery must
+    truncate); a failed fsync raises OSError (the store goes
+    fail-stop: the write was never acked); a short read returns a
+    truncated prefix exactly once per draw (recovery's stable-read
+    confirm pass must catch it instead of truncating acked history).
+    ``counts`` records what fired, for drill assertions."""
+
+    def __init__(
+        self,
+        seed: int = 1,
+        schedule: Optional[DiskFaultSchedule] = None,
+        sleep_fn: Callable[[float], None] = time.sleep,
+    ):
+        self.rng = random.Random(seed)
+        self.schedule = schedule if schedule is not None else DiskFaultSchedule.default()
+        self._sleep = sleep_fn
+        self.counts: dict[str, int] = {}
+
+    def _count(self, kind: str) -> None:
+        self.counts[kind] = self.counts.get(kind, 0) + 1
+
+    def write(self, f, data: bytes) -> None:
+        s = self.schedule
+        if s.slow_disk and self.rng.random() < s.slow_disk:
+            self._count("slow_disk")
+            self._sleep(s.slow_seconds)
+        if s.torn_write and self.rng.random() < s.torn_write:
+            self._count("torn_write")
+            keep = self.rng.randrange(len(data) + 1) if data else 0
+            f.write(data[:keep])
+            f.flush()
+            raise CrashPoint(f"torn write: {keep}/{len(data)} bytes hit disk")
+        super().write(f, data)
+
+    def fsync(self, f) -> None:
+        s = self.schedule
+        if s.slow_disk and self.rng.random() < s.slow_disk:
+            self._count("slow_disk")
+            self._sleep(s.slow_seconds)
+        if s.fsync_fail and self.rng.random() < s.fsync_fail:
+            self._count("fsync_fail")
+            raise OSError("injected fsync failure")
+        super().fsync(f)
+
+    def read_bytes(self, path: str) -> bytes:
+        data = super().read_bytes(path)
+        s = self.schedule
+        if data and s.short_read and self.rng.random() < s.short_read:
+            self._count("short_read")
+            return data[: self.rng.randrange(len(data))]
+        return data
+
+
+class KillPointIO(FileIO):
+    """Deterministic process-death injection: dies with
+    :class:`CrashPoint` at the N-th WAL IO op (write/fsync calls,
+    counted in order), so a drill can enumerate every commit point —
+    mid-append (torn record), pre-fsync (record in page cache only),
+    post-fsync pre-ack (durable but unacked). On death the un-fsynced
+    tail of the file is cut to a seeded random length, simulating the
+    page cache partially reaching disk. ``after_op=True`` performs the
+    fatal op first, then dies (the crash-after-fsync-before-ack
+    point)."""
+
+    def __init__(self, kill_at_op: int, seed: int = 1, after_op: bool = False):
+        self.kill_at = kill_at_op
+        self.after_op = after_op
+        self.rng = random.Random(seed)
+        self.ops = 0
+        self.dead = False
+        # path → bytes known durable (fsync high-water mark)
+        self._durable: dict[str, int] = {}
+
+    def _tick(self) -> bool:
+        self.ops += 1
+        return self.ops >= self.kill_at
+
+    def _die(self, f, partial: Optional[bytes] = None) -> None:
+        self.dead = True
+        if partial is not None:
+            keep = self.rng.randrange(len(partial) + 1) if partial else 0
+            f.write(partial[:keep])
+        f.flush()
+        # drop a seeded suffix of the un-fsynced page-cache tail
+        name = getattr(f, "name", None)
+        if name is not None:
+            size = os.path.getsize(name)
+            durable = self._durable.get(name, 0)
+            if size > durable:
+                keep_to = durable + self.rng.randrange(size - durable + 1)
+                with open(name, "r+b") as trunc:
+                    trunc.truncate(keep_to)
+        raise CrashPoint(f"injected process death at io op {self.ops}")
+
+    def write(self, f, data: bytes) -> None:
+        if self.dead:
+            raise CrashPoint("process already dead")
+        if self._tick() and not self.after_op:
+            self._die(f, partial=data)
+        super().write(f, data)
+        if self.ops >= self.kill_at and self.after_op:
+            self._die(f)
+
+    def fsync(self, f) -> None:
+        if self.dead:
+            raise CrashPoint("process already dead")
+        fatal = self._tick()
+        if fatal and not self.after_op:
+            self._die(f)
+        super().fsync(f)
+        name = getattr(f, "name", None)
+        if name is not None:
+            self._durable[name] = os.path.getsize(name)
+        if fatal and self.after_op:
+            self._die(f)
 
 
 @dataclass

@@ -53,9 +53,12 @@ from odh_kubeflow_tpu.machinery.store import (
     Conflict,
     Denied,
     Expired,
+    FencedOut,
     Invalid,
     NotFound,
     TooManyRequests,
+    reset_fence,
+    set_fence,
 )
 
 Obj = dict[str, Any]
@@ -65,6 +68,10 @@ _STATUS = {
     AlreadyExists: 409,
     Conflict: 409,
     Invalid: 422,
+    # 403 like Denied, but with its own Status.reason so the client
+    # re-raises FencedOut (a deposed controller must stand down, not
+    # treat it as an RBAC denial)
+    FencedOut: 403,
     Denied: 403,
     BadRequest: 400,
     Expired: 410,
@@ -381,6 +388,32 @@ class RestAPI:
         except NotFound as e:
             return self._error(404, str(e), start_response)
 
+        # a fenced remote write (machinery.leader.fenced on the client
+        # side) carries its lease epoch in X-Fencing-Token; parse it
+        # BEFORE the limiter admits the request — a malformed header
+        # returns 400 here and must not leak an inflight slot
+        fence = None
+        raw_fence = environ.get("HTTP_X_FENCING_TOKEN", "")
+        if raw_fence:
+            parts = raw_fence.split("/")
+            if len(parts) != 3:
+                return self._error(
+                    400,
+                    f"malformed X-Fencing-Token {raw_fence!r} "
+                    "(want namespace/lease/token)",
+                    start_response,
+                    reason="BadRequest",
+                )
+            try:
+                fence = (parts[0], parts[1], int(parts[2]))
+            except ValueError:
+                return self._error(
+                    400,
+                    f"non-numeric fencing token in {raw_fence!r}",
+                    start_response,
+                    reason="BadRequest",
+                )
+
         # APF-lite admission: cap concurrent non-watch requests per
         # client identity, shedding excess with 429 + Retry-After
         # instead of queueing unboundedly in the thread pool. Watches
@@ -406,6 +439,10 @@ class RestAPI:
                     reason="TooManyRequests",
                     headers=[_retry_after_header(self.limiter.retry_after)],
                 )
+        # re-install the parsed fence on this handler's context so the
+        # store validates the epoch atomically with the apply, same as
+        # the embedded path
+        fence_reset = set_fence(fence) if fence is not None else None
         try:
             return self._dispatch(kind, route, method, qs, environ, start_response)
         except APIError as e:
@@ -422,6 +459,8 @@ class RestAPI:
         except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
             return self._error(500, f"{type(e).__name__}: {e}", start_response)
         finally:
+            if fence_reset is not None:
+                reset_fence(fence_reset)
             if client is not None:
                 self.limiter.release(client)
 

@@ -63,6 +63,7 @@ def run_controller(name: str, register: Callable) -> None:
     api, cache = _wrap_cached(api)
 
     elector = None
+    shard = None
     if os.environ.get("LEADER_ELECT", "").lower() == "true":
         from odh_kubeflow_tpu.machinery.leader import LeaderElector
 
@@ -70,6 +71,7 @@ def run_controller(name: str, register: Callable) -> None:
             api,
             os.environ.get("LEADER_ELECTION_ID", f"{name}-leader"),
             namespace=os.environ.get("LEADER_ELECTION_NAMESPACE", "kubeflow"),
+            lease_duration=float(os.environ.get("LEASE_DURATION", "15")),
         )
         print(f"{name}: waiting for leader lease…", flush=True)
         elector.acquire()
@@ -80,7 +82,35 @@ def run_controller(name: str, register: Callable) -> None:
 
         elector.run(on_lost=lost)
 
-    mgr = Manager(api, cache=cache)
+    # SHARD_GROUP=<group>: horizontally-replicated manager — this
+    # replica joins the shard group and reconciles only the namespaces
+    # it owns under rendezvous hashing; its writes carry the membership
+    # lease's fencing token. Losing the membership heartbeat exits the
+    # process (peers already resharded our slice).
+    if os.environ.get("SHARD_GROUP", ""):
+        from odh_kubeflow_tpu.machinery.leader import ShardMembership
+
+        shard = ShardMembership(
+            api,
+            os.environ["SHARD_GROUP"],
+            identity=os.environ.get("SHARD_IDENTITY") or None,
+            namespace=os.environ.get("LEADER_ELECTION_NAMESPACE", "kubeflow"),
+            lease_duration=float(os.environ.get("LEASE_DURATION", "15")),
+        )
+        shard.join()
+
+        def shard_lost():
+            print(f"{name}: shard membership lost; exiting", flush=True)
+            os._exit(1)
+
+        shard.run(on_lost=shard_lost)
+        print(
+            f"{name}: shard member {shard.identity} of "
+            f"{shard.group} (epoch {shard.token})",
+            flush=True,
+        )
+
+    mgr = Manager(api, cache=cache, elector=elector, shard=shard)
     register(api, mgr)
     mgr.start()  # includes the informer start/sync barrier
 
@@ -105,6 +135,8 @@ def run_controller(name: str, register: Callable) -> None:
         mgr.stop()
         if elector is not None:
             elector.release()
+        if shard is not None:
+            shard.leave()
 
 
 def run_web(name: str, default_port: int, build: Callable) -> None:

@@ -22,8 +22,12 @@ delivery is synchronous enqueue, consumers drain from their own queue.
 
 from __future__ import annotations
 
+import contextvars
+import datetime
+import logging
 import queue
 import threading
+import time
 import uuid
 from collections import deque
 from dataclasses import dataclass, field
@@ -34,6 +38,16 @@ from odh_kubeflow_tpu.machinery import objects as obj_util
 from odh_kubeflow_tpu.utils import tracing
 
 Obj = dict[str, Any]
+
+log = logging.getLogger("apiserver")
+
+# the calling context's fencing token — set by machinery.leader.fenced()
+# around controller work (and by the REST façade from the
+# X-Fencing-Token header), validated by the store on every mutation.
+# (namespace, lease_name, token) — None means the write is unfenced.
+_FENCE: contextvars.ContextVar[Optional[tuple[str, str, int]]] = (
+    contextvars.ContextVar("odh_fence", default=None)
+)
 
 
 class APIError(Exception):
@@ -98,6 +112,16 @@ class Expired(APIError):
     code = 410
 
 
+class FencedOut(APIError):
+    """The write carried a fencing token from a deposed lease epoch —
+    the holder lost (or let expire) its Lease after starting the
+    operation, and a newer epoch exists. Retrying cannot help: the
+    caller must stand down (controller-runtime exits the process).
+    403, not 409: this is an authority failure, not a data race."""
+
+    code = 403
+
+
 @dataclass
 class TypeInfo:
     api_version: str
@@ -153,6 +177,35 @@ BUILTIN_KINDS: list[tuple[str, str, str, bool]] = [
     ("coordination.k8s.io/v1", "Lease", "leases", True),
     ("scheduling.k8s.io/v1", "PriorityClass", "priorityclasses", False),
 ]
+
+_BUILTIN_KIND_NAMES = frozenset(k for _, k, _, _ in BUILTIN_KINDS)
+
+
+def current_fence() -> Optional[tuple[str, str, int]]:
+    """The calling context's ``(namespace, lease_name, token)`` fence,
+    or None when the caller is unfenced."""
+    return _FENCE.get()
+
+
+def set_fence(fence: Optional[tuple[str, str, int]]):
+    """Install a fence on the calling context; returns the reset token
+    for ``contextvars.ContextVar.reset``. Use ``machinery.leader.
+    fenced()`` instead of calling this directly."""
+    return _FENCE.set(fence)
+
+
+def reset_fence(token) -> None:
+    _FENCE.reset(token)
+
+
+def parse_micro_time(s: str) -> float:
+    """RFC3339-micro (kube MicroTime, the Lease spec's format) → epoch
+    seconds. Shared with machinery.leader (which writes the format)."""
+    return (
+        datetime.datetime.strptime(s, "%Y-%m-%dT%H:%M:%S.%fZ")
+        .replace(tzinfo=datetime.timezone.utc)
+        .timestamp()
+    )
 
 
 class Watch:
@@ -246,8 +299,25 @@ class APIServer:
     # Class attr so chaos tests shrink it to force expiry.
     WATCH_CACHE_SIZE = 2048
 
-    def __init__(self):
+    # mutations between WAL snapshots (when a WAL is attached);
+    # overridable per instance and via SNAPSHOT_INTERVAL in the
+    # platform entrypoint
+    SNAPSHOT_INTERVAL = 1024
+
+    def __init__(self, wal: Optional[Any] = None, snapshot_interval: Optional[int] = None):
         self._lock = _sanitizer.new_rlock("apiserver.store")
+        # durability: when a WriteAheadLog is attached, every mutation
+        # appends a checksummed record and fsyncs BEFORE it is applied
+        # or acked; recovery (APIServer.recover) replays snapshot + WAL
+        # tail. No WAL (the default) = the old in-memory-only store.
+        self._wal = wal
+        self._wal_broken = False
+        self._replaying = False
+        if snapshot_interval is not None:
+            self.SNAPSHOT_INTERVAL = int(snapshot_interval)
+        # clock for fence-expiry validation; injectable so fake-clock
+        # leader-election tests and the store agree on "now"
+        self.fence_now_fn: Callable[[], float] = time.time
         self._types: dict[str, TypeInfo] = {}
         self._store: dict[str, dict[tuple[str, str], Obj]] = {}
         # kind → namespace → {key: obj} — the same objects as _store,
@@ -277,9 +347,29 @@ class APIServer:
         self, api_version: str, kind: str, plural: str, namespaced: bool = True
     ) -> None:
         with self._lock:
+            fresh = kind not in self._types
             self._types[kind] = TypeInfo(api_version, kind, plural, namespaced)
             self._store.setdefault(kind, {})
             self._ns_buckets.setdefault(kind, {})
+            # dynamic (CRD) registrations must survive a restart or the
+            # replay of their objects would hit an unknown kind; builtin
+            # kinds re-register from code, so only log the rest
+            if (
+                fresh
+                and self._wal is not None
+                and not self._replaying
+                and kind not in _BUILTIN_KIND_NAMES
+            ):
+                self._wal_append(
+                    {
+                        "op": "register",
+                        "rv": self._rv,
+                        "apiVersion": api_version,
+                        "kind": kind,
+                        "plural": plural,
+                        "namespaced": namespaced,
+                    }
+                )
 
     def _register_builtins(self) -> None:
         for api_version, kind, plural, namespaced in BUILTIN_KINDS:
@@ -352,6 +442,245 @@ class APIServer:
             if not bucket:
                 del self._ns_buckets[kind][key[0]]
 
+    # -- durability (write-ahead log) ---------------------------------------
+
+    def _wal_append(self, record: Obj) -> None:
+        """Append + fsync one record, fail-stop on IO failure: a store
+        that can no longer make writes durable must stop acking them
+        (etcd panics here; we reject every later mutation with a 500).
+        CrashPoint (the drills' simulated process death) propagates
+        untouched — a dead process doesn't convert its own crash into
+        an API error."""
+        from odh_kubeflow_tpu.machinery.wal import CrashPoint
+
+        if self._wal_broken:
+            raise APIError(
+                "write-ahead log failed earlier; store is fail-stop "
+                "for mutations"
+            )
+        try:
+            self._wal.append(record)
+        except CrashPoint:
+            raise
+        except Exception as e:  # OSError, injected disk fault, …
+            self._wal_broken = True
+            log.error("WAL append failed; store is now fail-stop: %s", e)
+            raise APIError(f"write-ahead log append failed: {e}") from e
+
+    def _log_mutation(self, event_type: str, obj: Obj) -> None:
+        """Called BEFORE the mutation is applied to the in-memory maps:
+        log-then-apply means a failed append leaves no half-applied
+        state, and the ack (the verb returning) always follows the
+        fsync."""
+        if self._wal is None or self._replaying:
+            return
+        try:
+            rv = int(obj["metadata"]["resourceVersion"])
+        except (KeyError, TypeError, ValueError):
+            rv = self._rv
+        self._wal_append({"rv": rv, "etype": event_type, "obj": obj})
+
+    def _maybe_snapshot(self) -> None:
+        """Snapshot cadence check — runs under the store lock AFTER the
+        mutation applied, so the snapshot's consistent cut includes the
+        record that crossed the threshold. A snapshot failure is
+        logged and retried after another interval: the WAL still holds
+        every acked write, so durability is unaffected."""
+        if (
+            self._wal is None
+            or self._replaying
+            or self._wal_broken
+            or self.SNAPSHOT_INTERVAL <= 0
+            or self._wal.records_since_snapshot < self.SNAPSHOT_INTERVAL
+        ):
+            return
+        from odh_kubeflow_tpu.machinery.wal import CrashPoint
+
+        try:
+            self.snapshot_now()
+        except CrashPoint:
+            raise
+        except Exception as e:  # noqa: BLE001 — disk full, injected fault
+            log.warning("snapshot failed (will retry next interval): %s", e)
+            self._wal.records_since_snapshot = 0
+
+    def snapshot_now(self) -> None:
+        """Write a full-state snapshot and rotate/GC the WAL."""
+        if self._wal is None:
+            raise APIError("no write-ahead log attached")
+        with self._lock:
+            state = {
+                "rv": self._rv,
+                "compacted_rv": self._compacted_rv,
+                "types": [
+                    [t.api_version, t.kind, t.plural, t.namespaced]
+                    for t in self._types.values()
+                    if t.kind not in _BUILTIN_KIND_NAMES
+                ],
+                "kind_rv": dict(self._kind_rv),
+                "objects": [
+                    obj
+                    for per_kind in self._store.values()
+                    for obj in per_kind.values()
+                ],
+                # the bounded watch cache rides along so rv resumes
+                # keep working across a restart beyond the WAL tail
+                "events": [list(e) for e in self._event_log],
+            }
+            self._wal.snapshot(state, self._rv)
+
+    @classmethod
+    def recover(
+        cls,
+        wal: Any,
+        snapshot_interval: Optional[int] = None,
+    ) -> "APIServer":
+        """Rebuild a store from its WAL directory: newest snapshot,
+        then the WAL tail (records with rv above the snapshot),
+        restoring objects, the rv counter, per-kind versions, dynamic
+        kind registrations, the Event dedupe index, and the bounded
+        watch cache. ``_compacted_rv`` is raised to the recovered
+        window's floor so rv resumes below it surface 410 Expired —
+        never a silent restart from empty."""
+        snap, records = wal.recover()
+        srv = cls(snapshot_interval=snapshot_interval)
+        srv._replaying = True
+        try:
+            snap_rv = 0
+            if snap is not None:
+                snap_rv = int(snap.get("rv", 0))
+                for api_version, kind, plural, namespaced in snap.get(
+                    "types", []
+                ):
+                    srv.register_kind(api_version, kind, plural, namespaced)
+                for obj in snap.get("objects", []):
+                    info = srv.type_info(obj.get("kind", ""))
+                    meta = obj.get("metadata", {})
+                    key = srv._key(
+                        info,
+                        meta.get("namespace") if info.namespaced else None,
+                        meta.get("name", ""),
+                    )
+                    srv._put(info.kind, key, obj)
+                srv._rv = snap_rv
+                srv._kind_rv = {
+                    k: int(v) for k, v in snap.get("kind_rv", {}).items()
+                }
+                srv._compacted_rv = int(snap.get("compacted_rv", 0))
+                for rv, kind, ns, etype, obj in snap.get("events", []):
+                    srv._event_log.append(
+                        (int(rv), kind, ns, etype, obj_util.freeze(obj))
+                    )
+            for rec in records:
+                if rec.get("op") == "register":
+                    srv.register_kind(
+                        rec["apiVersion"],
+                        rec["kind"],
+                        rec["plural"],
+                        bool(rec.get("namespaced", True)),
+                    )
+                    continue
+                rv = int(rec.get("rv", 0))
+                if rv <= snap_rv:
+                    continue  # the snapshot already covers it
+                etype, obj = rec.get("etype", ""), rec.get("obj") or {}
+                kind = obj.get("kind", "")
+                info = srv.type_info(kind)  # loud NotFound on unknown kind
+                meta = obj.get("metadata", {})
+                ns = meta.get("namespace") if info.namespaced else None
+                key = srv._key(info, ns, meta.get("name", ""))
+                if etype == "DELETED":
+                    srv._drop(kind, key)
+                else:
+                    srv._put(kind, key, obj_util.deepcopy(obj))
+                srv._rv = max(srv._rv, rv)
+                srv._kind_rv[kind] = rv
+                srv._event_log.append(
+                    (rv, kind, meta.get("namespace", ""), etype,
+                     obj_util.freeze(obj))
+                )
+                while len(srv._event_log) > srv.WATCH_CACHE_SIZE:
+                    srv._compacted_rv = max(
+                        srv._compacted_rv, srv._event_log.popleft()[0]
+                    )
+            # resume-window floor: a resume needs every event after its
+            # rv; events below the rebuilt window are gone, so resumes
+            # below (oldest retained − 1) must 410 instead of silently
+            # missing history. An empty window (fresh log) stays at the
+            # snapshot floor; a non-empty history with no retained
+            # events can only resume from the present.
+            if srv._event_log:
+                srv._compacted_rv = max(
+                    srv._compacted_rv, srv._event_log[0][0] - 1
+                )
+            elif srv._rv:
+                srv._compacted_rv = max(srv._compacted_rv, srv._rv)
+            # Event dedupe index: rebuilt from the recovered Events so
+            # repeat emissions keep deduping instead of duplicating
+            for ev in srv._store.get("Event", {}).values():
+                inv = ev.get("involvedObject", {})
+                srv._event_index[
+                    (
+                        ev.get("metadata", {}).get("namespace", "default"),
+                        inv.get("kind", ""),
+                        inv.get("name", ""),
+                        inv.get("uid", ""),
+                        ev.get("reason", ""),
+                        ev.get("message", ""),
+                        ev.get("type", "Normal"),
+                    )
+                ] = ev.get("metadata", {}).get("name", "")
+        finally:
+            srv._replaying = False
+        srv._wal = wal
+        return srv
+
+    # -- fencing -------------------------------------------------------------
+
+    def _check_fence(self, kind: str) -> None:
+        """Reject mutations carrying a deposed lease epoch. Validated
+        under the store lock, atomically with the apply — this closes
+        the leader-election TOCTOU where a paused holder finishes an
+        in-flight write after a peer took the lease over. Lease writes
+        themselves are exempt (acquire/renew/release must work while
+        contested; they are already serialized by optimistic
+        concurrency)."""
+        fence = _FENCE.get()
+        if fence is None or kind == "Lease":
+            return
+        ns, name, token = fence
+        lease = self._store.get("Lease", {}).get((ns, name))
+        if lease is None:
+            raise FencedOut(
+                f"fencing lease {ns}/{name} no longer exists; epoch "
+                f"{token} is deposed"
+            )
+        spec = lease.get("spec") or {}
+        try:
+            current = int(spec.get("fencingToken", -1))
+        except (TypeError, ValueError):
+            current = -1
+        if current != int(token):
+            raise FencedOut(
+                f"fencing token {token} for lease {ns}/{name} is stale "
+                f"(current epoch {current}); the holder was deposed"
+            )
+        renew = spec.get("renewTime")
+        duration = float(
+            spec.get("leaseDurationSeconds") or 0
+        )
+        if renew and duration:
+            try:
+                age = self.fence_now_fn() - parse_micro_time(renew)
+            except ValueError:
+                age = 0.0
+            if age > duration:
+                raise FencedOut(
+                    f"fencing lease {ns}/{name} expired "
+                    f"{age - duration:.3f}s ago; epoch {token} may not "
+                    "write until it re-acquires"
+                )
+
     # -- CRUD ---------------------------------------------------------------
 
     def create(self, obj: Obj, dry_run: bool = False) -> Obj:
@@ -365,6 +694,7 @@ class APIServer:
         if not meta.get("name"):
             raise Invalid("metadata.name required")
         with self._lock:
+            self._check_fence(kind)
             # admission first: a mutating hook may rewrite name/namespace,
             # and the store key must reflect what admission returns.
             obj = self._run_admission(AdmissionRequest("CREATE", obj, None, dry_run))
@@ -398,6 +728,8 @@ class APIServer:
             meta["creationTimestamp"] = obj_util.now_rfc3339()
             meta["generation"] = 1
             meta["resourceVersion"] = self._next_rv()
+            # durable before applied or acked (log-then-apply)
+            self._log_mutation("ADDED", obj)
             self._put(kind, key, obj)
             self._notify("ADDED", obj)
             return obj_util.deepcopy(obj)
@@ -449,6 +781,7 @@ class APIServer:
         name = meta.get("name", "")
         namespace = meta.get("namespace") if info.namespaced else None
         with self._lock:
+            self._check_fence(kind)
             key = self._key(info, namespace, name)
             current = self._store[kind].get(key)
             if current is None:
@@ -499,6 +832,7 @@ class APIServer:
             if _cmp_view(obj) == _cmp_view(current):
                 return obj_util.deepcopy(current)
             obj["metadata"]["resourceVersion"] = self._next_rv()
+            self._log_mutation("MODIFIED", obj)
             self._put(kind, key, obj)
             self._notify("MODIFIED", obj)
             # a finalizer removal may release a pending delete
@@ -533,14 +867,20 @@ class APIServer:
     def delete(self, kind: str, name: str, namespace: Optional[str] = None) -> None:
         info = self.type_info(kind)
         with self._lock:
+            self._check_fence(kind)
             key = self._key(info, namespace, name)
             current = self._store[kind].get(key)
             if current is None:
                 raise NotFound(f"{kind} {namespace or ''}/{name} not found")
             if current["metadata"].get("finalizers"):
                 if not current["metadata"].get("deletionTimestamp"):
+                    # on a private copy, so the log-then-apply ordering
+                    # holds: nothing visible changes if the append fails
+                    current = obj_util.deepcopy(current)
                     current["metadata"]["deletionTimestamp"] = obj_util.now_rfc3339()
                     current["metadata"]["resourceVersion"] = self._next_rv()
+                    self._log_mutation("MODIFIED", current)
+                    self._put(kind, key, current)
                     self._notify("MODIFIED", current)
                 return
             self._remove(info, current)
@@ -551,12 +891,17 @@ class APIServer:
             current["metadata"].get("namespace") if info.namespaced else None,
             current["metadata"]["name"],
         )
-        self._drop(info.kind, key)
         # a deletion is a new cluster state: stamp a FRESH rv (kube
         # does the same) so the watch cache orders it after the last
         # modification — a resume from the final modified rv must
-        # deliver the DELETED event, not silently skip it
+        # deliver the DELETED event, not silently skip it. Stamped on
+        # a private copy: log-then-apply means a failed WAL append
+        # must leave the stored object (still served to readers in the
+        # fail-stop store) bit-identical, carrying no unlogged rv.
+        current = obj_util.deepcopy(current)
         current["metadata"]["resourceVersion"] = self._next_rv()
+        self._log_mutation("DELETED", current)
+        self._drop(info.kind, key)
         self._notify("DELETED", current)
         self._cascade(current)
 
@@ -670,6 +1015,9 @@ class APIServer:
             if w.namespace and w.namespace != ns:
                 continue
             w._enqueue((event_type, shared))
+        # WAL snapshot cadence — after the apply, so the snapshot's
+        # consistent cut includes this mutation (re-entrant lock)
+        self._maybe_snapshot()
 
     # -- convenience --------------------------------------------------------
 
@@ -764,14 +1112,19 @@ class APIServer:
             for _, name in drop:
                 key = self._key(info, namespace, name)
                 expired = self._store["Event"].get(key)
-                self._drop("Event", key)
                 if expired is not None:
                     # watchers (and the informer cache) must see the
                     # expiry, or they'd retain pruned events forever —
                     # kube-apiserver's TTL expiry likewise ends watches
-                    # with DELETED (fresh rv, same as _remove)
+                    # with DELETED (fresh rv on a private copy, same
+                    # log-then-apply discipline as _remove)
+                    expired = obj_util.deepcopy(expired)
                     expired["metadata"]["resourceVersion"] = self._next_rv()
+                    self._log_mutation("DELETED", expired)
+                    self._drop("Event", key)
                     self._notify("DELETED", expired)
+                else:
+                    self._drop("Event", key)
             dead = {name for _, name in drop}
             self._event_index = {
                 k: v for k, v in self._event_index.items() if v not in dead

@@ -101,7 +101,21 @@ class Platform:
         sim: bool = False,
         spawner_config_path: Optional[str] = None,
     ):
-        self.api = APIServer()
+        # WAL_DIR=<path> makes the embedded apiserver durable: every
+        # mutation is WAL-logged + fsync'd before it is acked, a
+        # snapshot is cut every SNAPSHOT_INTERVAL mutations, and boot
+        # recovers the previous incarnation's objects, rv history, and
+        # watch-resume window from disk (see docs/GUIDE.md
+        # "Durability & failover"). Unset = the in-memory-only store.
+        wal_dir = os.environ.get("WAL_DIR", "")
+        if wal_dir:
+            from odh_kubeflow_tpu.machinery.wal import WriteAheadLog
+
+            wal = WriteAheadLog(wal_dir)
+            snap_every = int(os.environ.get("SNAPSHOT_INTERVAL", "1024"))
+            self.api = APIServer.recover(wal, snapshot_interval=snap_every)
+        else:
+            self.api = APIServer()
         register_crds(self.api)
         register_scheduling(self.api)
         register_sessions(self.api)

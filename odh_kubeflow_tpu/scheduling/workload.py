@@ -126,3 +126,32 @@ def chips_of(wl: Obj) -> int:
 
 def priority_of(wl: Obj) -> int:
     return int(obj_util.get_path(wl, "spec", "priority", default=0) or 0)
+
+
+def admitted_reservations(api: Any) -> dict[str, dict[str, Any]]:
+    """The scheduler's whole reservation picture, re-derived from the
+    store alone: per queue, the admitted workload names, committed chip
+    count, and assigned nodes. The scheduler is deliberately stateless
+    across cycles (everything lives in Workload status), which is what
+    makes the control plane's crash recovery work — the durability
+    drills assert this picture is bit-identical before a crash and
+    after WAL replay, and the recovery bench uses it as the
+    "reservations rebuilt" checkpoint."""
+    out: dict[str, dict[str, Any]] = {}
+    for wl in api.list("Workload"):  # cold path: recovery audit, not reconcile
+        if not is_admitted(wl):
+            continue
+        queue = (
+            obj_util.get_path(wl, "spec", "queue", default="") or ""
+        )
+        bucket = out.setdefault(
+            queue, {"workloads": [], "chips": 0, "nodes": []}
+        )
+        key = f"{obj_util.namespace_of(wl)}/{obj_util.name_of(wl)}"
+        bucket["workloads"].append(key)
+        bucket["chips"] += chips_of(wl)
+        bucket["nodes"].extend(assigned_nodes(wl))
+    for bucket in out.values():
+        bucket["workloads"].sort()
+        bucket["nodes"].sort()
+    return out

@@ -40,6 +40,7 @@ def test_rule_catalog_has_the_platform_rules():
         "metric-naming",
         "retry-without-backoff",
         "hot-path-json-dumps",
+        "unfenced-write",
     } <= ids
     assert len(ids) >= 5
 
@@ -540,6 +541,86 @@ def test_retry_without_backoff_suppressed_with_reason():
         "            pass\n"
     )
     assert lint_source(src, "machinery/x.py", ["retry-without-backoff"]) == []
+
+
+# ---------------------------------------------------------------------------
+# unfenced-write
+
+
+def test_unfenced_write_in_leader_electing_module_flagged():
+    # the leader-election TOCTOU shape: the module runs its own
+    # elector, then writes raw — a deposed holder's in-flight write
+    # would land unchecked
+    src = (
+        "from odh_kubeflow_tpu.machinery.leader import LeaderElector\n"
+        "def reconcile(api, obj):\n"
+        "    elector = LeaderElector(api, 'x-leader')\n"
+        "    if elector.try_acquire():\n"
+        "        api.update(obj)\n"
+    )
+    assert rule_ids(
+        lint_source(src, "controllers/x.py", ["unfenced-write"])
+    ) == ["unfenced-write"]
+
+
+def test_unfenced_write_clean_variants():
+    # fenced lexically: the with-block installs the epoch
+    src = (
+        "from odh_kubeflow_tpu.machinery.leader import LeaderElector\n"
+        "def reconcile(api, elector, obj):\n"
+        "    with elector.fence():\n"
+        "        api.update(obj)\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["unfenced-write"]) == []
+    # fenced via the helper function form
+    src = (
+        "from odh_kubeflow_tpu.machinery.leader import fenced\n"
+        "def reconcile(api, obj, token):\n"
+        "    with fenced('kubeflow', 'x-leader', token):\n"
+        "        api.create(obj)\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["unfenced-write"]) == []
+    # a fence-carrying handle passes by name
+    src = (
+        "from odh_kubeflow_tpu.machinery import leader\n"
+        "def reconcile(fenced_api, obj):\n"
+        "    fenced_api.update_status(obj)\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["unfenced-write"]) == []
+    # a module that does NOT use leader machinery is out of scope —
+    # its fence comes from the Manager (fence_fn), dynamically
+    src = (
+        "def reconcile(api, obj):\n"
+        "    api.update(obj)\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["unfenced-write"]) == []
+    # reads never need a fence
+    src = (
+        "from odh_kubeflow_tpu.machinery.leader import LeaderElector\n"
+        "def peek(api):\n"
+        "    return api.get('Lease', 'x-leader', 'kubeflow')\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["unfenced-write"]) == []
+
+
+def test_unfenced_write_marker_and_lambda_conservatism():
+    # boot-time/epoch-free writes annotate with a reason
+    src = (
+        "from odh_kubeflow_tpu.machinery.leader import LeaderElector\n"
+        "def seed(api, obj):\n"
+        "    api.create(obj)  # unfenced-ok: boot-time seeding, no epoch\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["unfenced-write"]) == []
+    # a lambda inside a fence block runs while the (dynamic) fence is
+    # installed — the rule must not flag it
+    src = (
+        "from odh_kubeflow_tpu.machinery import backoff\n"
+        "from odh_kubeflow_tpu.machinery.leader import LeaderElector\n"
+        "def reconcile(api, elector, obj):\n"
+        "    with elector.fence():\n"
+        "        return backoff.retry(lambda: api.update(obj))\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["unfenced-write"]) == []
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,854 @@
+"""Durable control plane: WAL + snapshot crash recovery, fencing, and
+sharded-controller failover drills.
+
+The invariants under test (docs/GUIDE.md "Durability & failover"):
+
+- **prefix consistency** — recovery yields exactly the acked history:
+  no acked write is ever lost, no unacked write is ever half-applied
+  (at most the single in-flight record, which is atomic);
+- **rv monotonicity** — the recovered rv counter is ≥ every acked rv,
+  so post-recovery writes never reuse history;
+- **watch-cache coherence** — rv resumes across a restart either
+  replay correctly from the rebuilt window or surface 410 Expired;
+  never a silent restart from empty;
+- **fencing/failover** — killing the active manager replica
+  mid-reconcile hands its namespace shard to a peer within the lease
+  window, and the dead epoch's in-flight writes are rejected by the
+  store (zero double-applied writes).
+
+Run under ``GRAFT_SANITIZE=1`` and a seeded ``GRAFT_CHAOS`` schedule
+via ``make durability`` (the CI drill step); the kill-point sweep and
+disk-fault schedules derive their seeds from ``GRAFT_CHAOS`` when set.
+"""
+
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.controllers.runtime import Manager
+from odh_kubeflow_tpu.machinery import backoff
+from odh_kubeflow_tpu.machinery.faults import (
+    DiskFaultSchedule,
+    FaultInjector,
+    FaultSchedule,
+    FaultyFileIO,
+    KillPointIO,
+    chaos_seed,
+)
+from odh_kubeflow_tpu.machinery.leader import ShardMembership
+from odh_kubeflow_tpu.machinery.store import (
+    AlreadyExists,
+    APIError,
+    APIServer,
+    Conflict,
+    Expired,
+    FencedOut,
+    NotFound,
+    TooManyRequests,
+)
+from odh_kubeflow_tpu.machinery.wal import (
+    CrashPoint,
+    FileIO,
+    WALCorruptError,
+    WriteAheadLog,
+)
+from odh_kubeflow_tpu.scheduling import register_scheduling
+from odh_kubeflow_tpu.scheduling.workload import admitted_reservations
+from odh_kubeflow_tpu.sessions import new_checkpoint, register_sessions
+from odh_kubeflow_tpu.sessions.checkpoint import SessionCheckpointStore
+from odh_kubeflow_tpu.sessions.manager import SessionManager
+from odh_kubeflow_tpu.utils import prometheus
+
+SEED = chaos_seed() or 11
+
+
+def _widget_api(wal, snapshot_interval=9):
+    api = APIServer(wal=wal, snapshot_interval=snapshot_interval)
+    api.register_kind("kubeflow.org/v1", "Widget", "widgets")
+    return api
+
+
+def _widgets_of(api) -> dict:
+    try:
+        items = api.list("Widget")
+    except NotFound:  # crashed before the registration record landed
+        return {}
+    return {
+        (o["metadata"]["namespace"], o["metadata"]["name"]): o["spec"]["v"]
+        for o in items
+    }
+
+
+# ---------------------------------------------------------------------------
+# WAL mechanics
+
+
+def test_wal_roundtrip_snapshot_rotation_and_gc(tmp_path):
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog(d)
+    api = _widget_api(wal, snapshot_interval=5)
+    for i in range(13):
+        api.create(
+            {"kind": "Widget", "metadata": {"name": f"w{i}", "namespace": "a"},
+             "spec": {"v": i}}
+        )
+    api.delete("Widget", "w3", "a")
+    w5 = api.get("Widget", "w5", "a")
+    w5["spec"]["v"] = 500
+    api.update(w5)
+    # snapshots fired (interval 5) and GC'd covered segments: the dir
+    # must not accumulate one file per record
+    names = sorted(os.listdir(d))
+    assert sum(n.startswith("snap-") for n in names) == 1
+    wal.close()
+
+    rec = APIServer.recover(WriteAheadLog(d))
+    assert len(rec.list("Widget", namespace="a")) == 12
+    assert rec.get("Widget", "w5", "a")["spec"]["v"] == 500
+    with pytest.raises(NotFound):
+        rec.get("Widget", "w3", "a")
+    # server-owned metadata survives bit-for-bit
+    orig, back = api.get("Widget", "w7", "a"), rec.get("Widget", "w7", "a")
+    assert orig["metadata"]["uid"] == back["metadata"]["uid"]
+    assert orig["metadata"]["resourceVersion"] == back["metadata"]["resourceVersion"]
+    # the rv counter continues, never reuses history
+    fresh = rec.create(
+        {"kind": "Widget", "metadata": {"name": "post", "namespace": "a"},
+         "spec": {"v": 1}}
+    )
+    assert int(fresh["metadata"]["resourceVersion"]) > int(
+        orig["metadata"]["resourceVersion"]
+    )
+
+
+def test_event_dedupe_index_survives_recovery(tmp_path):
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog(d)
+    api = _widget_api(wal)
+    obj = api.create(
+        {"kind": "Widget", "metadata": {"name": "w", "namespace": "a"},
+         "spec": {"v": 0}}
+    )
+    ev = api.emit_event(obj, "Scheduled", "placed on node n1")
+    wal.close()
+    rec = APIServer.recover(WriteAheadLog(d))
+    again = rec.emit_event(obj, "Scheduled", "placed on node n1")
+    assert again["metadata"]["name"] == ev["metadata"]["name"]
+    assert len(rec.list("Event", namespace="a")) == 1
+
+
+def test_torn_tail_is_truncated_and_never_acked_lost(tmp_path):
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog(d)
+    api = _widget_api(wal, snapshot_interval=0)  # no snapshots: pure log
+    for i in range(5):
+        api.create(
+            {"kind": "Widget", "metadata": {"name": f"w{i}", "namespace": "a"},
+             "spec": {"v": i}}
+        )
+    wal.close()
+    seg = [n for n in os.listdir(d) if n.startswith("wal-")][0]
+    path = os.path.join(d, seg)
+    whole = os.path.getsize(path)
+    # a crash tore the final append: append half a bogus record
+    with open(path, "ab") as f:
+        f.write(b"\xff\xff\x00\x00garbage-torn-tail")
+    rec = APIServer.recover(WriteAheadLog(d))
+    assert len(rec.list("Widget", namespace="a")) == 5  # acked all intact
+    # and the torn bytes were physically truncated for the next boot
+    assert os.path.getsize(path) == whole
+
+
+def test_corrupt_midlog_record_fails_loudly(tmp_path):
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog(d)
+    api = _widget_api(wal, snapshot_interval=0)
+    for i in range(6):
+        api.create(
+            {"kind": "Widget", "metadata": {"name": f"w{i}", "namespace": "a"},
+             "spec": {"v": i}}
+        )
+    wal.close()
+    seg = [n for n in os.listdir(d) if n.startswith("wal-")][0]
+    path = os.path.join(d, seg)
+    data = bytearray(open(path, "rb").read())
+    # flip a payload byte in the middle of the log (valid records
+    # follow): this is rot, not a torn write — refusing loudly beats
+    # silently dropping acked history
+    data[len(data) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(WALCorruptError):
+        APIServer.recover(WriteAheadLog(d))
+
+
+def test_corrupt_sealed_segment_fails_loudly(tmp_path):
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog(d)
+    api = _widget_api(wal, snapshot_interval=0)
+    api.create(
+        {"kind": "Widget", "metadata": {"name": "w0", "namespace": "a"},
+         "spec": {"v": 0}}
+    )
+    wal.close()
+    # recovery rotates to a fresh segment; the old one is now sealed
+    wal2 = WriteAheadLog(d)
+    rec = APIServer.recover(wal2)
+    rec.create(
+        {"kind": "Widget", "metadata": {"name": "w1", "namespace": "a"},
+         "spec": {"v": 1}}
+    )
+    wal2.close()
+    sealed = sorted(n for n in os.listdir(d) if n.startswith("wal-"))[0]
+    with open(os.path.join(d, sealed), "ab") as f:
+        f.write(b"tail-garbage")  # a "torn tail" in a SEALED segment
+    with pytest.raises(WALCorruptError):
+        APIServer.recover(WriteAheadLog(d))
+
+
+# ---------------------------------------------------------------------------
+# watch-resume window across restart (the 410 contract)
+
+
+def test_watch_resume_across_restart_replays_or_410(tmp_path, monkeypatch):
+    monkeypatch.setattr(APIServer, "WATCH_CACHE_SIZE", 16)
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog(d)
+    api = _widget_api(wal, snapshot_interval=10)
+    for i in range(40):
+        api.create(
+            {"kind": "Widget", "metadata": {"name": f"w{i}", "namespace": "a"},
+             "spec": {"v": i}}
+        )
+    live_floor = api._compacted_rv
+    assert live_floor > 0  # the live window already compacted
+    wal.close()
+
+    rec = APIServer.recover(WriteAheadLog(d))
+    assert rec._compacted_rv >= live_floor
+    # below the recovered window: 410, NEVER a silent empty stream
+    with pytest.raises(Expired):
+        rec.watch("Widget", resource_version="1")
+    # within the window: replay is correct and ordered
+    floor = rec._compacted_rv
+    w = rec.watch("Widget", resource_version=str(floor))
+    got, last = [], floor
+    while (item := w.try_get()) is not None:
+        etype, obj = item
+        rv = int(obj["metadata"]["resourceVersion"])
+        assert rv > last
+        last = rv
+        got.append((etype, obj["metadata"]["name"]))
+    assert got  # something replayed
+    assert last == rec._rv  # replay reaches the present
+
+
+def test_http_watch_resume_after_restart_maps_to_410(tmp_path, monkeypatch):
+    """Satellite: over the REST façade, a resume whose rv predates the
+    recovered window must surface the same 410 Expired Status the
+    compaction path established — not an empty watch stream."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from odh_kubeflow_tpu.machinery import httpapi
+
+    monkeypatch.setattr(APIServer, "WATCH_CACHE_SIZE", 16)
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog(d)
+    api = _widget_api(wal, snapshot_interval=10)
+    for i in range(40):
+        api.create(
+            {"kind": "Widget", "metadata": {"name": f"w{i}", "namespace": "a"},
+             "spec": {"v": i}}
+        )
+    wal.close()
+    rec = APIServer.recover(WriteAheadLog(d))
+    _, port, httpd = httpapi.serve(rec, event_loop=False)
+    try:
+        base = f"http://127.0.0.1:{port}/apis/kubeflow.org/v1/namespaces/a/widgets"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                base + "?watch=true&resourceVersion=1", timeout=5
+            )
+        assert exc.value.code == 410
+        status = json.loads(exc.value.read().decode())
+        assert status["reason"] == "Expired"
+        # a plain relist (the client's 410 recovery move) serves fully
+        with urllib.request.urlopen(base, timeout=5) as r:
+            assert len(json.loads(r.read().decode())["items"]) == 40
+    finally:
+        httpd.shutdown()
+
+
+def test_malformed_fence_header_400_does_not_leak_inflight_slots():
+    """Regression: the 400 for a bad X-Fencing-Token must be emitted
+    BEFORE the APF limiter admits the request — otherwise each bad
+    header permanently burns an inflight slot and a client can wedge
+    itself into perpetual 429s."""
+    import io as _io
+
+    from odh_kubeflow_tpu.machinery.httpapi import RestAPI
+
+    api = APIServer()
+    api.register_kind("kubeflow.org/v1", "Widget", "widgets")
+    app = RestAPI(api, inflight_limit=2)
+    statuses = []
+
+    def call(headers):
+        environ = {
+            "REQUEST_METHOD": "GET",
+            "PATH_INFO": "/apis/kubeflow.org/v1/namespaces/a/widgets",
+            "QUERY_STRING": "",
+            "REMOTE_ADDR": "1.2.3.4",
+            "wsgi.input": _io.BytesIO(b""),
+            **headers,
+        }
+        body = app(environ, lambda s, h: statuses.append(s))
+        return statuses[-1], b"".join(body)
+
+    for _ in range(5):  # way past the limit of 2
+        status, _ = call({"HTTP_X_FENCING_TOKEN": "garbage"})
+        assert status.startswith("400")
+        status, _ = call({"HTTP_X_FENCING_TOKEN": "ns/lease/not-a-number"})
+        assert status.startswith("400")
+    # the client's slots are all still free
+    status, _ = call({})
+    assert status.startswith("200")
+
+
+# ---------------------------------------------------------------------------
+# randomized kill-point / disk-fault drills
+
+
+def _ops_script(rng, n=26):
+    names = [f"w{i}" for i in range(5)]
+    ops = []
+    for i in range(n):
+        ops.append(
+            (
+                rng.choice(["create", "create", "update", "update", "delete"]),
+                rng.choice(["a", "b"]),
+                rng.choice(names),
+                i,
+            )
+        )
+    return ops
+
+
+def _apply_ops(api, ops):
+    """Drive the op script; returns (model, acked, in_flight, last_rv).
+    ``model`` reflects exactly the acked mutations; ``in_flight`` is
+    the single op that died mid-commit (None if the run completed or
+    the failure was a clean rejection)."""
+    model, acked, last_rv = {}, [], 0
+    for op, ns, name, i in ops:
+        key = (ns, name)
+        in_flight = (op, key, i)
+        try:
+            if op == "create":
+                try:
+                    out = api.create(
+                        {"kind": "Widget",
+                         "metadata": {"name": name, "namespace": ns},
+                         "spec": {"v": i}}
+                    )
+                except AlreadyExists:
+                    continue  # clean rejection: nothing committed
+                model[key] = i
+            elif op == "update":
+                try:
+                    cur = api.get("Widget", name, ns)
+                except NotFound:
+                    continue
+                cur["spec"]["v"] = i
+                out = api.update(cur)
+                model[key] = i
+            else:
+                try:
+                    api.delete("Widget", name, ns)
+                except NotFound:
+                    continue
+                out = None
+                model.pop(key, None)
+            if out is not None:
+                last_rv = max(last_rv, int(out["metadata"]["resourceVersion"]))
+            acked.append((op, key, i))
+        except (CrashPoint, APIError):
+            # crashed (or went fail-stop) mid-commit: the op was never
+            # acked — roll the model entry back
+            if op == "create":
+                model.pop(key, None)
+            elif op == "update":
+                pass  # model still holds the previous acked value
+            raise
+    return model, acked, None, last_rv
+
+
+def _run_to_crash(api, ops):
+    """Apply until process death / fail-stop; returns (model of acked
+    ops, in-flight op or None, last acked rv, crashed?)."""
+    model, acked, last_rv = {}, [], 0
+    for op, ns, name, i in ops:
+        key = (ns, name)
+        prev = dict(model)
+        try:
+            if op == "create":
+                try:
+                    out = api.create(
+                        {"kind": "Widget",
+                         "metadata": {"name": name, "namespace": ns},
+                         "spec": {"v": i}}
+                    )
+                except AlreadyExists:
+                    continue
+                model[key] = i
+            elif op == "update":
+                try:
+                    cur = api.get("Widget", name, ns)
+                except NotFound:
+                    continue
+                cur["spec"]["v"] = i
+                out = api.update(cur)
+                model[key] = i
+            else:
+                try:
+                    api.delete("Widget", name, ns)
+                except NotFound:
+                    continue
+                out = None
+                model.pop(key, None)
+            if out is not None:
+                last_rv = max(last_rv, int(out["metadata"]["resourceVersion"]))
+        except (CrashPoint, APIError):
+            # the in-flight mutation is allowed to be durable-but-
+            # unacked; model must NOT count it as acked
+            in_flight = {
+                "create": lambda m: {**m, key: i},
+                "update": lambda m: {**m, key: i} if key in m else m,
+                "delete": lambda m: {k: v for k, v in m.items() if k != key},
+            }[op](prev)
+            return prev, in_flight, last_rv, True
+    return model, None, last_rv, False
+
+
+def _recover_with_retries(d, io=None, attempts=4):
+    last = None
+    for _ in range(attempts):
+        try:
+            return APIServer.recover(WriteAheadLog(d, io=io))
+        except OSError as e:  # transient short read: retry recovery
+            last = e
+    raise last
+
+
+def _assert_watch_cache_coherent(rec):
+    """Folding the recovered resume window must agree with the
+    recovered store: live keys match values, deleted keys are gone,
+    and event rvs are strictly increasing."""
+    store_now = _widgets_of(rec)
+    try:
+        w = rec.watch("Widget", resource_version=str(rec._compacted_rv))
+    except (Expired, NotFound):
+        return
+    folded, deleted, last = {}, set(), rec._compacted_rv
+    while (item := w.try_get()) is not None:
+        etype, obj = item
+        rv = int(obj["metadata"]["resourceVersion"])
+        assert rv > last, "watch replay rvs must be strictly increasing"
+        last = rv
+        key = (obj["metadata"]["namespace"], obj["metadata"]["name"])
+        if etype == "DELETED":
+            deleted.add(key)
+            folded.pop(key, None)
+        else:
+            deleted.discard(key)
+            folded[key] = obj["spec"]["v"]
+    for key, v in folded.items():
+        assert store_now.get(key) == v
+    for key in deleted:
+        assert key not in store_now
+
+
+@pytest.mark.parametrize("after_op", [False, True])
+def test_kill_point_sweep_prefix_consistency(tmp_path, after_op):
+    """Process death injected at EVERY WAL IO op in turn (mid-append
+    with a torn record, pre-fsync, post-fsync pre-ack): restart must
+    recover exactly the acked prefix (± the one atomic in-flight
+    record), keep rv monotonic, and keep the watch cache coherent."""
+    rng = random.Random(SEED)
+    ops = _ops_script(rng)
+    # probe run: count the total IO ops a clean pass makes
+    probe_io = KillPointIO(10**9, seed=SEED)
+    probe_wal = WriteAheadLog(str(tmp_path / "probe"), io=probe_io)
+    _apply_ops(_widget_api(probe_wal, snapshot_interval=7), ops)
+    total_io = probe_io.ops
+    assert total_io > 20
+
+    for kill_at in range(1, total_io + 1):
+        d = str(tmp_path / f"k{int(after_op)}-{kill_at}")
+        io = KillPointIO(kill_at, seed=SEED * 1000 + kill_at, after_op=after_op)
+        try:
+            # the kind-registration record is WAL IO too: the earliest
+            # kill points fire before the first CRUD op
+            api = _widget_api(WriteAheadLog(d, io=io), snapshot_interval=7)
+        except CrashPoint:
+            acked_model, in_flight, last_rv, crashed = {}, None, 0, True
+        else:
+            acked_model, in_flight, last_rv, crashed = _run_to_crash(api, ops)
+        assert crashed  # kill_at ≤ total_io must fire
+
+        rec = _recover_with_retries(d)
+        recovered = _widgets_of(rec)
+        assert recovered in (acked_model, in_flight), (
+            f"kill@{kill_at}: recovered {recovered} is neither the "
+            f"acked prefix {acked_model} nor acked+in-flight {in_flight}"
+        )
+        assert rec._rv >= last_rv, "rv counter went backwards"
+        _assert_watch_cache_coherent(rec)
+        # the recovered store keeps working
+        if recovered:
+            (ns, name) = next(iter(recovered))
+            cur = rec.get("Widget", name, ns)
+            cur["spec"]["v"] = -1
+            assert int(
+                rec.update(cur)["metadata"]["resourceVersion"]
+            ) > last_rv
+
+
+def test_disk_fault_schedule_drill(tmp_path):
+    """Seeded torn-write / failed-fsync / short-read / slow-disk
+    weather over many runs: every recovery is the acked prefix (± the
+    in-flight record), and short reads during recovery are retried —
+    never mistaken for a torn tail."""
+    for case in range(12):
+        seed = SEED * 100 + case
+        rng = random.Random(seed)
+        ops = _ops_script(rng, n=22)
+        d = str(tmp_path / f"c{case}")
+        io = FaultyFileIO(
+            seed=seed,
+            schedule=DiskFaultSchedule(
+                torn_write=0.06, fsync_fail=0.04, short_read=0.25,
+                slow_disk=0.05, slow_seconds=0.0,
+            ),
+            sleep_fn=lambda s: None,
+        )
+        try:
+            api = _widget_api(WriteAheadLog(d, io=io), snapshot_interval=6)
+        except (CrashPoint, APIError):
+            acked_model, in_flight, last_rv, crashed = {}, None, 0, True
+        else:
+            acked_model, in_flight, last_rv, crashed = _run_to_crash(api, ops)
+        # recovery under short-read weather too
+        rec_io = FaultyFileIO(
+            seed=seed + 1,
+            schedule=DiskFaultSchedule(short_read=0.25),
+        )
+        rec = _recover_with_retries(d, io=rec_io)
+        recovered = _widgets_of(rec)
+        if crashed:
+            assert recovered in (acked_model, in_flight)
+        else:
+            assert recovered == acked_model
+        assert rec._rv >= last_rv
+        _assert_watch_cache_coherent(rec)
+
+
+def test_failed_fsync_is_failstop_and_never_half_applies(tmp_path):
+    d = str(tmp_path / "wal")
+    io = FaultyFileIO(seed=1, schedule=DiskFaultSchedule.none())
+    api = _widget_api(WriteAheadLog(d, io=io), snapshot_interval=0)
+    api.create(
+        {"kind": "Widget", "metadata": {"name": "ok", "namespace": "a"},
+         "spec": {"v": 1}}
+    )
+    io.schedule = DiskFaultSchedule(fsync_fail=1.0)
+    with pytest.raises(APIError):
+        api.create(
+            {"kind": "Widget", "metadata": {"name": "lost", "namespace": "a"},
+             "spec": {"v": 2}}
+        )
+    # log-then-apply: the failed write is NOT visible in memory…
+    with pytest.raises(NotFound):
+        api.get("Widget", "lost", "a")
+    # …and the store is fail-stop for further mutations (etcd panic
+    # posture), while reads keep serving
+    io.schedule = DiskFaultSchedule.none()
+    with pytest.raises(APIError):
+        api.create(
+            {"kind": "Widget", "metadata": {"name": "late", "namespace": "a"},
+             "spec": {"v": 3}}
+        )
+    assert api.get("Widget", "ok", "a")["spec"]["v"] == 1
+    # recovery: the acked write is there; the unacked one may or may
+    # not be (its record's durability is exactly what fsync could not
+    # promise) — but never a torn half-state
+    rec = _recover_with_retries(d)
+    got = _widgets_of(rec)
+    assert got in ({("a", "ok"): 1}, {("a", "ok"): 1, ("a", "lost"): 2})
+
+
+def test_snapshot_write_failure_does_not_lose_acked_writes(tmp_path):
+    class NoSnapshotIO(FileIO):
+        def open_trunc(self, path):  # every snapshot attempt fails
+            raise OSError("injected snapshot failure")
+
+    d = str(tmp_path / "wal")
+    api = _widget_api(
+        WriteAheadLog(d, io=NoSnapshotIO()), snapshot_interval=4
+    )
+    for i in range(14):  # crosses the snapshot threshold repeatedly
+        api.create(
+            {"kind": "Widget", "metadata": {"name": f"w{i}", "namespace": "a"},
+             "spec": {"v": i}}
+        )
+    rec = _recover_with_retries(d)
+    assert len(rec.list("Widget", namespace="a")) == 14
+
+
+def test_recovery_under_chaos_api_faults(tmp_path):
+    """The client-visible chaos layer (injected conflicts/429/5xx) on
+    top of a durable store: whatever the retrying client saw acked is
+    exactly what a post-crash recovery serves."""
+    d = str(tmp_path / "wal")
+    api = _widget_api(WriteAheadLog(d), snapshot_interval=8)
+    inj = FaultInjector(
+        api,
+        seed=SEED,
+        schedule=FaultSchedule(
+            conflict=0.08, too_many_requests=0.08, server_error=0.08
+        ),
+        registry=prometheus.Registry(),
+        sleep_fn=lambda s: None,
+    )
+    acked = {}
+    for i in range(40):
+        name, ns = f"w{i % 7}", "a"
+
+        def attempt(name=name, ns=ns, i=i):
+            try:
+                return inj.create(
+                    {"kind": "Widget",
+                     "metadata": {"name": name, "namespace": ns},
+                     "spec": {"v": i}}
+                )
+            except AlreadyExists:
+                cur = inj.get("Widget", name, ns)
+                cur["spec"]["v"] = i
+                return inj.update(cur)
+
+        try:
+            backoff.retry(
+                attempt,
+                retryable=(Conflict, TooManyRequests, APIError),
+                attempts=6,
+                sleep_fn=lambda s: None,
+            )
+            acked[(ns, name)] = i
+        except (Conflict, TooManyRequests, APIError):
+            pass  # never acked; the store may or may not hold it
+    rec = _recover_with_retries(d)
+    got = _widgets_of(rec)
+    for key, v in acked.items():
+        assert key in got, f"acked write {key} lost across recovery"
+    # unacked writes may exist (ambiguous failures), but nothing else
+    assert set(got) <= {("a", f"w{k}") for k in range(7)}
+
+
+# ---------------------------------------------------------------------------
+# failover drill: kill the active manager replica mid-reconcile
+
+
+def test_failover_drill_shard_handover_with_zero_double_applies(tmp_path):
+    """Two live manager replicas share the namespace space; replica 1
+    is killed mid-reconcile (heartbeat stopped while a reconcile is
+    parked holding a stale read). The drill asserts: the shard hands
+    over to replica 2 within the lease window, replica 1's in-flight
+    write is rejected by the fencing check (FencedOut), every Widget
+    is status-written EXACTLY once, and nothing is double-applied."""
+    lease = 1.0
+    api = _widget_api(
+        WriteAheadLog(str(tmp_path / "wal")), snapshot_interval=64
+    )
+    m1 = ShardMembership(
+        api, "mgr", identity="r1", namespace="default",
+        lease_duration=lease, renew_period=0.05, retry_period=0.02,
+    )
+    m2 = ShardMembership(
+        api, "mgr", identity="r2", namespace="default",
+        lease_duration=lease, renew_period=0.05, retry_period=0.02,
+    )
+    assert m1.join() and m2.join()
+
+    namespaces = [f"ns{i}" for i in range(8)]
+    r1_owned = [ns for ns in namespaces if m1.owns(ns)]
+    assert r1_owned, "rendezvous must give r1 something over 8 namespaces"
+    hang_ns = r1_owned[0]
+
+    applied = []  # (key, identity, t) appended ONLY after a landed write
+    fenced_out = []
+    lock = threading.Lock()
+    hung = threading.Event()  # r1 parked mid-reconcile
+    released = threading.Event()  # the stale write resumes
+
+    def make_reconcile(ident):
+        def reconcile(req):
+            obj = api.get("Widget", req.name, req.namespace)
+            if (obj.get("status") or {}).get("writer"):
+                return None  # level-triggered quiesce
+            if ident == "r1" and req.namespace == hang_ns and not released.is_set():
+                hung.set()
+                released.wait(timeout=20)  # paused holding a stale read
+            obj.setdefault("status", {})["writer"] = ident
+            try:
+                api.update_status(obj)
+            except FencedOut:
+                with lock:
+                    fenced_out.append((req.namespace, ident))
+                return None  # deposed: stand down, do NOT retry
+            with lock:
+                applied.append(
+                    (f"{req.namespace}/{req.name}", ident, time.monotonic())
+                )
+            return None
+
+        return reconcile
+
+    mgr1 = Manager(api, shard=m1)
+    mgr1.new_controller("drill", "Widget", make_reconcile("r1"))
+    mgr2 = Manager(api, shard=m2)
+    mgr2.new_controller("drill", "Widget", make_reconcile("r2"))
+    m1.run(on_lost=lambda: None)
+    m2.run(on_lost=lambda: None)
+    mgr1.start()
+    mgr2.start()
+    try:
+        for ns in namespaces:
+            api.create(
+                {"kind": "Widget", "metadata": {"name": "w", "namespace": ns},
+                 "spec": {"v": 1}}
+            )
+        assert hung.wait(timeout=10), "r1 never reached the hang point"
+
+        # ---- kill replica 1 mid-reconcile ----
+        t_kill = time.monotonic()
+        m1._stop.set()  # heartbeat dies; the lease will silently expire
+
+        # replica 2 must take over the hung namespace within the lease
+        # window (expiry + heartbeat detection + reconcile)
+        deadline = time.monotonic() + 10 * lease
+        taken_over = None
+        while time.monotonic() < deadline:
+            with lock:
+                done = [t for k, ident, t in applied
+                        if k == f"{hang_ns}/w" and ident == "r2"]
+            if done:
+                taken_over = done[0]
+                break
+            time.sleep(0.05)
+        assert taken_over is not None, "shard never handed over"
+        failover = taken_over - t_kill
+        assert failover < 6 * lease, f"failover took {failover:.2f}s"
+
+        # release the dead replica's parked reconcile: its write MUST
+        # be fenced (the TOCTOU this PR closes)
+        released.set()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with lock:
+                if fenced_out:
+                    break
+            time.sleep(0.05)
+        with lock:
+            assert fenced_out and fenced_out[0][0] == hang_ns
+
+        # every widget written exactly once; the hung one by r2
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with lock:
+                if len({k for k, _, _ in applied}) == len(namespaces):
+                    break
+            time.sleep(0.05)
+        with lock:
+            keys = [k for k, _, _ in applied]
+            assert sorted(keys) == sorted(f"{ns}/w" for ns in namespaces), (
+                f"double or missing applies: {keys}"
+            )
+        for ns in namespaces:
+            writer = api.get("Widget", "w", ns)["status"]["writer"]
+            assert writer in ("r1", "r2")
+        assert api.get("Widget", "w", hang_ns)["status"]["writer"] == "r2"
+    finally:
+        released.set()
+        mgr1.stop()
+        mgr2.stop()
+        m1._stop.set()
+        m2._stop.set()
+
+
+# ---------------------------------------------------------------------------
+# subsystem recovery: scheduling reservations + session receipts
+
+
+def test_scheduling_reservations_rebuilt_from_recovered_store(tmp_path):
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog(d)
+    api = APIServer(wal=wal, snapshot_interval=6)
+    register_scheduling(api)
+    for i in range(6):
+        wl = api.create(
+            {"kind": "Workload",
+             "metadata": {"name": f"gang{i}", "namespace": f"team{i % 2}"},
+             "spec": {"hosts": 2, "chipsPerHost": 4, "chips": 8,
+                      "queue": f"team{i % 2}", "priority": i}}
+        )
+        if i < 4:  # 4 admitted, 2 pending
+            wl["status"] = {
+                "state": "Admitted",
+                "assignment": {"nodes": [f"n{i}a", f"n{i}b"]},
+            }
+            api.update_status(wl)
+    before = admitted_reservations(api)
+    assert set(before) == {"team0", "team1"}
+    assert before["team0"]["chips"] == 16
+    wal.close()
+
+    rec = APIServer.recover(WriteAheadLog(d))
+    assert admitted_reservations(rec) == before
+
+
+def test_session_checkpoint_receipts_survive_restart(tmp_path):
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog(d)
+    api = APIServer(wal=wal, snapshot_interval=4)
+    register_sessions(api)
+    store = SessionCheckpointStore(str(tmp_path / "ckpt"), backend="json")
+    receipt = store.save("uid-1", {"cells": [1, 2, 3], "counter": 7})
+    notebook = {
+        "kind": "Notebook",
+        "metadata": {"name": "nb1", "namespace": "u1", "uid": "uid-1"},
+    }
+    ckpt = api.create(
+        new_checkpoint(notebook, chips=4, accel="tpu-v5e", topo="2x2")
+    )
+    ckpt["status"] = {
+        "phase": "Checkpointed",
+        "digest": receipt["digest"],
+        "checkpointStep": receipt["step"],
+        "sizeBytes": receipt["sizeBytes"],
+    }
+    api.update_status(ckpt)
+    wal.close()
+
+    rec = APIServer.recover(WriteAheadLog(d))
+    mgr = SessionManager(rec, store=store, registry=prometheus.Registry())
+    rows = mgr.verify_receipts()
+    assert rows and all(r["ok"] for r in rows), rows
+    assert rows[0]["detail"] == "bit-identical"
+    # losing the bytes is surfaced loudly, never silently ok
+    store.delete("uid-1")
+    rows = mgr.verify_receipts()
+    assert rows and not rows[0]["ok"]

@@ -1,11 +1,19 @@
 """Leader election over the coordination.k8s.io Lease API + client
 QPS throttling (reference flag parity: notebook-controller/main.go:56-70
---leader-elect / --kube-api-qps / --kube-api-burst)."""
+--leader-elect / --kube-api-qps / --kube-api-burst), fencing tokens
+(deposed-epoch writes rejected by the store), and namespace-shard
+membership."""
 
 import time
 
-from odh_kubeflow_tpu.machinery.leader import LeaderElector
-from odh_kubeflow_tpu.machinery.store import APIServer
+import pytest
+
+from odh_kubeflow_tpu.machinery.leader import (
+    LeaderElector,
+    ShardMembership,
+    fenced,
+)
+from odh_kubeflow_tpu.machinery.store import APIServer, FencedOut
 
 
 def _mk(api, ident, now_fn=time.time, **kw):
@@ -157,6 +165,172 @@ def test_leader_failover_under_injected_faults():
     assert standby.try_acquire() is True
     assert holder.try_acquire() is False
     holder._stop.set()
+
+
+def test_deposed_holder_in_flight_write_is_fenced():
+    """Regression for the leader-election TOCTOU: pod-a pauses (GC
+    stall) after reading state, its lease expires, pod-b takes over —
+    then pod-a resumes and completes its in-flight write. Without the
+    store's fencing-token check that write LANDS (this test fails on
+    the pre-fencing code); with it, the deposed epoch is rejected
+    atomically with the apply."""
+    clock = {"t": 1000.0}
+    api = APIServer()
+    api.fence_now_fn = lambda: clock["t"]  # store and electors agree on "now"
+    api.register_kind("kubeflow.org/v1", "Notebook", "notebooks")
+    nb = api.create(
+        {"kind": "Notebook", "metadata": {"name": "nb", "namespace": "u1"},
+         "spec": {"owner": "nobody"}}
+    )
+    a = _mk(api, "pod-a", now_fn=lambda: clock["t"])
+    b = _mk(api, "pod-b", now_fn=lambda: clock["t"])
+    assert a.try_acquire() and a.token == 1
+    # pod-a reads, then stalls; its lease expires and pod-b acquires a
+    # NEW epoch
+    in_flight = api.get("Notebook", "nb", "u1")
+    in_flight["spec"]["owner"] = "pod-a"
+    clock["t"] += 600.0
+    assert b.try_acquire() and b.token == 2
+    # pod-a resumes and tries to finish the write under its old epoch
+    with pytest.raises(FencedOut):
+        with a.fence():
+            api.update(in_flight)
+    assert api.get("Notebook", "nb", "u1")["spec"]["owner"] == "nobody"
+    # the live epoch's write lands
+    fresh = api.get("Notebook", "nb", "u1")
+    fresh["spec"]["owner"] = "pod-b"
+    with b.fence():
+        api.update(fresh)
+    assert api.get("Notebook", "nb", "u1")["spec"]["owner"] == "pod-b"
+
+
+def test_expired_lease_fences_even_without_takeover():
+    """A holder whose lease expired may not write even before anyone
+    takes the lease over — peers already consider it dead (a shard
+    group would have resharded its namespaces)."""
+    clock = {"t": 1000.0}
+    api = APIServer()
+    api.fence_now_fn = lambda: clock["t"]
+    api.register_kind("kubeflow.org/v1", "Notebook", "notebooks")
+    api.create({"kind": "Notebook", "metadata": {"name": "nb", "namespace": "u1"}})
+    a = _mk(api, "pod-a", now_fn=lambda: clock["t"])
+    assert a.try_acquire()
+    obj = api.get("Notebook", "nb", "u1")
+    obj["spec"] = {"x": 1}
+    clock["t"] += 600.0  # lease_duration is 10s
+    with pytest.raises(FencedOut):
+        with a.fence():
+            api.update(obj)
+    # after re-acquiring (same identity, expired lease → new epoch via
+    # renew) the write goes through
+    assert a.try_acquire()
+    with a.fence():
+        api.update(api.get("Notebook", "nb", "u1") | {"spec": {"x": 2}})
+    assert api.get("Notebook", "nb", "u1")["spec"] == {"x": 2}
+
+
+def test_fenced_write_propagates_lease_deletion():
+    api = APIServer()
+    api.register_kind("kubeflow.org/v1", "Notebook", "notebooks")
+    api.create({"kind": "Notebook", "metadata": {"name": "nb", "namespace": "u1"}})
+    a = _mk(api, "pod-a")
+    assert a.try_acquire()
+    api.delete("Lease", "notebook-controller-leader", "default")
+    with pytest.raises(FencedOut):
+        with fenced("default", "notebook-controller-leader", a.token):
+            api.delete("Notebook", "nb", "u1")
+    # unfenced contexts are unaffected (boot-time writes, tests)
+    api.delete("Notebook", "nb", "u1")
+
+
+# ---------------------------------------------------------------------------
+# namespace-shard membership
+
+
+def _member(api, ident, clock, **kw):
+    return ShardMembership(
+        api,
+        "mgr",
+        identity=ident,
+        namespace="default",
+        lease_duration=10.0,
+        renew_period=0.05,
+        now_fn=lambda: clock["t"],
+        **kw,
+    )
+
+
+def test_shard_members_partition_namespaces_disjointly_and_agree():
+    clock = {"t": 1000.0}
+    api = APIServer()
+    m1 = _member(api, "r1", clock)
+    m2 = _member(api, "r2", clock)
+    m3 = _member(api, "r3", clock)
+    assert m1.join() and m2.join() and m3.join()
+    members = [m1, m2, m3]
+    assert m1.members(fresh=True) == ["r1", "r2", "r3"]
+    namespaces = [f"ns{i}" for i in range(60)]
+    # every replica computes the same owner for every namespace…
+    for ns in namespaces:
+        owners = {m.owner_of(ns) for m in members}
+        assert len(owners) == 1
+    # …and the owned slices are disjoint and cover everything
+    slices = [
+        {ns for ns in namespaces if m.owns(ns)} for m in members
+    ]
+    assert slices[0] | slices[1] | slices[2] == set(namespaces)
+    assert not (slices[0] & slices[1] or slices[0] & slices[2] or slices[1] & slices[2])
+    # a reasonable spread (rendezvous hashing, 60 keys over 3 members)
+    assert all(len(s) >= 5 for s in slices)
+
+
+def test_shard_reshard_moves_only_the_dead_members_slice():
+    clock = {"t": 1000.0}
+    api = APIServer()
+    m1 = _member(api, "r1", clock)
+    m2 = _member(api, "r2", clock)
+    m3 = _member(api, "r3", clock)
+    assert m1.join() and m2.join() and m3.join()
+    namespaces = [f"ns{i}" for i in range(60)]
+    before = {ns: m1.owner_of(ns, m1.members(fresh=True)) for ns in namespaces}
+    # r3 dies (stops renewing); after the lease duration it ages out
+    clock["t"] += 600.0
+    assert m1.join() and m2.join()  # survivors keep renewing
+    assert m1.members(fresh=True) == ["r1", "r2"]
+    after = {ns: m1.owner_of(ns, m1.members(fresh=True)) for ns in namespaces}
+    for ns in namespaces:
+        if before[ns] != "r3":
+            # rendezvous property: surviving owners never move
+            assert after[ns] == before[ns]
+        else:
+            assert after[ns] in ("r1", "r2")
+
+
+def test_shard_rejoin_after_expiry_starts_a_new_epoch():
+    clock = {"t": 1000.0}
+    api = APIServer()
+    api.fence_now_fn = lambda: clock["t"]
+    m1 = _member(api, "r1", clock)
+    assert m1.join()
+    first_epoch = m1.token
+    clock["t"] += 600.0  # presumed dead
+    assert m1.join()  # rejoin
+    assert m1.token == first_epoch + 1
+
+
+def test_shard_membership_change_callback_fires_on_expiry():
+    clock = {"t": 1000.0}
+    api = APIServer()
+    m1 = _member(api, "r1", clock)
+    m2 = _member(api, "r2", clock)
+    assert m1.join() and m2.join()
+    changes = []
+    m1.add_on_change(lambda old, new: changes.append((old, new)))
+    m1._check_membership_change()  # primes the baseline
+    clock["t"] += 600.0  # r2 expires
+    assert m1.join()
+    m1._check_membership_change()
+    assert changes and changes[-1] == (["r1", "r2"], ["r1"])
 
 
 def test_client_qps_throttle_paces_requests():
