@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint sanitize chaos durability loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
+.PHONY: all test test-unit test-manifests lint sanitize chaos durability fleetbench loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
 
 all: lint test
 
@@ -57,6 +57,20 @@ durability:
 	$(PYTHON) loadtest/control_plane_bench.py --recovery-only \
 	  --recovery-counts 500,2000 --failover-reps 6 \
 	  --out /tmp/durability_bench.json
+
+# fleet-scale smoke (ISSUE 10): the 25k-notebook axis scaled down to
+# N=2000 with the SAME gates — group-commit ingest >=5x the
+# fsync-per-record baseline under 12 concurrent writers, paginated
+# list p99 bounded with no page over the limit, watch fanout +
+# admission-wait + cold-recovery recorded. Writes to a scratch copy so
+# the committed BENCH numbers change only when refreshed deliberately
+# (full run: `python loadtest/control_plane_bench.py --fleet
+# --notebooks 25000`).
+fleetbench:
+	cp BENCH_control_plane.json /tmp/fleetbench.json
+	$(PYTHON) loadtest/control_plane_bench.py --fleet --notebooks 2000 \
+	  --fleet-watchers 50 --out /tmp/fleetbench.json
+	$(PYTHON) -m pytest -q tests/test_fleet.py
 
 # the randomized property suites re-run as race probes: sanitized
 # locks record acquisition order, re-entry, and blocking-under-lock

@@ -142,6 +142,72 @@ class UncachedListRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# unbounded-list
+
+
+@register
+class UnboundedListRule(Rule):
+    """A list call on a serving path — web/HTTP handlers and the
+    informer's prime/resync — that names a kind but carries no
+    ``limit`` builds one response-sized payload for the WHOLE
+    collection: at fleet size (25k+ notebooks) that is a multi-MB
+    serialize-and-ship per request. Such calls must paginate
+    (``limit=`` / ``list_chunk`` walks) or be explicitly marked
+    ``# unbounded-ok: <reason>`` (the standing reason in web/ is a
+    cache-served zero-copy read — the informer mirror hands out shared
+    references, no payload is built). Scope: ``web/`` plus the
+    informer cache's own prime path."""
+
+    id = "unbounded-list"
+    description = (
+        "list of a kind without a limit on a serving/prime path "
+        "(fleet-sized payload)"
+    )
+    dirs = ("web",)
+    files = ("machinery/cache.py",)
+
+    _LISTERS = frozenset({"api", "client", "server", "store", "backend"})
+
+    def applies(self, src: SourceFile) -> bool:
+        # both scopes: the web serving tier AND the informer prime
+        return src.section in (self.dirs or ()) or src.rel in (self.files or ())
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "list"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            chain = _attr_chain(node.func)
+            if not any(part in self._LISTERS for part in chain[:-1]):
+                continue
+            if any(
+                kw.arg == "limit"
+                and not (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None
+                )
+                for kw in node.keywords
+            ):
+                continue
+            span = range(node.lineno, (node.end_lineno or node.lineno) + 1)
+            if any("unbounded-ok" in src.line(n) for n in span):
+                continue
+            yield self.finding(
+                src,
+                node,
+                f"list of {node.args[0].value!r} without a limit on a "
+                "serving/prime path; paginate (limit= / list_chunk) or "
+                "annotate with `# unbounded-ok: <reason>`",
+            )
+
+
+# ---------------------------------------------------------------------------
 # swallowed-exception
 
 

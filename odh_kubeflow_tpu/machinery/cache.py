@@ -34,6 +34,7 @@ frozen copy per store event now serves the cache AND every controller.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -50,7 +51,12 @@ from odh_kubeflow_tpu.machinery.objects import (  # noqa: F401 — public API
     is_frozen,
     mutable,
 )
-from odh_kubeflow_tpu.machinery.store import APIError, NotFound, Watch
+from odh_kubeflow_tpu.machinery.store import (
+    APIError,
+    NotFound,
+    Watch,
+    paged_list_all,
+)
 from odh_kubeflow_tpu.utils import prometheus
 
 log = logging.getLogger("machinery.cache")
@@ -420,10 +426,40 @@ class InformerCache:
             kc.degraded = False
             kc.retry_at = 0.0
 
+    # informer prime/resync page size (kube reflector's default chunk
+    # limit posture): the mirror needs the full set either way, but no
+    # single list RESPONSE carries the whole fleet. Env-tunable;
+    # INFORMER_PAGE_SIZE=0 disables chunking.
+    PAGE_SIZE = int(os.environ.get("INFORMER_PAGE_SIZE", "1000") or 0)
+
+    def _list_all(self, kind: str) -> list[Obj]:
+        """Full listing for prime/resync, walked in PAGE_SIZE chunks
+        when the api paginates. A continue token that 410s mid-walk
+        restarts the walk (same move as the watch 410 relist); after
+        repeated expiry we defer to ``api.list`` — one request against
+        the embedded store, or the client's own pager (which carries
+        its own 410-restart policy and unpaginated last resort) on a
+        remote api."""
+        chunk = getattr(self.api, "list_chunk", None)
+        if chunk is None or not self.PAGE_SIZE:
+            return self.api.list(kind)  # unbounded-ok: api without pagination
+        return paged_list_all(
+            chunk,
+            kind,
+            self.PAGE_SIZE,
+            lambda: self.api.list(kind),  # unbounded-ok: last-resort fallback after repeated 410s
+            on_restart=lambda: log.warning(
+                "informer %s: continue token expired mid-prime; "
+                "restarting the paginated walk", kind,
+            ),
+        )
+
     def resync(self, kind: str, count: bool = True) -> None:
         """Re-list the kind from the backing store and rebuild the
-        mirror + indexes — heals any dropped watch event."""
-        self._rebuild(kind, self.api.list(kind))
+        mirror + indexes — heals any dropped watch event. The list is
+        walked in pages (``_list_all``) so fleet-sized primes never
+        build one giant payload."""
+        self._rebuild(kind, self._list_all(kind))
         if count:
             self.m_resync.inc()
 
@@ -466,7 +502,7 @@ class InformerCache:
             except Exception as e:  # noqa: BLE001 — Expired/APIError/OSError
                 return self._degrade(kind, "watch re-open failed", e)
             try:
-                objs = self.api.list(kind)
+                objs = self._list_all(kind)
             except Exception as e:  # noqa: BLE001 — backend still flapping
                 try:
                     w.stop()
@@ -699,6 +735,7 @@ class InformerCache:
         namespace: Optional[str] = None,
         label_selector: Optional[Obj] = None,
         field_matches: Optional[dict[str, Any]] = None,
+        limit: Optional[int] = None,
     ) -> list[Obj]:
         with self._lock:
             kc = self._kinds[kind]
@@ -708,8 +745,10 @@ class InformerCache:
             )
             if label_selector is None and not field_matches and ns_filtered:
                 # plain namespace (or full) list: the bucket IS the
-                # answer — no per-object work at all
-                return candidates
+                # answer — no per-object work at all (limit is a
+                # truncation of the zero-copy result; the mirror holds
+                # no payload to bound)
+                return candidates[:limit] if limit else candidates
             out = []
             for obj in candidates:
                 if not ns_filtered and namespace and self._key_of(obj)[0] != namespace:
@@ -724,6 +763,8 @@ class InformerCache:
                 ):
                     continue
                 out.append(obj)
+                if limit and len(out) >= limit:
+                    break
             return out
 
     def _candidates(
@@ -901,14 +942,29 @@ class SerializedBytesCache:
             self._put(key, data)
         return data
 
-    def list_bytes(self, kind: str, items: Iterable[Obj]) -> bytes:
+    def list_bytes(
+        self,
+        kind: str,
+        items: Iterable[Obj],
+        continue_token: Optional[str] = None,
+    ) -> bytes:
         """The full ``{kind}List`` response payload, byte-identical to
         ``json.dumps({"kind": f"{kind}List", "apiVersion": "v1",
-        "items": [...]})``, composed from per-object cached bytes."""
+        "items": [...]})``, composed from per-object cached bytes.
+        Paginated responses (``continue_token`` not None, may be "")
+        additionally carry kube's ListMeta ``metadata.continue``."""
         inner = b", ".join(self.obj_bytes(o) for o in items)
+        meta = b""
+        if continue_token is not None:
+            meta = (
+                b'"metadata": {"continue": '
+                + serialize.dumps(continue_token)
+                + b"}, "
+            )
         return (
             b'{"kind": "' + kind.encode() + b'List", "apiVersion": "v1", '
-            b'"items": [' + inner + b"]}"
+            + meta
+            + b'"items": [' + inner + b"]}"
         )
 
     # whole-list payloads, keyed by the store's per-kind mutation
@@ -968,12 +1024,22 @@ class CachedClient:
         namespace: Optional[str] = None,
         label_selector: Optional[Obj] = None,
         field_matches: Optional[dict[str, Any]] = None,
+        limit: Optional[int] = None,
     ) -> list[Obj]:
         c = self.cache
         if self._serving(kind):
             c._hits[kind] = c._hits.get(kind, 0) + 1
-            return c.list(kind, namespace, label_selector, field_matches)
+            return c.list(kind, namespace, label_selector, field_matches, limit)
         c._misses[kind] = c._misses.get(kind, 0) + 1
+        if limit:
+            return self.api.list(
+                kind,
+                namespace=namespace,
+                label_selector=label_selector,
+                field_matches=field_matches,
+                limit=limit,
+            )
+        # legacy call shape: duck apis (test fakes) predate `limit`
         return self.api.list(
             kind,
             namespace=namespace,

@@ -41,6 +41,7 @@ from odh_kubeflow_tpu.machinery.store import (
     Invalid,
     current_fence as store_fence,
     NotFound,
+    paged_list_all,
     TooManyRequests,
     TypeInfo,
     Unauthorized,
@@ -112,10 +113,16 @@ class RemoteAPIServer:
         retries: int = 4,
         retry_base: float = 0.05,
         retry_cap: float = 2.0,
+        page_size: Optional[int] = None,
         registry: Optional[prometheus.Registry] = None,
     ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # kube client-go pager posture: with a page size, list() walks
+        # the collection in limit-sized chunks via continue tokens —
+        # no fleet-sized payload ever crosses the wire in one response.
+        # None = single unpaginated request (legacy behaviour).
+        self.page_size = page_size
         # shared backoff policy (machinery.backoff): `retries` total
         # attempts, exponential + decorrelated jitter between them
         self.retries = max(int(retries), 1)
@@ -131,6 +138,12 @@ class RemoteAPIServer:
         self._m_watch_reestablished = reg.counter(
             "watch_reestablished_total",
             "Watch streams re-established after a dropped connection",
+        )
+        self._m_list_restarts = reg.counter(
+            "client_list_restarts_total",
+            "Paginated lists restarted from a fresh full list after a "
+            "continue token expired (410) mid-walk",
+            labelnames=("kind",),
         )
         self._token = token
         self._token_file = token_file
@@ -384,30 +397,118 @@ class RemoteAPIServer:
     def get(self, kind: str, name: str, namespace: Optional[str] = None) -> Obj:
         return self._request("GET", self._path(kind, namespace, name))
 
+    def _selector_query(self, label_selector: Optional[Obj]) -> str:
+        if not label_selector:
+            return ""
+        return "labelSelector=" + urllib.parse.quote(
+            _selector_to_string(label_selector), safe=""
+        )
+
+    @staticmethod
+    def _field_filter(
+        items: list[Obj], field_matches: Optional[dict[str, Any]]
+    ) -> list[Obj]:
+        if not field_matches:
+            return items
+        return [
+            it
+            for it in items
+            if all(
+                obj_util.get_path(it, *path.split(".")) == want
+                for path, want in field_matches.items()
+            )
+        ]
+
+    def list_chunk(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Obj] = None,
+        field_matches: Optional[dict[str, Any]] = None,
+        limit: Optional[int] = None,
+        continue_token: Optional[str] = None,
+    ) -> tuple[list[Obj], str]:
+        """One page of a paginated list (``?limit=&continue=``); the
+        returned token is "" when the walk is done. An expired token
+        surfaces as :class:`Expired` (410) — restart from a fresh
+        list. ``field_matches`` filters client-side (it never crosses
+        the wire), so a page may come back shorter than ``limit``."""
+        p = self._path(kind, namespace, None, require_ns=False)
+        parts = [f"limit={int(limit)}" if limit else "limit=500"]
+        sel = self._selector_query(label_selector)
+        if sel:
+            parts.append(sel)
+        if continue_token:
+            parts.append(
+                "continue=" + urllib.parse.quote(continue_token, safe="")
+            )
+        resp = self._request("GET", p, query="&".join(parts))
+        items = self._field_filter(resp.get("items", []), field_matches)
+        token = (resp.get("metadata") or {}).get("continue", "") or ""
+        return items, token
+
+    # paginated-list restart cap: after this many mid-walk 410s the
+    # client falls back to ONE unpaginated list (always consistent)
+    LIST_RESTARTS_MAX = 3
+
     def list(
         self,
         kind: str,
         namespace: Optional[str] = None,
         label_selector: Optional[Obj] = None,
         field_matches: Optional[dict[str, Any]] = None,
+        limit: Optional[int] = None,
     ) -> list[Obj]:
-        p = self._path(kind, namespace, None, require_ns=False)
-        query = ""
-        if label_selector:
-            query = "labelSelector=" + urllib.parse.quote(
-                _selector_to_string(label_selector), safe=""
+        if limit:
+            # bounded read: first page only (kube limit-without-continue)
+            items, _ = self.list_chunk(
+                kind,
+                namespace=namespace,
+                label_selector=label_selector,
+                field_matches=field_matches,
+                limit=limit,
             )
-        items = self._request("GET", p, query=query).get("items", [])
-        if field_matches:
-            items = [
-                it
-                for it in items
-                if all(
-                    obj_util.get_path(it, *path.split(".")) == want
-                    for path, want in field_matches.items()
-                )
-            ]
-        return items
+            return items
+        p = self._path(kind, namespace, None, require_ns=False)
+
+        def unpaginated() -> list[Obj]:
+            items = self._request(
+                "GET", p, query=self._selector_query(label_selector)
+            ).get("items", [])
+            return self._field_filter(items, field_matches)
+
+        if not self.page_size:
+            return unpaginated()
+
+        # chunked walk (client-go pager) through the shared restart
+        # policy: a continue token that 410s mid-list restarts the
+        # whole walk (client_list_restarts_total), with one
+        # unpaginated request as the last resort.
+        def chunk(kind_: str, limit: int, continue_token: Optional[str]):
+            return self.list_chunk(
+                kind_,
+                namespace=namespace,
+                label_selector=label_selector,
+                field_matches=field_matches,
+                limit=limit,
+                continue_token=continue_token,
+            )
+
+        def on_restart() -> None:
+            self._m_list_restarts.inc({"kind": kind})
+            log.warning(
+                "list %s: continue token expired mid-walk; restarting "
+                "from a fresh list", kind,
+            )
+
+        return paged_list_all(
+            chunk,
+            kind,
+            self.page_size,
+            unpaginated,
+            restarts=self.LIST_RESTARTS_MAX,
+            on_restart=on_restart,
+        )
 
     def update(self, obj: Obj) -> Obj:
         meta = obj.get("metadata", {})
@@ -793,9 +894,14 @@ def api_from_env() -> RemoteAPIServer:
 
     Registers the platform CRD kinds for path mapping either way."""
     qps_env = os.environ.get("KUBE_API_QPS", "")
+    page_env = os.environ.get("KUBE_LIST_PAGE_SIZE", "500")
     common: dict[str, Any] = dict(
         qps=float(qps_env) if qps_env else None,
         burst=int(os.environ.get("KUBE_API_BURST", "10")),
+        # chunked lists by default (client-go pager parity): no
+        # split-process component ever pulls a fleet-sized list in one
+        # payload. KUBE_LIST_PAGE_SIZE=0 reverts to unpaginated.
+        page_size=int(page_env) if page_env and int(page_env) > 0 else None,
     )
     url = os.environ.get("KUBE_API_URL")
     if url:

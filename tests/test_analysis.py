@@ -39,6 +39,7 @@ def test_rule_catalog_has_the_platform_rules():
         "blocking-under-lock",
         "metric-naming",
         "retry-without-backoff",
+        "unbounded-list",
         "hot-path-json-dumps",
         "unfenced-write",
     } <= ids
@@ -438,6 +439,68 @@ def test_frozen_mutation_suppressed():
         '    nb["status"] = {}  # graftlint: disable=frozen-mutation raw-store path only\n'
     )
     assert lint_source(src, "controllers/x.py", ["frozen-mutation"]) == []
+
+
+# ---------------------------------------------------------------------------
+# unbounded-list
+
+
+def test_unbounded_list_true_positive_in_web_handler():
+    src = (
+        "def list_notebooks(self, request, ns):\n"
+        '    return self.api.list("Notebook", namespace=ns)\n'
+    )
+    assert rule_ids(lint_source(src, "web/x.py", ["unbounded-list"])) == [
+        "unbounded-list"
+    ]
+
+
+def test_unbounded_list_true_positive_in_informer_prime():
+    src = (
+        "def resync(self, kind):\n"
+        '    self._rebuild(kind, self.api.list("Pod"))\n'
+    )
+    assert rule_ids(
+        lint_source(src, "machinery/cache.py", ["unbounded-list"])
+    ) == ["unbounded-list"]
+
+
+def test_unbounded_list_limit_is_clean():
+    src = (
+        "def list_notebooks(self, request, ns):\n"
+        '    return self.api.list("Notebook", namespace=ns, limit=500)\n'
+    )
+    assert lint_source(src, "web/x.py", ["unbounded-list"]) == []
+    # chunked walks never flag (different terminal)
+    src = (
+        "def prime(self, kind):\n"
+        '    items, tok = self.api.list_chunk("Pod", limit=1000)\n'
+        "    return items\n"
+    )
+    assert lint_source(src, "machinery/cache.py", ["unbounded-list"]) == []
+
+
+def test_unbounded_list_marker_suppresses():
+    src = (
+        "def list_pvcs(self, request, ns):\n"
+        '    return self.api.list(  # unbounded-ok: cache-served zero-copy read\n'
+        '        "PersistentVolumeClaim", namespace=ns\n'
+        "    )\n"
+    )
+    assert lint_source(src, "web/x.py", ["unbounded-list"]) == []
+
+
+def test_unbounded_list_scope():
+    # controllers read through the zero-copy informer cache: no payload
+    # is built, the rule does not apply there
+    src = (
+        "def reconcile(self, req):\n"
+        '    return self.api.list("Pod", namespace=req.namespace)\n'
+    )
+    assert lint_source(src, "controllers/x.py", ["unbounded-list"]) == []
+    # non-clientish receivers (a plain python list attr) never flag
+    src = 'def f(self):\n    return self.rows.list("Pod")\n'
+    assert lint_source(src, "web/x.py", ["unbounded-list"]) == []
 
 
 # ---------------------------------------------------------------------------
