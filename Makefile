@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint sanitize chaos durability fleetbench loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
+.PHONY: all test test-unit test-manifests lint sanitize chaos durability explore fleetbench loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
 
 all: lint test
 
@@ -25,13 +25,27 @@ test-manifests:
 conformance:
 	$(PYTHON) -m odh_kubeflow_tpu.conformance
 
-# syntax check + graftlint (AST invariant rules: frozen-mutation,
-# uncached-list, swallowed-exception, blocking-under-lock,
-# metric-naming — see docs/GUIDE.md "Static analysis & concurrency
-# discipline"); exit-code gated
+# syntax check + graftlint: per-file AST invariant rules PLUS the
+# whole-program call-graph rules (lock-order-cycle,
+# blocking-reachable-under-lock, await-holding-lock) — see
+# docs/GUIDE.md "Static analysis & concurrency discipline". Exit-code
+# gated; fails only on findings NOT in analysis/baseline.json.
 lint:
 	$(PYTHON) -m compileall -q odh_kubeflow_tpu tests loadtest bench.py __graft_entry__.py
 	$(PYTHON) -m odh_kubeflow_tpu.analysis
+
+# deterministic schedule explorer (docs/GUIDE.md "Deterministic
+# schedule exploration"): seeded one-runnable-at-a-time interleavings
+# of the group-commit pipeline (writers x committer x snapshot cut),
+# lease-fencing handover, and informer heal-vs-read — plus the
+# reverted historical races (rate-limiter sleep-under-lock, store
+# apply-before-fsync) the explorer must re-find and replay from their
+# printed seeds. GRAFT_SCHED=<n> multiplies the schedule budgets: the
+# CI pyramid runs 1x, CI's dedicated explore step 3x; crank it for
+# deeper local sweeps (`make explore GRAFT_SCHED=8`).
+GRAFT_SCHED ?= 1
+explore:
+	GRAFT_SCHED=$(GRAFT_SCHED) $(PYTHON) -m pytest -q tests/test_schedule.py
 
 # seeded chaos suite: resilience property tests under injected
 # conflicts, 429s, 5xx, watch-stream drops, and resourceVersion expiry

@@ -1,17 +1,28 @@
-"""Static analysis + runtime concurrency sanitizer for the platform.
+"""Static analysis + runtime concurrency tooling for the platform.
 
-Two halves, one entry point:
+Three halves, one package:
 
 - **graftlint** (``analysis/graftlint.py`` + ``analysis/rules.py``):
-  AST-based invariant rules — frozen-mutation, uncached-list,
-  swallowed-exception, blocking-under-lock, metric-naming — with
-  per-line suppression and file/rule allowlists. Run with
-  ``python -m odh_kubeflow_tpu.analysis`` (exit-code gated, wired
-  into ``make lint`` and CI).
+  AST-based invariant rules — per-file (frozen-mutation,
+  uncached-list, swallowed-exception, blocking-under-lock,
+  metric-naming, …) and whole-program over the package call graph
+  (``analysis/callgraph.py``): ``lock-order-cycle``,
+  ``blocking-reachable-under-lock``, ``await-holding-lock``, each
+  reporting witness call chains. Run with
+  ``python -m odh_kubeflow_tpu.analysis`` (exit-code gated, wired into
+  ``make lint`` and CI); ``--format=json`` for machines, and a
+  committed ``analysis/baseline.json`` ratchet so the gate fails only
+  on NEW findings.
 - **sanitizer** (``analysis/sanitizer.py``): the ``GRAFT_SANITIZE=1``
   lock-wrapping layer that turns the randomized property tests into
   race probes (lock-order inversions, non-reentrant re-entry,
   blocking calls under store/cache locks).
+- **schedule** (``analysis/schedule.py``): the deterministic schedule
+  explorer — serializes scenario threads one-runnable-at-a-time via
+  the sanitizer lock factories plus explicit ``sched_point`` markers,
+  explores seeded random + bounded systematic interleavings, and
+  replays any failing schedule from its seed (``make explore``,
+  GRAFT_SCHED posture).
 
 This module is also the platform's single lint entry point:
 ``lint_registry`` re-exports the live-registry metric naming lint so
@@ -19,13 +30,18 @@ callers need exactly one import for every lint surface.
 """
 
 from odh_kubeflow_tpu.analysis import sanitizer  # noqa: F401
+from odh_kubeflow_tpu.analysis import schedule  # noqa: F401
 from odh_kubeflow_tpu.analysis.graftlint import (  # noqa: F401
     RULES,
     Finding,
+    ProgramRule,
     Rule,
     SourceFile,
     active_rules,
+    apply_baseline,
+    default_baseline_path,
     lint_source,
+    load_baseline,
     main,
     register,
     run_package,

@@ -34,6 +34,7 @@ from __future__ import annotations
 import argparse
 import ast
 import dataclasses
+import json
 import os
 import re
 import sys
@@ -128,6 +129,7 @@ class Rule:
     severity: str = "error"
     dirs: Optional[tuple[str, ...]] = None
     files: Optional[tuple[str, ...]] = None
+    whole_program = False
 
     def applies(self, src: SourceFile) -> bool:
         if self.files is not None:
@@ -151,6 +153,23 @@ class Rule:
             self.severity,
             end_line=getattr(node, "end_lineno", None) or line,
         )
+
+
+class ProgramRule(Rule):
+    """A whole-program rule: instead of one file at a time it sees the
+    complete analyzed file set as a :class:`callgraph.Program` (call
+    graph + lock-context dataflow) and reports across function and
+    file boundaries. ``check_program`` runs once per lint invocation;
+    per-line/file suppression applies to its findings exactly as to
+    per-file findings (by the finding's path + line span)."""
+
+    whole_program = True
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        return iter(())  # program rules never run per-file
+
+    def check_program(self, program) -> Iterator[Finding]:
+        raise NotImplementedError
 
 
 RULES: dict[str, Rule] = {}
@@ -190,10 +209,11 @@ def _ensure_rules_loaded() -> None:
 
 
 def run_source(src: SourceFile, rules: Iterable[Rule]) -> list[Finding]:
-    """Run ``rules`` over one parsed file, applying suppressions."""
+    """Run per-file ``rules`` over one parsed file, applying
+    suppressions."""
     findings: list[Finding] = []
     for rule in rules:
-        if not rule.applies(src):
+        if rule.whole_program or not rule.applies(src):
             continue
         for f in rule.check(src):
             if not src.suppressed(f.rule, f.line, f.end_line or f.line):
@@ -201,13 +221,43 @@ def run_source(src: SourceFile, rules: Iterable[Rule]) -> list[Finding]:
     return findings
 
 
+def run_program_rules(
+    sources: list[SourceFile], rules: Iterable[Rule]
+) -> list[Finding]:
+    """Run the whole-program rules once over the analyzed file set,
+    applying per-line/file suppressions by finding location."""
+    prules = [r for r in rules if r.whole_program]
+    if not prules:
+        return []
+    from odh_kubeflow_tpu.analysis.callgraph import build_program
+
+    program = build_program(sources)
+    by_rel = {s.rel: s for s in sources}
+    findings: list[Finding] = []
+    for rule in prules:
+        for f in rule.check_program(program):
+            src = by_rel.get(f.path)
+            if src is not None and src.suppressed(
+                f.rule, f.line, f.end_line or f.line
+            ):
+                continue
+            findings.append(f)
+    return findings
+
+
 def lint_source(
     text: str, rel: str, select: Optional[Iterable[str]] = None
 ) -> list[Finding]:
     """Lint a source string as if it lived at package-relative path
-    ``rel`` (the fixture-snippet entry point tests use)."""
+    ``rel`` (the fixture-snippet entry point tests use). Whole-program
+    rules see a one-file program, so interprocedural fixtures stay
+    self-contained."""
     src = SourceFile(path=rel, rel=rel, text=text)
-    return run_source(src, active_rules(select))
+    rules = active_rules(select)
+    findings = run_source(src, rules)
+    findings.extend(run_program_rules([src], rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
 
 
 def package_root() -> str:
@@ -241,11 +291,14 @@ def run_package(
     root: Optional[str] = None, select: Optional[Iterable[str]] = None
 ) -> list[Finding]:
     """Run the rule set over the whole package; findings come back
-    sorted by path/line (the tier-1 gate asserts this is empty)."""
+    sorted by path/line (the tier-1 gate asserts this is empty modulo
+    the committed baseline)."""
     rules = active_rules(select)
+    sources = list(iter_sources(root))
     findings: list[Finding] = []
-    for src in iter_sources(root):
+    for src in sources:
         findings.extend(run_source(src, rules))
+    findings.extend(run_program_rules(sources, rules))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 
@@ -256,18 +309,18 @@ def run_paths(
     """Run rules over explicit files/directories. Paths inside the
     package keep their package-relative section (so dir-scoped rules
     apply as in a package run); outside paths are treated as
-    section-less."""
+    section-less. Whole-program rules see exactly the given file set —
+    call chains leaving it are simply unresolved."""
     rules = active_rules(select)
     root = package_root()
-    findings: list[Finding] = []
+    sources: list[SourceFile] = []
     for path in paths:
         abspath = os.path.abspath(path)
         inside = abspath == root or abspath.startswith(root + os.sep)
         if os.path.isdir(path):
-            for src in iter_sources(
-                abspath, rel_root=root if inside else abspath
-            ):
-                findings.extend(run_source(src, rules))
+            sources.extend(
+                iter_sources(abspath, rel_root=root if inside else abspath)
+            )
             continue
         rel = (
             os.path.relpath(abspath, root)
@@ -276,9 +329,91 @@ def run_paths(
         )
         with open(path, encoding="utf-8") as f:
             text = f.read()
-        findings.extend(run_source(SourceFile(path, rel, text), rules))
+        sources.append(SourceFile(path, rel, text))
+    findings: list[Finding] = []
+    for src in sources:
+        findings.extend(run_source(src, rules))
+    findings.extend(run_program_rules(sources, rules))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline (ratcheting: CI fails only on NEW findings)
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+
+_LINE_REF_RE = re.compile(r"(\.py):\d+")
+
+
+def baseline_key(f: Finding) -> tuple[str, str, str]:
+    """A finding's baseline identity: rule + path + message, with NO
+    line numbers — not the finding's own, and not the ``file.py:NN``
+    references inside interprocedural witness chains (normalized to
+    ``file.py``). Unrelated edits shift lines, and a baseline that
+    churns on every refactor protects nothing. A finding whose
+    normalized message changes (different chain shape, different
+    lock) is a new finding."""
+    return (f.rule, f.path, _LINE_REF_RE.sub(r"\1", f.message))
+
+
+def load_baseline(path: str) -> list[tuple[str, str, str]]:
+    """The accepted-findings multiset from ``path`` ([] when the file
+    does not exist — an absent baseline accepts nothing). Messages are
+    normalized exactly like :func:`baseline_key` so hand-edited or
+    older baseline files keep matching."""
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    return [
+        (e["rule"], e["path"], _LINE_REF_RE.sub(r"\1", e["message"]))
+        for e in doc.get("findings", [])
+    ]
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    doc = {
+        "comment": (
+            "graftlint accepted-findings baseline: the gate fails only "
+            "on findings NOT in this list. Regenerate with "
+            "`python -m odh_kubeflow_tpu.analysis --write-baseline` "
+            "after deliberately accepting a finding; shrink it "
+            "whenever one is fixed."
+        ),
+        "findings": [
+            {"rule": f.rule, "path": f.path, "message": f.message}
+            for f in findings
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: list[tuple[str, str, str]]
+) -> tuple[list[Finding], int]:
+    """Subtract the baseline multiset from ``findings``: each baseline
+    entry absorbs at most one finding with the same identity (two NEW
+    instances of a baselined shape still surface one). Returns
+    (unbaselined findings, how many were absorbed)."""
+    budget: dict[tuple[str, str, str], int] = {}
+    for key in baseline:
+        budget[key] = budget.get(key, 0) + 1
+    out: list[Finding] = []
+    absorbed = 0
+    for f in findings:
+        key = baseline_key(f)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            absorbed += 1
+            continue
+        out.append(f)
+    return out, absorbed
 
 
 # ---------------------------------------------------------------------------
@@ -302,6 +437,29 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
     )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="findings output format (json: machine-readable array)",
+    )
+    parser.add_argument(
+        "--baseline",
+        help=(
+            "accepted-findings file to subtract (default: the committed "
+            "analysis/baseline.json on whole-package runs)"
+        ),
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring any baseline",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write the current findings to the baseline file and exit 0",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -323,14 +481,63 @@ def main(argv: Optional[list[str]] = None) -> int:
         findings = run_paths(args.paths, select)
     else:
         findings = run_package(select=select)
-    for f in findings:
-        print(f.render())
+
+    baseline_path = args.baseline or (
+        default_baseline_path() if not args.paths else None
+    )
+    if args.write_baseline:
+        if (args.paths or args.select) and not args.baseline:
+            # a scoped run sees a PARTIAL finding set; writing it to
+            # the committed package baseline would silently delete
+            # every other accepted entry
+            print(
+                "graftlint: refusing --write-baseline on a path/--select"
+                "-scoped run without an explicit --baseline path (it "
+                "would clobber the committed package baseline)",
+                file=sys.stderr,
+            )
+            return 2
+        path = args.baseline or default_baseline_path()
+        write_baseline(path, findings)
+        print(
+            f"graftlint: wrote {len(findings)} finding(s) to {path}",
+            file=sys.stderr,
+        )
+        return 0
+    absorbed = 0
+    if baseline_path is not None and not args.no_baseline:
+        findings, absorbed = apply_baseline(
+            findings, load_baseline(baseline_path)
+        )
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                [
+                    {
+                        "rule": f.rule,
+                        "path": f.path,
+                        "line": f.line,
+                        "end_line": f.end_line or f.line,
+                        "severity": f.severity,
+                        "message": f.message,
+                    }
+                    for f in findings
+                ],
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
     n_rules = len(active_rules(select))
+    suffix = f" ({absorbed} baselined)" if absorbed else ""
     if findings:
         print(
-            f"graftlint: {len(findings)} finding(s) across {n_rules} rule(s)",
+            f"graftlint: {len(findings)} new finding(s) across "
+            f"{n_rules} rule(s){suffix}",
             file=sys.stderr,
         )
         return 1
-    print(f"graftlint: clean ({n_rules} rules)", file=sys.stderr)
+    print(f"graftlint: clean ({n_rules} rules){suffix}", file=sys.stderr)
     return 0

@@ -15,10 +15,12 @@ clean against it from then on.
 from __future__ import annotations
 
 import ast
-from typing import Iterator, Optional
+from typing import Any, Iterator, Optional
 
+from odh_kubeflow_tpu.analysis import callgraph
 from odh_kubeflow_tpu.analysis.graftlint import (
     Finding,
+    ProgramRule,
     Rule,
     SourceFile,
     register,
@@ -61,17 +63,9 @@ _MUTATORS = frozenset(
 )
 
 
-def _attr_chain(node: ast.AST) -> list[str]:
-    """``self.api.get`` → ["self", "api", "get"]; empty when the
-    expression is not a plain name/attribute chain."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return list(reversed(parts))
-    return []
+# one attribute-chain walker for the per-file and whole-program
+# analyses (``self.api.get`` → ["self", "api", "get"])
+_attr_chain = callgraph._attr_chain
 
 
 def _root_name(node: ast.AST) -> Optional[str]:
@@ -300,9 +294,15 @@ class BlockingUnderLockRule(Rule):
         # run under store/cache locks — suspend would stall every reader
         "sessions/manager.py",
         "sessions/checkpoint.py",
+        # grew concurrent in PRs 7/10: the WAL's group-commit io_lock
+        # and the event-loop serving tier
+        "machinery/wal.py",
+        "machinery/eventloop.py",
     )
 
-    _LOCKISH = ("lock", "mutex", "_cv", "cond")
+    # one lock vocabulary for the per-file and whole-program analyses
+    # (callgraph.is_lockish uses the same tuple)
+    _LOCKISH = callgraph.LOCKISH_MARKERS
     _WAITS = frozenset({"wait", "wait_for"})
 
     def _is_lockish(self, expr: ast.AST) -> bool:
@@ -313,25 +313,17 @@ class BlockingUnderLockRule(Rule):
         return any(marker in terminal for marker in self._LOCKISH)
 
     def _blocking_call(self, call: ast.Call) -> Optional[str]:
-        chain = _attr_chain(call.func)
-        if not chain:
-            return None
-        terminal = chain[-1]
-        if terminal == "sleep":
-            return "time.sleep"
-        if terminal == "urlopen":
-            return "urllib.request.urlopen"
-        if terminal in ("request", "getresponse") and "http" in " ".join(
-            c.lower() for c in chain[:-1]
-        ):
-            return "http client call"
-        if (
-            terminal == "get"
-            and len(chain) > 1  # a method, not the builtin
-            and any(kw.arg == "timeout" for kw in call.keywords)
-        ):
-            return "blocking get(timeout=…) (queue/Watch)"
-        return None
+        # ONE blocking-leaf vocabulary for the per-file and
+        # through-calls analyses (callgraph.blocking_leaf): sleep,
+        # fsync, socket/HTTP IO, blocking get(timeout=…)
+        desc = callgraph.blocking_leaf(call)
+        if desc == "os.fsync":
+            chain = _attr_chain(call.func)
+            if chain and chain[0] == "self":
+                # self.io.fsync(f) — a method indirection (the WAL's
+                # FileIO), which the interprocedural rule chases
+                return None
+        return desc
 
     def _iter_immediate(self, node: ast.AST) -> Iterator[ast.AST]:
         """Descendants that execute inside the critical section —
@@ -991,3 +983,241 @@ class FrozenMutationRule(Rule):
                         f"{root!r} (shared, frozen); take a private copy "
                         f"first: {root} = mutable({root})",
                     )
+
+
+# ---------------------------------------------------------------------------
+# interprocedural rules (whole-program: analysis/callgraph.py)
+
+# the concurrency-bearing files whose critical sections the
+# interprocedural blocking analysis guards — the intra-procedural
+# blocking-under-lock scope, by construction
+_CONCURRENCY_FILES = BlockingUnderLockRule.files
+
+
+@register
+class BlockingReachableUnderLockRule(ProgramRule):
+    """The through-calls half of ``blocking-under-lock``: a ``with
+    lock:`` body that CALLS something which (transitively) sleeps,
+    fsyncs, or does socket IO stalls every contender exactly like an
+    inline sleep — this is the class of bug PR 10's off-lock snapshots
+    fixed by hand (the snapshot dump used to serialize under the store
+    lock, three calls deep). Findings carry the witness call chain.
+    Deliberate designs (the WAL's io_lock exists to serialize fsync
+    batches) annotate the call site with a reason."""
+
+    id = "blocking-reachable-under-lock"
+    description = (
+        "call chain from a with-lock body to sleep/socket IO/fsync in "
+        "a callee"
+    )
+
+    def check_program(self, program) -> Iterator[Finding]:
+        for fn in program.functions.values():
+            if fn.src.rel not in _CONCURRENCY_FILES:
+                continue
+            for region in fn.regions:
+                seen: set[str] = set()
+                for cs in region.calls:
+                    for target in cs.targets:
+                        if target == fn.qual:
+                            continue
+                        for desc, chain in sorted(
+                            program.reach_blocking(target).items()
+                        ):
+                            if desc in seen:
+                                continue
+                            seen.add(desc)
+                            head = callgraph.Step(
+                                fn.short, fn.src.rel, cs.node.lineno, cs.label
+                            )
+                            yield self.finding(
+                                fn.src,
+                                cs.node,
+                                f"{desc} reachable while holding "
+                                f"{region.lock!r}: "
+                                + callgraph.render_chain((head,) + chain)
+                                + "; move the blocking work off the "
+                                "critical section or annotate with a "
+                                "reason",
+                            )
+
+
+@register
+class LockOrderCycleRule(ProgramRule):
+    """Static lockdep: every ``with A:`` body that (directly or
+    through any resolved call chain) acquires B records the edge A→B;
+    a cycle in that graph is a deadlock waiting for the interleaving
+    the runtime sanitizer only catches when a test happens to execute
+    it. Both witness call paths are reported. Lock ranks come from the
+    sanitizer factory names, so the static graph and the
+    GRAFT_SANITIZE order graph speak the same language."""
+
+    id = "lock-order-cycle"
+    description = (
+        "cycle in the static acquires-while-holding graph (potential "
+        "deadlock), with witness call paths"
+    )
+
+    # the concurrency-bearing sections: lock edges are collected from
+    # every function defined here (callees may live anywhere)
+    _SECTIONS = ("machinery", "controllers", "scheduling")
+
+    def check_program(self, program) -> Iterator[Finding]:
+        # edge (held → wanted) → (witness text, src, anchor node)
+        edges: dict[tuple[str, str], tuple[str, Any, Any]] = {}
+        for fn in program.functions.values():
+            if fn.src.section not in self._SECTIONS:
+                continue
+            for region in fn.regions:
+                for site in region.nested:
+                    if site.lock == region.lock:
+                        continue
+                    edges.setdefault(
+                        (region.lock, site.lock),
+                        (
+                            f"{fn.short} "
+                            f"({fn.src.rel}:{site.node.lineno}) acquires "
+                            f"{site.lock!r} while holding {region.lock!r}",
+                            fn.src,
+                            region.node,
+                        ),
+                    )
+                for cs in region.calls:
+                    for target in cs.targets:
+                        if target == fn.qual:
+                            continue
+                        for lock, chain in sorted(
+                            program.reach_acquires(target).items()
+                        ):
+                            if lock == region.lock:
+                                continue
+                            head = callgraph.Step(
+                                fn.short,
+                                fn.src.rel,
+                                cs.node.lineno,
+                                cs.label,
+                            )
+                            edges.setdefault(
+                                (region.lock, lock),
+                                (
+                                    f"holding {region.lock!r}: "
+                                    + callgraph.render_chain(
+                                        (head,) + chain
+                                    ),
+                                    fn.src,
+                                    region.node,
+                                ),
+                            )
+        adj: dict[str, set[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+
+        def reaches(src_lock: str, dst: str) -> Optional[list[str]]:
+            # DFS path src→…→dst over the edge graph (deterministic
+            # order for reproducible witness selection)
+            stack = [(src_lock, [src_lock])]
+            seen = {src_lock}
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(adj.get(node, ())):
+                    if nxt == dst:
+                        return path + [nxt]
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append((nxt, path + [nxt]))
+            return None
+
+        reported: set[frozenset[str]] = set()
+        for (a, b), (witness, src, anchor) in sorted(edges.items()):
+            back = reaches(b, a)
+            if back is None:
+                continue
+            cycle = frozenset(back)
+            if cycle in reported:
+                continue
+            reported.add(cycle)
+            back_witnesses = [
+                edges[(back[i], back[i + 1])][0]
+                for i in range(len(back) - 1)
+            ]
+            yield self.finding(
+                src,
+                anchor,
+                f"lock-order cycle {a!r} → {b!r} → … → {a!r}: "
+                f"[forward] {witness}; [back] "
+                + "; ".join(back_witnesses),
+            )
+
+
+@register
+class AwaitHoldingLockRule(ProgramRule):
+    """Coroutines running inline on the event-loop thread multiplex
+    EVERY connection: one blocking call — or one acquisition of a lock
+    a slow writer might hold — parks the whole serving tier, not one
+    request. Nothing blocking and no lock may be reachable from an
+    ``async def`` in the event-loop tier; hand such work to the worker
+    pool (``run_in_executor``) instead. ``await``-ed calls and
+    ``asyncio.sleep`` yield the loop and are exempt."""
+
+    id = "await-holding-lock"
+    description = (
+        "blocking call or lock acquisition reachable from an event-"
+        "loop coroutine"
+    )
+
+    _FILES = ("machinery/eventloop.py",)
+
+    def check_program(self, program) -> Iterator[Finding]:
+        for fn in program.functions.values():
+            if fn.src.rel not in self._FILES or not fn.is_async:
+                continue
+            for desc, node in fn.blocking:
+                yield self.finding(
+                    fn.src,
+                    node,
+                    f"coroutine {fn.short} runs {desc} on the loop "
+                    "thread; every connection stalls behind it — use "
+                    "run_in_executor or an awaitable",
+                )
+            for site in fn.acquires:
+                yield self.finding(
+                    fn.src,
+                    site.node,
+                    f"coroutine {fn.short} acquires lock {site.lock!r} "
+                    "on the loop thread; a slow holder parks every "
+                    "connection — dispatch to the worker pool",
+                )
+            seen: set[tuple[str, str]] = set()
+            for cs in fn.calls:
+                for target in cs.targets:
+                    if target == fn.qual:
+                        continue  # self-recursion: the direct scan above owns it
+                    head = callgraph.Step(
+                        fn.short, fn.src.rel, cs.node.lineno, cs.label
+                    )
+                    for desc, chain in sorted(
+                        program.reach_blocking(target).items()
+                    ):
+                        if ("b", desc) in seen:
+                            continue
+                        seen.add(("b", desc))
+                        yield self.finding(
+                            fn.src,
+                            cs.node,
+                            f"{desc} reachable from loop coroutine "
+                            f"{fn.short}: "
+                            + callgraph.render_chain((head,) + chain),
+                        )
+                    for lock, chain in sorted(
+                        program.reach_acquires(target).items()
+                    ):
+                        if ("l", lock) in seen:
+                            continue
+                        seen.add(("l", lock))
+                        yield self.finding(
+                            fn.src,
+                            cs.node,
+                            f"lock {lock!r} acquisition reachable from "
+                            f"loop coroutine {fn.short}: "
+                            + callgraph.render_chain((head,) + chain),
+                        )

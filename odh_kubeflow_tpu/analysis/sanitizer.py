@@ -55,6 +55,7 @@ __all__ = [
     "note_blocking",
     "reports",
     "reset",
+    "set_factory_hook",
 ]
 
 
@@ -125,11 +126,15 @@ def _reachable(src: str, dst: str) -> bool:
 class SanitizedLock:
     """Instrumented Lock/RLock with the ``threading`` lock protocol
     (``acquire``/``release``/context manager), safe to hand to
-    ``threading.Condition``."""
+    ``threading.Condition``. ``allow_blocking`` marks a lock whose
+    critical section is DESIGNED to block (a coarse try-acquire-only
+    heal mutex): it still participates in order/re-entry tracking but
+    is exempt from blocking-under-lock reports."""
 
-    def __init__(self, name: str, reentrant: bool):
+    def __init__(self, name: str, reentrant: bool, allow_blocking: bool = False):
         self.name = name
         self.reentrant = reentrant
+        self.allow_blocking = allow_blocking
         self._raw = threading.RLock() if reentrant else threading.Lock()
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
@@ -204,19 +209,43 @@ class SanitizedLock:
 # ---------------------------------------------------------------------------
 # factories (the machinery's only lock constructors)
 
+# installed by analysis.schedule while a deterministic scheduler is
+# active: (name, reentrant) -> lock, or None to fall through. Lets the
+# explorer hand out cooperative locks without the machinery importing
+# anything new.
+_factory_hook: Optional[callable] = None
 
-def new_lock(name: str):
-    """A non-reentrant lock; sanitized when the sanitizer is enabled,
-    a raw ``threading.Lock`` (zero overhead) otherwise."""
+
+def set_factory_hook(fn) -> None:
+    global _factory_hook
+    _factory_hook = fn
+
+
+def new_lock(name: str, allow_blocking: bool = False):
+    """A non-reentrant lock; cooperative while a schedule explorer is
+    active, sanitized when the sanitizer is enabled, a raw
+    ``threading.Lock`` (zero overhead) otherwise. ``allow_blocking``
+    exempts the lock from blocking-under-lock reports (see
+    :class:`SanitizedLock`) — reserve it for coarse try-acquire-only
+    mutexes whose body blocks by design."""
+    if _factory_hook is not None:
+        lock = _factory_hook(name, False, allow_blocking)
+        if lock is not None:
+            return lock
     if _enabled:
-        return SanitizedLock(name, reentrant=False)
+        return SanitizedLock(name, reentrant=False, allow_blocking=allow_blocking)
     return threading.Lock()
 
 
-def new_rlock(name: str):
-    """A reentrant lock; sanitized when enabled, raw otherwise."""
+def new_rlock(name: str, allow_blocking: bool = False):
+    """A reentrant lock; cooperative under an active explorer,
+    sanitized when enabled, raw otherwise."""
+    if _factory_hook is not None:
+        lock = _factory_hook(name, True, allow_blocking)
+        if lock is not None:
+            return lock
     if _enabled:
-        return SanitizedLock(name, reentrant=True)
+        return SanitizedLock(name, reentrant=True, allow_blocking=allow_blocking)
     return threading.RLock()
 
 
@@ -231,7 +260,11 @@ def note_blocking(op: str) -> None:
     ``blocking-under-lock`` rule."""
     if not _enabled:
         return
-    held = _held_names()
+    held = [
+        lock.name
+        for lock in _held()
+        if not getattr(lock, "allow_blocking", False)
+    ]
     if held:
         _report(
             f"blocking-under-lock: {op} at {_call_site()} while holding "

@@ -56,7 +56,9 @@ def retry(
     base: float = 0.05,
     cap: float = 2.0,
     rng: Any = random,
-    sleep_fn: Callable[[float], None] = time.sleep,
+    # None = time.sleep, looked up at CALL time so the sanitizer's and
+    # schedule explorer's sleep interposition see retry pacing too
+    sleep_fn: Optional[Callable[[float], None]] = None,
     on_retry: Optional[Callable[[BaseException, int, float], None]] = None,
 ) -> Any:
     """Call ``fn`` until it succeeds, a non-retryable error escapes, or
@@ -92,5 +94,5 @@ def retry(
                 prev = max(prev, float(retry_after))
             if on_retry is not None:
                 on_retry(e, attempt, prev)
-            sleep_fn(prev)
+            (sleep_fn or time.sleep)(prev)
     raise AssertionError("unreachable")  # pragma: no cover

@@ -41,6 +41,7 @@ from collections import OrderedDict
 from typing import Any, Callable, Iterable, Optional
 
 from odh_kubeflow_tpu.analysis import sanitizer as _sanitizer
+from odh_kubeflow_tpu.analysis import schedule as _schedule
 from odh_kubeflow_tpu.machinery import objects as obj_util
 from odh_kubeflow_tpu.machinery import serialize
 from odh_kubeflow_tpu.machinery.objects import (  # noqa: F401 — public API
@@ -181,10 +182,15 @@ class InformerCache:
         self._kinds: dict[str, _KindCache] = {k: _KindCache() for k in kinds}
         # per-kind heal mutex: stream-loss recovery can be triggered by
         # the pump thread AND read-path pokes at once; only one may
-        # swap the watch + relist (plain Lock, taken non-blocking — a
-        # loser returns immediately instead of stacking up)
-        self._heal_locks: dict[str, threading.Lock] = {
-            k: threading.Lock() for k in self._kinds
+        # swap the watch + relist (taken non-blocking — a loser returns
+        # immediately instead of stacking up). Sanitizer-built so the
+        # heal path participates in lock-order tracking and schedule
+        # exploration; allow_blocking because the heal body BLOCKS by
+        # design (watch re-open + relist over HTTP on a remote api) and
+        # nothing can ever wait on this lock (try-acquire only).
+        self._heal_locks: dict[str, Any] = {
+            k: _sanitizer.new_lock("informer.heal", allow_blocking=True)
+            for k in self._kinds
         }
         self._handlers: dict[str, list[Handler]] = {}
         self._watches: dict[str, Watch] = {}
@@ -501,6 +507,9 @@ class InformerCache:
                 w = self.api.watch(kind, send_initial=False)
             except Exception as e:  # noqa: BLE001 — Expired/APIError/OSError
                 return self._degrade(kind, "watch re-open failed", e)
+            # explorer yield marker: fresh watch open, relist not yet
+            # taken — writes landing here must arrive as events
+            _schedule.sched_point("informer.heal.relist")
             try:
                 objs = self._list_all(kind)
             except Exception as e:  # noqa: BLE001 — backend still flapping
@@ -509,6 +518,9 @@ class InformerCache:
                 except (APIError, OSError, RuntimeError):
                     pass  # best-effort teardown of the half-opened stream
                 return self._degrade(kind, "relist after stream loss failed", e)
+            # explorer yield marker: listed snapshot in hand, mirror
+            # not yet rebuilt — reads racing the heal interleave here
+            _schedule.sched_point("informer.heal.rebuild")
             with self._lock:
                 old = self._watches.get(kind)
                 self._watches[kind] = w

@@ -128,7 +128,9 @@ class RemoteAPIServer:
         self.retries = max(int(retries), 1)
         self.retry_base = retry_base
         self.retry_cap = retry_cap
-        self._sleep = time.sleep  # injectable for tests
+        # injectable for tests; None = time.sleep looked up at call
+        # time (keeps the sanitizer/schedule-explorer sleep patch live)
+        self._sleep: Optional[Any] = None
         reg = registry or prometheus.default_registry
         self._m_retries = reg.counter(
             "client_retries_total",
@@ -723,7 +725,7 @@ class RemoteAPIServer:
                 )
                 if floor:
                     delay, floor = max(delay, floor), None
-                self._sleep(delay)
+                (self._sleep or time.sleep)(delay)
 
         threading.Thread(target=pump, daemon=True).start()
         # bounded wait (best effort): a down server keeps the pump in

@@ -95,7 +95,7 @@ class GcpIamClient:
         http_fn: Optional[HttpFn] = None,
         endpoint: str = "https://iam.googleapis.com/v1",
         max_retries: int = 3,
-        sleep_fn: Callable[[float], None] = time.sleep,
+        sleep_fn: Optional[Callable[[float], None]] = None,
     ):
         self.token_fn = token_fn or (lambda: "")
         self.http = http_fn or _default_http

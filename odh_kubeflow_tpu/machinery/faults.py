@@ -92,7 +92,7 @@ class FaultyFileIO(FileIO):
         self,
         seed: int = 1,
         schedule: Optional[DiskFaultSchedule] = None,
-        sleep_fn: Callable[[float], None] = time.sleep,
+        sleep_fn: Optional[Callable[[float], None]] = None,
     ):
         self.rng = random.Random(seed)
         self.schedule = schedule if schedule is not None else DiskFaultSchedule.default()
@@ -106,7 +106,7 @@ class FaultyFileIO(FileIO):
         s = self.schedule
         if s.slow_disk and self.rng.random() < s.slow_disk:
             self._count("slow_disk")
-            self._sleep(s.slow_seconds)
+            (self._sleep or time.sleep)(s.slow_seconds)
         if s.torn_write and self.rng.random() < s.torn_write:
             self._count("torn_write")
             keep = self.rng.randrange(len(data) + 1) if data else 0
@@ -119,7 +119,7 @@ class FaultyFileIO(FileIO):
         s = self.schedule
         if s.slow_disk and self.rng.random() < s.slow_disk:
             self._count("slow_disk")
-            self._sleep(s.slow_seconds)
+            (self._sleep or time.sleep)(s.slow_seconds)
         if s.fsync_fail and self.rng.random() < s.fsync_fail:
             self._count("fsync_fail")
             raise OSError("injected fsync failure")
@@ -242,7 +242,7 @@ class FaultInjector:
         seed: int = 1,
         schedule: Optional[FaultSchedule] = None,
         registry: Optional[prometheus.Registry] = None,
-        sleep_fn: Callable[[float], None] = time.sleep,
+        sleep_fn: Optional[Callable[[float], None]] = None,
     ):
         self.api = api
         self.seed = seed
@@ -340,7 +340,7 @@ class FaultInjector:
         rng = self._rng()
         if s.latency and rng.random() < s.latency:
             self._count("latency")
-            self._sleep(s.latency_seconds)
+            (self._sleep or time.sleep)(s.latency_seconds)
         if s.watch_drop and rng.random() < s.watch_drop:
             live = self._live_watches()
             if live:

@@ -45,6 +45,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, Optional
 
 from odh_kubeflow_tpu.analysis import sanitizer as _sanitizer
+from odh_kubeflow_tpu.analysis import schedule as _schedule
 from odh_kubeflow_tpu.machinery import backoff, objects as obj_util
 from odh_kubeflow_tpu.machinery import serialize
 from odh_kubeflow_tpu.utils import tracing
@@ -482,11 +483,18 @@ class APIServer:
         # bounded watch cache: (rv, kind, namespace, etype, frozen obj)
         # — the resume window behind watch(resource_version=…)
         self._event_log: deque[tuple[int, str, str, str, Obj]] = deque()
-        # pagination: sorted key lists per (kind, namespace) cached by
-        # the kind's last-mutation rv — a multi-page walk over an
-        # unchanged collection sorts ONCE instead of once per page
-        # (bounded LRU; any mutation of the kind invalidates via the
-        # rv tag)
+        # pagination (cluster-wide): one sorted key list per kind,
+        # maintained INCREMENTALLY at write time (bisect insert/remove
+        # — an O(n) memmove in C, vs the O(n log n) interpreter sort a
+        # fleet-sized page walk used to pay per page whenever any
+        # write invalidated the rv-tagged cache; BENCH fleet: cluster
+        # page p99 22.6ms vs 7.3ms namespaced)
+        self._sorted_keys: dict[str, list] = {}
+        # pagination (namespaced): sorted key lists per (kind,
+        # namespace) cached by the kind's last-mutation rv — a
+        # multi-page walk over an unchanged bucket sorts ONCE instead
+        # of once per page (bounded LRU; any mutation of the kind
+        # invalidates via the rv tag)
         self._page_keys: "OrderedDict[tuple[str, str], tuple[int, list]]" = (
             OrderedDict()
         )
@@ -508,6 +516,7 @@ class APIServer:
             self._types[kind] = TypeInfo(api_version, kind, plural, namespaced)
             self._store.setdefault(kind, {})
             self._ns_buckets.setdefault(kind, {})
+            self._sorted_keys.setdefault(kind, [])
             # dynamic (CRD) registrations must survive a restart or the
             # replay of their objects would hit an unknown kind; builtin
             # kinds re-register from code, so only log the rest
@@ -596,11 +605,22 @@ class APIServer:
         return str(self._rv)
 
     def _put(self, kind: str, key: tuple[str, str], obj: Obj) -> None:
-        self._store[kind][key] = obj
+        per_kind = self._store[kind]
+        if key not in per_kind and not self._replaying:
+            # incremental insert per live write; recovery replays in
+            # creation (not key) order, so per-record insort would be
+            # O(n^2) there — recover() rebuilds each index with ONE
+            # sort after replay instead
+            bisect.insort(self._sorted_keys[kind], key)
+        per_kind[key] = obj
         self._ns_buckets[kind].setdefault(key[0], {})[key] = obj
 
     def _drop(self, kind: str, key: tuple[str, str]) -> None:
-        self._store[kind].pop(key, None)
+        if self._store[kind].pop(key, None) is not None:
+            keys = self._sorted_keys[kind]
+            i = bisect.bisect_left(keys, key)
+            if i < len(keys) and keys[i] == key:
+                del keys[i]
         bucket = self._ns_buckets[kind].get(key[0])
         if bucket is not None:
             bucket.pop(key, None)
@@ -636,7 +656,14 @@ class APIServer:
                 daemon=True,
             )
             self._committer.start()
+            # under the schedule explorer: wait for the committer to
+            # register so the schedulable set is deterministic (no-op
+            # in production)
+            _schedule.thread_started(self._committer)
         self._commitq.put(entry)
+        # explorer yield marker: a prepared-but-unlogged record is in
+        # flight; racing writers/committer/snapshot interleave here
+        _schedule.sched_point("store.commit.enqueue")
         return entry
 
     def _commit_mutation(
@@ -684,9 +711,11 @@ class APIServer:
             return
         if not entry.done.is_set():
             # a durability wait must never run under a store/cache lock
-            # (sanitizer probe; no-op when GRAFT_SANITIZE is off)
+            # (sanitizer probe; no-op when GRAFT_SANITIZE is off).
+            # schedule.wait_event participates in exploration and is a
+            # plain Event.wait otherwise.
             _sanitizer.note_blocking("wal.commit-wait")
-            entry.done.wait()
+            _schedule.wait_event(entry.done)
         if entry.error is not None:
             raise entry.error
 
@@ -745,7 +774,7 @@ class APIServer:
         from odh_kubeflow_tpu.machinery.wal import CrashPoint
 
         while True:
-            entry = self._commitq.get()
+            entry = _schedule.queue_get(self._commitq)
             if entry is None:
                 return
             batch = [entry]
@@ -783,15 +812,21 @@ class APIServer:
                 self._batch_hwm = len(batch)
             groups = [batch] if self.group_commit else [[e] for e in batch]
             for gi, group in enumerate(groups):
+                # explorer yield marker: batch collected, fsync not yet
+                # issued — the window racing writers re-enqueue into
+                _schedule.sched_point("store.commit.fsync")
                 try:
                     with self._wal.io_lock:
                         for e in group:
                             self._wal.write_record(e.record)
-                        self._wal.sync()
+                        self._wal.sync()  # graftlint: disable=blocking-reachable-under-lock the group fsync under wal.io IS the commit; only snapshot rotation contends it, and rotation is O(1)
                 except BaseException as e:  # noqa: BLE001 — incl. CrashPoint
                     rest = [x for g in groups[gi + 1:] for x in g]
                     self._commit_failed(group + rest, e)
                     return
+                # explorer yield marker: durable but not yet applied —
+                # the log→fsync→apply→ack ordering's critical window
+                _schedule.sched_point("store.commit.apply")
                 with self._lock:
                     for e in group:
                         if e.etype != "register":
@@ -929,7 +964,11 @@ class APIServer:
         safe)."""
         if self._wal is None:
             raise APIError("no write-ahead log attached")
+        # explorer yield markers around the cut: the snapshot racing
+        # the group-commit pipeline is one of the drilled interleavings
+        _schedule.sched_point("store.snapshot.cut")
         state = self._snapshot_cut()
+        _schedule.sched_point("store.snapshot.persist")
         self._wal.snapshot(state, state["rv"])
 
     @classmethod
@@ -1041,6 +1080,10 @@ class APIServer:
                 ] = ev.get("metadata", {}).get("name", "")
         finally:
             srv._replaying = False
+        # ordered key index: one sort per kind over the recovered set
+        # (replay skipped the per-record insort — see _put)
+        for kind, per_kind in srv._store.items():
+            srv._sorted_keys[kind] = sorted(per_kind)
         srv._applied_rv = srv._rv
         srv._wal = wal
         return srv
@@ -1245,26 +1288,33 @@ class APIServer:
                 start_after = (str(k[0]), str(k[1]))
             else:
                 token_rv = self._applied_rv
+            out: list[Obj] = []
+            last_key: Optional[tuple[str, str]] = None
+            more = False
             if info.namespaced and namespace:
                 src: dict[tuple[str, str], Obj] = self._ns_buckets[kind].get(
                     namespace, {}
                 )
+                # namespaced pages: rv-tag-cached sort of the (small)
+                # bucket — any mutation of the kind invalidates via
+                # the kind-rv key
+                ck = (kind, namespace)
+                rv_tag = self._kind_rv.get(kind, 0)
+                cached = self._page_keys.get(ck)
+                if cached is not None and cached[0] == rv_tag:
+                    keys = cached[1]
+                else:
+                    keys = sorted(src)
+                    self._page_keys[ck] = (rv_tag, keys)
+                    while len(self._page_keys) > 64:
+                        self._page_keys.popitem(last=False)
+                self._page_keys.move_to_end(ck)
             else:
+                # cluster-wide pages: the incrementally-maintained
+                # ordered key index — no per-page sort even when
+                # writers race the walk
                 src = self._store[kind]
-            out: list[Obj] = []
-            last_key: Optional[tuple[str, str]] = None
-            more = False
-            ck = (kind, namespace or "")
-            rv_tag = self._kind_rv.get(kind, 0)
-            cached = self._page_keys.get(ck)
-            if cached is not None and cached[0] == rv_tag:
-                keys = cached[1]
-            else:
-                keys = sorted(src)
-                self._page_keys[ck] = (rv_tag, keys)
-                while len(self._page_keys) > 64:
-                    self._page_keys.popitem(last=False)
-            self._page_keys.move_to_end(ck)
+                keys = self._sorted_keys[kind]
             start = (
                 bisect.bisect_right(keys, start_after)
                 if start_after is not None

@@ -42,10 +42,10 @@ from __future__ import annotations
 import json
 import os
 import struct
-import threading
 import zlib
 from typing import Any, Iterator, Optional
 
+from odh_kubeflow_tpu.analysis import sanitizer as _sanitizer
 from odh_kubeflow_tpu.machinery import serialize
 
 Obj = dict[str, Any]
@@ -207,11 +207,14 @@ class WriteAheadLog:
         # write-batch/fsync vs a snapshot's rotate + GC. The snapshot's
         # own serialization and tmp-file write run OUTSIDE this lock
         # (different file), so appends never stall behind a fleet-sized
-        # snapshot dump — only the O(1) rotate excludes them.
-        self.io_lock = threading.Lock()
+        # snapshot dump — only the O(1) rotate excludes them. Built
+        # through the sanitizer factory so the runtime order graph and
+        # graftlint's static lock ranks share the "wal.io" name (and so
+        # the schedule explorer can serialize it).
+        self.io_lock = _sanitizer.new_lock("wal.io")
         # one snapshot at a time (the cadence snapshot on the committer
         # and a manual ``snapshot_now`` may overlap)
-        self._snap_lock = threading.Lock()
+        self._snap_lock = _sanitizer.new_lock("wal.snapshot")
         # sealed segment seq → max record rv it contains. Snapshot GC
         # may only remove a sealed segment whose every record the
         # snapshot covers (max rv ≤ snapshot rv) — with appends now
@@ -303,7 +306,7 @@ class WriteAheadLog:
         means the write was never acked and must not be applied."""
         with self.io_lock:
             self.write_record(record)
-            self.sync()
+            self.sync()  # graftlint: disable=blocking-reachable-under-lock wal.io exists to serialize fsync batches; nothing else contends it during an append
 
     def close(self) -> None:
         if self._f is not None:
@@ -335,7 +338,7 @@ class WriteAheadLog:
             f = self.io.open_trunc(tmp)
             try:
                 self.io.write(f, _encode(serialize.dumps(state)))
-                self.io.fsync(f)
+                self.io.fsync(f)  # graftlint: disable=blocking-reachable-under-lock wal.snapshot only serializes snapshot attempts; the append path never takes it
             finally:
                 f.close()
             self.io.replace(tmp, path)

@@ -463,3 +463,73 @@ def test_event_retention_env_bound_holds(monkeypatch):
     for i in range(40):
         api.emit_event(nb, "Churn", f"message {i}")
     assert len(api.list("Event", namespace="a")) <= 15
+
+
+# ---------------------------------------------------------------------------
+# ordered key index (ISSUE 11: cluster-wide pages skip the per-page sort)
+
+
+def test_ordered_key_index_tracks_churn_exactly():
+    """The incrementally-maintained cluster-wide key index must equal
+    sorted(store keys) through arbitrary create/update/delete churn —
+    it IS what cluster-wide pages walk, so drift would reorder or
+    drop page entries."""
+    api = _api()
+    _fill(api, 40)
+    for i in range(0, 40, 3):
+        api.delete("Notebook", f"nb-{i:04d}", ("a", "b")[i % 2])
+    for i in range(40, 55):
+        api.create(
+            {"kind": "Notebook",
+             "metadata": {"name": f"nb-{i:04d}", "namespace": "a"},
+             "spec": {}}
+        )
+    for i in range(41, 55, 4):  # updates must not duplicate keys
+        nb = api.get("Notebook", f"nb-{i:04d}", "a")
+        nb["spec"]["v"] = i
+        api.update(nb)
+    assert api._sorted_keys["Notebook"] == sorted(api._store["Notebook"])
+
+
+def test_cluster_page_walk_stays_sorted_under_interleaved_writes():
+    """A cluster-wide paginated walk with writers landing between
+    pages: every page arrives in (namespace, name) order and no
+    pre-existing, undeleted object is skipped (the at-least-as-fresh
+    contract) — without re-sorting the collection per page."""
+    api = _api()
+    _fill(api, 30)
+    seen = []
+    deleted = set()
+    token = None
+    page_no = 0
+    while True:
+        page, token = api.list_chunk("Notebook", limit=7, continue_token=token)
+        keys = [
+            (o["metadata"]["namespace"], o["metadata"]["name"]) for o in page
+        ]
+        assert keys == sorted(keys)
+        seen.extend(keys)
+        # interleave writes mid-walk: a create ahead of the cursor and
+        # a delete BEHIND it (exercises the index's bisect removal
+        # without disturbing what the remaining pages must return)
+        api.create(
+            {"kind": "Notebook",
+             "metadata": {"name": f"zz-{page_no}", "namespace": "b"},
+             "spec": {}}
+        )
+        if token and keys:
+            ns, name = keys[0]
+            api.delete("Notebook", name, ns)
+            deleted.add((ns, name))
+        page_no += 1
+        if not token:
+            break
+    assert seen == sorted(seen)
+    original = {
+        ("a", f"nb-{i:04d}") if i % 2 == 0 else ("b", f"nb-{i:04d}")
+        for i in range(30)
+    }
+    # every pre-existing object either appeared in the walk or was the
+    # one we deleted behind the cursor
+    assert original - deleted <= set(seen)
+    assert api._sorted_keys["Notebook"] == sorted(api._store["Notebook"])
