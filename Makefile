@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint sanitize chaos durability explore fleetbench loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
+.PHONY: all test test-unit test-manifests lint sanitize chaos durability explore fleetbench obs loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
 
 all: lint test
 
@@ -94,6 +94,15 @@ sanitize:
 	  tests/test_cache.py::test_cache_coherence_property_randomized_crud \
 	  tests/test_scheduling.py::test_property_random_admit_preempt_node_loss_sequences \
 	  tests/test_sessions.py::test_property_random_suspend_resume_oversubscribed
+
+# observability smoke (docs/GUIDE.md "Tracing, zpages & SLOs"): spawn
+# one notebook under a client trace against the sim platform and gate
+# the whole surface — ONE assembled trace with the
+# admission/gang-bind/container-start spans, OpenMetrics + trace-id
+# exemplars under content negotiation (plain exposition byte-stable),
+# SLO burn rates on /api/slo + slo_burn_rate gauges, /debug zpages
+obs:
+	$(PYTHON) -m loadtest.obs_smoke
 
 # platform load test against the embedded apiserver + sim kubelet
 # (loadtest/start_notebooks.py; reference notebook-controller/loadtest)

@@ -1,0 +1,224 @@
+"""Observability smoke: trace assembly + exemplars + SLO engine
+against a mini platform run (`make obs`).
+
+Boots the all-in-one platform with the sim kubelet, spawns one TPU
+notebook under a client-chosen trace, and then asserts the whole
+observability surface end to end:
+
+1. the spawn assembled into ONE trace on ``/debug/traces`` whose tree
+   contains the admission, gang-bind, and container-start milestone
+   spans (and, after a suspend/resume cycle, the restore span);
+2. ``/metrics`` serves OpenMetrics under content negotiation, with
+   trace-id exemplars on the spawn-path histograms, while the default
+   plain exposition stays exemplar-free;
+3. the SLO engine reports multi-window burn rates at the dashboard's
+   ``/api/slo`` and as ``slo_burn_rate`` gauges;
+4. ``/debug/queues`` and ``/debug/locks`` answer.
+
+Exits non-zero with the failing check named; prints one JSON summary
+line on success.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.request
+
+CHECKS: list[str] = []
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    if not ok:
+        raise SystemExit(f"OBS SMOKE FAILED at {name}: {detail}")
+    CHECKS.append(name)
+
+
+def http(url: str, headers: dict | None = None, body: bytes | None = None) -> tuple[int, bytes]:
+    req = urllib.request.Request(
+        url, data=body, headers=headers or {}, method="POST" if body else "GET"
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, r.read()
+
+
+def main() -> None:
+    from odh_kubeflow_tpu.platform import Platform
+    from odh_kubeflow_tpu.utils import tracing
+    from odh_kubeflow_tpu.utils.prometheus import parse_openmetrics
+
+    platform = Platform(sim=True)
+    platform.cluster.add_node("cpu-0")
+    platform.cluster.add_tpu_node_pool(
+        "v5e", "tpu-v5-lite-podslice", "2x2", num_hosts=1, chips_per_host=4
+    )
+    platform.api.create(
+        {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "Profile",
+            "metadata": {"name": "obs-team"},
+            "spec": {"owner": {"kind": "User", "name": "obs@example.com"}},
+        }
+    )
+    api_port, web_port = platform.start(api_port=0, web_port=0)
+    api = f"http://127.0.0.1:{api_port}"
+    web = f"http://127.0.0.1:{web_port}"
+
+    trace_id = tracing.new_trace_id()
+
+    def call(path, method="GET", body=None):
+        headers = {
+            "kubeflow-userid": "obs@example.com",
+            "Content-Type": "application/json",
+        }
+        if method != "GET":
+            headers["Cookie"] = "XSRF-TOKEN=t"
+            headers["x-xsrf-token"] = "t"
+            headers["traceparent"] = (
+                f"00-{trace_id}-{tracing.new_span_id()}-01"
+            )
+        req = urllib.request.Request(
+            web + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+            headers=headers,
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read().decode())
+
+    try:
+        # -- spawn one notebook under the trace ---------------------------
+        call(
+            "/jupyter/api/namespaces/obs-team/notebooks",
+            method="POST",
+            body={
+                "name": "obs-nb",
+                "image": "odh-kubeflow-tpu/jupyter-jax-tpu:v0.1.0",
+                "cpu": "1",
+                "memory": "1Gi",
+                "configurations": [],
+                "tpus": {
+                    "accelerator": "tpu-v5-lite-podslice",
+                    "topology": "2x2",
+                },
+            },
+        )
+        deadline = time.monotonic() + 60
+        ready = False
+        while time.monotonic() < deadline:
+            d = call("/jupyter/api/namespaces/obs-team/notebooks/obs-nb/details")
+            if d["details"]["status"]["phase"] == "ready":
+                ready = True
+                break
+            time.sleep(0.1)
+        check("spawn-ready", ready, "notebook never became ready")
+
+        # -- 1: one assembled trace with the milestone spans --------------
+        _, raw = http(f"{api}/debug/traces?trace={trace_id}&format=json")
+        traces = json.loads(raw)["traces"]
+        check("trace-recorded", bool(traces), "no spans for the spawn trace")
+        spans = traces[0]["spans"]
+        names = {s["name"] for s in spans}
+        for want in (
+            "scheduler.admit",
+            "kubelet.gang_bind",
+            "kubelet.container_start",
+        ):
+            check("trace-milestones", want in names, f"missing {want} in {sorted(names)}")
+        recs = [tracing.SpanRecord.from_dict(s) for s in spans]
+        tree = tracing.assemble(recs)
+        check("trace-one-tree", tree is not None, "assembly failed")
+
+        def count(node):
+            return 1 + sum(count(c) for c in node["children"])
+
+        check(
+            "trace-one-tree",
+            count(tree) == len(recs),
+            f"tree covers {count(tree)} of {len(recs)} spans",
+        )
+        # the text zpage renders it
+        _, page = http(f"{api}/debug/traces?trace={trace_id}")
+        check(
+            "trace-zpage",
+            b"scheduler.admit" in page,
+            "text zpage missing the admission span",
+        )
+
+        # -- 2: OpenMetrics + exemplars under content negotiation ---------
+        _, plain = http(f"{api}/metrics")
+        check(
+            "plain-exposition",
+            b"# EOF" not in plain and b"trace_id=" not in plain,
+            "plain exposition leaked OpenMetrics syntax",
+        )
+        _, om = http(
+            f"{api}/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        fams = parse_openmetrics(om.decode())  # raises if malformed
+        check(
+            "openmetrics",
+            "notebook_spawn_ready_seconds" in fams,
+            "spawn histogram missing from OpenMetrics exposition",
+        )
+        exemplars = [
+            ex
+            for fam in fams.values()
+            for (_n, _l, _v, ex) in fam["samples"]
+            if ex is not None
+        ]
+        check("exemplars", bool(exemplars), "no exemplars in OpenMetrics output")
+        check(
+            "exemplars",
+            any("trace_id" in ex[0] for ex in exemplars),
+            "exemplars carry no trace_id label",
+        )
+
+        # -- 3: SLO burn rates --------------------------------------------
+        slo = call("/api/slo?tick=1")
+        rows = slo["slos"]
+        check("slo-rows", bool(rows), "no SLO rows from /api/slo")
+        by_slo = {(r["slo"], r["window"]) for r in rows}
+        check(
+            "slo-rows",
+            ("spawn-ready-p99", "5m") in by_slo,
+            f"spawn-ready-p99/5m missing from {sorted(by_slo)}",
+        )
+        _, metrics2 = http(f"{api}/metrics")
+        check(
+            "slo-gauges",
+            b"slo_burn_rate{" in metrics2,
+            "slo_burn_rate gauges missing from /metrics",
+        )
+
+        # -- 4: the other zpages ------------------------------------------
+        _, queues = http(f"{api}/debug/queues?format=json")
+        qd = json.loads(queues)
+        check(
+            "queues-zpage",
+            "workqueues" in qd and "store" in qd,
+            f"unexpected /debug/queues shape: {qd}",
+        )
+        status, _locks = http(f"{api}/debug/locks")
+        check("locks-zpage", status == 200, "/debug/locks did not answer")
+
+        print(
+            json.dumps(
+                {
+                    "gate": "passed",
+                    "checks": CHECKS,
+                    "trace_id": trace_id,
+                    "trace_spans": len(spans),
+                    "slo_rows": len(rows),
+                    "exemplars": len(exemplars),
+                }
+            )
+        )
+    finally:
+        platform.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

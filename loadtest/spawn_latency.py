@@ -34,6 +34,7 @@ import urllib.request
 
 def measure_spawn_to_ready(with_suspend_resume: bool = False) -> dict:
     from odh_kubeflow_tpu.platform import Platform
+    from odh_kubeflow_tpu.utils import tracing
 
     platform = Platform(sim=True)
     platform.cluster.add_node("cpu-0")
@@ -48,8 +49,17 @@ def measure_spawn_to_ready(with_suspend_resume: bool = False) -> dict:
             "spec": {"owner": {"kind": "User", "name": "bench@example.com"}},
         }
     )
-    _, web_port = platform.start(api_port=0, web_port=0)
+    api_port, web_port = platform.start(api_port=0, web_port=0)
     base = f"http://127.0.0.1:{web_port}"
+    api_base = f"http://127.0.0.1:{api_port}"
+
+    # the spawn is ONE trace: the POST carries this traceparent, the
+    # store stamps the trace id on the Notebook, the controller fans it
+    # to Workload/pods, and scheduler/kubelet/session spans join it —
+    # the breakdown below is derived from the assembled tree and
+    # cross-checked against the legacy polled-annotation path
+    trace_id = tracing.new_trace_id()
+    traceparent = f"00-{trace_id}-{tracing.new_span_id()}-01"
 
     def call(path, method="GET", body=None):
         headers = {
@@ -59,6 +69,7 @@ def measure_spawn_to_ready(with_suspend_resume: bool = False) -> dict:
         if method != "GET":
             headers["Cookie"] = "XSRF-TOKEN=t"
             headers["x-xsrf-token"] = "t"
+            headers["traceparent"] = traceparent
         req = urllib.request.Request(
             base + path,
             data=json.dumps(body).encode() if body is not None else None,
@@ -69,6 +80,7 @@ def measure_spawn_to_ready(with_suspend_resume: bool = False) -> dict:
             return json.loads(r.read().decode())
 
     t0 = time.monotonic()
+    t0_wall = time.time()
     call(
         "/jupyter/api/namespaces/bench-team/notebooks",
         method="POST",
@@ -117,11 +129,106 @@ def measure_spawn_to_ready(with_suspend_resume: bool = False) -> dict:
             }
         )
     try:
+        out.update(_trace_breakdown(api_base, trace_id, t0_wall, out))
         if with_suspend_resume:
             out.update(_measure_suspend_resume(platform, call))
+            _assert_restore_traced(api_base, trace_id)
     finally:
         platform.stop()
     return out
+
+
+# the two breakdowns measure through different clocks (trace spans end
+# when the write lands; the legacy path polls the UI feed at 50ms and
+# the sim steps at 500ms), so agreement is bounded, not exact
+TRACE_TOLERANCE_S = 1.5
+
+
+def _fetch_trace(api_base: str, trace_id: str) -> list[dict]:
+    req = urllib.request.Request(
+        f"{api_base}/debug/traces?trace={trace_id}&format=json"
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        body = json.loads(r.read().decode())
+    traces = body.get("traces") or []
+    return traces[0]["spans"] if traces else []
+
+
+def _trace_breakdown(
+    api_base: str,
+    trace_id: str,
+    t0_wall: float,
+    legacy: dict,
+) -> dict:
+    """Derive the queue/schedule/start breakdown from the assembled
+    spawn trace (served by the apiserver's /debug/traces zpage) and
+    assert it agrees with the legacy polled-annotation path within
+    tolerance. Raises on a missing milestone span or a disagreement —
+    this IS the gate that the trace pipeline tells the truth."""
+    spans = _fetch_trace(api_base, trace_id)
+    ends: dict[str, float] = {}
+    for s in spans:
+        end = float(s["start"]) + float(s["duration"])
+        ends[s["name"]] = max(ends.get(s["name"], 0.0), end)
+    required = (
+        "scheduler.admit",
+        "kubelet.gang_bind",
+        "kubelet.container_start",
+    )
+    missing = [n for n in required if n not in ends]
+    if missing:
+        raise RuntimeError(
+            f"spawn trace {trace_id} is missing span(s) {missing}; "
+            f"got {sorted(ends)}"
+        )
+    admit_end = ends["scheduler.admit"] - t0_wall
+    bind_end = ends["kubelet.gang_bind"] - t0_wall
+    start_end = ends["kubelet.container_start"] - t0_wall
+    if not admit_end <= bind_end <= start_end:
+        raise RuntimeError(
+            "spawn trace milestones out of order: "
+            f"admit={admit_end:.3f}s bind={bind_end:.3f}s "
+            f"start={start_end:.3f}s"
+        )
+    derived = {
+        "queue_wait_trace_s": round(max(admit_end, 0.0), 3),
+        "scheduling_trace_s": round(max(bind_end - admit_end, 0.0), 3),
+        "container_start_trace_s": round(max(start_end - bind_end, 0.0), 3),
+        "trace_id": trace_id,
+        "trace_spans": len(spans),
+    }
+    for trace_key, legacy_key in (
+        ("queue_wait_trace_s", "queue_wait_s"),
+        ("scheduling_trace_s", "scheduling_s"),
+        ("container_start_trace_s", "container_start_s"),
+    ):
+        if legacy_key not in legacy:
+            continue
+        delta = abs(derived[trace_key] - legacy[legacy_key])
+        if delta > TRACE_TOLERANCE_S:
+            raise RuntimeError(
+                f"trace-derived {trace_key}={derived[trace_key]}s "
+                f"disagrees with legacy {legacy_key}="
+                f"{legacy[legacy_key]}s by {delta:.3f}s "
+                f"(tolerance {TRACE_TOLERANCE_S}s)"
+            )
+    return derived
+
+
+def _assert_restore_traced(api_base: str, trace_id: str) -> None:
+    """After a suspend/resume cycle the SAME spawn trace must contain
+    the session.restore span (the notebook keeps its trace annotation,
+    so the resume's restore lands in the original tree)."""
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        spans = _fetch_trace(api_base, trace_id)
+        if any(s["name"] == "session.restore" for s in spans):
+            return
+        time.sleep(0.2)
+    raise RuntimeError(
+        f"resume finished but trace {trace_id} has no session.restore "
+        "span"
+    )
 
 
 def _measure_suspend_resume(platform, call) -> dict:

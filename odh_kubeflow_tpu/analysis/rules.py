@@ -721,6 +721,95 @@ class HotPathJsonDumpsRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# span-in-hot-loop
+
+
+@register
+class SpanInHotLoopRule(Rule):
+    """Span recording is cheap per span but NOT free-per-million:
+    every ``tracing.span(...)`` allocates ids and lands a record in
+    the collector ring. Creating one inside a per-watch-event or
+    per-page loop in ``machinery/`` (the event pumps, list walkers,
+    and serving paths everything else rides on) turns a single
+    request into an unbounded span fan-out and flushes the ring of
+    the traces an operator actually wants. Span the operation, not
+    the iteration — or mark a deliberately-traced loop body with
+    ``# span-ok: <reason>``. Nested function bodies inside the loop
+    are skipped (they execute on their own schedule, not
+    per-iteration)."""
+
+    id = "span-in-hot-loop"
+    description = (
+        "tracing.span() created inside a per-event/per-page loop in "
+        "machinery/"
+    )
+    dirs = ("machinery",)
+
+    _SPAN_ATTRS = frozenset({"span", "child_span"})
+
+    @staticmethod
+    def _loop_body_nodes(loop: ast.AST) -> Iterator[ast.AST]:
+        """Walk a loop's body, pruning nested function/lambda scopes
+        (their bodies don't run per-iteration)."""
+        stack = list(loop.body) + list(getattr(loop, "orelse", []))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _is_span_call(self, node: ast.AST, bare_names: frozenset[str]) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in self._SPAN_ATTRS
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "tracing"
+        ):
+            return True
+        return isinstance(func, ast.Name) and func.id in bare_names
+
+    @staticmethod
+    def _bare_span_names(tree: ast.AST) -> frozenset[str]:
+        """Names bound via ``from …tracing import span [as …]``."""
+        names = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and (
+                node.module or ""
+            ).endswith("tracing"):
+                for a in node.names:
+                    if a.name in ("span", "child_span"):
+                        names.add(a.asname or a.name)
+        return frozenset(names)
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        bare = self._bare_span_names(src.tree)
+        for loop in ast.walk(src.tree):
+            if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+                continue
+            for node in self._loop_body_nodes(loop):
+                if not self._is_span_call(node, bare):
+                    continue
+                span_lines = range(
+                    node.lineno, (node.end_lineno or node.lineno) + 1
+                )
+                if any("span-ok" in src.line(n) for n in span_lines):
+                    continue
+                yield self.finding(
+                    src,
+                    node,
+                    "span created inside a loop on a machinery hot "
+                    "path; span the operation outside the loop or "
+                    "annotate with `# span-ok: <reason>`",
+                )
+
+
+# ---------------------------------------------------------------------------
 # metric-naming
 
 

@@ -327,6 +327,21 @@ def reports() -> list[str]:
         return list(_reports)
 
 
+def order_graph() -> dict[str, dict[str, str]]:
+    """The live lock-order graph: held-name → {acquired-after-name:
+    first witness site}. The ``/debug/locks`` zpage renders this so an
+    operator can read the process's actual lock hierarchy (and any
+    reported inversions) without reproducing a deadlock first."""
+    with _state_lock:
+        return {
+            src: {
+                dst: _witness.get((src, dst), "?")
+                for dst in sorted(dsts)
+            }
+            for src, dsts in sorted(_edges.items())
+        }
+
+
 if _enabled:  # GRAFT_SANITIZE=1 in the environment: arm immediately
     _enabled = False  # force enable() through its patch path
     enable()

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time as _time
 from typing import Any, Optional
 
 from odh_kubeflow_tpu.apis import (
@@ -50,7 +51,7 @@ from odh_kubeflow_tpu.scheduling.workload import (
     resolve_priority,
     workload_from_statefulset,
 )
-from odh_kubeflow_tpu.utils import prometheus
+from odh_kubeflow_tpu.utils import prometheus, tracing
 from odh_kubeflow_tpu.utils.tpu import TPU_TOPOLOGIES, chips_in_topology, hosts_in_slice
 
 Obj = dict[str, Any]
@@ -175,6 +176,15 @@ class NotebookController:
         self.m_last_cull = reg.gauge(
             "last_notebook_culling_timestamp_seconds",
             "Timestamp of the last notebook culling in seconds",
+        )
+        # spawn→ready, observed once per notebook at its FIRST ready
+        # transition (creation → readyReplicas>0; restarts/resumes are
+        # excluded via the Started event's dedupe count). Feeds the
+        # spawn-ready-p99 SLO (utils/slo.py default_slos).
+        self.m_spawn_ready = reg.histogram(
+            "notebook_spawn_ready_seconds",
+            "Notebook creation to first Ready (platform spawn path)",
+            buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0),
         )
         reg.register_collector(self._collect_running)
         # wire the metrics into the culler (reference metrics.go:13-20:
@@ -418,6 +428,14 @@ class NotebookController:
         desired = workload_from_statefulset(
             sts, priority=priority, priority_class=pclass
         )
+        if desired is not None:
+            # the Workload carries the notebook's spawn trace so the
+            # scheduler's admission span lands in the same tree
+            tid = tracing.trace_id_of(notebook)
+            if tid:
+                desired["metadata"].setdefault("annotations", {})[
+                    tracing.TRACE_ANNOTATION
+                ] = tid
         try:
             if desired is None:
                 try:
@@ -571,6 +589,16 @@ class NotebookController:
 
         labels = {"statefulset": name, "notebook-name": name}
         template.setdefault("metadata", {}).setdefault("labels", {}).update(labels)
+        # propagate the notebook's spawn trace down to its pods: the
+        # kubelet's gang-bind and container-start spans key off the pod
+        # annotation, so the whole spawn assembles into ONE trace. Part
+        # of the desired template (not a post-hoc stamp), so the
+        # reconcilehelper diff never churns on it.
+        tid = tracing.trace_id_of(notebook)
+        if tid:
+            template["metadata"].setdefault("annotations", {}).setdefault(
+                tracing.TRACE_ANNOTATION, tid
+            )
         return {
             "apiVersion": "apps/v1",
             "kind": "StatefulSet",
@@ -750,6 +778,15 @@ class NotebookController:
         phase = obj_util.get_path(notebook, "status", "phase", default="")
         if phase:
             status["phase"] = phase
+        # first-ever-ready marker (spawn-SLO dedupe): owned by this
+        # mirror, preserved across rebuilds like phase — durable state,
+        # unlike the Started event whose dedupe identity embeds the
+        # ready-host count and whose retention window prunes
+        first_ready = obj_util.get_path(
+            notebook, "status", "firstReadyAt", default=""
+        )
+        if first_ready:
+            status["firstReadyAt"] = first_ready
         # controller-owned conditions survive the pod-mirror rebuild
         for cond in (
             obj_util.get_path(notebook, "status", "conditions", default=[]) or []
@@ -782,6 +819,7 @@ class NotebookController:
         # ready-transition Event (0 → ready): level-triggered, so the
         # guard is the stored status — re-reconciles of a ready
         # notebook see prev_ready > 0 and stay quiet
+        observe_spawn = False
         if status["readyReplicas"] and not prev_ready:
             self.recorder.normal(
                 notebook,
@@ -789,6 +827,15 @@ class NotebookController:
                 f"Notebook server started ({status['readyReplicas']} "
                 "ready host(s))",
             )
+            # first-EVER ready only: a stop/restart or suspend/resume
+            # transition would otherwise observe creation→now and
+            # poison the spawn SLO. The histogram is observed AFTER
+            # the status write lands — a Conflict retry would
+            # otherwise re-observe the same spawn (the marker exists
+            # exactly so this fires once).
+            if not first_ready:
+                status["firstReadyAt"] = obj_util.now_rfc3339()
+                observe_spawn = True
         if (notebook.get("status") or {}) == status:
             # steady state: the mirrored status is already what's
             # stored — skip the API round-trip entirely (the store
@@ -797,6 +844,12 @@ class NotebookController:
             return
         notebook["status"] = status
         updated = self.api.update_status(notebook)
+        if observe_spawn:
+            created = obj_util.meta(notebook).get("creationTimestamp", "")
+            if created:
+                self.m_spawn_ready.observe(
+                    max(_time.time() - obj_util.parse_rfc3339(created), 0.0)
+                )
         # keep the in-hand dict fresh for follow-up status writes in the
         # same reconcile (slice health, conditions)
         notebook["metadata"]["resourceVersion"] = updated["metadata"][

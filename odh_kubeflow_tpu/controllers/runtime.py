@@ -311,12 +311,18 @@ class Controller:
                 fence = self.fence_fn() if self.fence_fn else contextlib.nullcontext()
                 with fence:
                     result = self.reconcile(req) or Result()
-            except Exception:
+            except Exception as e:
                 elapsed = self.time_fn() - start
                 self._m_reconcile_time.observe(elapsed)
                 self._m_reconcile_errors.inc()
                 self.metrics.reconcile_total.inc(
                     {"controller": self.name, "result": "error"}
+                )
+                # the exception is handled HERE (inside the span), so
+                # the span wouldn't see it escape — mark it explicitly
+                # or the collector's keep-error-traces rule can't fire
+                tracing.set_status(
+                    "error", f"{type(e).__name__}: {e}"
                 )
                 log.exception("%s: reconcile %s failed", self.name, req)
                 self._done(req)

@@ -643,6 +643,25 @@ class EventLoopServer:
 
     # -- handler execution ---------------------------------------------------
 
+    def _dispatch_traced(self, environ: dict, start_response):
+        """Run the WSGI app, with a dispatch span when the request is
+        traced (inbound ``traceparent``): the span shows which server
+        front end handled the hop and what the handler body cost,
+        distinct from the app's own request span. Untraced requests
+        pay one header check and nothing else."""
+        from odh_kubeflow_tpu.utils import tracing
+
+        remote = tracing.parse_traceparent(environ.get("HTTP_TRACEPARENT"))
+        if remote is None:
+            return self._app(environ, start_response)
+        with tracing.span(
+            "web.dispatch",
+            parent=remote,
+            server="eventloop",
+            method=environ.get("REQUEST_METHOD", ""),
+        ):
+            return self._app(environ, start_response)
+
     def _run_app(self, environ: dict) -> tuple[str, list, Any, float]:
         """Execute the WSGI app (inline on the loop or in the worker
         pool). Returns ``(status, headers, payload, elapsed)`` with
@@ -657,7 +676,7 @@ class EventLoopServer:
 
         t0 = time.perf_counter()
         try:
-            result = self._app(environ, start_response)
+            result = self._dispatch_traced(environ, start_response)
             if isinstance(result, WatchBody):
                 return (
                     state["status"], state["headers"], result,

@@ -43,7 +43,8 @@ from odh_kubeflow_tpu.machinery.eventloop import (
     WatchBody,
     event_loop_enabled,
 )
-from odh_kubeflow_tpu.utils import tracing
+from odh_kubeflow_tpu.machinery import zpages
+from odh_kubeflow_tpu.utils import prometheus, tracing
 from odh_kubeflow_tpu.utils.prometheus import Registry
 from odh_kubeflow_tpu.machinery.store import (
     AlreadyExists,
@@ -312,16 +313,38 @@ class RestAPI:
             and self.metrics_registry is not None
         ):
             # anonymous, like the health probes: controller-runtime
-            # serves its metrics listener without authn too
-            payload = self.metrics_registry.exposition().encode()
+            # serves its metrics listener without authn too.
+            # Content-negotiated: Accept: application/openmetrics-text
+            # gets the exemplar-bearing OpenMetrics dialect (the
+            # metric→trace pivot), everything else the byte-stable
+            # plain text.
+            om = prometheus.negotiate_openmetrics(environ.get("HTTP_ACCEPT"))
+            payload = self.metrics_registry.exposition(openmetrics=om).encode()
             start_response(
                 "200 OK",
                 [
-                    ("Content-Type", "text/plain; version=0.0.4"),
+                    (
+                        "Content-Type",
+                        prometheus.OPENMETRICS_CONTENT_TYPE
+                        if om
+                        else prometheus.PLAIN_CONTENT_TYPE,
+                    ),
                     ("Content-Length", str(len(payload))),
                 ],
             )
             return [payload]
+        if environ.get("PATH_INFO", "/").startswith("/debug/"):
+            # zpages (machinery/zpages.py): recent slow/error traces,
+            # the span-ingest endpoint split-process components ship
+            # spans to, queue depths, and the sanitizer lock graph
+            resp = zpages.handle_debug(
+                environ,
+                start_response,
+                registry=self.metrics_registry,
+                api=self.server,
+            )
+            if resp is not None:
+                return resp
         # an inbound traceparent joins this request to the caller's
         # trace: every store op (and admission hook) below runs inside
         # the span, so the CREATE path stamps the caller's trace id
@@ -334,7 +357,9 @@ class RestAPI:
             # the store must treat its creates like embedded reconcile
             # writes and skip the trace-annotation stamp
             attrs["controller"] = "remote"
-        with tracing.span("apiserver", parent=remote, **attrs):
+        with tracing.span(
+            "apiserver", parent=tracing.nested_parent(remote), **attrs
+        ):
             return self._handle(environ, start_response)
 
     def _handle(self, environ, start_response):

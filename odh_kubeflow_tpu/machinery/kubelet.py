@@ -23,6 +23,7 @@ from odh_kubeflow_tpu.scheduling import (
     ADMISSION_GATE_ANNOTATION,
     WORKLOAD_LABEL,
 )
+from odh_kubeflow_tpu.utils import tracing
 
 Obj = dict[str, Any]
 
@@ -361,7 +362,22 @@ class FakeCluster:
 
     def _bind_gang(self, pod: Obj, workload_name: str) -> bool:
         """Bind ALL pods of the gang to the scheduler's assignment, or
-        none. True only when the whole gang is bound (this pod
+        none — traced as ``kubelet.gang_bind`` in the spawn trace
+        (only the attempt that LANDS records a span; retries while the
+        gang materialises are discarded so the tree shows one bind)."""
+        tid = obj_util.annotations_of(pod).get(tracing.TRACE_ANNOTATION)
+        if not tid:
+            return self._bind_gang_inner(pod, workload_name)
+        with tracing.span(
+            "kubelet.gang_bind", trace_id=tid, workload=workload_name
+        ):
+            bound = self._bind_gang_inner(pod, workload_name)
+            if not bound:
+                tracing.discard()
+            return bound
+
+    def _bind_gang_inner(self, pod: Obj, workload_name: str) -> bool:
+        """True only when the whole gang is bound (this pod
         included): the full member set must exist, every assigned node
         must still exist with enough free chips, and only then do the
         nodeName writes happen — a half-alive slice is never
@@ -533,7 +549,20 @@ class FakeCluster:
                 ],
             }
         )
-        self.api.update_status(pod)
+        tid = obj_util.annotations_of(pod).get(tracing.TRACE_ANNOTATION)
+        if tid and phase != "Running":
+            # the Pending→Running edge in the spawn trace: its END
+            # timestamp is the container-start milestone the bench's
+            # trace-derived breakdown reads
+            with tracing.span(
+                "kubelet.container_start",
+                trace_id=tid,
+                pod=obj_util.name_of(pod),
+                node=str(node),
+            ):
+                self.api.update_status(pod)
+        else:
+            self.api.update_status(pod)
 
     # -- workload reconciliation --------------------------------------------
 

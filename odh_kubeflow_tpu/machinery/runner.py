@@ -45,6 +45,21 @@ def _wrap_cached(api):
     return CachedClient(api, cache), cache
 
 
+def _install_span_exporter(api) -> None:
+    """Ship finished spans to the apiserver's ``/debug/traces/ingest``
+    so split-process hops (webhook→store→reconcile→scheduler→kubelet)
+    assemble into ONE trace on its /debug/traces zpage. TRACE_EXPORT=
+    false opts out; no endpoint (embedded api) is a no-op."""
+    if os.environ.get("TRACE_EXPORT", "true").lower() != "true":
+        return
+    base_url = getattr(api, "base_url", None)
+    if not base_url:
+        return
+    from odh_kubeflow_tpu.utils import tracing
+
+    tracing.RemoteSpanExporter(base_url).install()
+
+
 def run_controller(name: str, register: Callable) -> None:
     """``register(api, mgr)`` wires controllers into the manager.
 
@@ -59,7 +74,9 @@ def run_controller(name: str, register: Callable) -> None:
 
     # GRAFT_CHAOS=<seed>: deterministic fault injection on the API path
     # (chaos soak runs); unset = the raw client, zero overhead
-    api = maybe_wrap(api_from_env())
+    raw = api_from_env()
+    _install_span_exporter(raw)
+    api = maybe_wrap(raw)
     api, cache = _wrap_cached(api)
 
     elector = None
@@ -144,7 +161,9 @@ def run_web(name: str, default_port: int, build: Callable) -> None:
     from odh_kubeflow_tpu.machinery.client import api_from_env
     from odh_kubeflow_tpu.machinery.faults import maybe_wrap
 
-    api, cache = _wrap_cached(maybe_wrap(api_from_env()))
+    raw = api_from_env()
+    _install_span_exporter(raw)
+    api, cache = _wrap_cached(maybe_wrap(raw))
     if cache is not None:
         cache.start(live=True)
         cache.wait_for_sync()

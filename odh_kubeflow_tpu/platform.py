@@ -51,6 +51,7 @@ from odh_kubeflow_tpu.scheduling.scheduler import SliceScheduler
 from odh_kubeflow_tpu.sessions import register_sessions
 from odh_kubeflow_tpu.sessions.manager import SessionConfig, SessionManager
 from odh_kubeflow_tpu.utils import prometheus
+from odh_kubeflow_tpu.utils.slo import SLOEngine
 from odh_kubeflow_tpu.web.dashboard import DashboardApp
 from odh_kubeflow_tpu.web.jwa import JupyterWebApp
 from odh_kubeflow_tpu.web.kfam_app import KfamApp
@@ -137,6 +138,13 @@ class Platform:
         # notebook controller's counters, and anything components add
         # all scrape from the apiserver's /metrics
         self.metrics_registry = prometheus.Registry()
+        # WAL/commit-pipeline instruments (fsyncs per batch, batch
+        # size, ack latency) — no-op for the in-memory store
+        self.api.attach_metrics(self.metrics_registry)
+        # declarative SLOs evaluated as multi-window burn rates from
+        # the live histograms (utils/slo.py): slo_burn_rate gauges on
+        # /metrics, rows on the dashboard's /api/slo
+        self.slo_engine = SLOEngine(self.metrics_registry)
 
         # the shared informer cache + indexed zero-copy client: every
         # controller and web backend reads through it; writes and
@@ -205,11 +213,22 @@ class Platform:
         self.tensorboard_controller = TensorboardController(self.cached_api)
         self.tensorboard_controller.register(self.manager)
 
-        self.jwa = JupyterWebApp(self.cached_api, config_path=spawner_config_path)
-        self.vwa = VolumesWebApp(self.cached_api)
-        self.twa = TensorboardsWebApp(self.cached_api)
-        self.kfam = KfamApp(self.cached_api)
-        self.dashboard = DashboardApp(self.cached_api, kfam=self.kfam.service)
+        self.jwa = JupyterWebApp(
+            self.cached_api,
+            config_path=spawner_config_path,
+            registry=self.metrics_registry,
+        )
+        self.vwa = VolumesWebApp(self.cached_api, registry=self.metrics_registry)
+        self.twa = TensorboardsWebApp(
+            self.cached_api, registry=self.metrics_registry
+        )
+        self.kfam = KfamApp(self.cached_api, registry=self.metrics_registry)
+        self.dashboard = DashboardApp(
+            self.cached_api,
+            kfam=self.kfam.service,
+            registry=self.metrics_registry,
+            slo_engine=self.slo_engine,
+        )
 
         self.web = PrefixRouter(self.dashboard.app)
         self.web.mount("/jupyter", self.jwa.app)
@@ -230,6 +249,9 @@ class Platform:
         """Starts controllers + servers on daemon threads; returns the
         bound (api_port, web_port)."""
         self.manager.start()
+        self.slo_engine.start(
+            interval=float(os.environ.get("SLO_TICK_SECONDS", "5"))
+        )
         _, api_port, self._api_httpd = httpapi.serve(
             self.api, host, api_port, metrics_registry=self.metrics_registry
         )
@@ -255,6 +277,7 @@ class Platform:
 
     def stop(self) -> None:
         self._stop.set()
+        self.slo_engine.stop()
         self.manager.stop()
         for httpd in (self._api_httpd, self._web_httpd):
             if httpd is not None:

@@ -50,7 +50,7 @@ from odh_kubeflow_tpu.scheduling.queue import (
     SliceInventory,
     pending_order,
 )
-from odh_kubeflow_tpu.utils import prometheus
+from odh_kubeflow_tpu.utils import prometheus, tracing
 
 Obj = dict[str, Any]
 
@@ -448,6 +448,32 @@ class SliceScheduler:
         inventory: SliceInventory,
         quotas: QuotaSnapshot,
     ) -> None:
+        tid = tracing.trace_id_of(wl)
+        if not tid:
+            return self._admit_inner(wl, pool, nodes, inventory, quotas)
+        # the admission milestone of the spawn trace (the Workload
+        # carries the notebook's trace annotation): forced onto that
+        # trace — the admission-cycle reconcile span is a synthetic
+        # request on its own trace and must not adopt this one
+        with tracing.span(
+            "scheduler.admit",
+            trace_id=tid,
+            workload=obj_util.name_of(wl),
+            pool=pool,
+        ):
+            if not self._admit_inner(wl, pool, nodes, inventory, quotas):
+                # status write lost a conflict: the admission didn't
+                # land, the next cycle re-admits (and re-traces)
+                tracing.discard()
+
+    def _admit_inner(
+        self,
+        wl: Obj,
+        pool: str,
+        nodes: list[str],
+        inventory: SliceInventory,
+        quotas: QuotaSnapshot,
+    ) -> bool:
         ns = obj_util.namespace_of(wl)
         chips_per_host = wlutil.chips_per_host_of(wl)
         for node in nodes:
@@ -470,7 +496,8 @@ class SliceScheduler:
                 "position": 0,
             }
         )
-        if self._write_status(wl):
+        written = self._write_status(wl)
+        if written:
             self.m_wait.observe(wait)
             self.m_attempts.inc({"result": "admitted"})
             self._record(
@@ -480,6 +507,7 @@ class SliceScheduler:
                 f"workload admitted to slice {pool} "
                 f"(hosts: {', '.join(nodes)})",
             )
+        return written
 
     # -- preemption ---------------------------------------------------------
 

@@ -13,13 +13,38 @@ plus ``_sum``/``_count``. Metrics declared with ``labelnames`` never
 emit a phantom unlabelled ``{name} 0`` sample; unlabelled counters and
 gauges still expose their zero value on registration (client_golang
 behaviour both ways).
+
+Two exposition dialects from one registry:
+
+- plain Prometheus text (``version=0.0.4``) — byte-stable with the
+  pre-exemplar output, what every existing scrape sees;
+- OpenMetrics (``exposition(openmetrics=True)``, negotiated via the
+  ``Accept`` header — see :func:`negotiate_openmetrics`) — counter
+  families drop the ``_total`` suffix from HELP/TYPE, the stream is
+  ``# EOF``-terminated, and histogram buckets carry **exemplars**: at
+  observe time the current trace id (``utils.tracing``) is attached to
+  the bucket the value landed in, so a Grafana-style metric→trace
+  pivot (bad p99 bucket → the request that caused it) works natively.
 """
 
 from __future__ import annotations
 
 import bisect
+import re as _re
 import threading
-from typing import Callable, Iterable, Optional, Sequence
+import time as _time
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+PLAIN_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+def negotiate_openmetrics(accept: Optional[str]) -> bool:
+    """Whether an ``Accept`` header asks for OpenMetrics (the
+    content-negotiation Prometheus itself performs when scraping)."""
+    return bool(accept) and "application/openmetrics-text" in accept
 
 
 def _escape_label_value(v: str) -> str:
@@ -99,9 +124,41 @@ class Metric:
     def labels(self, **labels: str) -> _Child:
         return _Child(self, labels)
 
-    def collect(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {_escape_help(self.help)}"
-        yield f"# TYPE {self.name} {self.type}"
+    def samples(self) -> list[tuple[dict[str, str], float]]:
+        """Every (labels, value) series — zpages and the SLO engine
+        read live state through this instead of poking ``_values``."""
+        with self._lock:
+            return [
+                (dict(k), v) for k, v in sorted(self._values.items())
+            ]
+
+    def sum_matching(self, match: Optional[dict[str, str]] = None) -> float:
+        """Sum of all series whose labels are a superset of ``match``
+        (empty match ⇒ the whole family). The SLO engine's total/bad
+        counts aggregate label dimensions this way."""
+        want = (match or {}).items()
+        with self._lock:
+            return sum(
+                v
+                for k, v in self._values.items()
+                if all(item in k for item in want)
+            )
+
+    def _family_name(self, openmetrics: bool) -> str:
+        # OpenMetrics names the counter FAMILY without the _total
+        # suffix; the sample line keeps it
+        if (
+            openmetrics
+            and self.type == "counter"
+            and self.name.endswith("_total")
+        ):
+            return self.name[: -len("_total")]
+        return self.name
+
+    def collect(self, openmetrics: bool = False) -> Iterable[str]:
+        fam = self._family_name(openmetrics)
+        yield f"# HELP {fam} {_escape_help(self.help)}"
+        yield f"# TYPE {fam} {self.type}"
         with self._lock:
             if not self._values and not self.labelnames:
                 # an unlabelled metric exposes its zero value from
@@ -154,10 +211,26 @@ def _fmt_le(b: float) -> str:
     return str(int(b)) if float(b).is_integer() else repr(float(b))
 
 
+def _current_trace_id() -> Optional[str]:
+    """The active trace id (``utils.tracing`` contextvar) — the
+    exemplar every histogram observation inside a traced request
+    carries. Deferred import keeps this module importable standalone."""
+    from odh_kubeflow_tpu.utils import tracing
+
+    ctx = tracing.current()
+    return ctx.trace_id if ctx is not None else None
+
+
 class Histogram(Metric):
     """Cumulative-bucket histogram. Per label set it tracks one count
     per configured bucket plus sum/count; exposition emits the
-    cumulative ``le`` series terminated by ``+Inf`` (== ``_count``)."""
+    cumulative ``le`` series terminated by ``+Inf`` (== ``_count``).
+
+    With ``exemplars`` on (the default), each observation made inside
+    an active trace records ``(trace_id, value, timestamp)`` on the
+    bucket it landed in (last-write-wins, the client_golang policy);
+    OpenMetrics exposition renders them so a metric→trace pivot works.
+    Plain-text exposition never shows them — it stays byte-stable."""
 
     def __init__(
         self,
@@ -165,25 +238,33 @@ class Histogram(Metric):
         help_: str,
         buckets: Sequence[float] = DEFAULT_BUCKETS,
         labelnames: Sequence[str] = (),
+        exemplars: bool = True,
     ):
         super().__init__(name, help_, "histogram", labelnames)
         if not buckets:
             raise ValueError(f"{name}: histogram needs at least one bucket")
         self.buckets = tuple(sorted(float(b) for b in buckets))
-        # per key: (per-bucket non-cumulative counts, sum, count)
+        self.exemplars = exemplars
+        # per key: [per-bucket non-cumulative counts, sum, count,
+        #           per-bucket exemplar (trace_id, value, ts) or None]
         self._series: dict[tuple, list] = {}
 
     def observe(self, value: float, labels: Optional[dict[str, str]] = None) -> None:
         value = float(value)
+        tid = _current_trace_id() if self.exemplars else None
         with self._lock:
             key = self._key(labels)
             st = self._series.get(key)
             if st is None:
-                st = self._series[key] = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                n = len(self.buckets) + 1
+                st = self._series[key] = [[0] * n, 0.0, 0, [None] * n]
             # index of the first bucket >= value; the last slot is +Inf
-            st[0][bisect.bisect_left(self.buckets, value)] += 1
+            idx = bisect.bisect_left(self.buckets, value)
+            st[0][idx] += 1
             st[1] += value
             st[2] += 1
+            if tid is not None:
+                st[3][idx] = (tid, value, _time.time())
 
     def value(self, labels: Optional[dict[str, str]] = None) -> float:
         """Observation count (the natural scalar view of a histogram)."""
@@ -196,30 +277,86 @@ class Histogram(Metric):
             st = self._series.get(self._key(labels))
             return float(st[1]) if st is not None else 0.0
 
-    def _emit_series(self, labels: dict[str, str], st) -> Iterable[str]:
-        counts, total, count = st
+    def samples(self) -> list[tuple[dict[str, str], float]]:
+        """(labels, observation count) per series."""
+        with self._lock:
+            return [
+                (dict(k), float(st[2]))
+                for k, st in sorted(self._series.items())
+            ]
+
+    def count_matching(self, match: Optional[dict[str, str]] = None) -> float:
+        """Total observations across series whose labels ⊇ ``match``."""
+        want = (match or {}).items()
+        with self._lock:
+            return float(
+                sum(
+                    st[2]
+                    for k, st in self._series.items()
+                    if all(item in k for item in want)
+                )
+            )
+
+    def count_le(
+        self, le: float, match: Optional[dict[str, str]] = None
+    ) -> float:
+        """Cumulative observations ≤ the largest bucket boundary not
+        exceeding ``le``, summed across series whose labels ⊇
+        ``match`` — the "good events" count of a latency SLI. ``le``
+        should be an exact bucket boundary (the SLO lint enforces it);
+        a value between boundaries counts conservatively (the bucket
+        below)."""
+        # number of buckets whose boundary is <= le
+        nbuckets = bisect.bisect_right(self.buckets, float(le))
+        want = (match or {}).items()
+        with self._lock:
+            total = 0
+            for k, st in self._series.items():
+                if all(item in k for item in want):
+                    total += sum(st[0][:nbuckets])
+            return float(total)
+
+    @staticmethod
+    def _fmt_exemplar(ex) -> str:
+        tid, value, ts = ex
+        return (
+            f' # {{trace_id="{_escape_label_value(tid)}"}} '
+            f"{_fmt_value(value)} {ts:.3f}"
+        )
+
+    def _emit_series(
+        self, labels: dict[str, str], st, openmetrics: bool
+    ) -> Iterable[str]:
+        counts, total, count, exs = st
         cum = 0
-        for b, c in zip(self.buckets, counts):
+        for i, (b, c) in enumerate(zip(self.buckets, counts)):
             cum += c
             lab = _fmt_labels({**labels, "le": _fmt_le(b)})
-            yield f"{self.name}_bucket{lab} {cum}"
+            line = f"{self.name}_bucket{lab} {cum}"
+            if openmetrics and exs[i] is not None:
+                line += self._fmt_exemplar(exs[i])
+            yield line
         lab = _fmt_labels({**labels, "le": "+Inf"})
-        yield f"{self.name}_bucket{lab} {count}"
+        line = f"{self.name}_bucket{lab} {count}"
+        if openmetrics and exs[-1] is not None:
+            line += self._fmt_exemplar(exs[-1])
+        yield line
         yield f"{self.name}_sum{_fmt_labels(labels)} {_fmt_value(total)}"
         yield f"{self.name}_count{_fmt_labels(labels)} {count}"
 
-    def collect(self) -> Iterable[str]:
+    def collect(self, openmetrics: bool = False) -> Iterable[str]:
         yield f"# HELP {self.name} {_escape_help(self.help)}"
         yield f"# TYPE {self.name} {self.type}"
         with self._lock:
             series = sorted(
-                (k, [list(st[0]), st[1], st[2]])
+                (k, [list(st[0]), st[1], st[2], list(st[3])])
                 for k, st in self._series.items()
             )
         if not series and not self.labelnames:
-            series = [((), [[0] * (len(self.buckets) + 1), 0.0, 0])]
+            n = len(self.buckets) + 1
+            series = [((), [[0] * n, 0.0, 0, [None] * n])]
         for key, st in series:
-            yield from self._emit_series(dict(key), st)
+            yield from self._emit_series(dict(key), st, openmetrics)
 
 
 class Registry:
@@ -283,14 +420,23 @@ class Registry:
         help_: str,
         buckets: Sequence[float] = DEFAULT_BUCKETS,
         labelnames: Sequence[str] = (),
+        exemplars: bool = True,
     ) -> Histogram:
-        return self.register(Histogram(name, help_, buckets, labelnames))  # type: ignore[return-value]
+        return self.register(  # type: ignore[return-value]
+            Histogram(name, help_, buckets, labelnames, exemplars=exemplars)
+        )
 
     def metrics(self) -> list[Metric]:
         with self._lock:
             return list(self._metrics)
 
-    def exposition(self) -> str:
+    def metric(self, name: str) -> Optional[Metric]:
+        """The registered family by name (the SLO engine resolves its
+        spec references through this)."""
+        with self._lock:
+            return self._by_name.get(name)
+
+    def exposition(self, openmetrics: bool = False) -> str:
         lines: list[str] = []
         with self._lock:
             metrics = list(self._metrics)
@@ -303,8 +449,10 @@ class Registry:
         for fn in fns:
             collector_lines.extend(fn())
         for m in metrics:
-            lines.extend(m.collect())
+            lines.extend(m.collect(openmetrics=openmetrics))
         lines.extend(collector_lines)
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 
@@ -315,13 +463,20 @@ default_registry = Registry()
 # naming lint (tier-1 guard: new metrics can't drift from conventions)
 
 
+# histogram names must end in their unit; _seconds is the default
+# (latency histograms), _bytes/_records/_size cover the WAL/commit
+# pipeline's size-shaped distributions
+HISTOGRAM_UNIT_SUFFIXES = ("_seconds", "_bytes", "_records", "_size")
+
+
 def metric_name_violations(
     name: str, typ: str, labelnames: Sequence[str] = ()
 ) -> list[str]:
     """Prometheus naming conventions for ONE metric family:
     - names are ``[a-z_][a-z0-9_]*`` (no uppercase, no leading digit);
     - counters end in ``_total``;
-    - histograms record durations and end in ``_seconds``;
+    - histograms end in their unit (``_seconds`` for durations,
+      ``_bytes``/``_records``/``_size`` for size distributions);
     - nothing but counters claims the ``_total`` suffix.
     Shared by the live-registry lint below and graftlint's static
     ``metric-naming`` rule (analysis/rules.py), so the conventions
@@ -337,8 +492,11 @@ def metric_name_violations(
         violations.append(f"{name}: counter names must end in _total")
     if typ != "counter" and name.endswith("_total"):
         violations.append(f"{name}: _total suffix is reserved for counters")
-    if typ == "histogram" and not name.endswith("_seconds"):
-        violations.append(f"{name}: duration histograms must end in _seconds")
+    if typ == "histogram" and not name.endswith(HISTOGRAM_UNIT_SUFFIXES):
+        violations.append(
+            f"{name}: histograms must end in a unit suffix "
+            f"{'/'.join(HISTOGRAM_UNIT_SUFFIXES)}"
+        )
     for ln in labelnames:
         if not re.fullmatch(r"[a-z_][a-z0-9_]*", ln):
             violations.append(f"{name}: label {ln!r} must be lowercase")
@@ -358,23 +516,123 @@ def lint_metric_names(registry: Registry) -> list[str]:
 
 
 # ---------------------------------------------------------------------------
+# OpenMetrics parsing (tests + the SLO/exemplar tier-1 lint round-trip
+# exposition through this, so the emitter can't drift from the format)
+
+_SAMPLE_RE = _re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[^ #]+)"
+    r"(?:\s+(?P<ts>[0-9.e+-]+))?"
+    r"(?:\s*#\s*\{(?P<exlabels>[^}]*)\}\s+(?P<exvalue>\S+)(?:\s+(?P<exts>\S+))?)?"
+    r"\s*$"
+)
+_LABEL_RE = _re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label_value(v: str) -> str:
+    return (
+        v.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+    )
+
+
+def _parse_labels(raw: Optional[str]) -> dict[str, str]:
+    if not raw:
+        return {}
+    return {
+        k: _unescape_label_value(v) for k, v in _LABEL_RE.findall(raw)
+    }
+
+
+def parse_openmetrics(text: str) -> dict[str, dict[str, Any]]:
+    """Parse an OpenMetrics exposition into
+    ``{family: {"type", "help", "samples": [(sample_name, labels,
+    value, exemplar|None)]}}`` where an exemplar is ``(labels, value,
+    timestamp|None)``. Validates the structural contract: ``# EOF``
+    terminal, HELP/TYPE before samples, no content after EOF."""
+    families: dict[str, dict[str, Any]] = {}
+    lines = text.splitlines()
+    saw_eof = False
+    for line in lines:
+        if not line.strip():
+            continue
+        if saw_eof:
+            raise ValueError(f"content after # EOF: {line!r}")
+        if line == "# EOF":
+            saw_eof = True
+            continue
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            families.setdefault(
+                name, {"help": None, "type": None, "samples": []}
+            )["help"] = line.split(" ", 3)[3] if len(line.split(" ", 3)) > 3 else ""
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            if len(parts) < 4:
+                raise ValueError(f"malformed TYPE line: {line!r}")
+            families.setdefault(
+                parts[2], {"help": None, "type": None, "samples": []}
+            )["type"] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"unparseable sample line: {line!r}")
+        sample = m.group("name")
+        # attribute the sample to its family (counter samples carry
+        # _total; histogram samples carry _bucket/_sum/_count)
+        base = sample
+        for suffix in ("_bucket", "_sum", "_count", "_total"):
+            if base.endswith(suffix) and base[: -len(suffix)] in families:
+                base = base[: -len(suffix)]
+                break
+        if base not in families:
+            raise ValueError(
+                f"sample {sample!r} before its HELP/TYPE: {line!r}"
+            )
+        if families[base]["type"] is None:
+            raise ValueError(f"sample {sample!r} with no TYPE")
+        exemplar = None
+        if m.group("exlabels") is not None:
+            exemplar = (
+                _parse_labels(m.group("exlabels")),
+                float(m.group("exvalue")),
+                float(m.group("exts")) if m.group("exts") else None,
+            )
+        families[base]["samples"].append(
+            (sample, _parse_labels(m.group("labels")), float(m.group("value")), exemplar)
+        )
+    if not saw_eof:
+        raise ValueError("OpenMetrics exposition must end with # EOF")
+    return families
+
+
+# ---------------------------------------------------------------------------
 # serving
 
 
 def metrics_app(registry: Registry):
     """WSGI app exposing ``registry`` at ``/metrics`` (and ``/``, the
     scrape-anything posture controller-runtime's metrics listener
-    has)."""
+    has). Content-negotiated: an ``Accept`` asking for
+    ``application/openmetrics-text`` gets the exemplar-bearing
+    OpenMetrics dialect; everything else gets byte-stable plain text."""
 
     def app(environ, start_response):
         if environ.get("PATH_INFO", "/") not in ("/", "/metrics"):
             start_response("404 Not Found", [("Content-Type", "text/plain")])
             return [b"not found"]
-        payload = registry.exposition().encode()
+        om = negotiate_openmetrics(environ.get("HTTP_ACCEPT"))
+        payload = registry.exposition(openmetrics=om).encode()
         start_response(
             "200 OK",
             [
-                ("Content-Type", "text/plain; version=0.0.4"),
+                (
+                    "Content-Type",
+                    OPENMETRICS_CONTENT_TYPE if om else PLAIN_CONTENT_TYPE,
+                ),
                 ("Content-Length", str(len(payload))),
             ],
         )

@@ -97,7 +97,7 @@ def user_of(request: Request) -> str:
 class CrudBackend:
     """Holds the API handle + RBAC evaluator; builds per-app WSGI apps."""
 
-    def __init__(self, api: APIServer, app_name: str, static_dir=None):
+    def __init__(self, api: APIServer, app_name: str, static_dir=None, registry=None):
         self.api = api
         self.rbac = RBACEvaluator(api)
         default_static, mounts = frontend_static(app_name)
@@ -105,6 +105,7 @@ class CrudBackend:
             app_name,
             static_dir=static_dir or default_static,
             static_mounts=mounts,
+            registry=registry,
         )
         # last-known-good listings for degraded-mode serving: when the
         # backend is unreachable, list endpoints answer from here with

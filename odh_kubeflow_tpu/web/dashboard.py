@@ -33,15 +33,27 @@ class DashboardApp:
         kfam: Optional[KfamService] = None,
         static_dir: Optional[str] = None,
         registry: Optional[prometheus.Registry] = None,
+        slo_engine: Optional[Any] = None,
     ):
         self.api = api
         self.kfam = kfam or KfamService(api)
         self.registry = registry or prometheus.default_registry
+        # burn-rate rows for /api/slo (utils.slo.SLOEngine); built here
+        # when not handed in. NOT started from the constructor — the
+        # owner starts the sampling cadence (Platform.start for the
+        # all-in-one, main() below for the split-process dashboard), so
+        # embedders and tests don't leak a ticking thread.
+        if slo_engine is None:
+            from odh_kubeflow_tpu.utils.slo import SLOEngine
+
+            slo_engine = SLOEngine(self.registry)
+        self.slo_engine = slo_engine
         default_static, mounts = frontend_static("centraldashboard")
         self.app = App(
             "centraldashboard",
             static_dir=static_dir or default_static,
             static_mounts=mounts,
+            registry=self.registry,
         )
         install_csrf(self.app)
         self._register_routes()
@@ -329,6 +341,18 @@ class DashboardApp:
                 }
             )
 
+        @app.route("/api/slo")
+        def slo(request):
+            """Multi-window burn rates per SLO (utils/slo.py): the
+            operator's budget view — which objective is burning, how
+            fast, over which window. ``tick=1`` forces a fresh sample
+            first (tests and ad-hoc curls; the serving cadence
+            otherwise samples in the background)."""
+            user_of(request)
+            if request.query.get("tick"):
+                self.slo_engine.tick()
+            return success({"slos": self.slo_engine.evaluate()})
+
         @app.route("/prometheus/metrics")
         def prom(request):
             return Response(
@@ -338,9 +362,21 @@ class DashboardApp:
 
 def main() -> None:
     """Split-process entrypoint (manifests/web)."""
+    import os
+
     from odh_kubeflow_tpu.machinery.runner import run_web
 
-    run_web("centraldashboard", 8082, DashboardApp)
+    def build(api):
+        dash = DashboardApp(api)
+        # the entrypoint owns the engine lifecycle (mirrors
+        # Platform.start): background sampling so /api/slo has
+        # window history without a ?tick on every request
+        dash.slo_engine.start(
+            interval=float(os.environ.get("SLO_TICK_SECONDS", "15"))
+        )
+        return dash
+
+    run_web("centraldashboard", 8082, build)
 
 
 if __name__ == "__main__":

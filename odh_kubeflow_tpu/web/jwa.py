@@ -163,8 +163,11 @@ class JupyterWebApp(CrudBackend):
         api: APIServer,
         config_path: Optional[str] = None,
         static_dir: Optional[str] = None,
+        registry=None,
     ):
-        super().__init__(api, "jupyter-web-app", static_dir=static_dir)
+        super().__init__(
+            api, "jupyter-web-app", static_dir=static_dir, registry=registry
+        )
         self.config_path = config_path
         self._config_mtime: Optional[float] = None
         self._config = copy.deepcopy(DEFAULT_CONFIG)

@@ -23,7 +23,7 @@ class KfamApp:
         registry: Optional[prometheus.Registry] = None,
     ):
         self.service = KfamService(api, cluster_admins)
-        self.app = App("kfam")
+        self.app = App("kfam", registry=registry)
         install_csrf(self.app)
         reg = registry or prometheus.default_registry
         self.m_requests = reg.counter(

@@ -17,6 +17,7 @@ import os
 import re
 import secrets as _secrets
 import threading
+import time
 import traceback
 from http.cookies import SimpleCookie
 from typing import Any, Callable, Optional
@@ -159,7 +160,11 @@ class App:
         name: str = "app",
         static_dir: Optional[str] = None,
         static_mounts: Optional[list[tuple[str, str]]] = None,
+        registry=None,
+        debug_routes: bool = True,
     ):
+        from odh_kubeflow_tpu.utils import prometheus
+
         self.name = name
         self.static_dir = static_dir
         # extra (url_prefix, directory) static mounts — the shared
@@ -169,6 +174,22 @@ class App:
         self._routes: list[tuple[str, re.Pattern, list[str], Callable]] = []
         self._before: list[Callable[[Request], Optional[Response]]] = []
         self._errors: dict[type, Callable] = {}
+        # per-app request latency (the web-serial SLO's SLI): one
+        # series per app, observed around the whole dispatch so the
+        # histogram's exemplars carry the request trace
+        reg = registry if registry is not None else prometheus.default_registry
+        self.registry = reg
+        self._m_requests = reg.histogram(
+            "http_request_duration_seconds",
+            "Web request handler latency per app",
+            labelnames=("app",),
+        ).labels(app=name)
+        if debug_routes:
+            # zpages on every web app: /debug/traces, /debug/queues
+            # (workqueue gauges from this app's registry), /debug/locks
+            from odh_kubeflow_tpu.machinery import zpages
+
+            zpages.install_debug_routes(self, registry=reg)
 
     # -- registration -------------------------------------------------------
 
@@ -273,11 +294,15 @@ class App:
         # the apiserver and onwards to the reconcile logs
         remote = tracing.parse_traceparent(request.headers.get("traceparent"))
         with tracing.span(
-            f"{self.name}:{request.method} {request.path}", parent=remote
+            f"{self.name}:{request.method} {request.path}",
+            parent=tracing.nested_parent(remote),
         ):
             return self._call_traced(request, environ, start_response)
 
     def _call_traced(self, request, environ, start_response):
+        from odh_kubeflow_tpu.utils import tracing
+
+        t0 = time.perf_counter()
         try:
             response = self._dispatch(request)
         except HTTPError as e:
@@ -297,6 +322,12 @@ class App:
                 response = Response(
                     {"success": False, "status": 500, "log": str(e)}, 500
                 )
+        finally:
+            # observed inside the request span: the latency histogram's
+            # exemplar is this request's trace id
+            self._m_requests.observe(time.perf_counter() - t0)
+        if response.status >= 500:
+            tracing.set_status("error", f"HTTP {response.status}")
         status_line = f"{response.status} {_status_text(response.status)}"
         start_response(status_line, list(response.headers.items()))
         return [response.body]

@@ -18,8 +18,12 @@ Obj = dict[str, Any]
 
 
 class TensorboardsWebApp(CrudBackend):
-    def __init__(self, api: APIServer, static_dir: Optional[str] = None):
-        super().__init__(api, "tensorboards-web-app", static_dir=static_dir)
+    def __init__(
+        self, api: APIServer, static_dir: Optional[str] = None, registry=None
+    ):
+        super().__init__(
+            api, "tensorboards-web-app", static_dir=static_dir, registry=registry
+        )
         self._register_routes()
 
     def _register_routes(self) -> None:

@@ -15,8 +15,12 @@ Obj = dict[str, Any]
 
 
 class VolumesWebApp(CrudBackend):
-    def __init__(self, api: APIServer, static_dir: Optional[str] = None):
-        super().__init__(api, "volumes-web-app", static_dir=static_dir)
+    def __init__(
+        self, api: APIServer, static_dir: Optional[str] = None, registry=None
+    ):
+        super().__init__(
+            api, "volumes-web-app", static_dir=static_dir, registry=registry
+        )
         self._register_routes()
 
     def _register_routes(self) -> None:

@@ -223,6 +223,72 @@ def test_hot_path_json_dumps_scope():
 
 
 # ---------------------------------------------------------------------------
+# span-in-hot-loop
+
+
+def test_span_in_hot_loop_true_positive():
+    src = (
+        "from odh_kubeflow_tpu.utils import tracing\n"
+        "def pump(watch):\n"
+        "    for etype, obj in watch:\n"
+        '        with tracing.span("handle-event"):\n'
+        "            handle(etype, obj)\n"
+    )
+    fs = lint_source(src, "machinery/x.py", ["span-in-hot-loop"])
+    assert rule_ids(fs) == ["span-in-hot-loop"] and fs[0].line == 4
+    # while-loops (the page walkers) are in scope too, and the bare
+    # imported name is seen
+    src = (
+        "from odh_kubeflow_tpu.utils.tracing import span\n"
+        "def walk(pages):\n"
+        "    while pages.more():\n"
+        '        with span("page"):\n'
+        "            pages.next()\n"
+    )
+    assert rule_ids(lint_source(src, "machinery/x.py", ["span-in-hot-loop"])) == [
+        "span-in-hot-loop"
+    ]
+
+
+def test_span_in_hot_loop_marker_suppresses():
+    src = (
+        "from odh_kubeflow_tpu.utils import tracing\n"
+        "def pump(watch):\n"
+        "    for etype, obj in watch:\n"
+        '        with tracing.span("x"):  # span-ok: deliberate per-event trace\n'
+        "            handle(etype, obj)\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["span-in-hot-loop"]) == []
+
+
+def test_span_in_hot_loop_clean_variants():
+    # span OUTSIDE the loop, a nested def inside the loop (not
+    # per-iteration), and non-machinery scope are all clean
+    src = (
+        "from odh_kubeflow_tpu.utils import tracing\n"
+        "def pump(watch):\n"
+        '    with tracing.span("pump"):\n'
+        "        for e in watch:\n"
+        "            handle(e)\n"
+        "def wire(specs):\n"
+        "    for s in specs:\n"
+        "        def cb(ev, _s=s):\n"
+        '            with tracing.span("cb"):\n'
+        "                handle(ev)\n"
+        "        register(cb)\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["span-in-hot-loop"]) == []
+    src = (
+        "from odh_kubeflow_tpu.utils import tracing\n"
+        "def f(items):\n"
+        "    for i in items:\n"
+        '        with tracing.span("per-item"):\n'
+        "            work(i)\n"
+    )
+    assert lint_source(src, "scheduling/x.py", ["span-in-hot-loop"]) == []
+
+
+# ---------------------------------------------------------------------------
 # swallowed-exception
 
 

@@ -8,6 +8,7 @@ import io
 import json
 import logging
 import re
+import urllib.error
 import urllib.request
 
 import pytest
@@ -462,6 +463,617 @@ def test_notebook_lifecycle_events(monkeypatch):
 # AST-accurate `metric-naming` rule; both the static definition-site
 # check and the live-registry check route through the unified
 # analysis entry point (python -m odh_kubeflow_tpu.analysis).
+
+
+# ---------------------------------------------------------------------------
+# span recording + the collector's tail-based keep rules
+
+
+def _fresh_collector(**kw):
+    """Swap in a fresh global collector; returns (collector, restore)."""
+    c = tracing.SpanCollector(**kw)
+    old = tracing.set_collector(c)
+    return c, lambda: tracing.set_collector(old)
+
+
+def test_parse_traceparent_rejects_forbidden_version_ff():
+    tid, sid = "a" * 32, "b" * 16
+    # W3C trace-context: version ff is forbidden outright
+    assert tracing.parse_traceparent(f"ff-{tid}-{sid}-01") is None
+    # all-zero trace/span ids are invalid too
+    assert tracing.parse_traceparent(f"00-{'0' * 32}-{sid}-01") is None
+    assert tracing.parse_traceparent(f"00-{tid}-{'0' * 16}-01") is None
+    # other versions parse (version-agnostic per spec), flags preserved
+    parsed = tracing.parse_traceparent(f"cc-{tid}-{sid}-00")
+    assert parsed is not None and parsed.trace_flags == "00"
+
+
+def test_span_records_timing_status_exception_and_events():
+    c, restore = _fresh_collector()
+    try:
+        with tracing.span("op", user="alice") as ctx:
+            tracing.add_event("milestone", detail="x")
+        with pytest.raises(RuntimeError):
+            with tracing.span("boom", parent=ctx):
+                raise RuntimeError("kaput")
+        spans = {s.name: s for s in c.trace(ctx.trace_id)}
+        ok = spans["op"]
+        assert ok.status == "ok" and ok.duration >= 0
+        assert ok.start == pytest.approx(__import__("time").time(), abs=30)
+        assert [e[1] for e in ok.events] == ["milestone"]
+        assert ok.events[0][2] == {"detail": "x"}
+        err = spans["boom"]
+        assert err.status == "error"
+        assert "RuntimeError: kaput" in err.error
+        assert err.parent_span_id == ok.span_id
+    finally:
+        restore()
+
+
+def test_collector_tail_keep_rules_error_and_slow_traces():
+    c, restore = _fresh_collector(
+        capacity=64, max_kept=8, default_threshold_s=0.5
+    )
+    try:
+        # an error ANYWHERE in a trace keeps it — children recorded
+        # BEFORE the error are pulled out of the ring (tail-based)
+        with tracing.span("root-err") as err_root:
+            with tracing.span("child"):
+                pass
+            tracing.set_status("error", "late failure")
+        assert c.keep_reason(err_root.trace_id) == "error"
+        assert {s.name for s in c.trace(err_root.trace_id)} == {
+            "root-err",
+            "child",
+        }
+
+        # a slow ROOT keeps its trace; the threshold is per root name
+        c.set_threshold("slow-root", 0.0)  # everything named this is slow
+        with tracing.span("slow-root") as slow_root:
+            with tracing.span("fast-child"):
+                pass
+        assert c.keep_reason(slow_root.trace_id) == "slow"
+        assert {s.name for s in c.trace(slow_root.trace_id)} == {
+            "slow-root",
+            "fast-child",
+        }
+
+        # ordinary fast/ok traces are NOT kept and age out of the ring
+        with tracing.span("plain") as plain:
+            pass
+        assert c.keep_reason(plain.trace_id) is None
+        for _ in range(80):  # flush the 64-slot ring
+            with tracing.span("filler"):
+                pass
+        assert c.trace(plain.trace_id) == []
+        # ...while the kept traces survive the churn
+        assert c.trace(err_root.trace_id) != []
+        # later spans of a kept trace append to it directly
+        with tracing.span("late", trace_id=err_root.trace_id):
+            pass
+        assert "late" in {s.name for s in c.trace(err_root.trace_id)}
+    finally:
+        restore()
+
+
+def test_kept_trace_is_bounded_against_crash_loop_retries():
+    """A persistently failing reconcile retries under ONE trace id;
+    the kept entry must cap, not grow for the life of the process."""
+    c, restore = _fresh_collector(max_spans_per_trace=16)
+    try:
+        with tracing.span("root") as root:
+            tracing.set_status("error", "boom")
+        for _ in range(100):  # the crash loop
+            with tracing.span("retry", trace_id=root.trace_id):
+                pass
+        assert len(c.trace(root.trace_id)) == 16
+        assert c.trace_spans_dropped_total >= 84
+    finally:
+        restore()
+
+
+def test_bff_debug_routes_require_an_authenticated_user():
+    """The apiserver façade serves /debug anonymously (kube posture);
+    the user-facing BFF apps must demand the same identity header as
+    every sibling route — trace attrs are cross-tenant data."""
+    from odh_kubeflow_tpu.web.microweb import App
+
+    app = App("probe", registry=Registry())
+    captured = {}
+
+    def start_response(status, headers):
+        captured["status"] = status
+
+    def get(path, user=None):
+        env = {"REQUEST_METHOD": "GET", "PATH_INFO": path, "QUERY_STRING": ""}
+        if user:
+            env["HTTP_KUBEFLOW_USERID"] = user
+        body = app(env, start_response)
+        return captured["status"], b"".join(body)
+
+    import odh_kubeflow_tpu.web.crud_backend as cb
+
+    old_dev = cb.DEV_MODE
+    cb.DEV_MODE = False
+    try:
+        for path in ("/debug/traces", "/debug/queues", "/debug/locks"):
+            status, _ = get(path)
+            assert status.startswith("401"), (path, status)
+        status, body = get("/debug/traces", user="ops@example.com")
+        assert status.startswith("200") and b"/debug/traces" in body
+    finally:
+        cb.DEV_MODE = old_dev
+
+
+def test_ingest_endpoint_rejects_wrong_shapes_and_oversize_bodies():
+    from odh_kubeflow_tpu.machinery import zpages
+
+    api = APIServer()
+    register_crds(api)
+    _c, restore = _fresh_collector()
+    thread, port, httpd = httpapi.serve(api)
+    try:
+        def post(payload: bytes, extra_headers=None):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/debug/traces/ingest",
+                data=payload,
+                method="POST",
+                headers={
+                    "Content-Type": "application/json",
+                    **(extra_headers or {}),
+                },
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return r.status, json.loads(r.read().decode())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read().decode())
+
+        # valid-JSON wrong shapes: skipped, never a 500
+        assert post(b"[1, 2]") == (200, {"ingested": 0})
+        assert post(b'{"spans": [42, {"traceId": "t", "spanId": "s"}]}') == (
+            200,
+            {"ingested": 1},
+        )
+        status, body = post(b"not json")
+        assert status == 400
+        # oversize Content-Length sheds with 413 BEFORE reading the
+        # body (exercised at the WSGI layer: the event-loop transport
+        # has its own, larger 16MB cap in front)
+        captured = {}
+
+        def start_response(s, headers):
+            captured["status"] = s
+
+        resp = zpages.handle_debug(
+            {
+                "REQUEST_METHOD": "POST",
+                "PATH_INFO": "/debug/traces/ingest",
+                "QUERY_STRING": "",
+                "CONTENT_LENGTH": str(zpages.INGEST_MAX_BYTES + 1),
+                # no wsgi.input on purpose: a read attempt would crash
+            },
+            start_response,
+        )
+        assert captured["status"].startswith("413") and resp is not None
+    finally:
+        restore()
+        httpd.shutdown()
+
+
+def test_trace_assembly_survives_cycles_and_self_parents():
+    """The ingest endpoint is anonymous: a hostile/buggy exporter can
+    send self-parented spans or parent cycles, and assembly (hence the
+    /debug/traces landing page) must render every span, never crash."""
+
+    def rec(sid, parent, start):
+        return tracing.SpanRecord(
+            trace_id="t" * 32,
+            span_id=sid,
+            parent_span_id=parent,
+            name=f"s-{sid}",
+            start=start,
+            duration=0.001,
+        )
+
+    def flatten(node):
+        out = [node["span"].span_id]
+        for c in node["children"]:
+            out += flatten(c)
+        return out
+
+    # self-parented only (no orphan at all): roots at the earliest span
+    tree = tracing.assemble([rec("a" * 16, "a" * 16, 5.0)])
+    assert tree["span"].span_id == "a" * 16 and tree["children"] == []
+    # mutual cycle + a valid root: every span appears exactly once
+    spans = [
+        rec("r" * 16, "", 1.0),
+        rec("b" * 16, "c" * 16, 2.0),
+        rec("c" * 16, "b" * 16, 3.0),
+    ]
+    tree = tracing.assemble(spans)
+    assert sorted(flatten(tree)) == sorted(s.span_id for s in spans)
+    # pure cycle, no root anywhere
+    tree = tracing.assemble(
+        [rec("b" * 16, "c" * 16, 2.0), rec("c" * 16, "b" * 16, 3.0)]
+    )
+    assert sorted(flatten(tree)) == sorted(["b" * 16, "c" * 16])
+    # the renderer stays up on all of it
+    assert "s-" in tracing.render_trace(spans)
+
+
+def test_openmetrics_parser_rejects_malformed_lines():
+    from odh_kubeflow_tpu.utils.prometheus import parse_openmetrics
+
+    with pytest.raises(ValueError):
+        parse_openmetrics("# TYPE foo\n# EOF\n")  # missing type token
+    with pytest.raises(ValueError):
+        parse_openmetrics("foo 1\n")  # no EOF
+    with pytest.raises(ValueError):
+        parse_openmetrics("# HELP a x\n# TYPE a counter\na 1\n# EOF\nb 2\n")
+
+
+def test_trace_assembly_one_tree_with_cross_process_orphans():
+    c, restore = _fresh_collector()
+    try:
+        with tracing.span("web-root") as root:
+            with tracing.span("apiserver"):
+                pass
+        # spans whose parent was never recorded here (another process,
+        # or the client's unrecorded span) attach under the primary root
+        orphan = tracing.SpanRecord(
+            trace_id=root.trace_id,
+            span_id="feedfeedfeedfeed",
+            parent_span_id="dead00000000beef",  # unknown parent
+            name="kubelet.container_start",
+            start=9e9,  # far later than the root
+            duration=0.01,
+        )
+        c.record(orphan)
+        spans = c.trace(root.trace_id)
+        tree = tracing.assemble(spans)
+        assert tree["span"].name == "web-root"
+
+        def flatten(node):
+            out = [node["span"].name]
+            for ch in node["children"]:
+                out += flatten(ch)
+            return out
+
+        names = flatten(tree)
+        assert sorted(names) == sorted(s.name for s in spans)
+        # round-trip through the wire dict form
+        rt = [
+            tracing.SpanRecord.from_dict(s.to_dict()) for s in spans
+        ]
+        assert tracing.assemble(rt)["span"].name == "web-root"
+        # and the text renderer shows the whole tree with durations
+        text = tracing.render_trace(spans)
+        assert "web-root" in text and "kubelet.container_start" in text
+        assert "ms" in text
+    finally:
+        restore()
+
+
+def test_remote_span_exporter_ships_to_ingest_endpoint():
+    """Split-process posture: spans recorded in a 'component' process
+    ship over HTTP to the apiserver's /debug/traces/ingest and
+    assemble into one tree on its zpage."""
+    api = APIServer()
+    register_crds(api)
+    server_collector, restore = _fresh_collector()
+    thread, port, httpd = httpapi.serve(api)
+    try:
+        exporter = tracing.RemoteSpanExporter(
+            f"http://127.0.0.1:{port}", flush_interval=999
+        )
+        # simulate the remote component: its spans only hit the sink
+        with tracing.span("reconcile-remote", controller="nbctl") as ctx:
+            pass
+        rec = server_collector.trace(ctx.trace_id)[0]
+        server_collector.clear()
+        exporter(rec)  # the sink interface
+        exporter.flush()
+        assert exporter.shipped_total == 1
+        shipped = server_collector.trace(ctx.trace_id)
+        assert len(shipped) == 1 and shipped[0].name == "reconcile-remote"
+        assert shipped[0].attrs.get("controller") == "nbctl"
+
+        # the zpage serves it back, text and json
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces?trace={ctx.trace_id}"
+            "&format=json",
+            timeout=10,
+        ) as r:
+            body = json.loads(r.read().decode())
+        assert body["traces"][0]["spans"][0]["name"] == "reconcile-remote"
+    finally:
+        restore()
+        httpd.shutdown()
+
+
+def test_debug_zpages_queues_and_locks_over_http():
+    api = APIServer()
+    register_crds(api)
+    mgr = Manager(api)
+    mgr.new_controller("notebook-controller", "Notebook", lambda req: Result())
+    api.create(_notebook())
+    mgr.drain()
+    thread, port, httpd = httpapi.serve(
+        api, metrics_registry=mgr.metrics_registry
+    )
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/queues?format=json", timeout=10
+        ) as r:
+            queues = json.loads(r.read().decode())
+        names = {q["name"] for q in queues["workqueues"]}
+        assert "notebook-controller" in names
+        # embedded in-memory store: pipeline depths present, wal absent
+        assert queues["store"]["groupCommit"]["queueDepth"] == 0
+        assert queues["store"]["wal"] is None
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/locks", timeout=10
+        ) as r:
+            locks = r.read().decode()
+        assert "sanitizer off" in locks or "lock-order graph" in locks
+        # unknown debug page → 404, not a crash
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/nope", timeout=10
+            )
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        httpd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# exemplars + OpenMetrics content negotiation
+
+
+def test_histogram_exemplars_and_openmetrics_negotiation_over_http():
+    api = APIServer()
+    register_crds(api)
+    reg = Registry()
+    h = reg.histogram("req_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(5.0)  # outside any span: no exemplar
+    with tracing.span("traced-req") as ctx:
+        h.observe(0.05)
+    thread, port, httpd = httpapi.serve(api, metrics_registry=reg)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            plain = r.read().decode()
+            plain_ct = r.headers["Content-Type"]
+        # plain exposition: byte-stable, no exemplar syntax, no EOF
+        assert plain_ct.startswith("text/plain")
+        assert "trace_id=" not in plain and "# EOF" not in plain
+        assert 'req_seconds_bucket{le="0.1"} 1' in plain
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            om = r.read().decode()
+            om_ct = r.headers["Content-Type"]
+        assert om_ct.startswith("application/openmetrics-text")
+        assert om.rstrip().endswith("# EOF")
+        # the traced observation carries its trace id on ITS bucket...
+        assert f'trace_id="{ctx.trace_id}"' in om
+        from odh_kubeflow_tpu.utils.prometheus import parse_openmetrics
+
+        fams = parse_openmetrics(om)
+        by_bucket = {
+            labels.get("le"): ex
+            for name, labels, _v, ex in fams["req_seconds"]["samples"]
+            if name == "req_seconds_bucket"
+        }
+        assert by_bucket["0.1"] is not None
+        ex_labels, ex_value, ex_ts = by_bucket["0.1"]
+        assert ex_labels == {"trace_id": ctx.trace_id}
+        assert ex_value == pytest.approx(0.05)
+        assert ex_ts is not None
+        # ...and the untraced one has none
+        assert by_bucket["+Inf"] is None
+    finally:
+        httpd.shutdown()
+
+
+def test_openmetrics_counter_family_drops_total_suffix():
+    reg = Registry()
+    c = reg.counter("req_total", "requests", labelnames=("code",))
+    c.inc({"code": "200"})
+    om = reg.exposition(openmetrics=True)
+    assert "# TYPE req counter" in om
+    assert 'req_total{code="200"} 1' in om
+    # plain text keeps the full name in TYPE — byte-stable
+    assert "# TYPE req_total counter" in reg.exposition()
+    from odh_kubeflow_tpu.utils.prometheus import parse_openmetrics
+
+    fams = parse_openmetrics(om)
+    assert fams["req"]["samples"][0][0] == "req_total"
+
+
+# ---------------------------------------------------------------------------
+# WAL / group-commit metrics (PR-10 satellite: the 0.084 fsyncs/record
+# figure was bench-only — now it's scrapeable)
+
+
+def test_wal_group_commit_metrics_exposed(tmp_path):
+    from odh_kubeflow_tpu.machinery.wal import WriteAheadLog
+
+    api = APIServer(wal=WriteAheadLog(str(tmp_path)))
+    reg = Registry()
+    api.attach_metrics(reg)
+    try:
+        for i in range(8):
+            api.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {"name": f"c{i}", "namespace": "default"},
+                }
+            )
+        text = reg.exposition()
+        m = re.search(r"^wal_fsync_total (\d+)$", text, re.M)
+        assert m and 0 < int(m.group(1)) <= api._wal.fsync_total
+        m = re.search(r"^wal_group_commit_batch_size_count (\d+)$", text, re.M)
+        assert m and int(m.group(1)) >= 1
+        m = re.search(r"^wal_commit_ack_seconds_count (\d+)$", text, re.M)
+        assert m and int(m.group(1)) == 8
+        # ack latency is a real measurement, not zeros
+        assert api.debug_queues()["wal"]["fsyncTotal"] == api._wal.fsync_total
+    finally:
+        api.close()
+
+
+def test_attach_metrics_is_noop_without_wal():
+    api = APIServer()
+    reg = Registry()
+    api.attach_metrics(reg)
+    assert "wal_fsync_total" not in reg.exposition()
+
+
+# ---------------------------------------------------------------------------
+# structured-logging satellites
+
+
+def test_json_log_formatter_stamps_trace_flags_and_span_status():
+    buf = io.StringIO()
+    handler = logging.StreamHandler(buf)
+    handler.setFormatter(tracing.JsonLogFormatter())
+    logger = logging.getLogger("zpage-test")
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        with tracing.span("op"):
+            tracing.set_status("error", "degraded")
+            logger.info("inside")
+        logger.info("outside")
+    finally:
+        logger.removeHandler(handler)
+    inside, outside = [
+        json.loads(line) for line in buf.getvalue().splitlines()
+    ]
+    assert inside["trace_flags"] == "01"
+    assert inside["span.status"] == "error"
+    assert "trace_flags" not in outside and "span.status" not in outside
+
+
+def test_configure_json_logging_is_idempotent():
+    root = logging.getLogger()
+    before = list(root.handlers)
+    prev_level = root.level
+    h1 = tracing.configure_json_logging()
+    try:
+        h2 = tracing.configure_json_logging(logging.DEBUG)
+        assert h1 is h2
+        added = [h for h in root.handlers if h not in before]
+        assert added == [h1], "repeat calls must not stack handlers"
+        assert root.level == logging.DEBUG
+    finally:
+        root.removeHandler(h1)
+        root.setLevel(prev_level)
+
+
+# ---------------------------------------------------------------------------
+# the spawn path is one trace (deterministic drain-mode version of the
+# live obs_smoke / spawn_latency gates)
+
+
+def test_cold_spawn_assembles_one_trace_with_milestone_spans():
+    from odh_kubeflow_tpu.apis import (
+        TPU_ACCELERATOR_ANNOTATION,
+        TPU_TOPOLOGY_ANNOTATION,
+    )
+    from odh_kubeflow_tpu.controllers.notebook import (
+        NotebookControllerConfig,
+    )
+    from odh_kubeflow_tpu.platform import Platform
+
+    collector, restore = _fresh_collector()
+    try:
+        platform = Platform(
+            sim=True,
+            nb_config=NotebookControllerConfig(
+                enable_queueing=True, enable_sessions=True
+            ),
+        )
+        platform.cluster.add_node("cpu-0")
+        platform.cluster.add_tpu_node_pool(
+            "v5e",
+            "tpu-v5-lite-podslice",
+            "2x2",
+            num_hosts=1,
+            chips_per_host=4,
+        )
+        nb = {
+            "apiVersion": "kubeflow.org/v1beta1",
+            "kind": "Notebook",
+            "metadata": {
+                "name": "traced-nb",
+                "namespace": "default",
+                "annotations": {
+                    TPU_ACCELERATOR_ANNOTATION: "tpu-v5-lite-podslice",
+                    TPU_TOPOLOGY_ANNOTATION: "2x2",
+                },
+            },
+            "spec": {
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {"name": "traced-nb", "image": "jax:latest"}
+                        ]
+                    }
+                }
+            },
+        }
+        # the "web request": one span around the create, exactly what
+        # the JWA POST handler does
+        with tracing.span("jwa:POST /notebooks") as root:
+            platform.api.create(nb)
+        ready = False
+        for _ in range(20):
+            platform.manager.drain()
+            platform.cluster.step()
+            platform.manager.drain()
+            sts = platform.api.get("StatefulSet", "traced-nb", "default")
+            if sts.get("status", {}).get("readyReplicas"):
+                ready = True
+                break
+        assert ready, "sim spawn never became ready"
+
+        spans = collector.trace(root.trace_id)
+        names = {s.name for s in spans}
+        assert {
+            "scheduler.admit",
+            "kubelet.gang_bind",
+            "kubelet.container_start",
+        } <= names, names
+        # ONE tree: every span reachable from the single root
+        tree = tracing.assemble(spans)
+
+        def count(node):
+            return 1 + sum(count(c) for c in node["children"])
+
+        assert count(tree) == len(spans)
+        assert tree["span"].name == "jwa:POST /notebooks"
+        # milestones in causal order
+        ends = {}
+        for s in spans:
+            ends[s.name] = max(ends.get(s.name, 0.0), s.end)
+        assert (
+            ends["scheduler.admit"]
+            <= ends["kubelet.gang_bind"]
+            <= ends["kubelet.container_start"]
+        )
+        platform.manager.stop()
+    finally:
+        restore()
 
 
 def test_metric_names_follow_prometheus_conventions():

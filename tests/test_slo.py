@@ -1,0 +1,334 @@
+"""SLO burn-rate engine (utils/slo.py): fixture-driven fast-burn and
+slow-burn scenarios over an injected clock, the ratio-SLI path, the
+dashboard's /api/slo surface, and the tier-1 lint binding every SLO
+spec to a real registry histogram with exemplars enabled (and the
+exemplar exposition round-tripping through the OpenMetrics parser)."""
+
+import json
+
+import pytest
+
+from odh_kubeflow_tpu.utils import tracing
+from odh_kubeflow_tpu.utils.prometheus import (
+    Histogram,
+    Registry,
+    parse_openmetrics,
+)
+from odh_kubeflow_tpu.utils.slo import (
+    DEFAULT_WINDOWS,
+    FAST_BURN_THRESHOLD,
+    SLO,
+    SLOEngine,
+    SLOW_BURN_THRESHOLD,
+    default_slos,
+)
+
+WINDOWS = {"5m": 300.0, "1h": 3600.0}
+
+
+def _latency_fixture():
+    clock = {"t": 100000.0}
+    reg = Registry()
+    h = reg.histogram("web_seconds", "latency", buckets=(0.25, 1.0, 5.0))
+    spec = SLO(
+        name="web-p99",
+        description="99% under 250ms",
+        objective=0.99,
+        histogram="web_seconds",
+        threshold_s=0.25,
+    )
+    eng = SLOEngine(
+        reg, [spec], windows=WINDOWS, time_fn=lambda: clock["t"]
+    )
+    return clock, reg, h, eng
+
+
+def _row(rows, slo, window):
+    out = [r for r in rows if r["slo"] == slo and r["window"] == window]
+    assert out, f"no row for {slo}/{window} in {rows}"
+    return out[0]
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        SLO(name="x", description="", objective=1.5, histogram="h")
+    with pytest.raises(ValueError):
+        SLO(name="x", description="", objective=0.99)  # no SLI at all
+    with pytest.raises(ValueError):
+        SLO(  # both SLI styles at once
+            name="x",
+            description="",
+            objective=0.99,
+            histogram="h",
+            total_metric="t",
+        )
+
+
+def test_fast_burn_scenario_pages_on_the_short_window():
+    """An hour of clean traffic, then 50% of the last five minutes'
+    requests blow the latency threshold: the 5m burn must scream
+    (50x budget) while the 1h window reads the diluted 4x."""
+    clock, _reg, h, eng = _latency_fixture()
+    eng.tick()
+    for _ in range(12):  # one clean hour, sampled every 5m
+        clock["t"] += 300
+        for _ in range(100):
+            h.observe(0.01)
+        eng.tick()
+    rows = eng.evaluate()
+    assert _row(rows, "web-p99", "5m")["burnRate"] == 0.0
+    assert _row(rows, "web-p99", "1h")["burnRate"] == 0.0
+
+    clock["t"] += 300  # the regression window: 50 good, 50 bad
+    for _ in range(50):
+        h.observe(0.01)
+    for _ in range(50):
+        h.observe(2.0)
+    eng.tick()
+    rows = eng.evaluate()
+    fast = _row(rows, "web-p99", "5m")
+    assert fast["bad"] == 50 and fast["total"] == 100
+    assert fast["badRatio"] == pytest.approx(0.5)
+    assert fast["burnRate"] == pytest.approx(50.0)
+    assert fast["alerting"] is True
+    assert fast["burnThreshold"] == FAST_BURN_THRESHOLD
+    slow = _row(rows, "web-p99", "1h")
+    # 50 bad of the 1200+100 requests inside the hour window
+    assert slow["burnRate"] == pytest.approx(
+        (50 / slow["total"]) / 0.01, abs=1e-3
+    )
+    assert slow["burnRate"] < fast["burnRate"]
+    assert slow["burnThreshold"] == SLOW_BURN_THRESHOLD
+    # the gauges mirror the rows
+    assert eng.m_burn.value(
+        {"slo": "web-p99", "window": "5m"}
+    ) == pytest.approx(50.0)
+
+
+def test_slow_burn_scenario_steady_leak_shows_on_both_windows():
+    """A steady 3% miss rate burns 3x budget on EVERY window — the
+    slow-burn signature (no fast-burn page, but the budget is going)."""
+    clock, _reg, h, eng = _latency_fixture()
+    eng.tick()
+    for _ in range(12):
+        clock["t"] += 300
+        for _ in range(97):
+            h.observe(0.01)
+        for _ in range(3):
+            h.observe(2.0)
+        eng.tick()
+    rows = eng.evaluate()
+    # burn 3.0 everywhere: below the 5m page threshold (14.4), exactly
+    # at the 1h ticket threshold (3.0) — the slow-burn signature
+    assert _row(rows, "web-p99", "5m")["alerting"] is False
+    for window in ("5m", "1h"):
+        row = _row(rows, "web-p99", window)
+        assert row["burnRate"] == pytest.approx(3.0, rel=1e-6)
+    assert _row(rows, "web-p99", "1h")["alerting"] is True
+
+
+def test_ratio_sli_from_counter_pair():
+    clock = {"t": 5000.0}
+    reg = Registry()
+    total = reg.counter(
+        "controller_runtime_reconcile_total",
+        "reconciles",
+        labelnames=("controller", "result"),
+    )
+    errors = reg.counter(
+        "controller_runtime_reconcile_errors_total",
+        "errors",
+        labelnames=("controller",),
+    )
+    spec = SLO(
+        name="reconcile-errors",
+        description="",
+        objective=0.999,
+        total_metric="controller_runtime_reconcile_total",
+        bad_metric="controller_runtime_reconcile_errors_total",
+    )
+    eng = SLOEngine(reg, [spec], windows=WINDOWS, time_fn=lambda: clock["t"])
+    eng.tick()
+    clock["t"] += 300
+    # 990 successes + 10 errors across two controllers: the SLI sums
+    # over every label dimension
+    total.inc({"controller": "a", "result": "success"}, by=600)
+    total.inc({"controller": "b", "result": "success"}, by=390)
+    total.inc({"controller": "a", "result": "error"}, by=6)
+    total.inc({"controller": "b", "result": "error"}, by=4)
+    errors.inc({"controller": "a"}, by=6)
+    errors.inc({"controller": "b"}, by=4)
+    eng.tick()
+    row = _row(eng.evaluate(), "reconcile-errors", "5m")
+    assert row["total"] == 1000 and row["bad"] == 10
+    assert row["burnRate"] == pytest.approx((10 / 1000) / 0.001)  # 10x
+
+
+def test_unregistered_metric_evaluates_to_zero_not_crash():
+    clock = {"t": 0.0}
+    reg = Registry()
+    eng = SLOEngine(
+        reg,
+        [
+            SLO(
+                name="ghost",
+                description="",
+                objective=0.99,
+                histogram="never_registered_seconds",
+                threshold_s=1.0,
+            )
+        ],
+        windows=WINDOWS,
+        time_fn=lambda: clock["t"],
+    )
+    eng.tick()
+    clock["t"] += 300
+    eng.tick()
+    row = _row(eng.evaluate(), "ghost", "5m")
+    assert row["total"] == 0 and row["burnRate"] == 0.0
+
+
+def test_engine_restarts_after_stop():
+    clock, _reg, h, eng = _latency_fixture()
+    eng.start(interval=0.01)
+    eng.stop()
+    # a second start must actually sample again (the stop flag clears)
+    eng.start(interval=0.01)
+    try:
+        h.observe(0.01)
+        import time as _t
+
+        before = len(eng._samples["web-p99"])
+        deadline = _t.monotonic() + 5
+        while (
+            len(eng._samples["web-p99"]) <= before
+            and _t.monotonic() < deadline
+        ):
+            _t.sleep(0.02)
+        assert len(eng._samples["web-p99"]) > before, (
+            "restarted engine never ticked"
+        )
+    finally:
+        eng.stop()
+
+
+def test_dashboard_api_slo_serves_burn_rate_rows():
+    from odh_kubeflow_tpu.machinery.store import APIServer
+    from odh_kubeflow_tpu.web.dashboard import DashboardApp
+
+    api = APIServer()
+    reg = Registry()
+    h = reg.histogram("web_seconds", "x", buckets=(0.25, 1.0))
+    clock = {"t": 777.0}
+    eng = SLOEngine(
+        reg,
+        [
+            SLO(
+                name="web-p99",
+                description="d",
+                objective=0.99,
+                histogram="web_seconds",
+                threshold_s=0.25,
+            )
+        ],
+        windows=WINDOWS,
+        time_fn=lambda: clock["t"],
+    )
+    eng.tick()
+    clock["t"] += 300
+    for _ in range(9):
+        h.observe(0.1)
+    h.observe(3.0)
+    dash = DashboardApp(api, registry=reg, slo_engine=eng)
+
+    captured = {}
+
+    def start_response(status, headers):
+        captured["status"] = status
+
+    body = dash.app(
+        {
+            "REQUEST_METHOD": "GET",
+            "PATH_INFO": "/api/slo",
+            "QUERY_STRING": "tick=1",
+            "HTTP_KUBEFLOW_USERID": "ops@example.com",
+        },
+        start_response,
+    )
+    assert captured["status"].startswith("200")
+    payload = json.loads(b"".join(body).decode())
+    rows = payload["slos"]
+    row = [r for r in rows if r["window"] == "5m"][0]
+    assert row["slo"] == "web-p99"
+    assert row["burnRate"] == pytest.approx(10.0)  # 10% bad / 1% budget
+    # the gauge surface exists alongside the JSON rows
+    assert "slo_burn_rate{" in reg.exposition()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 lint: the declarative specs must resolve against the LIVE
+# platform registry — a renamed histogram, disabled exemplars, or a
+# threshold that isn't a bucket boundary breaks the metric→trace→SLO
+# chain silently otherwise
+
+
+def test_slo_specs_resolve_against_platform_registry():
+    from odh_kubeflow_tpu.platform import Platform
+
+    platform = Platform()
+    reg = platform.metrics_registry
+    specs = default_slos()
+    assert len(specs) >= 4
+    for spec in specs:
+        if spec.histogram:
+            m = reg.metric(spec.histogram)
+            assert isinstance(m, Histogram), (
+                f"SLO {spec.name}: histogram {spec.histogram!r} is not "
+                "registered in the platform registry"
+            )
+            assert m.exemplars, (
+                f"SLO {spec.name}: {spec.histogram} must have exemplars "
+                "enabled (the metric→trace pivot feeds the SLO workflow)"
+            )
+            assert spec.threshold_s in m.buckets, (
+                f"SLO {spec.name}: threshold {spec.threshold_s}s is not "
+                f"an exact bucket boundary of {spec.histogram} "
+                f"{m.buckets} — the good-event count would be wrong"
+            )
+        else:
+            for name in (spec.total_metric, spec.bad_metric):
+                assert reg.metric(name) is not None, (
+                    f"SLO {spec.name}: counter {name!r} not registered"
+                )
+    # the default windows cover a fast and a slow burn signal
+    assert len(DEFAULT_WINDOWS) >= 2
+
+
+def test_exemplar_exposition_roundtrips_through_openmetrics_parser():
+    """Tier-1: observe through a real platform histogram inside a
+    span, and require the OpenMetrics exposition of the WHOLE platform
+    registry to parse cleanly with the exemplar intact — while the
+    plain exposition stays exemplar-free (byte-stable contract)."""
+    from odh_kubeflow_tpu.platform import Platform
+
+    platform = Platform()
+    reg = platform.metrics_registry
+    hist = reg.metric("http_request_duration_seconds")
+    with tracing.span("roundtrip") as ctx:
+        hist.observe(0.01, {"app": "jupyter-web-app"})
+    plain = reg.exposition()
+    assert "# EOF" not in plain and "trace_id=" not in plain
+    fams = parse_openmetrics(reg.exposition(openmetrics=True))
+    samples = fams["http_request_duration_seconds"]["samples"]
+    exemplars = [
+        ex
+        for name, labels, _v, ex in samples
+        if name.endswith("_bucket") and labels.get("app") == "jupyter-web-app"
+        if ex is not None
+    ]
+    assert exemplars, "no exemplar survived the round-trip"
+    assert any(ex[0].get("trace_id") == ctx.trace_id for ex in exemplars)
+    # every histogram an SLO references exposes with exemplars enabled
+    for spec in default_slos():
+        if spec.histogram:
+            assert spec.histogram in fams
