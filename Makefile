@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint sanitize chaos durability explore fleetbench obs loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
+.PHONY: all test test-unit test-manifests lint sanitize chaos durability explore fleetbench replicabench obs loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
 
 all: lint test
 
@@ -85,6 +85,19 @@ fleetbench:
 	$(PYTHON) loadtest/control_plane_bench.py --fleet --notebooks 2000 \
 	  --fleet-watchers 50 --out /tmp/fleetbench.json
 	$(PYTHON) -m pytest -q tests/test_fleet.py
+
+# read-replica smoke (ISSUE 13): the 100k-notebook / 1000-stream axis
+# scaled down to N=2000 with 2 followers and 100 streams, SAME gates —
+# shipping must tax leader ingest <10%, follower state bit-identical,
+# replica-served list p99 within the PR-10 leader-only bounds, sharded
+# watch fanout p99 within the PR-10 26ms bound, staleness p99 <250ms
+# under write load. Writes to a scratch copy (full run: `python
+# loadtest/control_plane_bench.py --replica --notebooks 100000`).
+replicabench:
+	cp BENCH_control_plane.json /tmp/replicabench.json
+	$(PYTHON) loadtest/control_plane_bench.py --replica --notebooks 2000 \
+	  --replica-streams 100 --out /tmp/replicabench.json
+	$(PYTHON) -m pytest -q tests/test_replica.py
 
 # the randomized property suites re-run as race probes: sanitized
 # locks record acquisition order, re-entry, and blocking-under-lock

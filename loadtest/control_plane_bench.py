@@ -811,6 +811,545 @@ def bench_fleet(
     return out
 
 
+# ---------------------------------------------------------------------------
+# replica axis: leader + followers read path (ISSUE 13; `make
+# replicabench` runs the scaled-down smoke)
+
+# the PR-10 leader-only numbers at N=25k (BENCH_control_plane.json
+# `fleet`) — the replica axis must serve lists at least this well and
+# hold fanout p99 at 10x the stream count
+PR10_NS_PAGE_P99_MS = 7.315
+PR10_CLUSTER_PAGE_P99_MS = 22.603
+PR10_FANOUT_P99_MS = 25.916
+
+
+def _replica_follower_child(
+    leader_url: str,
+    cmd_q,
+    res_q,
+    sample_every: int,
+    page_limit: int,
+    n_namespaces: int,
+) -> None:
+    """One follower replica as its own PROCESS (the deployment shape —
+    a follower shares no GIL with the leader; co-locating them would
+    bill the follower's apply work to the leader's ingest). Drives a
+    ReplicaStore + ReplicationClient and answers the parent's phase
+    commands over a queue pair. All latency joins use
+    ``time.perf_counter`` — CLOCK_MONOTONIC on Linux, one clock for
+    every process on the box."""
+    import threading
+
+    from odh_kubeflow_tpu.machinery.replica import (
+        ReplicaStore,
+        ReplicationClient,
+    )
+
+    import gc
+
+    # big-heap serving posture (same move the fleet axis makes): the
+    # follower accumulates the whole fleet; automatic gen2 collections
+    # over ~1M live objects land 100ms+ pauses mid-apply and the
+    # staleness axis measures the GC, not the replication
+    gc.disable()
+    rep = ReplicaStore(leader_url)
+    client = ReplicationClient(rep).start()
+    while not client.connected:
+        time.sleep(0.01)
+
+    # staleness rig: one watch over the whole ingest; sampled creates
+    # (index % sample_every == 0) are stamped at delivery and joined
+    # with the parent's leader-ack instants afterwards
+    stale_recv: dict[str, float] = {}
+    stale_stop = threading.Event()
+    stale_watch = rep.watch("Notebook", send_initial=False, inline=False)
+
+    def stale_drain():
+        while not stale_stop.is_set():
+            item = stale_watch.get(timeout=0.2)
+            if item is None:
+                continue
+            _etype, obj = item
+            t1 = time.perf_counter()
+            name = obj.get("metadata", {}).get("name", "")
+            try:
+                idx = int(name.rsplit("-", 1)[1])
+            except (IndexError, ValueError):
+                continue
+            if idx % sample_every == 0 and name not in stale_recv:
+                stale_recv[name] = t1
+
+    threading.Thread(target=stale_drain, daemon=True).start()
+    res_q.put(("ready", None))
+
+    while True:
+        cmd = cmd_q.get()
+        op = cmd[0]
+        if op == "caught_up?":
+            horizon = cmd[1]
+            t0 = time.perf_counter()
+            ok = client.wait_caught_up(300, target_rv=horizon)
+            took = time.perf_counter() - t0
+            time.sleep(0.25)  # grace: let the last sampled deliveries land
+            stale_stop.set()
+            stale_watch.stop()
+            res_q.put(
+                (
+                    "caught_up",
+                    {
+                        "ok": ok,
+                        "seconds": took,
+                        "applied_rv": rep.applied_rv(),
+                        "digest": rep.state_digest(),
+                        "stale_recv": dict(stale_recv),
+                        "evictions": rep.watch_evictions,
+                    },
+                )
+            )
+        elif op == "list":
+            ns_ms: list[float] = []
+            cluster_ms: list[float] = []
+            walked = 0
+            gc.collect()
+            gc.freeze()
+            # warmup: the first page per namespace pays the one-off
+            # bucket sort the rv-tagged page-key cache then reuses —
+            # the axis measures steady-state serving, same posture as
+            # the JWA/web-tier axes' warmup rounds
+            for ns in [f"team-{i:02d}" for i in range(n_namespaces)]:
+                rep.list_chunk("Notebook", namespace=ns, limit=page_limit)
+            for ns in [None] + [f"team-{i:02d}" for i in range(n_namespaces)]:
+                token = None
+                while True:
+                    t0 = time.perf_counter()
+                    page, token = rep.list_chunk(
+                        "Notebook", namespace=ns, limit=page_limit,
+                        continue_token=token,
+                    )
+                    (cluster_ms if ns is None else ns_ms).append(
+                        (time.perf_counter() - t0) * 1000.0
+                    )
+                    if ns is None:
+                        walked += len(page)
+                    if not token:
+                        break
+            gc.unfreeze()
+            res_q.put(
+                (
+                    "list",
+                    {"ns_ms": ns_ms, "cluster_ms": cluster_ms, "walked": walked},
+                )
+            )
+        elif op == "fanout":
+            n_streams, fan_events = cmd[1], cmd[2]
+            watches = [
+                rep.watch("Notebook", send_initial=False, inline=False)
+                for _ in range(n_streams)
+            ]
+            res_q.put(("fanout_ready", None))
+            recvs: list[tuple[int, float]] = []
+            rlock = threading.Lock()
+            # worker-pool consumers, NOT a thread per stream: 500
+            # blocked drain threads in one interpreter measure GIL
+            # scheduler collapse, not the server (p99 went 26ms →
+            # 1.3s). The PR-7 serving posture is the honest model —
+            # streams multiplex on a few pump threads parked on the
+            # Watch notify hook, exactly like the event-loop server.
+            workers = min(16, max(n_streams, 1))
+            groups = [watches[i::workers] for i in range(workers)]
+
+            def pump(group):
+                wake = threading.Event()
+                for w in group:
+                    w.set_notify(wake.set)
+                mine: list[tuple[int, float]] = []
+                remaining = len(group) * fan_events
+                deadline = time.monotonic() + 120
+                while remaining > 0 and time.monotonic() < deadline:
+                    if not wake.wait(timeout=1.0):
+                        continue
+                    wake.clear()
+                    for w in group:
+                        while True:
+                            item = w.try_get()
+                            if item is None:
+                                break
+                            mine.append(
+                                (
+                                    item[1]["spec"].get("fan", -1),
+                                    time.perf_counter(),
+                                )
+                            )
+                            remaining -= 1
+                with rlock:
+                    recvs.extend(mine)
+
+            fts = [
+                threading.Thread(target=pump, args=(g,), daemon=True)
+                for g in groups
+            ]
+            for t in fts:
+                t.start()
+            for t in fts:
+                t.join(timeout=150)
+            for w in watches:
+                w.stop()
+            res_q.put(("fanout", recvs))
+        elif op == "exit":
+            client.stop()
+            res_q.put(("exit", None))
+            return
+
+
+def bench_replica(
+    n_notebooks: int,
+    streams: int = 1000,
+    followers: int = 2,
+    writers: int = 12,
+    page_limit: int = 500,
+    fsync_ms: float = 3.0,
+    staleness_sample_every: int = 25,
+) -> dict:
+    """The read-replica axis at N notebooks / ``streams`` watch streams:
+
+    - **ingest tax**: N creates through the durable leader (group-commit
+      WAL, deterministic disk model) twice — alone, then with
+      ``followers`` replica PROCESSES pulling the live replication
+      stream over HTTP. Gate: shipping costs the leader's write path
+      <10%.
+    - **replica staleness**: during the with-replica ingest, every
+      ``staleness_sample_every``-th create is timestamped at leader ack
+      and joined with its watch delivery on each follower; p99 of
+      (delivery − ack) gates < 250ms under full write load.
+    - **catch-up + bit-identity**: wall time from ingest end to every
+      follower holding the leader's rv horizon, and a sha256 state
+      digest compared against the leader's.
+    - **replica-served lists**: kube-style limit/continue walks against
+      each follower; p99 gates ≤ the PR-10 leader-only numbers at 25k.
+    - **watch fanout**: ``streams`` watch streams split across the
+      followers, fanned out by the sharded dispatcher; write-to-delivery
+      p99 gates ≤ the PR-10 p99 at one-tenth the stream count.
+    """
+    import multiprocessing as mp
+    import shutil
+    import tempfile
+    import threading
+
+    from odh_kubeflow_tpu.machinery.wal import FileIO, WriteAheadLog
+
+    class BenchDiskIO(FileIO):
+        def fsync(self, f) -> None:
+            time.sleep(fsync_ms / 1000.0)
+            super().fsync(f)
+
+    n_namespaces = 16
+
+    def nb(i: int) -> dict:
+        return {
+            "kind": "Notebook",
+            "metadata": {
+                "name": f"nb-{i:05d}",
+                "namespace": f"team-{i % n_namespaces:02d}",
+                "labels": {"tier": "fleet"},
+            },
+            "spec": {"template": {"spec": {"containers": [{"name": "nb"}]}}},
+        }
+
+    def pct(samples: list[float], p: float) -> float:
+        s = sorted(samples)
+        return s[min(int(p * len(s)), len(s) - 1)]
+
+    def ingest(api, count: int, on_ack=None) -> float:
+        import gc
+
+        barrier = threading.Barrier(writers + 1)
+
+        def worker(w: int):
+            barrier.wait()
+            for i in range(w, count, writers):
+                api.create(nb(i))
+                if on_ack is not None:
+                    on_ack(i)
+
+        ts = [
+            threading.Thread(target=worker, args=(w,), daemon=True)
+            for w in range(writers)
+        ]
+        for t in ts:
+            t.start()
+        barrier.wait()
+        # GC off for the measured window — identically in the
+        # baseline and with-replica phases, so the tax ratio compares
+        # shipping, not gen2 pauses amplified by a bigger scan set
+        gc.disable()
+        t0 = time.perf_counter()
+        for t in ts:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        gc.enable()
+        gc.collect()
+        return elapsed
+
+    out: dict = {
+        "n_notebooks": n_notebooks,
+        "streams": streams,
+        "followers": followers,
+        "writers": writers,
+        "disk_model_fsync_ms": fsync_ms,
+    }
+
+    # ---- baseline: leader alone (serving tier up, no followers) -----------
+    # Two interleaved reps, best kept (the web-tier bench's anti-noise
+    # move): host-level stalls land on both phases instead of deciding
+    # the tax ratio. The baseline leader serves HTTP too — the REST
+    # façade is the leader's normal posture; only the followers and
+    # their stream are the delta under measurement.
+    def baseline_rate() -> float:
+        d_base = tempfile.mkdtemp(prefix="replica-base-")
+        base_srv = None
+        try:
+            base = APIServer(
+                wal=WriteAheadLog(d_base, io=BenchDiskIO()),
+                snapshot_interval=0,
+            )
+            base.register_kind(
+                "kubeflow.org/v1beta1", "Notebook", "notebooks"
+            )
+            _, _bport, base_srv = httpapi.serve(base, port=0)
+            wal = base._wal
+            elapsed = ingest(base, n_notebooks)
+            base.close()
+            fsync_rates.append(
+                round(wal.fsync_total / max(wal.appended_total, 1), 3)
+            )
+            return n_notebooks / elapsed
+        finally:
+            if base_srv is not None:
+                base_srv.shutdown()
+            shutil.rmtree(d_base, ignore_errors=True)
+
+    fsync_rates: list[float] = []  # fsyncs/record per ingest phase
+    base_rates = [baseline_rate()]  # second sample after the replica run
+
+    # ---- leader + follower processes on the live stream -------------------
+    d = tempfile.mkdtemp(prefix="replica-lead-")
+    srv = None
+    ctx = mp.get_context("fork")
+    procs: list = []
+    chans: list[tuple] = []
+    try:
+        leader = APIServer(
+            wal=WriteAheadLog(d, io=BenchDiskIO()), snapshot_interval=0
+        )
+        leader.register_kind("kubeflow.org/v1beta1", "Notebook", "notebooks")
+        _, port, srv = httpapi.serve(leader, port=0)
+        leader_url = f"http://127.0.0.1:{port}"
+        for _ in range(followers):
+            cmd_q, res_q = ctx.Queue(), ctx.Queue()
+            p = ctx.Process(
+                target=_replica_follower_child,
+                args=(
+                    leader_url,
+                    cmd_q,
+                    res_q,
+                    staleness_sample_every,
+                    page_limit,
+                    n_namespaces,
+                ),
+                daemon=True,
+            )
+            p.start()
+            procs.append(p)
+            chans.append((cmd_q, res_q))
+        for _cmd_q, res_q in chans:
+            tag, _ = res_q.get(timeout=60)
+            assert tag == "ready", tag
+
+        # leader-ack instants for the sampled creates (joined with the
+        # followers' delivery stamps after the catch-up barrier)
+        acks: dict[str, float] = {}
+        ack_lock = threading.Lock()
+
+        def on_ack(i: int) -> None:
+            if i % staleness_sample_every == 0:
+                with ack_lock:
+                    acks[f"nb-{i:05d}"] = time.perf_counter()
+
+        leader_wal = leader._wal
+        elapsed = ingest(leader, n_notebooks, on_ack=on_ack)
+        out["ingest_with_replicas_per_s"] = round(n_notebooks / elapsed, 1)
+        out["ingest_with_replicas_fsyncs_per_record"] = round(
+            leader_wal.fsync_total / max(leader_wal.appended_total, 1), 3
+        )
+
+        # ---- catch-up barrier, staleness join, bit-identity ---------------
+        horizon = leader.applied_rv()
+        for cmd_q, _res_q in chans:
+            cmd_q.put(("caught_up?", horizon))
+        digest = leader.state_digest()
+        stale_deltas: list[float] = []
+        catch_up = 0.0
+        identical = True
+        follower_evictions = 0
+        for _cmd_q, res_q in chans:
+            tag, info = res_q.get(timeout=300)
+            assert tag == "caught_up" and info["ok"], (tag, info)
+            catch_up = max(catch_up, info["seconds"])
+            identical = identical and info["digest"] == digest
+            follower_evictions += int(info.get("evictions", 0))
+            for name, t1 in info["stale_recv"].items():
+                t0 = acks.get(name)
+                if t0 is not None and t1 >= t0:
+                    stale_deltas.append(t1 - t0)
+        out["catch_up_after_ingest_s"] = round(catch_up, 3)
+        out["followers_bit_identical"] = identical
+        out["follower_watch_evictions"] = follower_evictions
+        # an evicted or dead staleness rig must FAIL the gate, not
+        # silently skip it: require most sampled creates to have joined
+        out["staleness_samples_expected"] = (
+            (n_notebooks // staleness_sample_every) * followers
+        )
+        if stale_deltas:
+            out["replica_staleness_ms"] = {
+                "samples": len(stale_deltas),
+                "p50": round(pct(stale_deltas, 0.50) * 1000.0, 3),
+                "p99": round(pct(stale_deltas, 0.99) * 1000.0, 3),
+            }
+
+        # ---- replica-served paginated lists (every follower) --------------
+        ns_ms: list[float] = []
+        cluster_ms: list[float] = []
+        for cmd_q, _res_q in chans:
+            cmd_q.put(("list", ))
+        for _cmd_q, res_q in chans:
+            tag, info = res_q.get(timeout=300)
+            assert tag == "list", tag
+            assert info["walked"] == n_notebooks, (
+                info["walked"], n_notebooks,
+            )
+            ns_ms.extend(info["ns_ms"])
+            cluster_ms.extend(info["cluster_ms"])
+        out["replica_list"] = {
+            "pages": len(ns_ms) + len(cluster_ms),
+            "ns_page_p50_ms": round(pct(ns_ms, 0.50), 3),
+            "ns_page_p99_ms": round(pct(ns_ms, 0.99), 3),
+            "cluster_page_p50_ms": round(pct(cluster_ms, 0.50), 3),
+            "cluster_page_p99_ms": round(pct(cluster_ms, 0.99), 3),
+        }
+
+        # ---- watch fanout at `streams` streams across followers -----------
+        fan_events = 40
+        per_follower = max(streams // followers, 1)
+        for cmd_q, _res_q in chans:
+            cmd_q.put(("fanout", per_follower, fan_events))
+        for _cmd_q, res_q in chans:
+            tag, _ = res_q.get(timeout=120)
+            assert tag == "fanout_ready", tag
+        sent: dict[int, float] = {}
+        for v in range(fan_events):
+            obj = leader.get("Notebook", "nb-00000", "team-00")
+            obj["spec"]["fan"] = v
+            sent[v] = time.perf_counter()
+            leader.update(obj)
+            time.sleep(0.01)  # distinct events, not one coalesced burst
+        deltas: list[float] = []
+        deliveries = 0
+        for _cmd_q, res_q in chans:
+            tag, recvs = res_q.get(timeout=300)
+            assert tag == "fanout", tag
+            deliveries += len(recvs)
+            for v, t1 in recvs:
+                t0 = sent.get(v)
+                if t0 is not None and t1 >= t0:
+                    deltas.append(t1 - t0)
+        out["watch_fanout"] = {
+            "streams": per_follower * followers,
+            "events": fan_events,
+            "deliveries": deliveries,
+            "dispatch_shards": leader.WATCH_DISPATCH_SHARDS,
+            "p50_ms": round(pct(deltas, 0.50) * 1000.0, 3),
+            "p99_ms": round(pct(deltas, 0.99) * 1000.0, 3),
+        }
+
+        for cmd_q, _res_q in chans:
+            cmd_q.put(("exit", ))
+        for p in procs:
+            p.join(timeout=30)
+        leader.close()
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        if srv is not None:
+            srv.shutdown()
+        shutil.rmtree(d, ignore_errors=True)
+
+    # second baseline sample AFTER the replica run: host-level drift
+    # lands on both sides of the tax ratio instead of deciding it
+    base_rates.append(baseline_rate())
+    out["ingest_no_replicas_per_s"] = round(
+        sum(base_rates) / len(base_rates), 1
+    )
+    out["ingest_no_replicas_fsyncs_per_record"] = max(fsync_rates)
+    out["ingest_tax_pct"] = round(
+        100.0
+        * (
+            1.0
+            - out["ingest_with_replicas_per_s"]
+            / out["ingest_no_replicas_per_s"]
+        ),
+        1,
+    )
+
+    # ---- gates (ratios/bounds hold at any N; `make replicabench` runs
+    # the same gates at N=2000 / 100 streams) -------------------------------
+    failures = []
+    if out["ingest_tax_pct"] > 10.0:
+        failures.append(
+            f"shipping taxed ingest {out['ingest_tax_pct']}% (> 10% gate)"
+        )
+    if not out["followers_bit_identical"]:
+        failures.append("follower digest diverged from the leader")
+    if out["replica_list"]["ns_page_p99_ms"] > PR10_NS_PAGE_P99_MS:
+        failures.append(
+            f"replica ns-page p99 {out['replica_list']['ns_page_p99_ms']}ms "
+            f"> PR-10 leader-only {PR10_NS_PAGE_P99_MS}ms"
+        )
+    if out["replica_list"]["cluster_page_p99_ms"] > PR10_CLUSTER_PAGE_P99_MS:
+        failures.append(
+            "replica cluster-page p99 "
+            f"{out['replica_list']['cluster_page_p99_ms']}ms > PR-10 "
+            f"leader-only {PR10_CLUSTER_PAGE_P99_MS}ms"
+        )
+    if out["watch_fanout"]["p99_ms"] > PR10_FANOUT_P99_MS:
+        failures.append(
+            f"fanout p99 {out['watch_fanout']['p99_ms']}ms at "
+            f"{out['watch_fanout']['streams']} streams > "
+            f"{PR10_FANOUT_P99_MS}ms gate"
+        )
+    stale = out.get("replica_staleness_ms")
+    if stale is None or stale["samples"] < out["staleness_samples_expected"] // 2:
+        failures.append(
+            "staleness rig under-sampled: "
+            f"{0 if stale is None else stale['samples']} joined of "
+            f"~{out['staleness_samples_expected']} expected — the "
+            "<250ms contract was not actually measured"
+        )
+    elif stale["p99"] > 250.0:
+        failures.append(
+            f"replica staleness p99 {stale['p99']}ms "
+            "> 250ms gate under write load"
+        )
+    if out["follower_watch_evictions"]:
+        failures.append(
+            f"{out['follower_watch_evictions']} follower watch "
+            "consumers were evicted during the run (slow-consumer 410s "
+            "invalidate the staleness/fanout samples)"
+        )
+    out["gates"] = {"passed": not failures, "failures": failures}
+    return out
+
+
 def bench_recovery(
     object_counts: list[int], failover_reps: int = 8
 ) -> dict:
@@ -1012,6 +1551,29 @@ def main() -> None:
         help="concurrent watch streams for the fanout axis",
     )
     parser.add_argument(
+        "--replica",
+        action="store_true",
+        help="run ONLY the read-replica axis (--notebooks sets N; "
+        "leader + --replica-followers on the live HTTP replication "
+        "stream: ingest tax, staleness p99, catch-up, replica-served "
+        "list p99, sharded watch fanout at --replica-streams) and "
+        "merge it into --out under the `replica` key; exits nonzero "
+        "when a gate fails",
+    )
+    parser.add_argument(
+        "--replica-streams",
+        type=int,
+        default=1000,
+        help="watch streams split across the followers for the fanout "
+        "axis",
+    )
+    parser.add_argument(
+        "--replica-followers",
+        type=int,
+        default=2,
+        help="follower replicas pulling the leader's stream",
+    )
+    parser.add_argument(
         "--recovery",
         action="store_true",
         help="include the durability axis (cold-recovery time vs "
@@ -1070,6 +1632,50 @@ def main() -> None:
         if not fleet["gates"]["passed"]:
             print(
                 "FLEET GATE FAILURES: " + "; ".join(fleet["gates"]["failures"]),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        return
+
+    if args.replica:
+        replica = bench_replica(
+            args.notebooks,
+            streams=args.replica_streams,
+            followers=args.replica_followers,
+            writers=args.fleet_writers,
+            page_limit=args.fleet_page_limit,
+        )
+        merged = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                merged = json.load(f)
+        merged["replica"] = replica
+        with open(args.out, "w") as f:
+            json.dump(merged, f, indent=2)
+        print(json.dumps({"replica": replica}, indent=2))
+        stale = replica.get("replica_staleness_ms", {})
+        print(
+            f"\nreplica @ N={replica['n_notebooks']} x "
+            f"{replica['watch_fanout']['streams']} streams / "
+            f"{replica['followers']} followers: ingest "
+            f"{replica['ingest_no_replicas_per_s']} -> "
+            f"{replica['ingest_with_replicas_per_s']}/s "
+            f"(tax {replica['ingest_tax_pct']}%, gate < 10%) | "
+            f"staleness p99 {stale.get('p99', 'n/a')}ms (gate < 250ms) | "
+            "replica list p99 ns "
+            f"{replica['replica_list']['ns_page_p99_ms']}ms / cluster "
+            f"{replica['replica_list']['cluster_page_p99_ms']}ms "
+            f"(gates <= {PR10_NS_PAGE_P99_MS}/{PR10_CLUSTER_PAGE_P99_MS}ms) | "
+            f"fanout p99 {replica['watch_fanout']['p99_ms']}ms x"
+            f"{replica['watch_fanout']['streams']} "
+            f"(gate <= {PR10_FANOUT_P99_MS}ms) | catch-up "
+            f"{replica['catch_up_after_ingest_s']}s | bit-identical "
+            f"{replica['followers_bit_identical']}"
+        )
+        if not replica["gates"]["passed"]:
+            print(
+                "REPLICA GATE FAILURES: "
+                + "; ".join(replica["gates"]["failures"]),
                 file=sys.stderr,
             )
             sys.exit(1)

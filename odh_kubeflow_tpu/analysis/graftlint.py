@@ -122,7 +122,10 @@ class Rule:
     """Base class: subclass, set ``id``/``description``, implement
     ``check``, and register with :func:`register`. ``dirs`` (sections
     under the package) and ``files`` (exact package-relative paths)
-    are the file allowlists — ``None`` means every file."""
+    are the file allowlists — the UNION applies when both are set
+    (a dir-scoped rule can pull in individual out-of-dir files, e.g.
+    ``unbounded-list`` covering ``machinery/replica.py`` next to
+    ``web/``); both ``None`` means every file."""
 
     id: str = ""
     description: str = ""
@@ -132,11 +135,12 @@ class Rule:
     whole_program = False
 
     def applies(self, src: SourceFile) -> bool:
-        if self.files is not None:
-            return src.rel in self.files
-        if self.dirs is not None:
-            return src.section in self.dirs
-        return True
+        if self.files is None and self.dirs is None:
+            return True
+        return bool(
+            (self.files is not None and src.rel in self.files)
+            or (self.dirs is not None and src.section in self.dirs)
+        )
 
     def check(self, src: SourceFile) -> Iterator[Finding]:
         raise NotImplementedError

@@ -158,13 +158,13 @@ class UnboundedListRule(Rule):
         "(fleet-sized payload)"
     )
     dirs = ("web",)
-    files = ("machinery/cache.py",)
+    # beyond web/: the informer prime path, and the read-replica
+    # serving tier — a fleet-sized unpaginated list there defeats the
+    # whole point of scaling the read path out. (Base Rule.applies
+    # unions files + dirs.)
+    files = ("machinery/cache.py", "machinery/replica.py")
 
     _LISTERS = frozenset({"api", "client", "server", "store", "backend"})
-
-    def applies(self, src: SourceFile) -> bool:
-        # both scopes: the web serving tier AND the informer prime
-        return src.section in (self.dirs or ()) or src.rel in (self.files or ())
 
     def check(self, src: SourceFile) -> Iterator[Finding]:
         for node in ast.walk(src.tree):
@@ -298,6 +298,10 @@ class BlockingUnderLockRule(Rule):
         # and the event-loop serving tier
         "machinery/wal.py",
         "machinery/eventloop.py",
+        # the replication pull loop blocks on sockets by design — but
+        # NEVER under the replica store's lock (rv-pinned reads park on
+        # a Condition there, which is the one exempt form)
+        "machinery/replica.py",
     )
 
     # one lock vocabulary for the per-file and whole-program analyses

@@ -41,6 +41,7 @@ from odh_kubeflow_tpu.machinery.store import (
     Invalid,
     current_fence as store_fence,
     NotFound,
+    NotLeader,
     paged_list_all,
     TooManyRequests,
     TypeInfo,
@@ -73,6 +74,9 @@ _REASON_TO_ERR = {
     "Expired": Expired,
     "FencedOut": FencedOut,
     "TooManyRequests": TooManyRequests,
+    # a mutation hit a read replica: the caller must write to the
+    # leader (the 307's Location header / the split client's write arm)
+    "NotLeader": NotLeader,
 }
 _EVENT_INDEX_MAX = 4096
 
@@ -378,6 +382,13 @@ class RemoteAPIServer:
             if klass is TooManyRequests:
                 raise TooManyRequests(
                     message, retry_after=_retry_after_of(e)
+                ) from None
+            if klass is NotLeader:
+                # surface the redirect target: a caller catching
+                # NotLeader retries its write against this URL
+                raise NotLeader(
+                    message,
+                    leader_url=(e.headers or {}).get("Location", ""),
                 ) from None
             raise klass(message) from None
 
@@ -883,14 +894,15 @@ def in_cluster_config() -> Optional[dict[str, Any]]:
     return cfg
 
 
-def api_from_env() -> RemoteAPIServer:
+def api_from_env(url: Optional[str] = None) -> RemoteAPIServer:
     """Client for split-process components (`python -m odh_kubeflow_tpu.
     controllers.notebook` etc.), the ``ctrl.GetConfigOrDie()`` ladder
     (`/root/reference/components/notebook-controller/main.go:61-81`):
 
-    1. ``$KUBE_API_URL`` explicit endpoint (+ optional
-       ``KUBE_API_TOKEN`` / ``KUBE_API_TOKEN_FILE`` / ``KUBE_API_CA_FILE``
-       / ``KUBE_API_INSECURE_SKIP_TLS_VERIFY``);
+    1. ``url`` when given (the runner's replica-read endpoint — same
+       credential env, different host), else ``$KUBE_API_URL`` (+
+       optional ``KUBE_API_TOKEN`` / ``KUBE_API_TOKEN_FILE`` /
+       ``KUBE_API_CA_FILE`` / ``KUBE_API_INSECURE_SKIP_TLS_VERIFY``);
     2. in-cluster config (kubernetes service env + serviceaccount mount);
     3. localhost:8001 (`kubectl proxy` posture) for dev.
 
@@ -905,7 +917,7 @@ def api_from_env() -> RemoteAPIServer:
         # payload. KUBE_LIST_PAGE_SIZE=0 reverts to unpaginated.
         page_size=int(page_env) if page_env and int(page_env) > 0 else None,
     )
-    url = os.environ.get("KUBE_API_URL")
+    url = url or os.environ.get("KUBE_API_URL")
     if url:
         api = RemoteAPIServer(
             url,

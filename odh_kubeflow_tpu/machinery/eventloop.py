@@ -97,6 +97,11 @@ class WatchBody:
     ``frame(item) -> bytes`` turns one ``(etype, obj)`` event into its
     wire line; the serve layer passes the serialized-bytes-cache frame
     so every subscriber of the same event writes the same bytes object.
+
+    ``heartbeat_fn`` (optional) builds each heartbeat line dynamically
+    — the replication stream uses it to ship a CONTROL frame carrying
+    the leader's current rv/epoch/wall-clock, which is what makes
+    follower lag and staleness observable even on an idle stream.
     """
 
     def __init__(
@@ -105,11 +110,18 @@ class WatchBody:
         frame: Callable[[tuple[str, Any]], bytes],
         heartbeat: float,
         heartbeat_line: bytes = b'{"type":"HEARTBEAT"}\n',
+        heartbeat_fn: Optional[Callable[[], bytes]] = None,
     ):
         self.watch = watch
         self.frame = frame
         self.heartbeat = heartbeat
-        self.heartbeat_line = heartbeat_line
+        self._static_heartbeat = heartbeat_line
+        self.heartbeat_fn = heartbeat_fn
+
+    @property
+    def heartbeat_line(self) -> bytes:
+        fn = self.heartbeat_fn
+        return fn() if fn is not None else self._static_heartbeat
 
     def __iter__(self) -> Iterator[bytes]:
         w = self.watch
@@ -121,11 +133,25 @@ class WatchBody:
             while True:
                 item = w.get(timeout=self.heartbeat)
                 if item is None:
+                    # a server-side-ended stream (slow-consumer
+                    # eviction, replica teardown) must CLOSE, not
+                    # heartbeat forever on a dead queue; the client
+                    # reconnects/relists per its 410 contract
+                    if w.ended or w._stopped:
+                        return
                     # queue timeout → heartbeat; a dead client raises
                     # on the write and the finally stops the watch
                     yield self.heartbeat_line
                     continue
-                yield self.frame(item)
+                # join the pending burst into one chunk (one socket
+                # write downstream) — same batching the async pump does
+                frames = [self.frame(item)]
+                while len(frames) < 256:
+                    nxt = w.try_get()
+                    if nxt is None:
+                        break
+                    frames.append(self.frame(nxt))
+                yield b"".join(frames) if len(frames) > 1 else frames[0]
         finally:
             w.stop()
 
@@ -508,7 +534,20 @@ class _Connection(asyncio.Protocol):
                     return
                 item = w.try_get()
                 if item is not None:
-                    transport.write(wb.frame(item))
+                    # drain the whole pending burst into ONE transport
+                    # write: events arrive in group-commit batches, and
+                    # per-event write+wait iterations (a syscall and a
+                    # coroutine resume each) were the serving loop's
+                    # dominant per-record cost on the replication path
+                    frames = [wb.frame(item)]
+                    while len(frames) < 256:
+                        nxt = w.try_get()
+                        if nxt is None:
+                            break
+                        frames.append(wb.frame(nxt))
+                    transport.write(
+                        b"".join(frames) if len(frames) > 1 else frames[0]
+                    )
                     continue
                 if w._stopped or w.ended:
                     return

@@ -30,6 +30,7 @@ import json
 import math
 import os
 import threading
+import time
 from typing import Any, Optional
 from urllib.parse import parse_qs
 from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
@@ -57,6 +58,7 @@ from odh_kubeflow_tpu.machinery.store import (
     FencedOut,
     Invalid,
     NotFound,
+    NotLeader,
     TooManyRequests,
     reset_fence,
     set_fence,
@@ -77,9 +79,17 @@ _STATUS = {
     BadRequest: 400,
     Expired: 410,
     TooManyRequests: 429,
+    # kube-style leader redirect: a mutation hit a read replica; the
+    # Status reason is NotLeader and Location points at the leader
+    NotLeader: 307,
 }
 
 WATCH_HEARTBEAT_SECONDS = 15.0
+
+# replication CONTROL-frame cadence: each frame carries the leader's
+# current rv/epoch/wall-clock, so follower lag and staleness resolve at
+# this granularity even on an idle stream
+REPLICATION_HEARTBEAT_SECONDS = 1.0
 
 # APF-lite default: per-client concurrent (non-watch) request cap.
 # kube-apiserver's Priority & Fairness rejects excess work with 429 +
@@ -398,6 +408,14 @@ class RestAPI:
             return self._json(
                 200, {"gitVersion": "odh-kubeflow-tpu", "major": "1"}, start_response
             )
+        if path.startswith("/replication/"):
+            try:
+                return self._replication(path, method, qs, start_response)
+            except APIError as e:
+                return self._error(
+                    _err_status(e), str(e), start_response,
+                    reason=type(e).__name__,
+                )
         if (
             method == "POST"
             and path == "/apis/authorization.k8s.io/v1/subjectaccessreviews"
@@ -474,6 +492,12 @@ class RestAPI:
             headers = []
             if isinstance(e, TooManyRequests):
                 headers.append(_retry_after_header(e.retry_after))
+            if isinstance(e, NotLeader) and e.leader_url:
+                # kube-style redirect: the Status body says NotLeader,
+                # Location points the writer at the leader
+                headers.append(
+                    ("Location", e.leader_url + environ.get("PATH_INFO", "/"))
+                )
             return self._error(
                 _err_status(e),
                 str(e),
@@ -527,6 +551,103 @@ class RestAPI:
         raw = environ["wsgi.input"].read(length) if length else b"{}"
         return json.loads(raw.decode() or "{}")
 
+    # -- replication (leader → follower WAL shipping) ------------------------
+
+    def _replication(self, path, method, qs, start_response):
+        """The WAL-shipping surface follower replicas pull from
+        (docs/GUIDE.md "Read replicas & bounded staleness"):
+
+        - ``GET /replication/snapshot`` — a consistent full-state cut
+          (rv, types, objects, kind_rv, watch-cache events, epoch) for
+          cold catch-up;
+        - ``GET /replication/stream?from=<rv>`` — committed records of
+          every kind above ``from``, in rv order, as watch-framed JSON
+          lines, interleaved with CONTROL frames carrying the leader's
+          current rv/epoch/wall-clock. A ``from`` below the compacted
+          window answers 410 (catch up from a snapshot instead).
+        """
+        if method != "GET":
+            raise Invalid(f"unsupported {method} on {path}")
+        cut_fn = getattr(self.server, "replication_cut", None)
+        feed_fn = getattr(self.server, "replication_watch", None)
+        if path == "/replication/snapshot" and cut_fn is not None:
+            # pointer collection under the store lock; the (possibly
+            # fleet-sized) serialization runs here, off-lock
+            return self._json(200, cut_fn(), start_response)
+        if path == "/replication/stream" and feed_fn is not None:
+            try:
+                from_rv = int(qs.get("from", ["0"])[0])
+            except ValueError:
+                raise Invalid("replication 'from' rv must be numeric") from None
+            w = feed_fn(from_rv)  # Expired → the caller's 410 mapping
+            start_response(
+                "200 OK",
+                [
+                    ("Content-Type", "application/json"),
+                    ("X-Stream", "replication"),
+                ],
+            )
+            return WatchBody(
+                w,
+                self._replication_frame,
+                heartbeat=REPLICATION_HEARTBEAT_SECONDS,
+                heartbeat_fn=self._replication_control_line,
+            )
+        return self._error(404, f"unrecognised path {path}", start_response)
+
+    def _replication_frame(self, item) -> bytes:
+        etype, obj = item
+        if etype == "REGISTER":
+            return (
+                b'{"type": "REGISTER", "object": '
+                + serialize.dumps(obj)
+                + b"}\n"
+            )
+        if self.bytes_cache is not None:
+            # the same cached bytes every watch subscriber of this
+            # event fans out — shipping serializes nothing new
+            return self.bytes_cache.event_bytes(etype, obj)
+        return (
+            json.dumps({"type": etype, "object": obj}).encode()  # dumps-ok: legacy baseline (fast_serialize=False)
+            + b"\n"
+        )
+
+    def _replication_control_line(self) -> bytes:
+        return (
+            serialize.dumps(
+                {
+                    "type": "CONTROL",
+                    "rv": self.server.applied_rv(),
+                    "epoch": getattr(self.server, "replication_epoch", 0),
+                    "ts": time.time(),
+                }
+            )
+            + b"\n"
+        )
+
+    def _rv_headers(self) -> list[tuple[str, str]]:
+        """``X-Served-RV``: the applied-rv horizon this read was served
+        at — on a follower, the bounded-staleness contract made
+        visible per response."""
+        fn = getattr(self.server, "applied_rv", None)
+        return [("X-Served-RV", str(fn()))] if fn is not None else []
+
+    def _await_rv(self, rv) -> None:
+        """rv-pinned read against a store that can lag (a follower
+        replica): wait — bounded — for replication to reach the pinned
+        horizon, else 410 (the wait-or-410 contract). The leader has
+        no ``wait_for_rv``: every rv it ever issued is already
+        applied when a read runs, so the pin is a no-op there."""
+        if rv is None:
+            return
+        wait_fn = getattr(self.server, "wait_for_rv", None)
+        if wait_fn is None:
+            return
+        try:
+            wait_fn(int(rv))  # Expired on timeout → the 410 mapping
+        except (TypeError, ValueError):
+            raise Invalid(f"resourceVersion {rv!r} is not numeric") from None
+
     def _dispatch(self, kind, route, method, qs, environ, start_response):
         ns, name = route.namespace, route.name
 
@@ -534,21 +655,35 @@ class RestAPI:
             if qs.get("watch", ["false"])[0] in ("true", "1"):
                 send_initial = qs.get("sendInitialEvents", ["true"])[0] != "false"
                 rv = qs.get("resourceVersion", [None])[0]
+                # a replica waits (bounded) for its replication stream
+                # to reach a pinned resume rv before opening — the
+                # wait-or-410 half of the bounded-staleness contract
+                self._await_rv(rv)
                 # the watch opens BEFORE streaming starts so a 410
                 # Expired resume surfaces as a proper Status response
                 # (raised here → the APIError handler), not a broken
-                # stream
+                # stream. inline=False: HTTP streams are fanned out by
+                # the store's dispatcher shards, never the mutator.
                 w = self.server.watch(
                     kind,
                     namespace=ns,
                     send_initial=send_initial,
                     resource_version=rv,
+                    inline=False,
                 )
                 start_response(
                     "200 OK",
                     [("Content-Type", "application/json"), ("X-Stream", "watch")],
                 )
                 return self._watch_stream(w)
+            # rv-pinned list against a replica: wait for the horizon
+            # (or 410), then serve — reads never go back in time past
+            # an rv the client already observed on the leader
+            self._await_rv(qs.get("resourceVersion", [None])[0])
+            # the horizon header is read BEFORE the list: a racing
+            # writer can only make the served state NEWER than the
+            # advertised rv, never staler
+            rv_hdrs = self._rv_headers()
             selector = None
             if "labelSelector" in qs:
                 selector = obj_util.parse_selector_string(qs["labelSelector"][0])
@@ -588,6 +723,7 @@ class RestAPI:
                             kind, items, continue_token=token
                         ),
                         start_response,
+                        headers=rv_hdrs,
                     )
                 return self._json(
                     200,
@@ -598,6 +734,7 @@ class RestAPI:
                         "items": items,
                     },
                     start_response,
+                    headers=rv_hdrs,
                 )
             ver_fn = getattr(self.server, "kind_version", None)
             if self.bytes_cache is not None and ver_fn is not None:
@@ -618,7 +755,7 @@ class RestAPI:
                     )
                     payload = self.bytes_cache.list_bytes(kind, items)
                     self.bytes_cache.store_list_payload(lkey, payload)
-                return self._raw(200, payload, start_response)
+                return self._raw(200, payload, start_response, headers=rv_hdrs)
             items = self.server.list(kind, namespace=ns, label_selector=selector)
             if self.bytes_cache is not None:
                 # composed from per-object cached bytes: a repeat list
@@ -628,16 +765,22 @@ class RestAPI:
                     200,
                     self.bytes_cache.list_bytes(kind, items),
                     start_response,
+                    headers=rv_hdrs,
                 )
             return self._json(
                 200,
                 {"kind": f"{kind}List", "apiVersion": "v1", "items": items},
                 start_response,
+                headers=rv_hdrs,
             )
 
         if method == "GET":
+            self._await_rv(qs.get("resourceVersion", [None])[0])
             return self._object(
-                200, self.server.get(kind, name, ns), start_response
+                200,
+                self.server.get(kind, name, ns),
+                start_response,
+                headers=self._rv_headers(),
             )
 
         if method == "POST" and name is None:

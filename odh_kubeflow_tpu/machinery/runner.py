@@ -14,6 +14,22 @@ import time
 from typing import Callable
 
 
+def _split_reads(api):
+    """``READ_FROM_REPLICA=<url>``: serve this component's reads —
+    lists, watches (so the informer cache feeds off the replica), and
+    gets — from a follower replica, writes from the leader as before.
+    The replica's bounded-staleness contract (X-Served-RV horizon,
+    wait-or-410 on pinned rvs) rides along; unset = everything to the
+    leader, exactly the old wiring."""
+    read_url = os.environ.get("READ_FROM_REPLICA", "")
+    if not read_url:
+        return api
+    from odh_kubeflow_tpu.machinery.client import api_from_env
+    from odh_kubeflow_tpu.machinery.replica import ReadSplitAPI
+
+    return ReadSplitAPI(api, api_from_env(url=read_url))
+
+
 def _wrap_cached(api):
     """Front the remote api with the informer-backed shared cache
     (reads become watch-fed, indexed, zero-copy; writes pass through).
@@ -77,7 +93,7 @@ def run_controller(name: str, register: Callable) -> None:
     raw = api_from_env()
     _install_span_exporter(raw)
     api = maybe_wrap(raw)
-    api, cache = _wrap_cached(api)
+    api, cache = _wrap_cached(_split_reads(api))
 
     elector = None
     shard = None
@@ -156,6 +172,17 @@ def run_controller(name: str, register: Callable) -> None:
             shard.leave()
 
 
+def run_replica(name: str = "replica") -> None:
+    """``REPLICA_OF=<leader-url>``: run a follower read replica — WAL
+    stream pulled from the leader, list/watch served locally, writes
+    307'd back at the leader. Deployment shape: one leader + N of
+    these behind a read load balancer, with controllers/web apps
+    pointed at them via ``READ_FROM_REPLICA``."""
+    from odh_kubeflow_tpu.machinery.replica import serve_replica
+
+    serve_replica()
+
+
 def run_web(name: str, default_port: int, build: Callable) -> None:
     """``build(api)`` returns an object exposing a ``.app`` WSGI app."""
     from odh_kubeflow_tpu.machinery.client import api_from_env
@@ -163,7 +190,7 @@ def run_web(name: str, default_port: int, build: Callable) -> None:
 
     raw = api_from_env()
     _install_span_exporter(raw)
-    api, cache = _wrap_cached(maybe_wrap(raw))
+    api, cache = _wrap_cached(_split_reads(maybe_wrap(raw)))
     if cache is not None:
         cache.start(live=True)
         cache.wait_for_sync()

@@ -23,8 +23,13 @@ Threading: a single re-entrant lock serialises mutation PREPARES
 records flow through a group-commit pipeline — a committer thread
 covers each batch of concurrent writers with one fsync, applies in rv
 order, and releases each waiter only after the fsync that covers its
-record (ack-after-durable). Watch delivery is synchronous enqueue at
-apply time; consumers drain from their own queue.
+record (ack-after-durable). Watch delivery: in-process consumers are
+enqueued synchronously at apply time (read-your-writes through the
+informer poke); serving-tier streams (HTTP watches, replication
+feeds) are fanned out by K dispatcher threads, rendezvous-hashed per
+watcher, so a mutation pays one queue put per shard instead of one
+per subscriber. Consumers drain from their own (bounded) queue; a
+consumer that falls more than the bound behind is closed with 410.
 """
 
 from __future__ import annotations
@@ -33,6 +38,7 @@ import base64
 import bisect
 import contextvars
 import datetime
+import hashlib
 import json
 import logging
 import os
@@ -133,6 +139,20 @@ class FencedOut(APIError):
     403, not 409: this is an authority failure, not a data race."""
 
     code = 403
+
+
+class NotLeader(APIError):
+    """A mutation was sent to a read replica. Replicas serve list/watch
+    only; the client must retry the write against the leader, whose
+    URL rides in ``leader_url`` (the REST façade answers with a
+    kube-style 307 + ``Location`` and a Status whose reason is
+    ``NotLeader``)."""
+
+    code = 307
+
+    def __init__(self, message: str = "", leader_url: str = ""):
+        super().__init__(message)
+        self.leader_url = leader_url
 
 
 @dataclass
@@ -311,18 +331,39 @@ class Watch:
     chaos) sets ``ended = True`` (and ``error`` when there is one)
     before enqueueing the ``None`` sentinel, so consumers can tell
     "the stream broke — relist" apart from "I asked it to stop"
-    (``_stopped``). The embedded in-process watch never ends on its
-    own."""
+    (``_stopped``).
 
-    def __init__(self, server: "APIServer", kind: str, namespace: Optional[str]):
+    ``maxsize`` bounds the undrained event backlog (kube "too old"
+    semantics): a consumer that falls more than ``maxsize`` events
+    behind is CLOSED with 410 Expired (``evicted = True``) instead of
+    growing server memory without bound — by then the watch cache has
+    compacted past it anyway, so an rv resume would 410 too; the
+    consumer relists, exactly the stream-loss path it already handles.
+    0 disables (client-side pumps bound their own memory). ``kind`` of
+    ``None`` is the replication feed: every kind, every namespace."""
+
+    def __init__(
+        self,
+        server: "APIServer",
+        kind: Optional[str],
+        namespace: Optional[str],
+        maxsize: int = 0,
+    ):
         self._q: "queue.Queue[Optional[tuple[str, Obj]]]" = queue.Queue()
         self._server = server
         self.kind = kind
         self.namespace = namespace
+        self.maxsize = maxsize
         self._stopped = False
         self.ended = False
+        self.evicted = False
         self.error: Optional[Exception] = None
         self._notify_cb: Optional[Callable[[], None]] = None
+        # dispatcher shard index (None = inline delivery at apply time)
+        self._shard: Optional[int] = None
+        # burst-dispatch scratch flag, touched only by the one
+        # dispatcher thread that owns this watch's shard
+        self._burst_mark = False
 
     def set_notify(self, fn: Optional[Callable[[], None]]) -> None:
         """Register a wake callback fired (from the enqueuing thread)
@@ -344,9 +385,34 @@ class Watch:
             except RuntimeError:
                 pass  # the consumer's event loop is shutting down
 
-    def _enqueue(self, event: tuple[str, Obj]) -> None:
-        if not self._stopped:
-            self._q.put(event)
+    def _enqueue(self, event: tuple[str, Obj], wake: bool = True) -> None:
+        """``wake=False`` defers the notify callback — the dispatch
+        shards deliver bursts and wake each touched consumer ONCE per
+        burst instead of once per event (the wake is a
+        ``call_soon_threadsafe`` hop into the event loop, and per-event
+        it was the dominant leader-side cost of fanout)."""
+        # evicted (not merely ended) also stops enqueues: consumers —
+        # and tests — may mark a stream `ended` to simulate loss while
+        # a drain is still catching up on its queue
+        if self._stopped or self.evicted:
+            return
+        if self.maxsize and self._q.qsize() >= self.maxsize:
+            # slow consumer: close with 410 rather than buffer without
+            # bound. The error is set BEFORE the sentinel so the
+            # consumer's drain sees a dead stream with a reason, never
+            # a live-looking empty queue.
+            self.evicted = True
+            self.error = Expired(
+                f"watch consumer fell more than {self.maxsize} events "
+                "behind and was evicted; relist and re-watch"
+            )
+            self.ended = True
+            self._q.put(None)
+            self._wake()
+            self._server._evict_watch(self)
+            return
+        self._q.put(event)
+        if wake:
             self._wake()
 
     def stop(self) -> None:
@@ -387,6 +453,38 @@ class Watch:
         return item
 
 
+class _WatchShard:
+    """One watch-dispatch shard: a FIFO of applied events and the
+    dispatcher thread that fans them out to this shard's watchers.
+    ``watchers`` is a copy-on-write tuple (replaced under the store
+    lock, read lock-free by the dispatcher) so fanout never contends
+    with registration."""
+
+    __slots__ = ("q", "thread", "watchers")
+
+    def __init__(self):
+        self.q: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        self.thread: Optional[threading.Thread] = None
+        self.watchers: tuple[Watch, ...] = ()
+
+
+def _rendezvous_shard(token: str, shards: int) -> int:
+    """Highest-random-weight choice of dispatch shard for a watcher —
+    the same rendezvous scheme ``machinery.leader`` uses for namespace
+    ownership, so adding a shard moves only 1/K of the watchers."""
+    best, best_w = 0, -1
+    for i in range(shards):
+        w = int.from_bytes(
+            hashlib.blake2b(
+                f"{token}|{i}".encode(), digest_size=8
+            ).digest(),
+            "big",
+        )
+        if w > best_w:
+            best, best_w = i, w
+    return best
+
+
 class APIServer:
     # retained watch-cache window (events, not seconds): a watch may
     # resume from any resourceVersion still inside it; older resumes
@@ -394,6 +492,27 @@ class APIServer:
     # Class attr so chaos tests shrink it to force expiry; the
     # WATCH_CACHE_SIZE env var overrides per process (fleet sizing).
     WATCH_CACHE_SIZE = 2048
+
+    # watch-dispatch shards (K dispatcher threads): serving-tier
+    # watches (HTTP streams, replication feeds) are rendezvous-hashed
+    # across K dispatcher threads, so a mutation enqueues at most K
+    # items instead of one per subscriber — at 1000 streams the old
+    # mutator-thread fanout WAS the write path. In-process consumers
+    # (informer caches, controller tests) stay inline: their
+    # synchronous enqueue-at-apply is what gives read-your-writes
+    # through CachedClient.poke. 0 = everything inline (the pre-PR
+    # fanout). Env: WATCH_DISPATCH_SHARDS.
+    WATCH_DISPATCH_SHARDS = 4
+
+    # dispatcher coalescing window (ms): after picking up work, a
+    # dispatch shard sleeps this long so one fanout pass covers the
+    # whole commit burst. Milliseconds of added delivery latency buy
+    # the write path its batches back — measured at 12 writers + 2
+    # replication streams, per-event dispatch wakes interleaved the
+    # GIL so hard that leader ingest dropped 25%; with a 2ms coalesce
+    # the tax is ~5% and fanout p99 stays far inside the 26ms gate.
+    # 0 disables. Env: WATCH_DISPATCH_COALESCE_MS.
+    WATCH_DISPATCH_COALESCE_MS = 2
 
     # mutations between WAL snapshots (when a WAL is attached);
     # overridable per instance and via SNAPSHOT_INTERVAL in the
@@ -476,6 +595,29 @@ class APIServer:
         self.EVENT_RETENTION = _env_int(
             "EVENT_RETENTION", type(self).EVENT_RETENTION
         )
+        self.WATCH_DISPATCH_SHARDS = _env_int(
+            "WATCH_DISPATCH_SHARDS", type(self).WATCH_DISPATCH_SHARDS
+        )
+        self.WATCH_DISPATCH_COALESCE_MS = _env_int(
+            "WATCH_DISPATCH_COALESCE_MS",
+            type(self).WATCH_DISPATCH_COALESCE_MS,
+        )
+        # sharded watch dispatch (started lazily on the first
+        # dispatcher-delivered watch); _inline_watches is the subset of
+        # _watches delivered synchronously at apply time. The delivery
+        # buffer batches shard puts across one group-commit apply
+        # (set/flushed by the committer under the store lock).
+        self._shards: list[_WatchShard] = []
+        self._inline_watches: list[Watch] = []
+        self._delivery_buffer: Optional[list[tuple]] = None
+        self._watch_seq = 0  # stable per-watch shard-hash token
+        # slow consumers closed with 410 (watch_consumers_evicted_total)
+        self.watch_evictions = 0
+        self._evictions_seen = 0
+        # replication: the epoch this store ships under (a promoted
+        # leader's ShardMembership fencing token; 0 = never fenced).
+        # Followers reject streams from a lower epoch (FencedOut).
+        self.replication_epoch = 0
         # clock for fence-expiry validation; injectable so fake-clock
         # leader-election tests and the store agree on "now"
         self.fence_now_fn: Callable[[], float] = time.time
@@ -529,6 +671,21 @@ class APIServer:
             self._store.setdefault(kind, {})
             self._ns_buckets.setdefault(kind, {})
             self._sorted_keys.setdefault(kind, [])
+            # a dynamic registration must also reach follower replicas,
+            # or replicated objects of the kind would hit an unknown
+            # type on apply — same reason the WAL logs it below
+            if fresh and not self._replaying and kind not in _BUILTIN_KIND_NAMES:
+                self._deliver_event(
+                    "REGISTER",
+                    {
+                        "apiVersion": api_version,
+                        "kind": kind,
+                        "plural": plural,
+                        "namespaced": namespaced,
+                    },
+                    kind=None,
+                    ns="",
+                )
             # dynamic (CRD) registrations must survive a restart or the
             # replay of their objects would hit an unknown kind; builtin
             # kinds re-register from code, so only log the rest
@@ -663,8 +820,16 @@ class APIServer:
         ``wal_fsync_total`` (one per group-commit batch),
         ``wal_group_commit_batch_size`` (records covered by each
         fsync), and ``wal_commit_ack_seconds`` (prepare → durable ack,
-        the latency every writer actually waits). No-op without a WAL
-        — the in-memory store has no durability pipeline to meter."""
+        the latency every writer actually waits), plus
+        ``watch_consumers_evicted_total`` (slow watch consumers closed
+        with 410 by the bounded-backlog contract — WAL or not). The
+        WAL pipeline metrics are a no-op without a WAL."""
+        self._m_evicted = registry.counter(
+            "watch_consumers_evicted_total",
+            "Watch consumers closed with 410 Expired after falling "
+            "more than the bounded backlog behind",
+        )
+        registry.register_collector(self._flush_eviction_counter)
         if self._wal is None:
             return
         self._m_fsync = registry.counter(
@@ -699,6 +864,17 @@ class APIServer:
                     self._wal_fsync_seen = n
         return ()
 
+    def _flush_eviction_counter(self):
+        # same scrape-time delta-flush idiom as the fsync counter: the
+        # hot path bumps a plain int, the family catches up on scrape
+        with self._wal_metrics_lock:
+            n = self.watch_evictions
+            delta = n - self._evictions_seen
+            if delta > 0:
+                self._m_evicted.inc(by=delta)
+                self._evictions_seen = n
+        return ()
+
     def debug_queues(self) -> Obj:
         """Live pipeline depths for the /debug/queues zpage."""
         with self._lock:
@@ -710,6 +886,13 @@ class APIServer:
                 "batchHighWater": self._batch_hwm,
                 "groupCommit": self.group_commit,
                 "failStop": self._wal_broken,
+            },
+            "watchDispatch": {
+                "shards": len(self._shards),
+                "queueDepths": [s.q.qsize() for s in self._shards],
+                "watchersPerShard": [len(s.watchers) for s in self._shards],
+                "inlineWatchers": len(self._inline_watches),
+                "evictedTotal": self.watch_evictions,
             },
             "wal": None,
         }
@@ -878,15 +1061,37 @@ class APIServer:
                 # the fsync covers every active writer. The previous
                 # batch size is the high-water mark — once this batch
                 # matches it every released writer is back in, so stop
-                # lingering immediately. A lone serial writer pays at
-                # most ONE empty linger round — far less than the
-                # fsync it amortizes.
-                for _ in range(8):
+                # lingering immediately. An empty round no longer ends
+                # the linger on its own: when serving threads (watch
+                # dispatch, replication streams) contend for the GIL,
+                # writers routinely need more than one 0.2ms window to
+                # re-prepare, and giving up early halved batch sizes —
+                # doubling fsyncs/record — the moment followers
+                # attached. Two consecutive empty rounds still mean
+                # the writers are genuinely gone. A lone serial writer
+                # (hwm 1) pays no linger at all.
+                # budget scales with the high-water mark: under GIL
+                # contention each writer's re-prepare can span several
+                # 0.2ms windows (a serving thread may hold the GIL for
+                # a full 5ms switch interval between arrivals), and a
+                # fixed 8-round budget capped batches well below the
+                # active writer count (0.084 → 0.12 fsyncs/record with
+                # two replication streams attached — the entire
+                # measured shipping tax was lost batching, not bytes).
+                # Four consecutive empty rounds mean the writers are
+                # genuinely gone; a full batch still breaks instantly,
+                # so the steady state pays no trailing linger at all.
+                empty = 0
+                for _ in range(8 + 2 * self._batch_hwm):
                     if len(batch) >= self._batch_hwm:
                         break
                     time.sleep(self.GROUP_COMMIT_LINGER)
-                    if not _drain():
-                        break
+                    if _drain():
+                        empty = 0
+                    else:
+                        empty += 1
+                        if empty >= 4:
+                            break
                 self._batch_hwm = len(batch)
             groups = [batch] if self.group_commit else [[e] for e in batch]
             for gi, group in enumerate(groups):
@@ -906,13 +1111,24 @@ class APIServer:
                 # the log→fsync→apply→ack ordering's critical window
                 _schedule.sched_point("store.commit.apply")
                 with self._lock:
-                    for e in group:
-                        if e.etype != "register":
-                            self._apply_record(
-                                e.etype, e.kind, e.key, e.obj, e.rv
-                            )
-                        if self._pending.get((e.kind, e.key)) is e:
-                            del self._pending[(e.kind, e.key)]
+                    # buffer sharded watch delivery across the whole
+                    # batch apply: one shard put per batch, not per
+                    # record (see _deliver_event — per-record puts
+                    # mid-apply broke group-commit batching)
+                    self._delivery_buffer = []
+                    try:
+                        for e in group:
+                            if e.etype != "register":
+                                self._apply_record(
+                                    e.etype, e.kind, e.key, e.obj, e.rv
+                                )
+                            if self._pending.get((e.kind, e.key)) is e:
+                                del self._pending[(e.kind, e.key)]
+                    finally:
+                        buffered = self._delivery_buffer
+                        self._delivery_buffer = None
+                        if buffered:
+                            self._flush_delivery(buffered)
                 if self._m_batch is not None:
                     self._m_batch.observe(len(group))
                 ack_t = time.perf_counter()
@@ -981,9 +1197,15 @@ class APIServer:
         with self._lock:
             self._closed = True
             committer, self._committer = self._committer, None
+            shards, self._shards = self._shards, []
         if committer is not None:
             self._commitq.put(None)
             committer.join(timeout=30)
+        for shard in shards:
+            shard.q.put(None)
+        for shard in shards:
+            if shard.thread is not None:
+                shard.thread.join(timeout=10)
 
     def _maybe_snapshot(self) -> None:
         """Snapshot cadence check — runs on the committer thread at a
@@ -1629,12 +1851,22 @@ class APIServer:
         namespace: Optional[str] = None,
         send_initial: bool = True,
         resource_version: Optional[str] = None,
+        inline: bool = True,
     ) -> Watch:
         """Open a watch stream. ``resource_version`` resumes from a
         previously observed rv: events after it replay from the watch
         cache, then the stream goes live — no initial ADDED dump. A
         resume point older than the retained window raises
-        :class:`Expired` (410); the caller must relist."""
+        :class:`Expired` (410); resuming exactly AT the compaction
+        floor is fine (that client saw the newest dropped event, and
+        everything after it is still retained). The caller must relist
+        on 410.
+
+        ``inline=False`` routes live delivery through the sharded
+        watch dispatcher (the serving tier's posture — the REST façade
+        passes it for every HTTP stream); the replay below still runs
+        synchronously under the lock, and shard registration happens
+        under the same hold, so no event can land between them."""
         info = self.type_info(kind)
         with self._lock:
             w = Watch(self, kind, namespace)
@@ -1667,13 +1899,198 @@ class APIServer:
                     items = self._store[kind].values()
                 for item in items:
                     w._enqueue(("ADDED", obj_util.freeze(item)))
-            self._watches.append(w)
+            # the slow-consumer bound covers the LIVE backlog on top of
+            # whatever the replay/initial dump just queued — a fleet-
+            # sized initial sync must not evict its own consumer before
+            # it gets a chance to drain
+            w.maxsize = w._q.qsize() + self.WATCH_CACHE_SIZE
+            self._register_watch(w, inline=inline)
             return w
+
+    def replication_watch(self, from_rv: int = 0, inline: bool = False) -> Watch:
+        """A follower replica's feed: every committed record of every
+        kind, in rv order — replayed from the watch cache above
+        ``from_rv``, then live. The same 410 contract as a watch
+        resume: ``from_rv`` below the compaction floor raises
+        :class:`Expired` and the follower must catch up from a
+        snapshot (``replication_cut``) instead. Dynamic kind
+        registrations arrive as ``("REGISTER", typeinfo)`` records.
+        Delivery is dispatcher-sharded: shipping costs the write path
+        one queue put, not a per-record serialize-and-send."""
+        with self._lock:
+            if from_rv < self._compacted_rv:
+                raise Expired(
+                    f"replication resume rv {from_rv} predates the "
+                    f"compacted window (oldest resumable is "
+                    f"{self._compacted_rv}); catch up from a snapshot"
+                )
+            w = Watch(self, None, None)
+            # non-builtin registrations first: replayed objects of a
+            # dynamic kind must find their type registered
+            for t in self._types.values():
+                if t.kind not in _BUILTIN_KIND_NAMES:
+                    w._enqueue(
+                        (
+                            "REGISTER",
+                            {
+                                "apiVersion": t.api_version,
+                                "kind": t.kind,
+                                "plural": t.plural,
+                                "namespaced": t.namespaced,
+                            },
+                        )
+                    )
+            for erv, _kind, _ns, etype, obj in self._event_log:
+                if erv > from_rv:
+                    w._enqueue((etype, obj))
+            w.maxsize = w._q.qsize() + self.WATCH_CACHE_SIZE
+            # inline=True is the deterministic in-process shipper's
+            # mode (drills); the serving tier ships dispatcher-sharded
+            self._register_watch(w, inline=inline)
+            return w
+
+    def replication_cut(self) -> Obj:
+        """A consistent full-state cut for follower cold catch-up —
+        the snapshot shape (`rv`, `types`, `objects`, `kind_rv`,
+        `compacted_rv`, `events`) plus the shipping epoch. Pointer
+        collection under the lock; serialization is the caller's
+        (off-lock, same discipline as ``snapshot_now``)."""
+        state = self._snapshot_cut()
+        state["epoch"] = self.replication_epoch
+        return state
+
+    def applied_rv(self) -> int:
+        """The durable-and-applied rv horizon reads are served at (the
+        ``X-Served-RV`` header on the wire). On a follower this is the
+        replication high-water mark — the bounded-staleness surface."""
+        with self._lock:
+            return self._applied_rv
+
+    def state_digest(self) -> str:
+        """sha256 over the canonical serialization of every applied
+        object in deterministic (kind, key) order — bit-identity
+        evidence for the replication coherence drills (two stores with
+        equal digests serve byte-identical reads)."""
+        h = hashlib.sha256()
+        with self._lock:
+            for kind in sorted(self._store):
+                per_kind = self._store[kind]
+                for key in sorted(per_kind):
+                    h.update(serialize.dumps(per_kind[key]))
+        return h.hexdigest()
+
+    # -- watch dispatch (sharded fanout) ------------------------------------
+
+    def _register_watch(self, w: Watch, inline: bool) -> None:
+        """Called under the store lock. Inline watches join the
+        synchronous fanout; dispatcher watches are rendezvous-hashed
+        onto a shard (started lazily) by a stable per-watch token —
+        their registration ordinal. With the process-fixed shard count
+        this is a deterministic balanced spread (the cost is K tiny
+        digests once per REGISTRATION, never per event); the HRW form
+        is kept deliberately so live shard resizing, if ever added,
+        inherits minimal reassignment instead of a full mod-K
+        reshuffle — the same scheme namespace ownership already uses
+        in machinery.leader."""
+        self._watches.append(w)
+        if inline or self.WATCH_DISPATCH_SHARDS <= 0:
+            self._inline_watches.append(w)
+            return
+        self._ensure_shards()
+        if not self._shards:
+            # racing close(): no dispatchers will ever run — deliver
+            # inline so the registration degrades cleanly instead of
+            # indexing an empty shard list
+            self._inline_watches.append(w)
+            return
+        self._watch_seq += 1
+        sid = _rendezvous_shard(f"w{self._watch_seq}", len(self._shards))
+        w._shard = sid
+        shard = self._shards[sid]
+        shard.watchers = shard.watchers + (w,)
+
+    def _ensure_shards(self) -> None:
+        if self._shards or self._closed:
+            return
+        for i in range(self.WATCH_DISPATCH_SHARDS):
+            shard = _WatchShard()
+            shard.thread = threading.Thread(
+                target=self._dispatch_loop,
+                args=(shard,),
+                name=f"apiserver-watch-dispatch-{i}",
+                daemon=True,
+            )
+            self._shards.append(shard)
+        for shard in self._shards:
+            shard.thread.start()
+
+    def _dispatch_loop(self, shard: _WatchShard) -> None:
+        """One dispatch shard: pop applied events in rv order, fan out
+        to this shard's watchers. No store lock is ever taken on the
+        fast path — the watcher tuple is copy-on-write and per-watcher
+        queues are thread-safe; eviction of a slow consumer (inside
+        ``_enqueue``) is the only re-entry into the store.
+
+        Events are drained in BURSTS (the group committer applies in
+        batches, so they arrive in batches) and each touched consumer
+        is woken once per burst: per-event wakes cost a
+        ``call_soon_threadsafe`` into the serving loop each, and at
+        ingest rate they — not the enqueues — were the tax on the
+        write path."""
+        while True:
+            item = shard.q.get()
+            if item is None:
+                return
+            if self.WATCH_DISPATCH_COALESCE_MS:
+                # coalesce: let the commit burst (and the next one)
+                # finish landing so one fanout pass + one wake per
+                # consumer covers it all — NOT under any lock
+                time.sleep(self.WATCH_DISPATCH_COALESCE_MS / 1000.0)
+            burst = [item]  # each item is a LIST of events (one batch)
+            done = False
+            while True:
+                try:
+                    nxt = shard.q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    done = True
+                    break
+                burst.append(nxt)
+            touched: list[Watch] = []
+            for events in burst:
+                for etype, obj, kind, ns in events:
+                    for w in shard.watchers:
+                        if self._watch_match(w, kind, ns):
+                            w._enqueue((etype, obj), wake=False)
+                            if not w._burst_mark:
+                                w._burst_mark = True
+                                touched.append(w)
+            for w in touched:
+                w._burst_mark = False
+                w._wake()
+            if done:
+                return
 
     def _remove_watch(self, w: Watch) -> None:
         with self._lock:
             if w in self._watches:
                 self._watches.remove(w)
+            if w in self._inline_watches:
+                self._inline_watches.remove(w)
+            if w._shard is not None and w._shard < len(self._shards):
+                shard = self._shards[w._shard]
+                shard.watchers = tuple(
+                    x for x in shard.watchers if x is not w
+                )
+
+    def _evict_watch(self, w: Watch) -> None:
+        """A slow consumer was closed with 410 by its own `_enqueue`
+        (the bounded-backlog contract); deregister it and count the
+        eviction (`watch_consumers_evicted_total`)."""
+        self._remove_watch(w)
+        with self._lock:
+            self.watch_evictions += 1
 
     def kind_version(self, kind: str) -> int:
         """The resourceVersion of the last mutation that touched
@@ -1714,12 +2131,46 @@ class APIServer:
             self._compacted_rv = max(
                 self._compacted_rv, self._event_log.popleft()[0]
             )
-        for w in list(self._watches):
-            if w.kind != kind:
-                continue
-            if w.namespace and w.namespace != ns:
-                continue
-            w._enqueue((event_type, shared))
+        self._deliver_event(event_type, shared, kind, ns)
+
+    @staticmethod
+    def _watch_match(w: Watch, kind: Optional[str], ns: str) -> bool:
+        if w.kind is None:
+            return True  # replication feed: every kind, every namespace
+        if kind is None:
+            return False  # control records (REGISTER) are feed-only
+        if w.kind != kind:
+            return False
+        return not w.namespace or w.namespace == ns
+
+    def _deliver_event(
+        self, event_type: str, obj: Obj, kind: Optional[str], ns: str
+    ) -> None:
+        """Fan one applied event out. Inline watchers (in-process
+        informers, tests) are enqueued synchronously at apply time —
+        the embedded read-your-writes contract. Dispatcher-delivered
+        watchers (HTTP streams, replication feeds) cost the mutator
+        ONE queue put per nonempty shard — and when the group
+        committer is applying a batch, one put per shard per BATCH
+        (``_delivery_buffer``): per-record puts inside the apply
+        window handed the GIL to the dispatcher mid-batch, writers
+        re-enqueued late, batches shrank, and fsyncs/record nearly
+        doubled — the shipping tax was never the bytes, it was the
+        lost batching. Runs under the store lock, so delivery order ==
+        rv order."""
+        for w in list(self._inline_watches):
+            if self._watch_match(w, kind, ns):
+                w._enqueue((event_type, obj))
+        item = (event_type, obj, kind, ns)
+        if self._delivery_buffer is not None:
+            self._delivery_buffer.append(item)
+        else:
+            self._flush_delivery([item])
+
+    def _flush_delivery(self, items: list[tuple]) -> None:
+        for shard in self._shards:
+            if shard.watchers:
+                shard.q.put(items)
 
     # -- convenience --------------------------------------------------------
 

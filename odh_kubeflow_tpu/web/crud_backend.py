@@ -224,6 +224,22 @@ class CrudBackend:
         body: dict[str, Any] = {field: rows}
         if degraded:
             body["degraded"] = True
+        # replica-read deployments (READ_FROM_REPLICA): stamp the rv
+        # horizon the backing replica served at, so API consumers see
+        # the bounded-staleness contract instead of guessing. Scoped to
+        # actual replica reads (a ReadSplitAPI or a follower store) —
+        # leader-served listings keep their exact pre-replica shape.
+        target = getattr(self.api, "read_api", None)
+        if target is None and getattr(self.api, "is_follower", False):
+            target = self.api
+        rv_fn = getattr(target, "applied_rv", None)
+        if rv_fn is not None:
+            try:
+                served = rv_fn()
+            except APIError:
+                served = None  # backend blip: the rows still stand
+            if served is not None:
+                body["servedRv"] = int(served)
         return body
 
     # -- listing pagination -------------------------------------------------

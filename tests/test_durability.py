@@ -1014,3 +1014,132 @@ def test_session_checkpoint_receipts_survive_restart(tmp_path):
     store.delete("uid-1")
     rows = mgr.verify_receipts()
     assert rows and not rows[0]["ok"]
+
+
+# ---------------------------------------------------------------------------
+# read-replica failover (ISSUE 13): leader dies mid-stream, a follower
+# promotes under a bumped fencing epoch, the zombie stream is rejected
+
+
+def test_replica_failover_drill_promote_follower_and_fence_old_leader():
+    """Kill the leader mid-replication-stream and promote the follower
+    via the lease machinery (ShardMembership liveness + the leader
+    lease's monotonic fencing token). Asserts:
+
+    - a client watching THROUGH the follower sees a contiguous,
+      duplicate-free event history across the handover (everything the
+      follower applied plus the promoted leader's own writes);
+    - the promoted follower reuses the rv number space the dead leader
+      never shipped — which is exactly why the deposed stream must be
+      rejected by EPOCH (``FencedOut``), not by rv;
+    - the async-replication loss window is explicit: records the dead
+      leader committed but never shipped do not resurrect."""
+    from odh_kubeflow_tpu.machinery.leader import LeaderElector
+    from odh_kubeflow_tpu.machinery.replica import (
+        InProcessReplication,
+        ReplicaStore,
+    )
+
+    lease = 1.0
+    coord = APIServer()  # the control cluster holding the leases
+    leader = APIServer()
+    leader.register_kind("kubeflow.org/v1", "Widget", "widgets")
+    follower = ReplicaStore()
+    ship = InProcessReplication(leader, follower)
+
+    m_lead = ShardMembership(
+        coord, "repl", identity="leader", namespace="default",
+        lease_duration=lease, renew_period=0.05, retry_period=0.02,
+    )
+    m_fol = ShardMembership(
+        coord, "repl", identity="follower", namespace="default",
+        lease_duration=lease, renew_period=0.05, retry_period=0.02,
+    )
+    assert m_lead.join() and m_fol.join()
+    e_lead = LeaderElector(
+        coord, "repl-leader", namespace="default", identity="leader",
+        lease_duration=lease, renew_period=0.05, retry_period=0.02,
+    )
+    assert e_lead.acquire(timeout=5)
+    leader.replication_epoch = e_lead.token
+    old_epoch = e_lead.token
+
+    def widget(name, v=0):
+        return {"kind": "Widget",
+                "metadata": {"name": name, "namespace": "a"},
+                "spec": {"v": v}}
+
+    # ship the Widget REGISTER record, then open the client watch
+    # THROUGH the follower (the read path under test)
+    assert ship.step() == 1
+    client = follower.watch("Widget", namespace="a", send_initial=False)
+
+    for i in range(15):
+        leader.create(widget(f"w{i:02d}", v=i))
+    # mid-stream: only the first 10 records ship before the leader
+    # dies (no renew; its leases age out)
+    applied = ship.step(budget=10)
+    assert applied == 10, applied
+    shipped_horizon = follower.applied_rv()
+    ship.drop_stream()
+
+    # the follower observes the leader age out of the membership, then
+    # takes the leader lease over — the bumped token IS the new epoch
+    deadline = time.monotonic() + 15 * lease
+    while time.monotonic() < deadline:
+        m_fol.join()
+        if m_fol.members(fresh=True) == ["follower"]:
+            break
+        time.sleep(0.05)
+    assert m_fol.members(fresh=True) == ["follower"], "leader never aged out"
+    e_fol = LeaderElector(
+        coord, "repl-leader", namespace="default", identity="follower",
+        lease_duration=lease, renew_period=0.05, retry_period=0.02,
+    )
+    assert e_fol.acquire(timeout=15 * lease), "takeover never happened"
+    assert e_fol.token == old_epoch + 1, (e_fol.token, old_epoch)
+    follower.promote(e_fol.token)
+
+    # the promoted follower serves writes from its replicated horizon
+    promoted_rvs = []
+    for i in range(5):
+        created = follower.create(widget(f"p{i}", v=100 + i))
+        promoted_rvs.append(int(created["metadata"]["resourceVersion"]))
+    # it REUSES rv numbers the dead leader assigned but never shipped —
+    # rv cannot disambiguate the two histories, only the epoch can
+    assert promoted_rvs[0] == shipped_horizon + 1
+
+    # the deposed leader's zombie stream (an in-flight record from the
+    # old epoch) is rejected, never merged
+    with pytest.raises(FencedOut):
+        follower.apply_replicated(
+            "ADDED",
+            {"kind": "Widget",
+             "metadata": {"name": "w10", "namespace": "a",
+                          "resourceVersion": str(shipped_horizon + 1)},
+             "spec": {"v": 10}},
+            epoch=old_epoch,
+        )
+
+    # client continuity across the handover: exactly the follower's
+    # applied history — 10 pre-death ADDs + 5 post-promotion ADDs — in
+    # strictly increasing rv order, zero lost, zero duplicated
+    got = []
+    while True:
+        item = client.try_get()
+        if item is None:
+            break
+        got.append(item)
+    client.stop()
+    names = [o["metadata"]["name"] for _e, o in got]
+    rvs = [int(o["metadata"]["resourceVersion"]) for _e, o in got]
+    assert names == [f"w{i:02d}" for i in range(10)] + [
+        f"p{i}" for i in range(5)
+    ]
+    assert len(set(rvs)) == len(rvs), "duplicated event across handover"
+    assert rvs == sorted(rvs), "event order broke across handover"
+    # the unshipped tail (w10..w14) is the async-replication loss
+    # window: absent, explicitly — not silently resurrected
+    served = {o["metadata"]["name"] for o in follower.list("Widget", namespace="a")}
+    assert served == set(names)
+    m_fol.leave()

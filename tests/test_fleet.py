@@ -452,6 +452,51 @@ def test_watch_cache_size_env_bound_holds_under_high_churn(monkeypatch):
         api.watch("Notebook", resource_version="1")
 
 
+def test_watch_resume_exactly_at_compaction_floor_replays():
+    """Boundary contract: ``_compacted_rv`` is the HIGHEST rv dropped
+    from the watch cache. A client resuming exactly AT the floor saw
+    the newest dropped event, and everything after it is still
+    retained — so the resume must replay from the floor, not raise an
+    off-by-one Expired. One below the floor is a real gap → 410."""
+    api = _api()
+    api.WATCH_CACHE_SIZE = 16
+    _fill(api, 50)
+    floor = api._compacted_rv
+    assert floor > 0, "churn must have compacted something"
+    retained = [erv for erv, *_ in api._event_log]
+    assert retained[0] == floor + 1, (
+        "the retained window must start right above the floor"
+    )
+
+    w = api.watch("Notebook", resource_version=str(floor))
+    got = []
+    while True:
+        item = w.try_get()
+        if item is None:
+            break
+        got.append(int(item[1]["metadata"]["resourceVersion"]))
+    w.stop()
+    assert got == retained, "resume at the floor must replay the whole window"
+
+    # one below the floor: the dropped event at `floor` can never be
+    # replayed — Expired, the client relists
+    with pytest.raises(Expired):
+        api.watch("Notebook", resource_version=str(floor - 1))
+    # the same boundary holds for continue tokens (token_rv == floor
+    # resumes; below 410s)
+    from odh_kubeflow_tpu.machinery.store import encode_continue
+
+    ok_token = encode_continue(
+        {"rv": floor, "kind": "Notebook", "ns": "", "k": ["a", "nb-0000"]}
+    )
+    api.list_chunk("Notebook", limit=5, continue_token=ok_token)
+    bad_token = encode_continue(
+        {"rv": floor - 1, "kind": "Notebook", "ns": "", "k": ["a", "nb-0000"]}
+    )
+    with pytest.raises(Expired):
+        api.list_chunk("Notebook", limit=5, continue_token=bad_token)
+
+
 def test_event_retention_env_bound_holds(monkeypatch):
     monkeypatch.setenv("EVENT_RETENTION", "15")
     api = _api()

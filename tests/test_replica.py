@@ -1,0 +1,454 @@
+"""Read-replica control plane: WAL-shipped followers, bounded
+staleness, sharded watch dispatch, and slow-consumer eviction.
+
+The contract under test (docs/GUIDE.md "Read replicas & bounded
+staleness"):
+
+- a follower converges to a **bit-identical** copy of the leader
+  (rv + sha256 state digest) through snapshot catch-up + live stream,
+  across drops, reconnects, and compaction-forced re-snapshots;
+- replicas serve **list/watch only** — mutations answer kube-style
+  ``NotLeader`` (HTTP 307 + Location + Status reason);
+- **bounded staleness**: reads carry the served rv horizon,
+  ``resourceVersion``-pinned reads wait-or-410;
+- **fenced shipping**: a deposed leader's stream is rejected
+  (``FencedOut``), never merged;
+- **bounded fanout**: serving-tier watches ride dispatcher shards, and
+  a consumer that falls more than the backlog bound behind is closed
+  with 410 (``watch_consumers_evicted_total``).
+"""
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from odh_kubeflow_tpu.machinery import httpapi
+from odh_kubeflow_tpu.machinery.replica import (
+    InProcessReplication,
+    ReadSplitAPI,
+    ReplicaStore,
+    ReplicationClient,
+)
+from odh_kubeflow_tpu.machinery.store import (
+    APIServer,
+    Expired,
+    FencedOut,
+    NotLeader,
+)
+from odh_kubeflow_tpu.utils import prometheus
+
+
+def _widget_api(**kwargs) -> APIServer:
+    api = APIServer(**kwargs)
+    api.register_kind("kubeflow.org/v1", "Widget", "widgets")
+    return api
+
+
+def _widget(name: str, ns: str = "a", v: int = 0) -> dict:
+    return {
+        "kind": "Widget",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"v": v},
+    }
+
+
+# ---------------------------------------------------------------------------
+# in-process shipping: convergence + read-only surface
+
+
+def test_follower_converges_and_rejects_writes():
+    leader = _widget_api()
+    rep = ReplicaStore()
+    ship = InProcessReplication(leader, rep)
+    for i in range(7):
+        leader.create(_widget(f"w{i}", v=i))
+    leader.delete("Widget", "w3", "a")
+    w5 = leader.get("Widget", "w5", "a")
+    w5["spec"]["v"] = 500
+    leader.update(w5)
+    ship.sync()
+
+    assert rep.applied_rv() == leader.applied_rv()
+    assert rep.state_digest() == leader.state_digest()
+    assert len(rep.list("Widget", namespace="a")) == 6
+    assert rep.get("Widget", "w5", "a")["spec"]["v"] == 500
+    # server-owned metadata is bit-for-bit the leader's
+    assert (
+        rep.get("Widget", "w1", "a")["metadata"]["uid"]
+        == leader.get("Widget", "w1", "a")["metadata"]["uid"]
+    )
+    # paginated reads serve from the follower's own ordered index
+    page, token = rep.list_chunk("Widget", namespace="a", limit=4)
+    assert len(page) == 4 and token
+    rest, token = rep.list_chunk(
+        "Widget", namespace="a", limit=4, continue_token=token
+    )
+    assert len(rest) == 2 and not token
+
+    for verb, call in [
+        ("create", lambda: rep.create(_widget("x"))),
+        ("update", lambda: rep.update(rep.get("Widget", "w5", "a"))),
+        ("patch", lambda: rep.patch("Widget", "w5", {"spec": {"v": 9}}, "a")),
+        ("delete", lambda: rep.delete("Widget", "w5", "a")),
+        ("emit_event", lambda: rep.emit_event(_widget("w5"), "R", "m")),
+    ]:
+        with pytest.raises(NotLeader):
+            call()
+
+
+def test_follower_registers_dynamic_kinds_from_stream():
+    leader = APIServer()
+    rep = ReplicaStore()
+    ship = InProcessReplication(leader, rep)
+    ship.sync()
+    # a kind registered AFTER the follower joined arrives as a
+    # REGISTER record ahead of its objects
+    leader.register_kind("kubeflow.org/v1", "Widget", "widgets")
+    leader.create(_widget("w0"))
+    ship.sync()
+    assert rep.get("Widget", "w0", "a")["spec"]["v"] == 0
+    assert rep.type_info("Widget").plural == "widgets"
+
+
+def test_follower_watch_serves_same_resume_contract():
+    leader = _widget_api()
+    rep = ReplicaStore()
+    ship = InProcessReplication(leader, rep)
+    leader.create(_widget("w0"))
+    ship.sync()
+    seen_rv = rep.get("Widget", "w0", "a")["metadata"]["resourceVersion"]
+    w = rep.watch("Widget", namespace="a", resource_version=seen_rv)
+    leader.create(_widget("w1", v=1))
+    ship.sync()
+    etype, obj = w.get(timeout=1)
+    assert etype == "ADDED" and obj["metadata"]["name"] == "w1"
+    w.stop()
+
+
+def test_rv_pinned_read_waits_then_410():
+    leader = _widget_api()
+    rep = ReplicaStore()
+    rep.RV_WAIT_SECONDS = 0.15
+    ship = InProcessReplication(leader, rep)
+    leader.create(_widget("w0"))
+    ship.sync()
+    future_rv = leader.applied_rv() + 1
+    # behind the pinned horizon and replication never catches up → 410
+    with pytest.raises(Expired):
+        rep.wait_for_rv(future_rv)
+    # the wait half: a catch-up mid-wait releases the reader
+    leader.create(_widget("w1"))
+    done = threading.Event()
+
+    def catch_up():
+        done.wait(0.05)
+        ship.sync()
+
+    t = threading.Thread(target=catch_up, daemon=True)
+    t.start()
+    rep.RV_WAIT_SECONDS = 5.0
+    rep.wait_for_rv(future_rv)  # must not raise
+    t.join()
+    assert rep.applied_rv() >= future_rv
+
+
+# ---------------------------------------------------------------------------
+# fencing: a deposed leader's stream is rejected, not merged
+
+
+def test_deposed_leader_stream_is_fenced_out():
+    leader = _widget_api()
+    rep = ReplicaStore()
+    ship = InProcessReplication(leader, rep)
+    leader.create(_widget("w0"))
+    ship.sync()
+    # epoch 7 takes over (a promoted peer's ShardMembership token)
+    rep.observe_leader(rep.applied_rv(), epoch=7, ts=time.time())
+    with pytest.raises(FencedOut):
+        rep.apply_replicated(
+            "ADDED",
+            _widget("zombie")
+            | {"metadata": {"name": "zombie", "namespace": "a",
+                            "resourceVersion": "999"}},
+            epoch=3,
+        )
+    assert "zombie" not in {
+        o["metadata"]["name"] for o in rep.list("Widget", namespace="a")
+    }
+
+
+def test_promoted_follower_serves_writes_and_fences_stale_epoch():
+    leader = _widget_api()
+    rep = ReplicaStore()
+    ship = InProcessReplication(leader, rep)
+    leader.create(_widget("w0"))
+    ship.sync()
+    rep.promote(epoch=11)
+    created = rep.create(_widget("post-promo", v=1))
+    assert created["metadata"]["name"] == "post-promo"
+    with pytest.raises(FencedOut):
+        rep.apply_replicated(
+            "ADDED",
+            {"kind": "Widget",
+             "metadata": {"name": "stale", "namespace": "a",
+                          "resourceVersion": "999"},
+             "spec": {"v": 0}},
+            epoch=2,
+        )
+
+
+# ---------------------------------------------------------------------------
+# HTTP shipping: snapshot catch-up, live stream, 307 mutations
+
+
+def test_http_replication_cold_join_live_stream_and_307(tmp_path):
+    leader = _widget_api()
+    for i in range(5):
+        leader.create(_widget(f"w{i}", v=i))
+    _t, port, srv = httpapi.serve(leader, port=0)
+    url = f"http://127.0.0.1:{port}"
+    rep = ReplicaStore(url)
+    registry = prometheus.Registry()
+    rep.attach_replica_metrics(registry)
+    client = ReplicationClient(rep).start()
+    try:
+        assert client.wait_caught_up(30, target_rv=leader.applied_rv())
+        assert client.snapshots_loaded == 1  # cold join went via snapshot
+        leader.create(_widget("live", v=42))
+        deadline = time.time() + 10
+        while time.time() < deadline and rep.applied_rv() < leader.applied_rv():
+            time.sleep(0.01)
+        assert rep.get("Widget", "live", "a")["spec"]["v"] == 42
+        assert rep.state_digest() == leader.state_digest()
+        assert rep.lag_records() == 0
+
+        # the replica's own REST façade: reads carry X-Served-RV,
+        # mutations 307 at the leader with a NotLeader Status
+        _t2, port2, srv2 = httpapi.serve(rep, port=0)
+        try:
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port2}/apis/kubeflow.org/v1/"
+                "namespaces/a/widgets"
+            )
+            assert resp.headers["X-Served-RV"] == str(rep.applied_rv())
+            assert len(json.loads(resp.read().decode())["items"]) == 6
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port2}/apis/kubeflow.org/v1/"
+                "namespaces/a/widgets",
+                data=b'{"metadata": {"name": "nope"}}',
+                method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req)
+            assert err.value.code == 307
+            assert err.value.headers["Location"].startswith(url)
+            body = json.loads(err.value.read().decode())
+            assert body["reason"] == "NotLeader"
+        finally:
+            srv2.shutdown()
+        # the lag/staleness gauges are wired into the registry
+        exposition = registry.exposition()
+        assert "replica_lag_records 0" in exposition
+        assert "replica_staleness_seconds" in exposition
+    finally:
+        client.stop()
+        srv.shutdown()
+
+
+def test_http_stream_reconnect_resumes_without_loss_or_duplicates():
+    leader = _widget_api()
+    _t, port, srv = httpapi.serve(leader, port=0)
+    rep = ReplicaStore(f"http://127.0.0.1:{port}")
+    # sever the stream after every few records — the reconnect resumes
+    # from the applied rv and the idempotent apply dedupes overlap
+    rng = random.Random(7)
+    client = ReplicationClient(
+        rep, chaos_drop=lambda: rng.random() < 0.2
+    ).start()
+    try:
+        assert client.wait_caught_up(30, target_rv=leader.applied_rv())
+        for i in range(40):
+            leader.create(_widget(f"w{i}", v=i))
+        assert client.wait_caught_up(60, target_rv=leader.applied_rv())
+        assert rep.state_digest() == leader.state_digest()
+        assert len(rep.list("Widget", namespace="a")) == 40
+        assert client.reconnects > 0  # the chaos actually fired
+    finally:
+        client.stop()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellite: randomized replication-coherence property test
+
+
+def test_replication_coherence_property_randomized():
+    """Seeded writer churn with injected stream drops/reconnects (and
+    compaction-forced re-snapshots via a tiny watch cache); after the
+    writers quiesce the follower must converge bit-identical — same
+    rv, same sha256 state digest — to the leader."""
+    from odh_kubeflow_tpu.machinery.faults import chaos_seed
+
+    seed = chaos_seed() or 13
+    rng = random.Random(seed)
+    leader = _widget_api()
+    leader.WATCH_CACHE_SIZE = 32  # force Expired resumes → re-snapshots
+    rep = ReplicaStore()
+    ship = InProcessReplication(leader, rep)
+    live: set[str] = set()
+    for step in range(400):
+        op = rng.random()
+        name = f"w{rng.randrange(60)}"
+        try:
+            if op < 0.5 or name not in live:
+                leader.create(_widget(name, v=step))
+                live.add(name)
+            elif op < 0.8:
+                obj = leader.get("Widget", name, "a")
+                obj["spec"]["v"] = step
+                leader.update(obj)
+            else:
+                leader.delete("Widget", name, "a")
+                live.discard(name)
+        except Exception:  # noqa: BLE001 — AlreadyExists under churn
+            pass
+        if rng.random() < 0.08:
+            ship.drop_stream()  # injected disconnect
+        if rng.random() < 0.3:
+            ship.step(budget=rng.randrange(1, 8))
+    ship.sync()
+    assert rep.applied_rv() == leader.applied_rv()
+    assert rep.state_digest() == leader.state_digest(), (
+        f"replica diverged from leader under seed {seed}"
+    )
+    assert {o["metadata"]["name"] for o in rep.list("Widget", namespace="a")} == live
+    assert ship.reconnects > 1  # the drops really happened
+    # and one deterministic fall-off-the-window: drop the stream, churn
+    # past the leader's whole retained window, reconnect — the resume
+    # 410s and the follower must converge through a fresh snapshot
+    ship.drop_stream()
+    for i in range(leader.WATCH_CACHE_SIZE + 5):
+        obj = leader.get("Widget", sorted(live)[0], "a")
+        obj["spec"]["v"] = 10_000 + i
+        leader.update(obj)
+    ship.sync()
+    assert ship.snapshots_loaded >= 1
+    assert rep.state_digest() == leader.state_digest()
+
+
+# ---------------------------------------------------------------------------
+# satellite: bounded per-watcher queues (kube "too old" eviction)
+
+
+def test_slow_watch_consumer_evicted_with_410():
+    api = _widget_api()
+    api.WATCH_CACHE_SIZE = 16
+    registry = prometheus.Registry()
+    api.attach_metrics(registry)
+    w = api.watch("Widget", namespace="a", send_initial=False)
+    assert w.maxsize == 16
+    for i in range(40):  # never drained: 2.5x the bound
+        api.create(_widget(f"w{i}"))
+    assert w.evicted and w.ended
+    assert isinstance(w.error, Expired)
+    assert api.watch_evictions == 1
+    assert "watch_consumers_evicted_total 1" in registry.exposition()
+    # the dead stream drains its backlog then the sentinel — and the
+    # store no longer holds (or feeds) the watch
+    drained = sum(1 for _ in w.events(timeout=0.1))
+    assert drained == 16
+    assert w not in api._watches
+    # a fresh watch works; the evicted consumer relists per its 410
+    w2 = api.watch("Widget", namespace="a", send_initial=False)
+    api.create(_widget("after"))
+    etype, obj = w2.get(timeout=1)
+    assert obj["metadata"]["name"] == "after"
+    w2.stop()
+
+
+def test_initial_dump_never_self_evicts():
+    api = _widget_api()
+    api.WATCH_CACHE_SIZE = 8
+    for i in range(50):
+        api.create(_widget(f"w{i}"))
+    # 50 initial ADDEDs against a bound of 8: the bound must cover the
+    # live backlog ON TOP of the dump, not kill the consumer at open
+    w = api.watch("Widget", namespace="a")
+    assert not w.evicted
+    assert sum(1 for _ in w.events(timeout=0.1)) == 50
+
+
+# ---------------------------------------------------------------------------
+# sharded dispatch: ordering + delivery off the mutator thread
+
+
+def test_dispatcher_watches_preserve_rv_order_and_deliver_all():
+    api = _widget_api()
+    watches = [
+        api.watch("Widget", namespace="a", send_initial=False, inline=False)
+        for _ in range(24)
+    ]
+    assert api._shards and all(w._shard is not None for w in watches)
+    for i in range(30):
+        api.create(_widget(f"w{i:02d}", v=i))
+    results = []
+    for w in watches:
+        got = []
+        while len(got) < 30:
+            item = w.get(timeout=5)
+            assert item is not None, "dispatcher dropped an event"
+            got.append(item)
+        results.append(got)
+        w.stop()
+    for got in results:
+        rvs = [int(o["metadata"]["resourceVersion"]) for _e, o in got]
+        assert rvs == sorted(rvs), "per-watcher rv order violated"
+        assert len(rvs) == 30
+
+
+def test_read_split_api_routes_reads_to_replica_writes_to_leader():
+    leader = _widget_api()
+    rep = ReplicaStore()
+    ship = InProcessReplication(leader, rep)
+    split = ReadSplitAPI(leader, rep)
+    split.create(_widget("w0", v=5))  # → leader
+    ship.sync()
+    assert split.list("Widget", namespace="a")[0]["spec"]["v"] == 5  # ← replica
+    # read-your-writes: a just-created object not yet shipped falls
+    # through to the leader on get
+    split.create(_widget("fresh", v=9))
+    assert split.get("Widget", "fresh", "a")["spec"]["v"] == 9
+    assert split.applied_rv() == rep.applied_rv()
+    ship.sync()  # ship "fresh" before the watch opens
+    w = split.watch("Widget", namespace="a", send_initial=False)
+    split.update(split.get("Widget", "w0", "a") | {"spec": {"v": 6}})
+    ship.sync()
+    etype, obj = w.get(timeout=1)
+    assert etype == "MODIFIED" and obj["spec"]["v"] == 6
+    w.stop()
+
+
+# ---------------------------------------------------------------------------
+# re-snapshot past a follower's own watchers: their streams 410
+
+
+def test_follower_resnapshot_expires_its_own_watchers():
+    leader = _widget_api()
+    leader.WATCH_CACHE_SIZE = 8
+    rep = ReplicaStore()
+    ship = InProcessReplication(leader, rep)
+    leader.create(_widget("w0"))
+    ship.sync()
+    consumer = rep.watch("Widget", namespace="a", send_initial=False)
+    ship.drop_stream()
+    for i in range(1, 30):  # blow past the leader's retained window
+        leader.create(_widget(f"w{i}"))
+    ship.sync()  # resume 410s → snapshot reload
+    assert ship.snapshots_loaded >= 1
+    assert consumer.ended and isinstance(consumer.error, Expired)
+    assert rep.state_digest() == leader.state_digest()
