@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint sanitize chaos durability explore fleetbench replicabench obs loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
+.PHONY: all test test-unit test-manifests lint sanitize chaos durability explore fleetbench replicabench zonedrill obs loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
 
 all: lint test
 
@@ -98,6 +98,18 @@ replicabench:
 	$(PYTHON) loadtest/control_plane_bench.py --replica --notebooks 2000 \
 	  --replica-streams 100 --out /tmp/replicabench.json
 	$(PYTHON) -m pytest -q tests/test_replica.py
+
+# zone failure-domain drills (docs/GUIDE.md "Zones & failure
+# domains"): replicated-checkpoint write-all/heal, zone-spread
+# placement, drain_zone checkpoint-then-migrate, NodeLost-storm
+# escalation, the seeded zone-kill drill (one zone's checkpoint stores
+# + nodes die mid-session; every suspended session resumes in the
+# surviving zone bit-identical) and the promotion watchdog's hands-off
+# failover — all under the sanitizer + a seeded chaos schedule, then
+# the end-to-end two-act drill script
+zonedrill:
+	GRAFT_SANITIZE=1 GRAFT_CHAOS=17 $(PYTHON) -m pytest -q tests/test_zones.py
+	GRAFT_SANITIZE=1 $(PYTHON) -m loadtest.zone_drill
 
 # the randomized property suites re-run as race probes: sanitized
 # locks record acquisition order, re-entry, and blocking-under-lock

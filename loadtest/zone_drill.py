@@ -1,0 +1,308 @@
+"""Zone-failure drill: kill a failure domain, watch the platform heal
+itself — gated, scriptable, no cluster needed.
+
+Two acts (both must pass; non-zero exit otherwise):
+
+1. **zone-kill**: a two-zone sim platform with zone-replicated session
+   checkpoints; sessions suspended across both zones; zone-a's nodes
+   AND its checkpoint-store arm die in the same instant. Gate: every
+   suspended session resumes in zone-b with digest-verified
+   bit-identical state, every surviving placement is in zone-b, and no
+   node is double-booked.
+2. **promotion**: a leader + WAL-shipped follower pair with the
+   promotion watchdog sidecar; the leader zone dies (stream silent,
+   lease renewals stop). Gate: the follower is promoted under the
+   bumped fencing epoch with ZERO manual ``promote()`` calls, within
+   the bounded ``1 + grace`` lease windows, and the deposed leader's
+   zombie record is ``FencedOut``.
+
+Run: ``python -m loadtest.zone_drill`` (``make zonedrill`` wraps it
+with GRAFT_SANITIZE=1 and the pytest drills).
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+import time
+
+CHECKS: list[tuple[str, bool, str]] = []
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    CHECKS.append((name, bool(ok), detail))
+    print(f"  [{'PASS' if ok else 'FAIL'}] {name}" + (f" — {detail}" if detail else ""))
+
+
+def drill_zone_kill() -> None:
+    print("act 1: zone-kill — replicated checkpoints, resume-anywhere")
+    from odh_kubeflow_tpu.apis import (
+        TPU_ACCELERATOR_ANNOTATION,
+        TPU_TOPOLOGY_ANNOTATION,
+        register_crds,
+    )
+    from odh_kubeflow_tpu.controllers.notebook import (
+        NotebookController,
+        NotebookControllerConfig,
+    )
+    from odh_kubeflow_tpu.controllers.runtime import Manager
+    from odh_kubeflow_tpu.machinery import objects as obj_util
+    from odh_kubeflow_tpu.machinery.faults import kill_zone
+    from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
+    from odh_kubeflow_tpu.machinery.store import APIServer, NotFound
+    from odh_kubeflow_tpu.scheduling import register_scheduling
+    from odh_kubeflow_tpu.scheduling.scheduler import SliceScheduler
+    from odh_kubeflow_tpu.sessions import register_sessions
+    from odh_kubeflow_tpu.sessions.checkpoint import (
+        ReplicatedCheckpointStore,
+        parse_zone_spec,
+    )
+    from odh_kubeflow_tpu.sessions.manager import (
+        SessionConfig,
+        SessionManager,
+    )
+    from odh_kubeflow_tpu.utils.prometheus import Registry
+
+    api = APIServer()
+    register_crds(api)
+    register_scheduling(api)
+    register_sessions(api)
+    cluster = FakeCluster(api)
+    registry = Registry()
+    mgr = Manager(api)
+    root = tempfile.mkdtemp(prefix="zone-drill-")
+    store = ReplicatedCheckpointStore(
+        parse_zone_spec("zone-a,zone-b", root), backend="json"
+    )
+    session_mgr = SessionManager(
+        api,
+        SessionConfig(checkpoint_dir=root, backend="json"),
+        registry=registry,
+        runtime=cluster.session_runtime,
+        store=store,
+    )
+    NotebookController(
+        api=api,
+        config=NotebookControllerConfig(
+            enable_queueing=True, enable_sessions=True, enable_culling=False
+        ),
+        registry=registry,
+    ).register(mgr)
+    session_mgr.register(mgr)
+    scheduler = SliceScheduler(api, registry=registry, suspender=session_mgr)
+    scheduler.register(mgr)
+    for zone in ("zone-a", "zone-b"):
+        for i in range(4):
+            cluster.add_tpu_node_pool(
+                f"{zone}-pool-{i}", "tpu-v5-lite-podslice", "2x2",
+                num_hosts=1, chips_per_host=4, zone=zone,
+            )
+
+    def notebook(name):
+        return {
+            "apiVersion": "kubeflow.org/v1beta1",
+            "kind": "Notebook",
+            "metadata": {
+                "name": name,
+                "namespace": "team-a",
+                "annotations": {
+                    TPU_ACCELERATOR_ANNOTATION: "tpu-v5-lite-podslice",
+                    TPU_TOPOLOGY_ANNOTATION: "2x2",
+                },
+            },
+            "spec": {"template": {"spec": {"containers": [
+                {"name": name, "image": "jax:latest"}
+            ]}}},
+        }
+
+    def quiesce(rounds=6):
+        for _ in range(rounds):
+            cluster.step()
+            try:
+                mgr.drain()
+            except RuntimeError:
+                pass
+            time.sleep(0.002)
+
+    def annotate(name, ann):
+        api.patch(
+            "Notebook", name, {"metadata": {"annotations": ann}}, "team-a"
+        )
+
+    names = [f"nb-{i}" for i in range(4)]
+    for name in names:
+        api.create(notebook(name))
+        quiesce()
+    states = {
+        name: {"owner": name, "cells": [f"{name}-cell-{i}" for i in range(8)]}
+        for name in names
+    }
+    for name in names:
+        cluster.set_session_state("team-a", name, states[name])
+    suspended = names[:2]
+    now = obj_util.now_rfc3339()
+    for name in suspended:
+        annotate(name, {
+            "kubeflow-resource-stopped": now,
+            "notebooks.kubeflow.org/suspended-at": now,
+            "notebooks.kubeflow.org/suspend-reason": "user",
+        })
+    quiesce(10)
+    durable = all(
+        obj_util.get_path(
+            api.get("SessionCheckpoint", n, "team-a"), "status", "phase"
+        ) == "Suspended"
+        and obj_util.get_path(
+            api.get("SessionCheckpoint", n, "team-a"), "status", "zones"
+        ) == ["zone-a", "zone-b"]
+        for n in suspended
+    )
+    check("suspends durable in BOTH zones before the kill", durable)
+
+    killed = kill_zone(cluster, store, "zone-a")
+    check("zone-a killed (nodes + checkpoint arm)", bool(killed["nodes"]),
+          f"{len(killed['nodes'])} nodes")
+    quiesce(10)
+    for name in suspended:
+        annotate(name, {
+            "kubeflow-resource-stopped": None,
+            "notebooks.kubeflow.org/suspended-at": None,
+            "notebooks.kubeflow.org/suspend-reason": None,
+            "notebooks.kubeflow.org/resume-requested-at": (
+                obj_util.now_rfc3339()
+            ),
+        })
+    quiesce(14)
+
+    ok_state = all(
+        cluster.get_session_state("team-a", n) == states[n]
+        for n in suspended
+    )
+    check("suspended sessions resumed bit-identical from zone-b", ok_state)
+    placements = []
+    for name in names:
+        try:
+            wl = api.get("Workload", name, "team-a")
+        except NotFound:
+            continue
+        zone = obj_util.get_path(wl, "status", "assignment", "zone")
+        if zone is not None:
+            placements.append(zone)
+    check(
+        "every surviving placement in zone-b",
+        placements and all(z == "zone-b" for z in placements),
+        f"{len(placements)} gangs",
+    )
+    digests_ok = True
+    for name in suspended:
+        ck = api.get("SessionCheckpoint", name, "team-a")
+        loaded = store.load(
+            obj_util.get_path(ck, "spec", "notebookUID"),
+            expect_digest=obj_util.get_path(ck, "status", "digest"),
+        )
+        digests_ok = digests_ok and loaded is not None and (
+            loaded[1] == obj_util.get_path(ck, "status", "digest")
+        )
+    check("checkpoint bytes verify against CR receipts", digests_ok)
+
+
+def drill_promotion() -> None:
+    print("act 2: promotion — hands-off control-plane failover")
+    from odh_kubeflow_tpu.machinery.leader import _fmt_micro
+    from odh_kubeflow_tpu.machinery.promoter import PromotionWatchdog
+    from odh_kubeflow_tpu.machinery.replica import (
+        InProcessReplication,
+        ReplicaStore,
+    )
+    from odh_kubeflow_tpu.machinery.store import APIServer, FencedOut
+    from odh_kubeflow_tpu.utils.prometheus import Registry
+
+    clock = {"now": 1000.0}
+    duration = 1.0
+    leader = APIServer()
+    leader.register_kind("kubeflow.org/v1", "Widget", "widgets")
+    leader.replication_epoch = 7
+    leader.create({
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": {"name": "control-plane-leader", "namespace": "kubeflow"},
+        "spec": {
+            "holderIdentity": "leader-0",
+            "leaseDurationSeconds": 1,
+            "renewTime": _fmt_micro(clock["now"]),
+            "fencingToken": 7,
+        },
+    })
+    follower = ReplicaStore()
+    ship = InProcessReplication(leader, follower)
+    ship.step()
+    stream = {"alive": True}
+    dog = PromotionWatchdog(
+        follower,
+        lease_name="control-plane-leader",
+        namespace="kubeflow",
+        identity="watchdog",
+        lease_duration=duration,
+        grace_windows=1.0,
+        stream_alive_fn=lambda: stream["alive"],
+        now_fn=lambda: clock["now"],
+        registry=Registry(),
+    )
+    for i in range(10):
+        leader.create(
+            {"kind": "Widget", "metadata": {"name": f"w{i}", "namespace": "a"}}
+        )
+    ship.step()
+    check("watchdog holds while leader alive", dog.step() == "leader-alive")
+
+    # the leader zone dies: renewals stop, stream goes silent
+    stream["alive"] = False
+    ship.drop_stream()
+    windows = 0.0
+    while dog.state != "promoted" and windows < 6:
+        clock["now"] += 0.5 * duration
+        windows += 0.5
+        dog.step()
+    check(
+        "promoted hands-off within bounded lease windows",
+        dog.state == "promoted" and windows <= 3.0,
+        f"{windows:.1f} windows, epoch {dog.promoted_epoch}",
+    )
+    check("fencing epoch bumped", dog.promoted_epoch == 8)
+    lease = follower.get("Lease", "control-plane-leader", "kubeflow")
+    check(
+        "takeover lease written by the watchdog",
+        lease["spec"]["holderIdentity"] == "watchdog"
+        and int(lease["spec"]["fencingToken"]) == 8,
+    )
+    follower.create({"kind": "Widget", "metadata": {"name": "post", "namespace": "a"}})
+    try:
+        follower.apply_replicated(
+            "ADDED",
+            {"kind": "Widget", "metadata": {
+                "name": "zombie", "namespace": "a",
+                "resourceVersion": str(follower.applied_rv() + 50),
+            }},
+            epoch=7,
+        )
+        fenced = False
+    except FencedOut:
+        fenced = True
+    check("deposed leader's stream FencedOut", fenced)
+
+
+def main() -> int:
+    drill_zone_kill()
+    drill_promotion()
+    failed = [name for name, ok, _ in CHECKS if not ok]
+    print(
+        f"zone drill: {len(CHECKS) - len(failed)}/{len(CHECKS)} checks passed"
+    )
+    if failed:
+        print("FAILED: " + ", ".join(failed))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -177,6 +177,10 @@ class RemoteAPIServer:
         self._refill_t = time.monotonic()
         self._types: dict[str, TypeInfo] = {}
         self._watches: list[Watch] = []
+        # the highest X-Served-RV the server has stamped on our
+        # responses: the applied-rv horizon our reads were served at
+        # (None until the first response carries the header)
+        self._served_rv: Optional[int] = None
         self._lock = _sanitizer.new_rlock("remote-client")
         # LRU-bounded: long-running controllers emit events with dynamic
         # detail; the dedupe cache must not grow with them
@@ -361,6 +365,12 @@ class RemoteAPIServer:
             with urllib.request.urlopen(
                 req, timeout=self.timeout, context=self._ssl_ctx
             ) as r:
+                served = r.headers.get("X-Served-RV")
+                if served is not None:
+                    try:
+                        self._note_served_rv(int(served))
+                    except ValueError:
+                        pass
                 return json.loads(r.read().decode() or "{}")
         except urllib.error.HTTPError as e:
             message, reason = str(e), ""
@@ -391,6 +401,20 @@ class RemoteAPIServer:
                     leader_url=(e.headers or {}).get("Location", ""),
                 ) from None
             raise klass(message) from None
+
+    def _note_served_rv(self, rv: int) -> None:
+        with self._lock:
+            if self._served_rv is None or rv > self._served_rv:
+                self._served_rv = rv
+
+    def applied_rv(self) -> Optional[int]:
+        """The server's ``X-Served-RV`` horizon as mirrored onto this
+        client's responses — what lets HTTP-split web apps stamp
+        ``servedRv`` on listings exactly like in-process read splits
+        do. None until the first response carried the header (an old
+        server, or no request yet)."""
+        with self._lock:
+            return self._served_rv
 
     # -- CRUD (APIServer duck type) -----------------------------------------
 
@@ -557,10 +581,18 @@ class RemoteAPIServer:
         namespace: Optional[str] = None,
         send_initial: bool = True,
         resource_version: Optional[str] = None,
+        reconnect_window: Optional[float] = None,
     ) -> Watch:
         """Watch with automatic stream recovery: a dropped connection
         logs a warning and reconnects, resuming from the last-seen
-        resourceVersion (no events lost, no duplicate replay). A 410
+        resourceVersion (no events lost, no duplicate replay).
+        ``reconnect_window`` bounds the recovery loop: when set and no
+        connection succeeds for that many seconds, the watch ends with
+        an error so the consumer relists and re-establishes —
+        replica-fanout consumers use this to re-home a stream whose
+        endpoint died for good (default None keeps the
+        reconnect-forever posture single-endpoint deployments want: a
+        restarting leader comes back on the same URL). A 410
         Expired resume — the server compacted our resume point — ends
         the Watch with ``ended=True`` / ``error=Expired`` so the
         consumer relists (the informer cache does exactly that); other
@@ -612,6 +644,7 @@ class RemoteAPIServer:
             delay: Optional[float] = None
             floor: Optional[float] = None  # Retry-After from a 429
             connected_once = False
+            last_alive = time.monotonic()
             while not w._stopped:
                 resp = None
                 try:
@@ -637,6 +670,7 @@ class RemoteAPIServer:
                         )
                     connected_once = True
                     delay = None  # healthy stream resets the backoff
+                    last_alive = time.monotonic()
                     for line in resp:
                         if w._stopped:
                             break
@@ -712,6 +746,25 @@ class RemoteAPIServer:
                         except OSError:
                             pass
                 if w._stopped:
+                    break
+                if (
+                    reconnect_window is not None
+                    and time.monotonic() - last_alive > reconnect_window
+                ):
+                    # the endpoint has been unreachable past the bound:
+                    # surface instead of spinning — the consumer's
+                    # relist + re-watch goes back through the fanout's
+                    # probe and homes on a live replica
+                    w.error = APIError(
+                        f"watch {kind}: no successful connection for "
+                        f"{reconnect_window:.0f}s; relist and re-watch"
+                    )
+                    w.ended = True
+                    log.warning(
+                        "watch %s: endpoint unreachable beyond the "
+                        "%.0fs reconnect window; stream ended for "
+                        "re-homing", kind, reconnect_window,
+                    )
                     break
                 if rv is None and not send_initial and connected_once:
                     # a stream that OPENED and then dropped before any
@@ -833,6 +886,232 @@ class RemoteAPIServer:
         return created
 
 
+class ReplicaFanout:
+    """Read-spreading façade over N replica endpoints (the
+    comma-separated ``READ_FROM_REPLICA`` form): each read goes to one
+    endpoint — round-robin for point reads and lists, rendezvous-sticky
+    per (kind, namespace) for watches so a long-lived stream keeps one
+    home — and an endpoint that errors (network, 5xx, 429 shed) is
+    marked down for ``cooldown`` seconds while the call falls through
+    to the next replica. All endpoints down → every endpoint is tried
+    anyway (serving degraded beats failing fast on a blip).
+
+    Pagination is sticky too: every page of one continue-token walk
+    must come from the SAME replica (another replica's horizon may
+    differ, and an offset into a different history silently skips or
+    repeats rows), so ``list_chunk`` homes on the (kind, namespace)
+    endpoint and a mid-walk endpoint failure surfaces as
+    :class:`Expired` — the callers' existing restart-from-fresh-list
+    logic handles it.
+
+    Reads only: the runner hands this to :class:`ReadSplitAPI` as the
+    read arm; writes keep going to the leader. ``applied_rv`` reports
+    the LOWEST horizon any endpoint has served (the conservative
+    bounded-staleness stamp: whichever replica served the rows, its
+    horizon is at least this)."""
+
+    def __init__(self, clients: list["RemoteAPIServer"], cooldown: float = 5.0):
+        if not clients:
+            raise ValueError("ReplicaFanout needs >=1 endpoint")
+        self.clients = list(clients)
+        self.cooldown = cooldown
+        self._next = 0
+        self._down_until: dict[int, float] = {}
+        self._lock = _sanitizer.new_lock("replica-fanout")
+
+    # -- endpoint choice ------------------------------------------------------
+
+    def _order(self, sticky_key: Optional[str] = None) -> list[int]:
+        now = time.monotonic()
+        with self._lock:
+            healthy = [
+                i
+                for i in range(len(self.clients))
+                if self._down_until.get(i, 0.0) <= now
+            ]
+            if sticky_key is None:
+                self._next += 1
+                rr = self._next
+        if not healthy:
+            healthy = list(range(len(self.clients)))
+        if sticky_key is None:
+            first = healthy[rr % len(healthy)]
+        else:
+            # true rendezvous (highest-random-weight, the SAME
+            # primitive shard/promoter ranking uses): one endpoint
+            # blipping out of the healthy set remaps ONLY the keys it
+            # owned — hash-mod over the dynamic list would tear every
+            # sticky stream down on any membership wobble
+            from odh_kubeflow_tpu.machinery.leader import _hrw_weight
+
+            first = max(
+                healthy,
+                key=lambda i: _hrw_weight(
+                    self.clients[i].base_url, sticky_key
+                ),
+            )
+        ordered = [first] + [i for i in healthy if i != first]
+        ordered += [i for i in range(len(self.clients)) if i not in ordered]
+        return ordered
+
+    def _endpoint_failed(self, e: Exception) -> bool:
+        if isinstance(e, TooManyRequests):
+            return True  # shed load: another replica may have headroom
+        if isinstance(e, APIError):
+            return e.code >= 500
+        return isinstance(e, (OSError, http.client.HTTPException))
+
+    def _mark_down(self, idx: int, e: Exception) -> None:
+        with self._lock:
+            self._down_until[idx] = time.monotonic() + self.cooldown
+        log.warning(
+            "replica endpoint %s failed (%s: %s); trying the next replica",
+            self.clients[idx].base_url, type(e).__name__, e,
+        )
+
+    def _call(self, method: str, *args, sticky_key=None, **kwargs):
+        last: Optional[Exception] = None
+        for idx in self._order(sticky_key):
+            try:
+                return getattr(self.clients[idx], method)(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 — policy-checked below
+                if not self._endpoint_failed(e):
+                    raise
+                last = e
+                self._mark_down(idx, e)
+        assert last is not None
+        raise last
+
+    # -- the read surface -----------------------------------------------------
+
+    def get(self, kind: str, name: str, namespace: Optional[str] = None) -> Obj:
+        return self._call("get", kind, name, namespace)
+
+    def list(self, *args, **kwargs):
+        return self._call("list", *args, **kwargs)
+
+    # marker appended to continue tokens to pin the walk's endpoint:
+    # stickiness via rendezvous alone breaks when a better-ranked
+    # endpoint RECOVERS mid-walk (the winner changes between pages and
+    # the token resumes against a different replica's history)
+    _TOKEN_PIN = "@@replica:"
+
+    def _page_endpoint(
+        self, kind: str, kwargs: dict
+    ) -> tuple[int, Optional[str]]:
+        """(endpoint index, unwrapped server token) for one page. A
+        continued walk is pinned to the endpoint recorded in its own
+        token; a fresh walk homes on the healthy rendezvous winner."""
+        token = kwargs.get("continue_token")
+        if token and self._TOKEN_PIN in token:
+            server_token, _, idx = token.rpartition(self._TOKEN_PIN)
+            try:
+                return int(idx), server_token
+            except ValueError:
+                pass  # foreign token shape: treat as unpinned
+        key = f"list\x00{kind}\x00{kwargs.get('namespace') or ''}"
+        return self._order(sticky_key=key)[0], token
+
+    def list_chunk(self, kind: str, *args, **kwargs):
+        # EVERY page of one continue walk must come from the same
+        # replica — another endpoint's horizon differs, and an offset
+        # into a different history silently skips/repeats rows — so
+        # the token itself carries the endpoint it belongs to
+        idx, server_token = self._page_endpoint(kind, kwargs)
+        pinned = bool(kwargs.get("continue_token"))
+        kwargs["continue_token"] = server_token
+
+        def page(i: int):
+            items, token = self.clients[i].list_chunk(kind, *args, **kwargs)
+            return items, (
+                f"{token}{self._TOKEN_PIN}{i}" if token else ""
+            )
+
+        try:
+            return page(idx)
+        except Exception as e:  # noqa: BLE001 — policy-checked below
+            if not self._endpoint_failed(e):
+                raise
+            self._mark_down(idx, e)
+            if pinned:
+                # mid-walk: the token belongs to the dead endpoint's
+                # history — 410 so the caller's existing restart-from-
+                # fresh-list logic takes over (never resume the walk
+                # against a different replica's state)
+                raise Expired(
+                    "replica serving this paginated walk became "
+                    "unavailable; restart from a fresh list"
+                ) from e
+            key = f"list\x00{kind}\x00{kwargs.get('namespace') or ''}"
+            for other in self._order(sticky_key=key):
+                if other == idx:
+                    continue
+                try:
+                    return page(other)
+                except Exception as e2:  # noqa: BLE001
+                    if not self._endpoint_failed(e2):
+                        raise
+                    self._mark_down(other, e2)
+                    e = e2
+            raise e
+
+    def watch(self, kind: str, namespace: Optional[str] = None, **kwargs):
+        # sticky: the stream (and its resume rv space) lives on ONE
+        # replica; the client pump's own reconnect loop handles blips.
+        # watch() itself never raises (the pump retries forever), so a
+        # dead home would spin unmarked — probe it with a bounded read
+        # first and fail over to the next endpoint like any read. A
+        # home that dies AFTER establishment is bounded too: the
+        # reconnect_window ends the stream so the consumer's relist +
+        # re-watch comes back through this probe and re-homes.
+        key = f"{kind}\x00{namespace or ''}"
+        kwargs.setdefault("reconnect_window", max(3 * self.cooldown, 15.0))
+        last: Optional[Exception] = None
+        for idx in self._order(sticky_key=key):
+            try:
+                self.clients[idx].list(kind, namespace=namespace, limit=1)
+            except Exception as e:  # noqa: BLE001 — policy-checked below
+                if not self._endpoint_failed(e):
+                    raise
+                self._mark_down(idx, e)
+                last = e
+                continue
+            return self.clients[idx].watch(kind, namespace=namespace, **kwargs)
+        assert last is not None
+        raise last
+
+    def applied_rv(self) -> Optional[int]:
+        # the MIN observed horizon: conservative — whichever endpoint
+        # actually served the rows has a horizon at least this high,
+        # so the stamp never promises freshness a lagging replica
+        # didn't deliver
+        horizons = [
+            rv
+            for rv in (c.applied_rv() for c in self.clients)
+            if rv is not None
+        ]
+        return min(horizons) if horizons else None
+
+    def register_kind(self, *args, **kwargs) -> None:
+        for c in self.clients:
+            c.register_kind(*args, **kwargs)
+
+    def type_info(self, kind: str) -> TypeInfo:
+        return self.clients[0].type_info(kind)
+
+    def kind_for_plural(self, plural: str) -> str:
+        return self.clients[0].kind_for_plural(plural)
+
+    def register_admission_hook(self, *args, **kwargs) -> None:
+        """No-op, same as every remote client."""
+
+    def __getattr__(self, name: str):
+        # anything else (writes should never land here — the runner
+        # pairs this with ReadSplitAPI's leader write arm) delegates
+        # to the first endpoint
+        return getattr(self.clients[0], name)
+
+
 def _retry_after_of(e: urllib.error.HTTPError) -> float:
     """The Retry-After header as seconds (delay-seconds form only —
     the HTTP-date form is overkill for an apiserver hint), default 1s."""
@@ -894,7 +1173,7 @@ def in_cluster_config() -> Optional[dict[str, Any]]:
     return cfg
 
 
-def api_from_env(url: Optional[str] = None) -> RemoteAPIServer:
+def api_from_env(url: Optional[str] = None) -> Any:
     """Client for split-process components (`python -m odh_kubeflow_tpu.
     controllers.notebook` etc.), the ``ctrl.GetConfigOrDie()`` ladder
     (`/root/reference/components/notebook-controller/main.go:61-81`):
@@ -906,7 +1185,23 @@ def api_from_env(url: Optional[str] = None) -> RemoteAPIServer:
     2. in-cluster config (kubernetes service env + serviceaccount mount);
     3. localhost:8001 (`kubectl proxy` posture) for dev.
 
+    A comma-separated ``url`` (the multi-replica ``READ_FROM_REPLICA``
+    form) returns a :class:`ReplicaFanout` spreading reads across the
+    endpoints with per-endpoint failure fallback; a single URL returns
+    the plain :class:`RemoteAPIServer` exactly as before.
+
     Registers the platform CRD kinds for path mapping either way."""
+    if url and "," in url:
+        return ReplicaFanout(
+            [
+                api_from_env(part.strip())
+                for part in url.split(",")
+                if part.strip()
+            ],
+            cooldown=float(
+                os.environ.get("REPLICA_FANOUT_COOLDOWN", "5")
+            ),
+        )
     qps_env = os.environ.get("KUBE_API_QPS", "")
     page_env = os.environ.get("KUBE_LIST_PAGE_SIZE", "500")
     common: dict[str, Any] = dict(

@@ -478,6 +478,25 @@ class FaultInjector:
         return getattr(self.api, name)
 
 
+def kill_zone(
+    cluster: Any, checkpoint_store: Optional[Any], zone: str
+) -> dict[str, Any]:
+    """The zone-outage drill's one-call failure injection: every node
+    in ``zone`` is preempted (kubelet sim — Node objects deleted,
+    bound pods Failed, container memory lost) AND the zone's
+    checkpoint-store arm goes dark, in the same instant — the
+    correlated failure a real zone loss is. Returns what was killed so
+    the drill can assert against it; ``heal`` with
+    ``cluster.add_tpu_node_pool(...)`` + ``checkpoint_store.
+    heal_zone(zone)``."""
+    nodes = cluster.kill_zone(zone)
+    if checkpoint_store is not None and hasattr(
+        checkpoint_store, "fail_zone"
+    ):
+        checkpoint_store.fail_zone(zone)
+    return {"zone": zone, "nodes": nodes}
+
+
 def chaos_seed() -> Optional[int]:
     """The ``GRAFT_CHAOS`` seed, or None when chaos is off."""
     raw = os.environ.get(CHAOS_ENV, "")

@@ -30,6 +30,8 @@ Obj = dict[str, Any]
 TPU_RESOURCE = "google.com/tpu"
 TPU_ACCEL_LABEL = "cloud.google.com/gke-tpu-accelerator"
 TPU_TOPO_LABEL = "cloud.google.com/gke-tpu-topology"
+ZONE_LABEL = "topology.kubernetes.io/zone"
+SPOT_LABEL = "cloud.google.com/gke-spot"
 ORDINAL_LABEL = "apps.kubernetes.io/pod-index"
 
 
@@ -146,24 +148,50 @@ class FakeCluster:
         topology: str,
         num_hosts: int = 1,
         chips_per_host: int = 4,
+        zone: str = "",
+        spot: bool = False,
     ) -> list[Obj]:
         """One Node per TPU host in the slice, labelled the way GKE
         labels TPU node pools (accelerator + topology + worker hostnames
-        feed multi-host scheduling)."""
+        feed multi-host scheduling). ``zone`` stamps the well-known
+        ``topology.kubernetes.io/zone`` failure-domain label and
+        ``spot`` the GKE spot capacity class — both flow end-to-end
+        into the slice inventory and the recorded gang assignment, so
+        zone bookkeeping is testable without a cluster."""
+        labels = {
+            TPU_ACCEL_LABEL: accelerator_type,
+            TPU_TOPO_LABEL: topology,
+            "cloud.google.com/gke-nodepool": name,
+        }
+        if zone:
+            labels[ZONE_LABEL] = zone
+        if spot:
+            labels[SPOT_LABEL] = "true"
         nodes = []
         for i in range(num_hosts):
             nodes.append(
                 self.add_node(
                     f"{name}-{i}",
-                    labels={
-                        TPU_ACCEL_LABEL: accelerator_type,
-                        TPU_TOPO_LABEL: topology,
-                        "cloud.google.com/gke-nodepool": name,
-                    },
+                    labels=dict(labels),
                     extra_capacity={TPU_RESOURCE: str(chips_per_host)},
                 )
             )
         return nodes
+
+    def kill_zone(self, zone: str) -> list[str]:
+        """Take a whole failure domain down: every node labelled with
+        ``zone`` is preempted (object deleted, bound pods Failed,
+        container memory lost) in one storm — what a real zone outage
+        looks like from the control plane. Returns the node names
+        killed."""
+        doomed = [
+            obj_util.name_of(n)
+            for n in self.api.list("Node")
+            if obj_util.labels_of(n).get(ZONE_LABEL) == zone
+        ]
+        for name in doomed:
+            self.preempt_node(name)
+        return doomed
 
     def preempt_node(self, name: str) -> None:
         """Simulate GKE reclaiming a spot/preemptible TPU host: the Node

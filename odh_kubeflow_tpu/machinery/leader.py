@@ -92,6 +92,26 @@ def default_identity() -> str:
     return f"{socket.gethostname()}_{os.getpid()}"
 
 
+def lease_expired(
+    lease: Optional[Obj], now: float, default_duration: float = 15.0
+) -> bool:
+    """THE lease-freshness rule, shared by the elector's takeover, the
+    shard heartbeat's rejoin-epoch bump, and the promotion watchdog's
+    leader-death detection: a lease with no parseable renewTime, or
+    one older than its own leaseDurationSeconds, is expired."""
+    spec = (lease or {}).get("spec") or {}
+    renew = spec.get("renewTime")
+    if not renew:
+        return True
+    try:
+        age = now - _parse_micro(renew)
+    except (ValueError, TypeError):
+        return True
+    return age > float(
+        spec.get("leaseDurationSeconds", default_duration) or default_duration
+    )
+
+
 class LeaderElector:
     def __init__(
         self,
@@ -166,13 +186,7 @@ class LeaderElector:
                 return True
             except Conflict:
                 return False  # someone raced us: treat as lost
-        renew = spec.get("renewTime")
-        expired = (
-            not renew
-            or self.now() - _parse_micro(renew)
-            > float(spec.get("leaseDurationSeconds", self.lease_duration))
-        )
-        if not expired:
+        if not lease_expired(lease, self.now(), self.lease_duration):
             return False
         # take over a dead holder's lease; the bumped fencing token
         # deposes every write still in flight from the old epoch
@@ -360,14 +374,8 @@ class ShardMembership:
             except (AlreadyExists, Conflict):
                 return False
         spec = lease.get("spec") or {}
-        renew = spec.get("renewTime")
-        expired = (
-            not renew
-            or self.now() - _parse_micro(renew)
-            > float(spec.get("leaseDurationSeconds", self.lease_duration))
-        )
         token = int(spec.get("fencingToken", 0) or 0)
-        if expired:
+        if lease_expired(lease, self.now(), self.lease_duration):
             token += 1
         lease["spec"] = self._lease_obj(token)["spec"]
         try:

@@ -424,8 +424,22 @@ class ReplicationClient:
         self.records_applied = 0
         self.snapshots_loaded = 0
         self.reconnects = 0
+        # monotonic instant of the last frame (CONTROL included) the
+        # stream delivered — the promotion watchdog's is-the-leader-
+        # really-dead veto reads this
+        self.last_frame_at: Optional[float] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def stream_recently_active(self, window: float = 5.0) -> bool:
+        """Whether the replication stream delivered ANY frame within
+        ``window`` seconds. CONTROL heartbeats arrive every second on
+        a healthy stream, so a quiet window longer than the leader's
+        lease means the leader (or the path to it) is gone — the
+        promotion watchdog's second signal."""
+        if self.fenced or self.last_frame_at is None:
+            return False
+        return time.monotonic() - self.last_frame_at < window
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -574,6 +588,7 @@ class ReplicationClient:
                     continue
                 if not isinstance(frame, dict):
                     continue
+                self.last_frame_at = time.monotonic()
                 ftype = frame.get("type")
                 if ftype == "CONTROL":
                     epoch = int(frame.get("epoch", 0))
@@ -733,7 +748,15 @@ def serve_replica() -> None:
     """``REPLICA_OF=<leader-url>`` entrypoint: run a follower replica
     process — pull the leader's stream, serve list/watch (and 307
     mutations back at the leader) on ``PORT``. The deployment shape is
-    leader + N of these behind a read load balancer."""
+    leader + N of these behind a read load balancer.
+
+    ``PROMOTION_WATCHDOG=true`` additionally runs the hands-off
+    failover sidecar (:mod:`machinery.promoter`): when the replicated
+    leader Lease expires beyond ``PROMOTION_GRACE_WINDOWS`` extra
+    windows AND the stream has gone silent, this follower promotes
+    itself under the bumped fencing epoch, starts serving writes, and
+    fences the deposed leader's stream out — zero manual
+    ``promote()`` calls."""
     from odh_kubeflow_tpu.machinery import httpapi
 
     leader_url = os.environ["REPLICA_OF"]
@@ -747,6 +770,73 @@ def serve_replica() -> None:
 
     register_crds(replica)
     client = ReplicationClient(replica).start()
+    watchdog = None
+    if os.environ.get("PROMOTION_WATCHDOG", "").lower() == "true":
+        from odh_kubeflow_tpu.machinery.promoter import PromotionWatchdog
+
+        lease_duration = float(os.environ.get("LEASE_DURATION", "15"))
+
+        def on_promoted(epoch: int) -> None:
+            client.stop()
+            print(
+                f"replica promoted to leader (epoch {epoch}); "
+                "replication pull stopped, serving writes",
+                flush=True,
+            )
+
+        namespace = os.environ.get("LEADER_ELECTION_NAMESPACE", "kubeflow")
+        group = os.environ.get("SHARD_GROUP", "")
+        watchdog = PromotionWatchdog(
+            replica,
+            lease_name=os.environ.get(
+                "LEADER_ELECTION_ID", "control-plane-leader"
+            ),
+            namespace=namespace,
+            identity=os.environ.get("SHARD_IDENTITY", ""),
+            lease_duration=lease_duration,
+            grace_windows=float(
+                os.environ.get("PROMOTION_GRACE_WINDOWS", "1")
+            ),
+            membership_group=group,
+            stream_alive_fn=lambda: client.stream_recently_active(
+                lease_duration
+            ),
+            on_promoted=on_promoted,
+            registry=registry,
+        ).run()
+        if group:
+            # the one-promoter rendezvous ranks the SURVIVING watchdog
+            # identities — which each watchdog can only see if its
+            # peers heartbeat their membership leases THROUGH the
+            # leader (replication then ships them to every follower).
+            # The heartbeat deliberately tolerates a dead leader: the
+            # frozen replicated membership at death is exactly what
+            # the survivors rank against.
+            from odh_kubeflow_tpu.machinery.client import api_from_env
+            from odh_kubeflow_tpu.machinery.leader import ShardMembership
+
+            member = ShardMembership(
+                api_from_env(leader_url),
+                group,
+                identity=watchdog.identity,
+                namespace=namespace,
+                lease_duration=lease_duration,
+            )
+
+            def heartbeat():
+                while True:
+                    try:
+                        member.join()
+                    except Exception as e:  # noqa: BLE001 — leader down is expected here
+                        log.warning(
+                            "watchdog membership heartbeat failed "
+                            "(%s: %s); leader unreachable", type(e).__name__, e,
+                        )
+                    time.sleep(member.renew_period)
+
+            threading.Thread(
+                target=heartbeat, name="watchdog-membership", daemon=True
+            ).start()
     host = os.environ.get("HOST", "0.0.0.0")
     port = int(os.environ.get("PORT", "8002"))
     _, bound, srv = httpapi.serve(
@@ -758,6 +848,8 @@ def serve_replica() -> None:
             time.sleep(3600)
     except KeyboardInterrupt:
         client.stop()
+        if watchdog is not None:
+            watchdog.stop()
         srv.shutdown()
 
 

@@ -15,12 +15,15 @@ from typing import Callable
 
 
 def _split_reads(api):
-    """``READ_FROM_REPLICA=<url>``: serve this component's reads —
-    lists, watches (so the informer cache feeds off the replica), and
-    gets — from a follower replica, writes from the leader as before.
-    The replica's bounded-staleness contract (X-Served-RV horizon,
-    wait-or-410 on pinned rvs) rides along; unset = everything to the
-    leader, exactly the old wiring."""
+    """``READ_FROM_REPLICA=<url>[,<url>…]``: serve this component's
+    reads — lists, watches (so the informer cache feeds off the
+    replica), and gets — from follower replicas, writes from the
+    leader as before. A comma-separated list spreads reads across N
+    replicas (round-robin, rendezvous-sticky watches) with
+    per-endpoint failure fallback to the next replica. The replica's
+    bounded-staleness contract (X-Served-RV horizon, wait-or-410 on
+    pinned rvs) rides along; unset = everything to the leader,
+    exactly the old wiring."""
     read_url = os.environ.get("READ_FROM_REPLICA", "")
     if not read_url:
         return api

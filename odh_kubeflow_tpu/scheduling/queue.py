@@ -28,14 +28,20 @@ from typing import Any, Optional
 from odh_kubeflow_tpu.apis import TPU_RESOURCE
 from odh_kubeflow_tpu.machinery import objects as obj_util
 from odh_kubeflow_tpu.machinery.kubelet import (
+    SPOT_LABEL,
     TPU_ACCEL_LABEL,
     TPU_TOPO_LABEL,
+    ZONE_LABEL,
 )
 from odh_kubeflow_tpu.scheduling import workload as wlutil
 
 Obj = dict[str, Any]
 
 NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+# a pool's zone (topology.kubernetes.io/zone, via machinery.kubelet)
+# is its failure domain; spot/preemptible pools are reclaimable at any
+# time, so placement prefers on-demand capacity when both fit
+PREEMPTIBLE_LABEL = "cloud.google.com/gke-preemptible"
 TPU_QUOTA_KEYS = (f"requests.{TPU_RESOURCE}", TPU_RESOURCE)
 
 
@@ -50,6 +56,10 @@ class SlicePool:
     name: str
     accelerator_type: str
     topology: str
+    # failure domain (topology.kubernetes.io/zone); "" = unzoned
+    zone: str = ""
+    # spot/preemptible capacity — reclaimable by the cloud at any time
+    spot: bool = False
     # node name → free chips (allocatable minus charges)
     free: dict[str, int] = dataclasses.field(default_factory=dict)
 
@@ -100,11 +110,24 @@ class SliceInventory:
             pool = inv.pools.get(pool_name)
             if pool is None:
                 pool = inv.pools[pool_name] = SlicePool(
-                    pool_name, accel, labels.get(TPU_TOPO_LABEL, "")
+                    pool_name,
+                    accel,
+                    labels.get(TPU_TOPO_LABEL, ""),
+                    zone=labels.get(ZONE_LABEL, ""),
+                    spot=labels.get(SPOT_LABEL, "").lower() == "true"
+                    or labels.get(PREEMPTIBLE_LABEL, "").lower() == "true",
                 )
             pool.free[name] = capacity
             inv._node_pool[name] = pool_name
         return inv
+
+    def zone_of_pool(self, pool_name: str) -> str:
+        pool = self.pools.get(pool_name)
+        return pool.zone if pool is not None else ""
+
+    def zones(self) -> set[str]:
+        """Every failure domain with TPU capacity in the cluster."""
+        return {p.zone for p in self.pools.values() if p.zone}
 
     def has_node(self, node: str) -> bool:
         return node in self._node_pool
@@ -134,31 +157,54 @@ class SliceInventory:
         topology: str,
         hosts: int,
         chips_per_host: int,
+        exclude_zones: Optional[set[str]] = None,
+        zone_load: Optional[dict[str, int]] = None,
     ) -> Optional[tuple[str, list[str]]]:
         """All-or-nothing topology-aware fit: ``hosts`` nodes in ONE
-        matching pool, or None. Best-fit across pools (fewest total
-        free chips first) keeps big contiguous slices available for
-        big gangs."""
-        best: Optional[tuple[int, str, list[str]]] = None
+        matching pool, or None. Pool preference order:
+
+        1. never a pool in ``exclude_zones`` (drained/dead domains);
+        2. the least-loaded zone by ``zone_load`` (chips already
+           committed per zone) — the zone-spread preference that keeps
+           one zone loss from taking every session;
+        3. on-demand before spot/preemptible capacity;
+        4. best-fit (fewest total free chips first) so big contiguous
+           slices stay available for big gangs."""
+        best: Optional[tuple[tuple, str, list[str]]] = None
         for pool in self.pools.values():
             if not pool.matches(accelerator_type, topology):
+                continue
+            if exclude_zones and pool.zone in exclude_zones:
                 continue
             nodes = pool.fit_nodes(hosts, chips_per_host)
             if nodes is None:
                 continue
             slack = sum(pool.free.values())
-            if best is None or (slack, pool.name) < (best[0], best[1]):
-                best = (slack, pool.name, nodes)
+            rank = (
+                (zone_load or {}).get(pool.zone, 0),
+                1 if pool.spot else 0,
+                slack,
+                pool.name,
+            )
+            if best is None or rank < best[0]:
+                best = (rank, pool.name, nodes)
         if best is None:
             return None
         return best[1], best[2]
 
-    def capacity_exists(self, accelerator_type: str, topology: str) -> bool:
+    def capacity_exists(
+        self,
+        accelerator_type: str,
+        topology: str,
+        exclude_zones: Optional[set[str]] = None,
+    ) -> bool:
         """Whether ANY matching pool exists at all — distinguishes
         "queue behind other workloads" from "this topology is not in
         the cluster" for the unschedulable message."""
         return any(
-            p.matches(accelerator_type, topology) for p in self.pools.values()
+            p.matches(accelerator_type, topology)
+            and not (exclude_zones and p.zone in exclude_zones)
+            for p in self.pools.values()
         )
 
 

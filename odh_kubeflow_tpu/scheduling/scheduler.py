@@ -20,6 +20,16 @@ Kueue-shaped semantics, sized for this platform:
 - **requeue with backoff** — unschedulable workloads retry on an
   exponential backoff (and on any Workload/Node/Pod/quota change,
   since every watch event re-triggers the cycle).
+- **zone awareness** — pools carry their failure domain
+  (``topology.kubernetes.io/zone``) and spot/preemptible class; the
+  fit spreads gangs across the least-committed zone and prefers
+  on-demand capacity. ``drain_zone`` runs checkpoint-then-preempt as
+  **checkpoint-then-migrate**: every gang in the zone suspends to its
+  (zone-replicated) checkpoint, its Workload re-enqueues with the
+  drained zone excluded, and the session resumes in a surviving zone
+  — hard-evict only for non-suspendable gangs. A NodeLost storm
+  (≥ ``zone_storm_threshold`` gangs losing hosts in one zone in one
+  cycle) escalates per-node eviction into exactly that zone drain.
 
 The cycle is a pure function of cluster state: snapshot, charge
 admitted, scan pending, write statuses. Re-running it with no state
@@ -71,9 +81,26 @@ class SliceScheduler:
         registry: Optional[prometheus.Registry] = None,
         time_fn: Callable[[], float] = time.time,
         suspender: Optional[Any] = None,
+        zone_storm_threshold: int = 2,
+        zone_drain_cooldown: float = 60.0,
     ):
         self.api = api
         self.now = time_fn
+        # gangs losing hosts in ONE zone in ONE cycle before per-node
+        # eviction escalates to a full zone drain
+        self.zone_storm_threshold = max(int(zone_storm_threshold), 1)
+        # how long a storm-triggered drain outlives the last loss
+        # before a zone with live capacity is trusted again
+        self.zone_drain_cooldown = zone_drain_cooldown
+        # zone → {"trigger": operator|node-storm, "since": ts}; fit
+        # excludes these failure domains and the drain pass migrates
+        # everything already placed in them
+        self._drained_zones: dict[str, dict[str, Any]] = {}
+        # whether zone-drain suspends may be outstanding — gates the
+        # per-cycle migration scan off the hot path. Starts True: a
+        # restarted scheduler must scan once to pick up suspends a
+        # previous incarnation requested (its memory died with it).
+        self._zone_migrations_pending = True
         # checkpoint-then-preempt hooks (sessions.manager.SessionManager
         # duck: is_suspendable / suspend_in_flight / request_suspend).
         # None → every preemption is a hard kill, as before.
@@ -99,6 +126,19 @@ class SliceScheduler:
             "workload_preemptions_total",
             "Admitted workloads evicted, by cause",
             labelnames=("reason",),
+        )
+        self.m_zone_drains = reg.counter(
+            "zone_drains_total",
+            "Zone drains started, by trigger",
+            labelnames=("trigger",),
+        )
+        self.m_migrations = reg.counter(
+            "zone_migrations_total",
+            "Suspended sessions re-enqueued out of a drained zone",
+        )
+        self.m_drained = reg.gauge(
+            "drained_zones",
+            "Failure domains currently excluded from placement",
         )
         # per-workload failed-admission streak (in memory: backoff is
         # scheduler-local state, not API truth — a restarted scheduler
@@ -152,6 +192,9 @@ class SliceScheduler:
 
         admitted: list[Obj] = []
         pending: list[Obj] = []
+        # NodeLost-storm ledger: gangs that lost assigned hosts this
+        # cycle, per failure domain (the zone recorded at admission)
+        lost_zones: dict[str, int] = {}
         for wl in workloads:
             if wlutil.is_admitted(wl) and not self._assignment_lost(
                 wl, inventory
@@ -174,6 +217,11 @@ class SliceScheduler:
                         f"assigned TPU host(s) {', '.join(lost)} lost; "
                         "gang requeued"
                     )
+                    zone = obj_util.get_path(
+                        wl, "status", "assignment", "zone", default=""
+                    )
+                    if zone:
+                        lost_zones[zone] = lost_zones.get(zone, 0) + 1
                 else:
                     reason, metric_reason = (
                         "AssignmentInvalid",
@@ -192,6 +240,15 @@ class SliceScheduler:
                 pending.append(wl)
             else:
                 pending.append(wl)
+
+        # zone failure handling BEFORE capacity is charged: a NodeLost
+        # storm escalates to a drain, healed storm-drains expire, and
+        # the drain pass migrates gangs still placed in drained zones
+        self._detect_zone_storms(lost_zones)
+        self._expire_storm_drains(inventory, lost_zones)
+        if self._drained_zones:
+            self._drain_pass(admitted, pending)
+        self.m_drained.set(len(self._drained_zones))
 
         # charge what's already admitted (workload-level reservation)…
         for wl in admitted:
@@ -220,6 +277,20 @@ class SliceScheduler:
             quotas.release(obj_util.namespace_of(wl), wlutil.chips_of(wl))
             pending.append(wl)
 
+        # per-zone committed chips — the zone-spread preference's load
+        # axis (admissions below keep it current as they land)
+        zone_load: dict[str, int] = {}
+        for wl in admitted:
+            zone = obj_util.get_path(
+                wl, "status", "assignment", "zone", default=""
+            ) or inventory.zone_of_pool(
+                obj_util.get_path(
+                    wl, "status", "assignment", "pool", default=""
+                )
+            )
+            if zone:
+                zone_load[zone] = zone_load.get(zone, 0) + wlutil.chips_of(wl)
+
         # strict priority scan with head-of-line blocking per pool
         blocked_ns: set[str] = set()
         blocked_flavor: set[tuple[str, str]] = set()
@@ -239,10 +310,18 @@ class SliceScheduler:
                 quotas,
                 admitted,
                 blocked=(ns in blocked_ns or flavor in blocked_flavor),
+                zone_load=zone_load,
             )
             if outcome is None:  # admitted — wl's status was written in place
                 self._attempts.pop(key, None)
                 admitted.append(wl)
+                zone = obj_util.get_path(
+                    wl, "status", "assignment", "zone", default=""
+                )
+                if zone:
+                    zone_load[zone] = zone_load.get(zone, 0) + wlutil.chips_of(
+                        wl
+                    )
                 continue
             reason, message = outcome
             any_unadmitted = True
@@ -263,6 +342,12 @@ class SliceScheduler:
             )
         self._known_queues |= set(pending_counts)
 
+        # checkpoint-then-migrate, the resume half: zone-drain suspends
+        # whose checkpoint is durable re-enqueue their Workload (the
+        # scan above — and every later cycle — places them with the
+        # drained zone excluded)
+        migrations_pending = self._advance_zone_migrations()
+
         if any_unadmitted:
             streak = max(self._attempts.values(), default=1)
             return Result(
@@ -270,6 +355,11 @@ class SliceScheduler:
                     _BACKOFF_BASE * (2 ** min(streak - 1, 8)), _BACKOFF_CAP
                 )
             )
+        if migrations_pending or self._drained_zones:
+            # drains settle asynchronously (snapshots landing, storm
+            # cooldowns expiring) — keep the cycle coming back even
+            # when no watch event fires
+            return Result(requeue_after=2.0)
         return Result()
 
     # -- admission ----------------------------------------------------------
@@ -281,6 +371,7 @@ class SliceScheduler:
         quotas: QuotaSnapshot,
         admitted: list[Obj],
         blocked: bool,
+        zone_load: Optional[dict[str, int]] = None,
     ) -> Optional[tuple[str, str]]:
         """Admit ``wl`` (returns None) or return the (reason, message)
         it stays pending with."""
@@ -291,6 +382,7 @@ class SliceScheduler:
         hosts = wlutil.hosts_of(wl)
         chips_per_host = wlutil.chips_per_host_of(wl)
         chips = wlutil.chips_of(wl)
+        exclude = set(self._drained_zones)
 
         if blocked:
             self.m_attempts.inc({"result": "blocked"})
@@ -303,7 +395,14 @@ class SliceScheduler:
         session_ok = quotas.fits_sessions(ns, obj_util.name_of(wl), chips)
         quota_ok = quotas.fits(ns, chips)
         fit = (
-            inventory.fit(accel, topo, hosts, chips_per_host)
+            inventory.fit(
+                accel,
+                topo,
+                hosts,
+                chips_per_host,
+                exclude_zones=exclude,
+                zone_load=zone_load,
+            )
             if quota_ok and session_ok
             else None
         )
@@ -372,7 +471,14 @@ class SliceScheduler:
                     ns, obj_util.name_of(wl), chips
                 )
                 quota_ok = quotas.fits(ns, chips)
-                fit = inventory.fit(accel, topo, hosts, chips_per_host)
+                fit = inventory.fit(
+                    accel,
+                    topo,
+                    hosts,
+                    chips_per_host,
+                    exclude_zones=exclude,
+                    zone_load=zone_load,
+                )
 
         # oversubscription reclaim: still starved with no hard-kill
         # plan — ask idle suspendable sessions (equal priority allowed;
@@ -416,7 +522,16 @@ class SliceScheduler:
             if suspends_pending:
                 return self._awaiting_suspend(suspends_pending)
             self.m_attempts.inc({"result": "unschedulable"})
-            if not inventory.capacity_exists(accel, topo):
+            if not inventory.capacity_exists(
+                accel, topo, exclude_zones=exclude
+            ):
+                if exclude and inventory.capacity_exists(accel, topo):
+                    return (
+                        "ZoneDrained",
+                        f"the only {accel}/{topo} capacity is in "
+                        f"drained zone(s) {', '.join(sorted(exclude))}; "
+                        "queued until a surviving zone has capacity",
+                    )
                 return (
                     "NoMatchingSlice",
                     f"no node pool with accelerator {accel} topology "
@@ -439,6 +554,198 @@ class SliceScheduler:
             f"waiting for {count} session(s) to suspend to checkpoint "
             "and release their slice reservation",
         )
+
+    # -- zone drains (checkpoint-then-migrate) ------------------------------
+
+    def drain_zone(self, zone: str, trigger: str = "operator") -> None:
+        """Mark ``zone`` drained and run a cycle: placement excludes it
+        from here on, and every gang already placed there migrates —
+        suspendable sessions via checkpoint-then-migrate (suspend,
+        re-enqueue excluding the zone, resume in a surviving zone),
+        the rest via gang eviction + requeue."""
+        if zone not in self._drained_zones:
+            self._drained_zones[zone] = {
+                "trigger": trigger,
+                "since": self.now(),
+            }
+            self.m_zone_drains.inc({"trigger": trigger})
+        self.run_cycle()
+
+    def undrain_zone(self, zone: str) -> None:
+        """Re-admit ``zone`` to placement (operator drains only clear
+        here; storm drains also expire on their own once the zone has
+        live capacity and losses stop)."""
+        self._drained_zones.pop(zone, None)
+        self.run_cycle()
+
+    def drained_zones(self) -> dict[str, str]:
+        return {z: d["trigger"] for z, d in self._drained_zones.items()}
+
+    def _detect_zone_storms(self, lost_zones: dict[str, int]) -> None:
+        """Escalate per-node eviction into a zone drain when one cycle
+        sees ``zone_storm_threshold`` or more gangs lose hosts in the
+        same failure domain — that is a zone dying, not a node blip,
+        and waiting for each remaining node to fail individually just
+        strands more kernels on doomed hosts."""
+        for zone, count in lost_zones.items():
+            if count < self.zone_storm_threshold:
+                continue
+            drain = self._drained_zones.get(zone)
+            if drain is None:
+                self._drained_zones[zone] = {
+                    "trigger": "node-storm",
+                    "since": self.now(),
+                }
+                self.m_zone_drains.inc({"trigger": "node-storm"})
+            else:
+                drain["since"] = self.now()  # storm still raging
+
+    def _expire_storm_drains(
+        self, inventory: SliceInventory, lost_zones: dict[str, int]
+    ) -> None:
+        """A storm-triggered drain heals itself: once the zone shows
+        live TPU capacity again, no gang lost a host there this cycle,
+        and the cooldown since the last loss has passed, the zone
+        rejoins placement. Operator drains never auto-clear."""
+        for zone in list(self._drained_zones):
+            drain = self._drained_zones[zone]
+            if drain["trigger"] != "node-storm":
+                continue
+            if zone in lost_zones:
+                drain["since"] = self.now()
+                continue
+            if (
+                zone in inventory.zones()
+                and self.now() - drain["since"] >= self.zone_drain_cooldown
+            ):
+                del self._drained_zones[zone]
+
+    def _drain_pass(self, admitted: list[Obj], pending: list[Obj]) -> None:
+        """Migrate every gang still placed in a drained zone. The
+        checkpoint-then-preempt machinery runs as checkpoint-then-
+        migrate: suspendable sessions snapshot first (their pods stay
+        up until the checkpoint is durable, then the Workload deletes
+        and :meth:`_advance_zone_migrations` re-enqueues it with the
+        zone excluded); non-suspendable gangs hard-evict and requeue
+        directly."""
+        for wl in list(admitted):
+            zone = obj_util.get_path(
+                wl, "status", "assignment", "zone", default=""
+            )
+            if zone not in self._drained_zones:
+                continue
+            if self.suspender is not None and self.suspender.suspend_in_flight(
+                wl
+            ):
+                continue  # snapshot already being taken; release coming
+            if self.suspender is not None and self.suspender.is_suspendable(
+                wl
+            ):
+                if self.suspender.request_suspend(
+                    wl,
+                    f"zone {zone} draining; suspending session to "
+                    "checkpoint for migration to a surviving zone",
+                    reason="zone-drain",
+                ):
+                    self.m_preemptions.inc({"reason": "suspend"})
+                    self._zone_migrations_pending = True
+                continue  # stays admitted until its checkpoint lands
+            self._evict(
+                wl,
+                reason="ZoneDrained",
+                message=(
+                    f"zone {zone} draining; gang requeued for placement "
+                    "in a surviving zone"
+                ),
+                metric_reason="zone_drain",
+            )
+            admitted.remove(wl)
+            pending.append(wl)
+
+    def _advance_zone_migrations(self) -> int:
+        """The resume half of checkpoint-then-migrate: a zone-drain
+        suspend whose checkpoint is durable clears its stop/suspend
+        contract and stamps resume-requested — the notebook controller
+        re-enqueues the Workload, the scan places it with the drained
+        zone excluded, and the SessionManager restores the state
+        digest-checked. Returns how many migrations are still in
+        flight (durable-but-unresumed plus still-snapshotting)."""
+        if self.suspender is None:
+            return 0
+        # hot-path guard: the checkpoint scan only runs while a drain
+        # is active or a zone-drain suspend may still be outstanding
+        # (flag starts True so a restarted scheduler scans once)
+        if not self._drained_zones and not self._zone_migrations_pending:
+            return 0
+        from odh_kubeflow_tpu.apis import (
+            RESUME_REQUESTED_ANNOTATION,
+            STOP_ANNOTATION,
+            SUSPEND_REASON_ANNOTATION,
+            SUSPENDED_AT_ANNOTATION,
+        )
+        from odh_kubeflow_tpu.sessions import checkpoint_durable
+
+        in_flight = 0
+        try:
+            checkpoints = self.api.list("SessionCheckpoint")  # uncached-ok: drain bookkeeping over a small kind
+        except NotFound:
+            return 0
+        for ckpt in checkpoints:
+            ns = obj_util.namespace_of(ckpt)
+            name = obj_util.get_path(
+                ckpt, "spec", "notebook", default=obj_util.name_of(ckpt)
+            )
+            try:
+                nb = self.api.get("Notebook", name, ns)
+            except NotFound:
+                continue
+            ann = obj_util.annotations_of(nb)
+            if ann.get(SUSPEND_REASON_ANNOTATION) != "zone-drain":
+                continue
+            suspended_at = ann.get(SUSPENDED_AT_ANNOTATION)
+            if not suspended_at:
+                continue
+            in_flight += 1
+            if not checkpoint_durable(ckpt, suspended_at):
+                continue  # snapshot still landing; resume would lose it
+            try:
+                wl = self.api.get("Workload", name, ns)
+                if wlutil.is_admitted(wl):
+                    # the notebook controller hasn't finished the
+                    # scale-down yet — clearing the stop now would
+                    # cancel it and pin the gang in the drained zone
+                    continue
+            except NotFound:
+                pass  # workload deleted: the slice is released
+            try:
+                self.api.patch(
+                    "Notebook",
+                    name,
+                    {
+                        "metadata": {
+                            "annotations": {
+                                STOP_ANNOTATION: None,
+                                SUSPENDED_AT_ANNOTATION: None,
+                                SUSPEND_REASON_ANNOTATION: None,
+                                RESUME_REQUESTED_ANNOTATION: (
+                                    obj_util.now_rfc3339()
+                                ),
+                            }
+                        }
+                    },
+                    ns,
+                )
+            except (Conflict, NotFound):
+                continue  # next cycle retries from fresh state
+            self.m_migrations.inc()
+            self.recorder.normal(
+                nb,
+                "ZoneMigration",
+                "checkpoint durable; re-enqueuing the workload for a "
+                "surviving zone",
+            )
+        self._zone_migrations_pending = in_flight > 0
+        return in_flight
 
     def _admit(
         self,
@@ -484,13 +791,23 @@ class SliceScheduler:
         ) or obj_util.meta(wl).get("creationTimestamp", "")
         now = self.now()
         wait = max(now - obj_util.parse_rfc3339(queued_at), 0.0) if queued_at else 0.0
+        # the recorded assignment carries the failure domain + capacity
+        # class: NodeLost-storm detection and the drain pass key off
+        # the zone AS ADMITTED (the node objects may be gone by then)
+        assignment: Obj = {"pool": pool, "nodes": list(nodes)}
+        pool_obj = inventory.pools.get(pool)
+        if pool_obj is not None:
+            if pool_obj.zone:
+                assignment["zone"] = pool_obj.zone
+            if pool_obj.spot:
+                assignment["spot"] = True
         wl.setdefault("status", {})
         wl["status"].update(
             {
                 "state": STATE_ADMITTED,
                 "reason": "Admitted",
                 "message": f"admitted to slice {pool}",
-                "assignment": {"pool": pool, "nodes": list(nodes)},
+                "assignment": assignment,
                 "admittedAt": obj_util.now_rfc3339(),
                 "queuedAt": queued_at,
                 "position": 0,
@@ -587,7 +904,13 @@ class SliceScheduler:
                 and quotas.fits_sessions(
                     ns, obj_util.name_of(wl), wlutil.chips_of(wl)
                 )
-                and inventory.fit(accel, topo, hosts, chips_per_host)
+                and inventory.fit(
+                    accel,
+                    topo,
+                    hosts,
+                    chips_per_host,
+                    exclude_zones=set(self._drained_zones),
+                )
             )
 
         chosen: list[Obj] = []
@@ -663,7 +986,13 @@ class SliceScheduler:
                 and quotas.fits_sessions(
                     ns, obj_util.name_of(wl), wlutil.chips_of(wl)
                 )
-                and inventory.fit(accel, topo, hosts, chips_per_host)
+                and inventory.fit(
+                    accel,
+                    topo,
+                    hosts,
+                    chips_per_host,
+                    exclude_zones=set(self._drained_zones),
+                )
             )
 
         # releases already on their way (snapshots being taken now)
@@ -973,7 +1302,15 @@ def main() -> None:
                 api, SessionConfig.from_env(), registry=mgr.metrics_registry
             )
         SliceScheduler(
-            api, registry=mgr.metrics_registry, suspender=suspender
+            api,
+            registry=mgr.metrics_registry,
+            suspender=suspender,
+            zone_storm_threshold=int(
+                os.environ.get("ZONE_STORM_THRESHOLD", "2")
+            ),
+            zone_drain_cooldown=float(
+                os.environ.get("ZONE_DRAIN_COOLDOWN_SECONDS", "60")
+            ),
         ).register(mgr)
 
     run_controller("tpu-scheduler", register)

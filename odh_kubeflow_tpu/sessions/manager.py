@@ -63,7 +63,11 @@ from odh_kubeflow_tpu.sessions import (
     checkpoint_of,
     new_checkpoint,
 )
-from odh_kubeflow_tpu.sessions.checkpoint import SessionCheckpointStore
+from odh_kubeflow_tpu.sessions.checkpoint import (
+    ReplicatedCheckpointStore,
+    SessionCheckpointStore,
+    parse_zone_spec,
+)
 from odh_kubeflow_tpu.utils import prometheus, tracing
 
 Obj = dict[str, Any]
@@ -81,6 +85,15 @@ class SessionConfig:
     # process-local temp dir (sim / tests)
     checkpoint_dir: str = ""
     backend: str = "auto"  # orbax | json | auto
+    # zone-replicated checkpoints: comma-separated ``zone=path`` (one
+    # independent volume per failure domain) or bare zone names
+    # (subdirs of checkpoint_dir — sim/dev). ≥2 zones turns every
+    # suspend into a write-all across them; empty keeps the single
+    # store exactly as before.
+    zones: str = ""
+    # how often a degraded checkpoint (fewer zones than configured
+    # hold its bytes) retries re-replication
+    zone_heal_retry_seconds: float = 30.0
     # how long a session must be idle before the scheduler may reclaim
     # its slice via suspend (checkpoint-then-preempt at equal priority)
     reclaim_idle_seconds: float = 300.0
@@ -104,6 +117,10 @@ class SessionConfig:
         return SessionConfig(
             checkpoint_dir=env.get("SESSION_CHECKPOINT_DIR", ""),
             backend=env.get("SESSION_CHECKPOINT_BACKEND", "auto"),
+            zones=env.get("SESSION_CHECKPOINT_ZONES", ""),
+            zone_heal_retry_seconds=float(
+                env.get("SESSION_ZONE_HEAL_RETRY_SECONDS", "30")
+            ),
             reclaim_idle_seconds=float(
                 env.get("SESSION_RECLAIM_IDLE_SECONDS", "300")
             ),
@@ -185,9 +202,15 @@ class SessionManager:
         root = self.config.checkpoint_dir or tempfile.mkdtemp(
             prefix="session-ckpt-"
         )
-        self.store = store or SessionCheckpointStore(
-            root, backend=self.config.backend
-        )
+        if store is not None:
+            self.store = store
+        else:
+            zones = parse_zone_spec(self.config.zones, root)
+            self.store = (
+                ReplicatedCheckpointStore(zones, backend=self.config.backend)
+                if zones
+                else SessionCheckpointStore(root, backend=self.config.backend)
+            )
         self.recorder = EventRecorder(api, COMPONENT)
         reg = registry or prometheus.default_registry
         self.m_suspend = reg.histogram(
@@ -214,10 +237,20 @@ class SessionManager:
             "session_checkpoint_size_bytes",
             "Serialized size of the most recent kernel snapshot",
         )
+        self.m_heals = reg.counter(
+            "session_checkpoint_heals_total",
+            "Degraded checkpoints re-replicated to their full zone set",
+        )
+        self.m_degraded = reg.gauge(
+            "session_checkpoints_degraded",
+            "Checkpoints (any phase) currently held by fewer zones "
+            "than configured",
+        )
         reg.register_collector(self._collect_suspended)
 
     def _collect_suspended(self):
         counts: dict[str, int] = {}
+        degraded = 0
         try:
             rows = self.api.list("SessionCheckpoint")  # uncached-ok: metrics scrape over a small kind
         except NotFound:
@@ -226,6 +259,9 @@ class SessionManager:
             if obj_util.get_path(ck, "status", "phase") == PHASE_SUSPENDED:
                 ns = obj_util.namespace_of(ck)
                 counts[ns] = counts.get(ns, 0) + 1
+            if obj_util.get_path(ck, "status", "replicationDegraded"):
+                degraded += 1
+        self.m_degraded.set(degraded)
         yield (
             "# HELP suspended_sessions Sessions suspended to checkpoint, "
             "holding no chips, per quota pool"
@@ -293,6 +329,11 @@ class SessionManager:
             PHASE_RESUMING,
         ):
             self._set_phase(notebook, "")
+        if ckpt is not None:
+            # a checkpoint degraded at suspend time keeps healing even
+            # after the session resumed (the retained bytes are still
+            # single-zone until every configured zone holds them)
+            return self._reconcile_replication(notebook, ckpt)
         return Result()
 
     # -- suspend ------------------------------------------------------------
@@ -302,9 +343,11 @@ class SessionManager:
     ) -> Result:
         if checkpoint_durable(ckpt, suspended_at):
             # snapshot durable — the notebook controller scales down /
-            # deletes the Workload; just keep the phase honest
+            # deletes the Workload; keep the phase honest and, when
+            # the checkpoint landed in fewer zones than configured,
+            # keep re-replicating until every zone holds the bytes
             self._set_phase(notebook, PHASE_SUSPENDED)
-            return Result()
+            return self._reconcile_replication(notebook, ckpt)
 
         self._set_phase(notebook, PHASE_SUSPENDING)
         uid = obj_util.meta(notebook).get("uid", "")
@@ -324,6 +367,11 @@ class SessionManager:
                 "digest": prev_status.get("digest", ""),
                 "sizeBytes": prev_status.get("sizeBytes", 0),
             }
+            if "zones" in prev_status:
+                receipt["zones"] = prev_status["zones"]
+                receipt["degraded"] = bool(
+                    prev_status.get("replicationDegraded")
+                )
             captured = True
         else:
             pod = self._running_pod0(notebook)
@@ -359,19 +407,24 @@ class SessionManager:
                     "snapshot hook unreachable); suspending without state",
                 )
             receipt = self.store.save(uid, state if captured else {})
-        self._upsert_checkpoint(
-            notebook,
-            {
-                "phase": PHASE_SUSPENDED,
-                "suspendedAt": suspended_at,
-                "checkpointStep": receipt["step"],
-                "digest": receipt["digest"],
-                "sizeBytes": receipt["sizeBytes"],
-                "stateCaptured": captured,
-                "resumedAt": None,
-            },
-            ckpt=ckpt,
-        )
+        status_patch = {
+            "phase": PHASE_SUSPENDED,
+            "suspendedAt": suspended_at,
+            "checkpointStep": receipt["step"],
+            "digest": receipt["digest"],
+            "sizeBytes": receipt["sizeBytes"],
+            "stateCaptured": captured,
+            "resumedAt": None,
+        }
+        if "zones" in receipt:
+            # zone-replicated store: the CR status is the operator's
+            # replication surface — which zones hold the bytes, and
+            # whether the write degraded to fewer than configured
+            status_patch["zones"] = list(receipt["zones"])
+            status_patch["replicationDegraded"] = bool(
+                receipt.get("degraded")
+            )
+        self._upsert_checkpoint(notebook, status_patch, ckpt=ckpt)
         reason = (
             obj_util.annotations_of(notebook).get(
                 SUSPEND_REASON_ANNOTATION
@@ -390,6 +443,55 @@ class SessionManager:
             "reservation",
         )
         self._set_phase(notebook, PHASE_SUSPENDED)
+        if receipt.get("degraded"):
+            self.recorder.warning(
+                notebook,
+                "CheckpointReplicationDegraded",
+                f"checkpoint durable in zone(s) "
+                f"{', '.join(receipt.get('zones', []))} only; "
+                "re-replicating when the missing zone(s) heal",
+            )
+            return Result(
+                requeue_after=self.config.zone_heal_retry_seconds
+            )
+        return Result()
+
+    def _reconcile_replication(self, notebook: Obj, ckpt: Obj) -> Result:
+        """Re-replicate a degraded checkpoint (its bytes live in fewer
+        zones than configured — a zone was down at suspend time) once
+        the missing zones heal. Level-triggered: retried every
+        ``zone_heal_retry_seconds`` while degraded, a no-op for
+        fully-replicated checkpoints and non-replicated stores."""
+        status = ckpt.get("status") or {}
+        if not status.get("replicationDegraded"):
+            return Result()
+        heal = getattr(self.store, "heal", None)
+        digest = status.get("digest", "")
+        uid = obj_util.get_path(ckpt, "spec", "notebookUID", default="")
+        if heal is None or not digest or not uid:
+            return Result()
+        # blocking checkpoint IO — reconcile body, no locks held
+        replication = heal(uid, digest)
+        if replication["degraded"]:
+            return Result(
+                requeue_after=self.config.zone_heal_retry_seconds
+            )
+        self._upsert_checkpoint(
+            notebook,
+            {
+                "zones": list(replication["zones"]),
+                "replicationDegraded": False,
+            },
+            ckpt=ckpt,
+        )
+        self.m_heals.inc()
+        self.recorder.normal(
+            notebook,
+            "CheckpointReplicated",
+            "checkpoint re-replicated; every configured zone holds "
+            "bit-identical bytes "
+            f"({', '.join(replication['zones'])})",
+        )
         return Result()
 
     # -- resume -------------------------------------------------------------
@@ -427,10 +529,12 @@ class SessionManager:
         with tracing.span(
             "session.restore", notebook=obj_util.name_of(notebook)
         ):
-            loaded = self.store.load(uid)
             saved_digest = obj_util.get_path(
                 ckpt, "status", "digest", default=""
             )
+            # the receipt digest steers a replicated store to a zone
+            # whose bytes verify (read-from-any-SURVIVING-zone)
+            loaded = self.store.load(uid, expect_digest=saved_digest or None)
             result = "restored"
             if loaded is None:
                 result = "empty"
@@ -551,10 +655,15 @@ class SessionManager:
                 return False
         return True
 
-    def request_suspend(self, wl: Obj, message: str) -> bool:
+    def request_suspend(
+        self, wl: Obj, message: str, reason: str = "preempt"
+    ) -> bool:
         """Stamp the suspend contract onto the workload's notebook.
         Returns True only when this call initiated the suspend (the
-        caller counts the preemption metric off it)."""
+        caller counts the preemption metric off it). ``reason`` lands
+        in ``SUSPEND_REASON_ANNOTATION`` — the scheduler's zone drain
+        passes ``zone-drain`` so its migrate step can tell its own
+        suspends from user/preempt ones."""
         nb = self._notebook_for(wl)
         if nb is None:
             return False
@@ -570,7 +679,7 @@ class SessionManager:
                         "annotations": {
                             STOP_ANNOTATION: now,
                             SUSPENDED_AT_ANNOTATION: now,
-                            SUSPEND_REASON_ANNOTATION: "preempt",
+                            SUSPEND_REASON_ANNOTATION: reason,
                         }
                     }
                 },
@@ -729,14 +838,20 @@ class SessionManager:
     def _gc(self, req: Request) -> Result:
         """Notebook gone: drop its checkpoint object AND the stored
         bytes (the object is deliberately not owner-referenced so the
-        UID survives long enough to clean the store)."""
+        UID survives long enough to clean the store). A zone that is
+        dark at delete time may still hold the bytes — the CR is the
+        ONLY uid→bytes record, so it stays (and this reconcile
+        requeues) until the delete lands in every zone; dropping it
+        early would orphan a checkpoint on the healed volume forever."""
         try:
             ckpt = self.api.get("SessionCheckpoint", req.name, req.namespace)
         except NotFound:
             return Result()
         uid = obj_util.get_path(ckpt, "spec", "notebookUID", default="")
-        if uid:
-            self.store.delete(uid)
+        if uid and self.store.delete(uid) is False:
+            return Result(
+                requeue_after=self.config.zone_heal_retry_seconds
+            )
         try:
             self.api.delete("SessionCheckpoint", req.name, req.namespace)
         except NotFound:

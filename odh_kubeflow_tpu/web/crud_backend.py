@@ -227,10 +227,17 @@ class CrudBackend:
         # replica-read deployments (READ_FROM_REPLICA): stamp the rv
         # horizon the backing replica served at, so API consumers see
         # the bounded-staleness contract instead of guessing. Scoped to
-        # actual replica reads (a ReadSplitAPI or a follower store) —
-        # leader-served listings keep their exact pre-replica shape.
+        # actual replica reads (a ReadSplitAPI, a follower store, or an
+        # HTTP client mirroring the server's X-Served-RV header) —
+        # in-process leader-served listings keep their exact
+        # pre-replica shape.
         target = getattr(self.api, "read_api", None)
         if target is None and getattr(self.api, "is_follower", False):
+            target = self.api
+        if target is None and getattr(self.api, "base_url", ""):
+            # HTTP split: the remote client surfaces the last-seen
+            # X-Served-RV as applied_rv(), so split web apps carry the
+            # same servedRv stamp in-process splits do
             target = self.api
         rv_fn = getattr(target, "applied_rv", None)
         if rv_fn is not None:

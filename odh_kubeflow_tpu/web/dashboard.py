@@ -261,6 +261,11 @@ class DashboardApp:
             user_of(request)
             capacity: dict[str, float] = {}
             used: dict[str, float] = {}
+            # per-failure-domain axes (topology.kubernetes.io/zone):
+            # a zone running hot — or dark — shows up here first
+            zone_capacity: dict[str, float] = {}
+            zone_used: dict[str, float] = {}
+            node_zone: dict[str, str] = {}
             for node in self.api.list("Node"):  # uncached-ok: cluster inventory  # unbounded-ok: cache-served zero-copy read
                 labels = obj_util.labels_of(node)
                 accel = labels.get("cloud.google.com/gke-tpu-accelerator")
@@ -272,6 +277,10 @@ class DashboardApp:
                     )
                 )
                 capacity[accel] = capacity.get(accel, 0) + cap
+                zone = labels.get("topology.kubernetes.io/zone", "")
+                if zone:
+                    node_zone[obj_util.name_of(node)] = zone
+                    zone_capacity[zone] = zone_capacity.get(zone, 0) + cap
             # only pods holding TPU chips matter — the ``tpu`` field
             # index (all buckets) replaces the all-pods scan on the
             # cached path
@@ -291,14 +300,22 @@ class DashboardApp:
                 accel = sel.get("cloud.google.com/gke-tpu-accelerator")
                 if not accel:
                     continue
+                zone = node_zone.get(
+                    obj_util.get_path(pod, "spec", "nodeName", default="")
+                    or "",
+                    "",
+                )
                 for c in obj_util.get_path(
                     pod, "spec", "containers", default=[]
                 ) or []:
-                    used[accel] = used.get(accel, 0) + obj_util.parse_quantity(
+                    chips = obj_util.parse_quantity(
                         obj_util.get_path(
                             c, "resources", "limits", "google.com/tpu", default=0
                         )
                     )
+                    used[accel] = used.get(accel, 0) + chips
+                    if zone:
+                        zone_used[zone] = zone_used.get(zone, 0) + chips
             # suspended sessions hold committed chips without occupying
             # inventory — the occupancy panel shows both axes so an
             # oversubscribed pool (committed > capacity) is visible;
@@ -335,6 +352,14 @@ class DashboardApp:
                             + suspended_chips.get(accel, 0),
                         }
                         for accel, cap in sorted(capacity.items())
+                    ],
+                    "zones": [
+                        {
+                            "zone": zone,
+                            "capacityChips": cap,
+                            "usedChips": zone_used.get(zone, 0),
+                        }
+                        for zone, cap in sorted(zone_capacity.items())
                     ],
                     "notebooks": len(self.api.list("Notebook")),  # uncached-ok: count only  # unbounded-ok: cache-served zero-copy read
                     "suspendedSessions": suspended_count,

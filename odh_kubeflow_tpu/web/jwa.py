@@ -356,6 +356,7 @@ class JupyterWebApp(CrudBackend):
                     "pods": pods,
                     "annotations": obj_util.annotations_of(nb),
                     "workload": self._workload_row(nb),
+                    "checkpoint": self._checkpoint_row(nb),
                 }
             })
 
@@ -627,6 +628,33 @@ class JupyterWebApp(CrudBackend):
             "hosts": spec.get("hosts", 0),
             "chips": spec.get("chips", 0),
         }
+
+    def _checkpoint_row(self, nb: Obj) -> Optional[Obj]:
+        """The detail page's durability block: where the session's
+        checkpoint bytes live (which zones) and whether replication is
+        degraded — the user-visible half of the zone-replication
+        contract."""
+        try:
+            ck = self.api.get(
+                "SessionCheckpoint",
+                obj_util.name_of(nb),
+                obj_util.namespace_of(nb),
+            )
+        except NotFound:  # never suspended, or sessions not installed
+            return None
+        status = ck.get("status") or {}
+        row: Obj = {
+            "phase": status.get("phase", ""),
+            "digest": status.get("digest", ""),
+            "sizeBytes": status.get("sizeBytes", 0),
+            "suspendedAt": status.get("suspendedAt", ""),
+        }
+        if "zones" in status:
+            row["zones"] = status.get("zones") or []
+            row["replicationDegraded"] = bool(
+                status.get("replicationDegraded")
+            )
+        return row
 
     # -- form → Notebook (form.py:17-252) ------------------------------------
 

@@ -1,0 +1,1154 @@
+"""Multi-zone failure domains: replicated checkpoints, zone-aware
+placement, and hands-off failover.
+
+Drives the zone half of NotebookOS (arXiv 2503.20591) end-to-end on
+the embedded apiserver + kubelet sim: write-all checkpoint replication
+with per-zone durability receipts and read-from-any-surviving-zone,
+zone-spread gang placement with a spot/on-demand preference,
+``drain_zone`` running checkpoint-then-preempt as
+checkpoint-then-migrate, NodeLost-storm escalation into a zone drain,
+the zone-kill property drill under ``GRAFT_CHAOS`` (kill one zone's
+checkpoint store + nodes mid-session; every suspended session resumes
+in the surviving zone bit-identical, no double-booked chips), and the
+promotion watchdog failing the control plane over with zero manual
+``promote()`` calls.
+"""
+
+import random
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.apis import (
+    RESUME_REQUESTED_ANNOTATION,
+    STOP_ANNOTATION,
+    SUSPEND_REASON_ANNOTATION,
+    SUSPENDED_AT_ANNOTATION,
+    TPU_ACCELERATOR_ANNOTATION,
+    TPU_TOPOLOGY_ANNOTATION,
+    register_crds,
+)
+from odh_kubeflow_tpu.controllers.notebook import (
+    NotebookController,
+    NotebookControllerConfig,
+)
+from odh_kubeflow_tpu.controllers.runtime import Manager
+from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery.faults import (
+    FaultInjector,
+    FaultSchedule,
+    chaos_seed,
+    kill_zone,
+)
+from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
+from odh_kubeflow_tpu.machinery.store import (
+    APIServer,
+    FencedOut,
+    NotFound,
+)
+from odh_kubeflow_tpu.scheduling import register_scheduling
+from odh_kubeflow_tpu.scheduling.queue import SliceInventory
+from odh_kubeflow_tpu.scheduling.scheduler import SliceScheduler
+from odh_kubeflow_tpu.sessions import register_sessions
+from odh_kubeflow_tpu.sessions.checkpoint import (
+    ReplicatedCheckpointStore,
+    SessionCheckpointStore,
+    parse_zone_spec,
+)
+from odh_kubeflow_tpu.sessions.manager import SessionConfig, SessionManager
+from odh_kubeflow_tpu.utils.prometheus import Registry, lint_metric_names
+
+V5E = "tpu-v5-lite-podslice"
+SEED = chaos_seed() or 20260804
+
+
+# ---------------------------------------------------------------------------
+# environment
+
+
+def make_env(
+    tmp_path,
+    *,
+    zones=("zone-a", "zone-b"),
+    pools_per_zone=1,
+    hosts=1,
+    chips=4,
+    chaos=None,
+    storm_threshold=2,
+    spot_pool_zone=None,
+):
+    """Two-zone platform: notebook controller + session manager (zone-
+    replicated checkpoint store) + suspender-wired scheduler over the
+    embedded store, one TPU pool per zone (plus an optional spot pool)."""
+    api = APIServer()
+    register_crds(api)
+    register_scheduling(api)
+    register_sessions(api)
+    cluster = FakeCluster(api)
+    registry = Registry()
+    injector = None
+    controller_api = api
+    if chaos is not None:
+        injector = FaultInjector(
+            api,
+            seed=SEED,
+            schedule=chaos,
+            registry=registry,
+            sleep_fn=lambda _s: None,
+        )
+        controller_api = injector
+    mgr = Manager(controller_api)
+    store = ReplicatedCheckpointStore(
+        parse_zone_spec(",".join(zones), str(tmp_path / "ckpts")),
+        backend="json",
+    )
+    session_mgr = SessionManager(
+        controller_api,
+        SessionConfig(
+            checkpoint_dir=str(tmp_path / "ckpts"),
+            backend="json",
+            reclaim_idle_seconds=0.0,
+            zone_heal_retry_seconds=0.01,
+        ),
+        registry=registry,
+        runtime=cluster.session_runtime,
+        store=store,
+    )
+    ctrl = NotebookController(
+        api=controller_api,
+        config=NotebookControllerConfig(
+            enable_queueing=True,
+            enable_sessions=True,
+            enable_culling=False,
+        ),
+        registry=registry,
+    )
+    ctrl.register(mgr)
+    session_mgr.register(mgr)
+    scheduler = SliceScheduler(
+        controller_api,
+        registry=registry,
+        suspender=session_mgr,
+        zone_storm_threshold=storm_threshold,
+        zone_drain_cooldown=3600.0,  # drills control undrain explicitly
+    )
+    scheduler.register(mgr)
+    for zone in zones:
+        for i in range(pools_per_zone):
+            cluster.add_tpu_node_pool(
+                f"{zone}-pool-{i}",
+                V5E,
+                "2x2",
+                num_hosts=hosts,
+                chips_per_host=chips,
+                zone=zone,
+            )
+    if spot_pool_zone:
+        cluster.add_tpu_node_pool(
+            f"{spot_pool_zone}-spot",
+            V5E,
+            "2x2",
+            num_hosts=hosts,
+            chips_per_host=chips,
+            zone=spot_pool_zone,
+            spot=True,
+        )
+    return api, cluster, mgr, registry, session_mgr, scheduler, store, injector
+
+
+def notebook(name, ns="team-a"):
+    return {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            "annotations": {
+                TPU_ACCELERATOR_ANNOTATION: V5E,
+                TPU_TOPOLOGY_ANNOTATION: "2x2",
+            },
+        },
+        "spec": {
+            "template": {
+                "spec": {"containers": [{"name": name, "image": "jax:latest"}]}
+            }
+        },
+    }
+
+
+def quiesce(cluster, mgr, rounds=4):
+    from odh_kubeflow_tpu.machinery.store import APIError
+
+    for _ in range(rounds):
+        cluster.step()
+        try:
+            mgr.drain()
+        except (RuntimeError, APIError):
+            pass  # chaos rounds may not converge; end state is gated
+        time.sleep(0.002)
+
+
+def assignment_of(api, name, ns="team-a"):
+    try:
+        wl = api.get("Workload", name, ns)
+    except NotFound:
+        return None
+    return obj_util.get_path(wl, "status", "assignment", default=None)
+
+
+def converge(cluster, mgr, predicate, rounds=40, kick=None):
+    """Quiesce until ``predicate()`` holds (chaos rounds may need many
+    retries before the level-triggered controllers win through the
+    injected faults). ``kick(i)`` runs each round — a reconcile that
+    failed mid-chaos sits in requeue backoff, and any fresh watch
+    event re-triggers it immediately (the level-triggered contract a
+    real cluster's resync provides). Returns whether it converged."""
+    for i in range(rounds):
+        if predicate():
+            return True
+        if kick is not None:
+            kick(i)
+        quiesce(cluster, mgr, rounds=2)
+    return predicate()
+
+
+def resync(mgr):
+    """A manager-wide resync (the same list-and-re-enqueue
+    ``Manager._reshard_resync`` performs): re-enqueue every primary
+    object through the real queue. The bare-Manager test harness has
+    no informer cache to heal a chaos-killed watch stream, so the
+    drill provides the resync a production deployment gets for free."""
+    from odh_kubeflow_tpu.controllers.runtime import Request
+
+    def kick(_i):
+        for c in mgr.controllers:
+            try:
+                objs = mgr.api.list(c.for_kind)
+            except Exception:  # noqa: BLE001 — chaos blip; next kick retries
+                continue
+            for obj in objs:
+                c.enqueue(
+                    Request(
+                        obj_util.namespace_of(obj), obj_util.name_of(obj)
+                    )
+                )
+
+    return kick
+
+
+def pod_running(api, name, ns="team-a"):
+    try:
+        pod = api.get("Pod", f"{name}-0", ns)
+    except NotFound:
+        return False
+    return obj_util.get_path(pod, "status", "phase") == "Running"
+
+
+def suspend(api, name, ns="team-a", reason="user"):
+    now = obj_util.now_rfc3339()
+    api.patch(
+        "Notebook",
+        name,
+        {
+            "metadata": {
+                "annotations": {
+                    STOP_ANNOTATION: now,
+                    SUSPENDED_AT_ANNOTATION: now,
+                    SUSPEND_REASON_ANNOTATION: reason,
+                }
+            }
+        },
+        ns,
+    )
+
+
+def resume(api, name, ns="team-a"):
+    api.patch(
+        "Notebook",
+        name,
+        {
+            "metadata": {
+                "annotations": {
+                    STOP_ANNOTATION: None,
+                    SUSPENDED_AT_ANNOTATION: None,
+                    SUSPEND_REASON_ANNOTATION: None,
+                    RESUME_REQUESTED_ANNOTATION: obj_util.now_rfc3339(),
+                }
+            }
+        },
+        ns,
+    )
+
+
+def no_double_booked_chips(api):
+    """Every node's bound TPU chips stay within its allocatable — the
+    cross-zone migration must never double-book a host."""
+    from odh_kubeflow_tpu.apis import pod_tpu_chips
+
+    alloc = {}
+    for node in api.list("Node"):
+        alloc[obj_util.name_of(node)] = float(
+            obj_util.parse_quantity(
+                obj_util.get_path(
+                    node, "status", "allocatable", "google.com/tpu", default=0
+                )
+            )
+        )
+    used = {}
+    for pod in api.list("Pod"):
+        if obj_util.get_path(pod, "status", "phase") in ("Succeeded", "Failed"):
+            continue
+        node = obj_util.get_path(pod, "spec", "nodeName")
+        if node:
+            used[node] = used.get(node, 0.0) + pod_tpu_chips(pod)
+    return all(used.get(n, 0.0) <= alloc.get(n, 0.0) for n in used)
+
+
+# ---------------------------------------------------------------------------
+# replicated checkpoint store
+
+
+def test_replicated_store_write_all_receipts_and_heal(tmp_path):
+    store = ReplicatedCheckpointStore(
+        parse_zone_spec("zone-a,zone-b", str(tmp_path)), backend="json"
+    )
+    receipt = store.save("uid-1", {"cells": [1, 2, 3]})
+    assert receipt["zones"] == ["zone-a", "zone-b"]
+    assert receipt["degraded"] is False
+    # each zone independently holds bit-identical bytes
+    for zone in ("zone-a", "zone-b"):
+        loaded = store.stores[zone].load("uid-1")
+        assert loaded is not None and loaded[1] == receipt["digest"]
+
+    # one zone dark at save time → degraded single-zone receipt
+    store.fail_zone("zone-b")
+    receipt2 = store.save("uid-1", {"cells": [4]})
+    assert receipt2["zones"] == ["zone-a"] and receipt2["degraded"] is True
+    status = store.replication_status("uid-1", receipt2["digest"])
+    assert status["missing"] == ["zone-b"] and status["degraded"]
+
+    # zone heals → re-replication converges to every zone, bit-identical
+    store.heal_zone("zone-b")
+    healed = store.heal("uid-1", receipt2["digest"])
+    assert healed["degraded"] is False
+    assert healed["zones"] == ["zone-a", "zone-b"]
+    assert store.stores["zone-b"].load("uid-1")[1] == receipt2["digest"]
+
+
+def test_replicated_store_reads_newest_from_surviving_zone(tmp_path):
+    store = ReplicatedCheckpointStore(
+        parse_zone_spec("zone-a,zone-b", str(tmp_path)), backend="json"
+    )
+    store.save("u", {"v": 1})
+    # zone-b misses the second save (down), so it holds a STALE epoch
+    store.fail_zone("zone-b")
+    r2 = store.save("u", {"v": 2})
+    store.heal_zone("zone-b")
+    # the receipt digest steers the read past the stale zone-b copy
+    state, digest = store.load("u", expect_digest=r2["digest"])
+    assert state == {"v": 2} and digest == r2["digest"]
+    # kill the fresh zone entirely: the surviving zone serves what it
+    # has (the stale epoch) and the caller's digest check decides
+    store.fail_zone("zone-a")
+    state, digest = store.load("u", expect_digest=r2["digest"])
+    assert state == {"v": 1} and digest != r2["digest"]
+    # both zones down → nothing to read
+    store.fail_zone("zone-b")
+    assert store.load("u") is None
+
+
+def test_replicated_store_delete_incomplete_while_zone_dark(tmp_path):
+    """A delete during a zone outage must NOT report complete — the
+    caller keeps the CR (the only uid→bytes record) and retries after
+    the heal, or the dark volume leaks one checkpoint per deleted
+    session forever."""
+    store = ReplicatedCheckpointStore(
+        parse_zone_spec("zone-a,zone-b", str(tmp_path)), backend="json"
+    )
+    store.save("u", {"v": 1})
+    store.fail_zone("zone-b")
+    assert store.delete("u") is False  # zone-b may still hold bytes
+    store.heal_zone("zone-b")
+    assert store.stores["zone-b"].exists("u")  # it did
+    assert store.delete("u") is True
+    assert not store.exists("u")
+
+
+def test_parse_zone_spec_paths_and_subdirs(tmp_path):
+    spec = parse_zone_spec(
+        f"zone-a={tmp_path}/pvc-a, zone-b", str(tmp_path / "root")
+    )
+    assert spec["zone-a"] == f"{tmp_path}/pvc-a"
+    assert spec["zone-b"].endswith("root/zone-b")
+    assert parse_zone_spec("", "/x") == {}
+
+
+# ---------------------------------------------------------------------------
+# zone-aware placement
+
+
+def test_zone_labels_flow_inventory_to_assignment(tmp_path):
+    api, cluster, mgr, *_ = make_env(tmp_path, spot_pool_zone="zone-b")
+    inv = SliceInventory.snapshot(api)
+    pools = {p.name: p for p in inv.pools.values()}
+    assert pools["zone-a-pool-0"].zone == "zone-a"
+    assert pools["zone-a-pool-0"].spot is False
+    assert pools["zone-b-spot"].zone == "zone-b"
+    assert pools["zone-b-spot"].spot is True
+    assert inv.zones() == {"zone-a", "zone-b"}
+
+    api.create(notebook("nb-assign"))
+    quiesce(cluster, mgr)
+    assignment = assignment_of(api, "nb-assign")
+    assert assignment is not None
+    assert assignment["zone"] in ("zone-a", "zone-b")
+    assert assignment["pool"].startswith(assignment["zone"])
+
+
+def test_zone_spread_and_on_demand_preference(tmp_path):
+    api, cluster, mgr, *_ = make_env(tmp_path, spot_pool_zone="zone-a")
+    for i in range(2):
+        api.create(notebook(f"nb-{i}"))
+        quiesce(cluster, mgr)
+    zones = {assignment_of(api, f"nb-{i}")["zone"] for i in range(2)}
+    # spread: the two gangs land in two different failure domains
+    assert zones == {"zone-a", "zone-b"}
+    # on-demand preference: the spot pool is last-resort capacity, so
+    # neither gang took it while on-demand pools fit
+    assert not any(
+        assignment_of(api, f"nb-{i}")["pool"].endswith("-spot")
+        for i in range(2)
+    )
+    # third gang has only the spot pool left — used, and flagged
+    api.create(notebook("nb-2"))
+    quiesce(cluster, mgr)
+    assignment = assignment_of(api, "nb-2")
+    assert assignment["pool"] == "zone-a-spot" and assignment["spot"] is True
+
+
+def test_drain_zone_checkpoint_then_migrate(tmp_path):
+    (
+        api,
+        cluster,
+        mgr,
+        _registry,
+        _session_mgr,
+        scheduler,
+        store,
+        _inj,
+    ) = make_env(tmp_path)
+    api.create(notebook("nb-live"))
+    quiesce(cluster, mgr)
+    src = assignment_of(api, "nb-live")["zone"]
+    dst = "zone-b" if src == "zone-a" else "zone-a"
+    state = {"cells": ["x = 42", "train()"], "counter": 7}
+    cluster.set_session_state("team-a", "nb-live", state)
+
+    scheduler.drain_zone(src)
+    quiesce(cluster, mgr, rounds=10)
+
+    # the gang migrated: resumed Admitted in the surviving zone with
+    # the kernel state restored bit-identical, and the drained zone is
+    # excluded from its new placement
+    assignment = assignment_of(api, "nb-live")
+    assert assignment is not None and assignment["zone"] == dst
+    assert cluster.get_session_state("team-a", "nb-live") == state
+    # the migration ran checkpoint-then-migrate (a durable, digest-
+    # stamped, zone-replicated checkpoint exists), not a hard kill
+    ckpt = api.get("SessionCheckpoint", "nb-live", "team-a")
+    assert obj_util.get_path(ckpt, "status", "digest")
+    assert scheduler.drained_zones() == {src: "operator"}
+    assert no_double_booked_chips(api)
+
+    scheduler.undrain_zone(src)
+    assert scheduler.drained_zones() == {}
+
+
+def test_drained_zone_excluded_from_new_admissions(tmp_path):
+    api, cluster, mgr, _r, _s, scheduler, _store, _i = make_env(tmp_path)
+    scheduler.drain_zone("zone-a")
+    api.create(notebook("nb-new"))
+    quiesce(cluster, mgr)
+    assert assignment_of(api, "nb-new")["zone"] == "zone-b"
+    # and with EVERY zone's capacity drained, the pending reason says so
+    scheduler.drain_zone("zone-b")
+    api.create(notebook("nb-blocked"))
+    quiesce(cluster, mgr)
+    wl = api.get("Workload", "nb-blocked", "team-a")
+    assert obj_util.get_path(wl, "status", "state") == "Pending"
+    assert obj_util.get_path(wl, "status", "reason") == "ZoneDrained"
+
+
+def test_node_lost_storm_escalates_to_zone_drain(tmp_path):
+    api, cluster, mgr, _r, _s, scheduler, _store, _i = make_env(
+        tmp_path, pools_per_zone=3, storm_threshold=2
+    )
+    for i in range(3):
+        api.create(notebook(f"nb-{i}"))
+        quiesce(cluster, mgr)
+    in_a = [
+        f"nb-{i}"
+        for i in range(3)
+        if assignment_of(api, f"nb-{i}")["zone"] == "zone-a"
+    ]
+    # spread put at least one gang in zone-b; force 2 into zone-a for
+    # the storm by draining nothing and checking the spread landed 2/1
+    # either way — kill the two pools hosting zone-a gangs
+    if len(in_a) < 2:
+        in_a = [
+            f"nb-{i}"
+            for i in range(3)
+            if assignment_of(api, f"nb-{i}")["zone"] == "zone-b"
+        ]
+        storm_zone = "zone-b"
+    else:
+        storm_zone = "zone-a"
+    for name in in_a[:2]:
+        for node in assignment_of(api, name)["nodes"]:
+            cluster.preempt_node(node)
+    quiesce(cluster, mgr, rounds=8)
+    # two gangs losing hosts in one zone in one cycle == the zone is
+    # dying: the scheduler escalates to a drain and re-places every
+    # survivor out of it
+    assert scheduler.drained_zones().get(storm_zone) == "node-storm"
+    for i in range(3):
+        assignment = assignment_of(api, f"nb-{i}")
+        if assignment is not None:
+            assert assignment["zone"] != storm_zone
+    assert no_double_booked_chips(api)
+
+
+# ---------------------------------------------------------------------------
+# the zone-kill drill (GRAFT_CHAOS-compatible seeded churn)
+
+
+def test_zone_kill_drill_sessions_resume_in_surviving_zone(tmp_path):
+    """The acceptance drill: seeded writer/suspend churn across two
+    zones, then one zone's nodes AND checkpoint store arm die in the
+    same instant. Every suspended session must resume in the surviving
+    zone with digest-verified bit-identical state and no double-booked
+    chips."""
+    chaos = FaultSchedule.default() if chaos_seed() is not None else None
+    (
+        api,
+        cluster,
+        mgr,
+        registry,
+        _session_mgr,
+        scheduler,
+        store,
+        injector,
+    ) = make_env(tmp_path, pools_per_zone=4, chaos=chaos)
+    raw = api  # assertions & the sim read raw truth
+    rng = random.Random(SEED)
+    names = [f"nb-{i}" for i in range(4)]
+    states = {}
+    for name in names:
+        raw.create(notebook(name))
+    assert converge(
+        cluster, mgr, lambda: all(pod_running(raw, n) for n in names)
+    ), "notebooks never came up"
+    for name in names:
+        states[name] = {
+            "cells": [f"cell-{rng.randrange(1 << 30)}" for _ in range(3)],
+            "seed": rng.randrange(1 << 30),
+        }
+        cluster.set_session_state("team-a", name, states[name])
+    # churn: suspend a seeded subset mid-session (their state must
+    # survive the zone kill as a replicated checkpoint)
+    suspended = sorted(rng.sample(names, 2))
+    for name in suspended:
+        suspend(raw, name)
+    quiesce(cluster, mgr, rounds=8)
+
+    def checkpoints_durable():
+        for name in suspended:
+            try:
+                ckpt = raw.get("SessionCheckpoint", name, "team-a")
+            except NotFound:
+                return False
+            if obj_util.get_path(ckpt, "status", "phase") != "Suspended":
+                return False
+        return True
+
+    # the drill's precondition is "sessions suspended across 2 zones":
+    # liveness converges once the weather clears (repo chaos idiom —
+    # safety holds DURING faults, convergence is asserted after)
+    if injector is not None:
+        injector.set_schedule(FaultSchedule.none())
+    assert converge(
+        cluster, mgr, checkpoints_durable, kick=resync(mgr)
+    ), "suspends never checkpointed"
+    for name in suspended:
+        ckpt = raw.get("SessionCheckpoint", name, "team-a")
+        assert obj_util.get_path(ckpt, "status", "zones") == [
+            "zone-a",
+            "zone-b",
+        ]
+
+    # THE ZONE DIES — with the fault weather re-armed, so recovery
+    # itself runs through injected conflicts/429s/5xx/stream drops:
+    # nodes preempted + checkpoint store arm dark in the same instant
+    if injector is not None:
+        injector.set_schedule(chaos)
+    killed = kill_zone(cluster, store, "zone-a")
+    assert killed["nodes"], "drill must actually kill nodes"
+    quiesce(cluster, mgr, rounds=10)
+
+    # resume the suspended sessions — their checkpoints must be served
+    # from the surviving zone
+    for name in suspended:
+        resume(raw, name)
+    quiesce(cluster, mgr, rounds=10)
+
+    def all_restored():
+        for name in suspended:
+            if not pod_running(raw, name):
+                return False
+            if cluster.get_session_state("team-a", name) != states[name]:
+                return False
+        return True
+
+    if injector is not None:
+        injector.set_schedule(FaultSchedule.none())
+    assert converge(
+        cluster, mgr, all_restored, rounds=60, kick=resync(mgr)
+    ), "suspended sessions never resumed bit-identical"
+
+    for name in names:
+        assignment = assignment_of(raw, name)
+        if assignment is not None:
+            assert assignment["zone"] == "zone-b", (
+                f"{name} placed in the dead zone"
+            )
+    for name in suspended:
+        ckpt = raw.get("SessionCheckpoint", name, "team-a")
+        saved = obj_util.get_path(ckpt, "status", "digest")
+        loaded = store.load(
+            obj_util.get_path(ckpt, "spec", "notebookUID"),
+            expect_digest=saved,
+        )
+        assert loaded is not None and loaded[1] == saved
+    assert no_double_booked_chips(raw)
+    # the suspended checkpoints survive in the surviving zone only —
+    # and are marked degraded for re-replication on zone heal
+    for name in suspended:
+        ckpt = raw.get("SessionCheckpoint", name, "team-a")
+        digest = obj_util.get_path(ckpt, "status", "digest")
+        status = store.replication_status(
+            obj_util.get_path(ckpt, "spec", "notebookUID"), digest
+        )
+        assert "zone-b" in status["zones"]
+    assert lint_metric_names(registry) == []
+
+
+def test_degraded_checkpoint_rereplicates_on_zone_heal(tmp_path):
+    (
+        api,
+        cluster,
+        mgr,
+        _registry,
+        _session_mgr,
+        _scheduler,
+        store,
+        _inj,
+    ) = make_env(tmp_path)
+    api.create(notebook("nb-heal"))
+    quiesce(cluster, mgr)
+    cluster.set_session_state("team-a", "nb-heal", {"k": "v"})
+    store.fail_zone("zone-b")
+    suspend(api, "nb-heal")
+    quiesce(cluster, mgr, rounds=8)
+    ckpt = api.get("SessionCheckpoint", "nb-heal", "team-a")
+    assert obj_util.get_path(ckpt, "status", "zones") == ["zone-a"]
+    assert obj_util.get_path(ckpt, "status", "replicationDegraded") is True
+
+    store.heal_zone("zone-b")
+    quiesce(cluster, mgr, rounds=8)
+    ckpt = api.get("SessionCheckpoint", "nb-heal", "team-a")
+    assert obj_util.get_path(ckpt, "status", "zones") == [
+        "zone-a",
+        "zone-b",
+    ]
+    assert obj_util.get_path(ckpt, "status", "replicationDegraded") is False
+    digest = obj_util.get_path(ckpt, "status", "digest")
+    uid = obj_util.get_path(ckpt, "spec", "notebookUID")
+    assert store.stores["zone-b"].load(uid)[1] == digest
+
+
+def test_degraded_checkpoint_heals_even_after_resume(tmp_path):
+    """A session resumed while its checkpoint was still degraded keeps
+    healing: the retained bytes are single-zone until every configured
+    zone holds them — resume must not freeze replicationDegraded."""
+    (
+        api,
+        cluster,
+        mgr,
+        _registry,
+        _session_mgr,
+        _scheduler,
+        store,
+        _inj,
+    ) = make_env(tmp_path)
+    api.create(notebook("nb-rh"))
+    quiesce(cluster, mgr)
+    cluster.set_session_state("team-a", "nb-rh", {"k": "v"})
+    store.fail_zone("zone-b")
+    suspend(api, "nb-rh")
+    quiesce(cluster, mgr, rounds=8)
+    assert (
+        obj_util.get_path(
+            api.get("SessionCheckpoint", "nb-rh", "team-a"),
+            "status",
+            "replicationDegraded",
+        )
+        is True
+    )
+    # resume BEFORE the zone heals — the restore serves from zone-a
+    resume(api, "nb-rh")
+    quiesce(cluster, mgr, rounds=10)
+    assert cluster.get_session_state("team-a", "nb-rh") == {"k": "v"}
+    # the zone comes back: the degraded (now Restored) checkpoint
+    # still re-replicates and the status clears
+    store.heal_zone("zone-b")
+    assert converge(
+        cluster,
+        mgr,
+        lambda: obj_util.get_path(
+            api.get("SessionCheckpoint", "nb-rh", "team-a"),
+            "status",
+            "replicationDegraded",
+        )
+        is False,
+        kick=resync(mgr),
+    ), "resumed session's degraded checkpoint never healed"
+    ckpt = api.get("SessionCheckpoint", "nb-rh", "team-a")
+    uid = obj_util.get_path(ckpt, "spec", "notebookUID")
+    digest = obj_util.get_path(ckpt, "status", "digest")
+    assert store.stores["zone-b"].load(uid)[1] == digest
+
+
+# ---------------------------------------------------------------------------
+# hands-off failover (the promotion watchdog)
+
+
+def _lease(name, holder, token, now, duration=1.0):
+    from odh_kubeflow_tpu.machinery.leader import _fmt_micro
+
+    return {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": {"name": name, "namespace": "kubeflow"},
+        "spec": {
+            "holderIdentity": holder,
+            "leaseDurationSeconds": max(1, int(duration)),
+            "renewTime": _fmt_micro(now),
+            "fencingToken": token,
+        },
+    }
+
+
+def test_promotion_watchdog_hands_off_failover(tmp_path):
+    """Leader-zone loss → follower promoted with ZERO manual
+    ``promote()`` calls, within a bounded number of lease windows, and
+    the deposed leader's stream ``FencedOut``."""
+    from odh_kubeflow_tpu.machinery.leader import _fmt_micro
+    from odh_kubeflow_tpu.machinery.promoter import PromotionWatchdog
+    from odh_kubeflow_tpu.machinery.replica import (
+        InProcessReplication,
+        ReplicaStore,
+    )
+
+    clock = {"now": 1000.0}
+    now = lambda: clock["now"]  # noqa: E731
+    duration = 1.0
+    leader = APIServer()
+    leader.register_kind("kubeflow.org/v1", "Widget", "widgets")
+    leader.replication_epoch = 3
+    leader.create(_lease("control-plane-leader", "leader-0", 3, now()))
+    follower = ReplicaStore()
+    ship = InProcessReplication(leader, follower)
+    ship.step()
+
+    stream_live = {"alive": True}
+    registry = Registry()
+    dog = PromotionWatchdog(
+        follower,
+        lease_name="control-plane-leader",
+        namespace="kubeflow",
+        identity="watchdog-1",
+        lease_duration=duration,
+        grace_windows=1.0,
+        stream_alive_fn=lambda: stream_live["alive"],
+        now_fn=now,
+        registry=registry,
+    )
+
+    # healthy leader: renewals ship, the watchdog stays put
+    for _ in range(3):
+        clock["now"] += 0.4
+        lease = leader.get("Lease", "control-plane-leader", "kubeflow")
+        lease["spec"]["renewTime"] = _fmt_micro(now())
+        leader.update(lease)
+        ship.step()
+        assert dog.step() == "leader-alive"
+
+    for i in range(5):
+        leader.create(
+            {"kind": "Widget", "metadata": {"name": f"w{i}", "namespace": "a"}}
+        )
+    ship.step()
+    shipped_rv = follower.applied_rv()
+
+    # lease stale but the stream still delivers → NOT a dead leader
+    clock["now"] += 2 * duration
+    assert dog.step() == "stream-alive"
+
+    # THE LEADER ZONE DIES: stream silent, renewals stop
+    stream_live["alive"] = False
+    ship.drop_stream()
+    assert dog.step() == "grace"  # expiry noticed, confirmation window
+    assert dog.promoted_epoch == 0
+    clock["now"] += 1.5 * duration  # beyond expiry + grace_windows
+    assert dog.step() == "promoted"
+
+    # bounded: expiry (1 window) + grace (1 window) ≈ promoted within
+    # ~3.5 windows of the last renewal, and the epoch is the bumped
+    # fencing token — no manual promote() call anywhere in this test
+    assert dog.promoted_epoch == 4
+    assert follower.is_follower is False
+    # the watchdog's takeover lease landed in the promoted store
+    lease = follower.get("Lease", "control-plane-leader", "kubeflow")
+    assert lease["spec"]["holderIdentity"] == "watchdog-1"
+    assert int(lease["spec"]["fencingToken"]) == 4
+
+    # promoted follower serves writes; the deposed leader's zombie
+    # record (old epoch) is FencedOut, never merged
+    created = follower.create(
+        {"kind": "Widget", "metadata": {"name": "post", "namespace": "a"}}
+    )
+    assert int(created["metadata"]["resourceVersion"]) == shipped_rv + 2
+    with pytest.raises(FencedOut):
+        follower.apply_replicated(
+            "ADDED",
+            {
+                "kind": "Widget",
+                "metadata": {
+                    "name": "zombie",
+                    "namespace": "a",
+                    "resourceVersion": str(shipped_rv + 99),
+                },
+            },
+            epoch=3,
+        )
+    # steady state: the watchdog renews its own leadership
+    clock["now"] += 0.4
+    assert dog.step() == "promoted"
+
+
+def test_promotion_watchdog_standby_when_not_chosen(tmp_path):
+    """With several surviving watchdogs only the rendezvous-chosen one
+    promotes; the rest stand by for the new leader's stream."""
+    from odh_kubeflow_tpu.machinery.leader import _hrw_weight
+    from odh_kubeflow_tpu.machinery.promoter import PromotionWatchdog
+    from odh_kubeflow_tpu.machinery.replica import (
+        InProcessReplication,
+        ReplicaStore,
+    )
+
+    clock = {"now": 500.0}
+    now = lambda: clock["now"]  # noqa: E731
+    leader = APIServer()
+    leader.replication_epoch = 1
+    leader.create(_lease("cp-leader", "leader-0", 1, now()))
+    # the watchdogs' own membership leases, replicated like any record
+    from odh_kubeflow_tpu.machinery.leader import SHARD_LABEL
+
+    for ident in ("wd-a", "wd-b"):
+        lease = _lease(f"shard-wd-{ident}", ident, 1, now())
+        lease["metadata"]["labels"] = {SHARD_LABEL: "wd"}
+        leader.create(lease)
+    follower = ReplicaStore()
+    InProcessReplication(leader, follower).step()
+
+    chosen = max(
+        ["wd-a", "wd-b"], key=lambda m: _hrw_weight(m, "kubeflow/cp-leader")
+    )
+    loser = "wd-a" if chosen == "wd-b" else "wd-b"
+    registry = Registry()
+    dogs = {
+        ident: PromotionWatchdog(
+            follower,
+            lease_name="cp-leader",
+            namespace="kubeflow",
+            identity=ident,
+            lease_duration=1.0,
+            grace_windows=0.0,
+            membership_group="wd",
+            now_fn=now,
+            registry=registry,
+        )
+        for ident in ("wd-a", "wd-b")
+    }
+    clock["now"] += 5.0  # leader long dead
+    assert dogs[loser].step() == "standby"
+    assert dogs[chosen].step() == "promoted"
+    assert follower.is_follower is False
+
+
+def test_promotion_watchdog_never_promotes_without_a_lease():
+    from odh_kubeflow_tpu.machinery.promoter import PromotionWatchdog
+    from odh_kubeflow_tpu.machinery.replica import ReplicaStore
+
+    follower = ReplicaStore()
+    dog = PromotionWatchdog(
+        follower,
+        lease_name="cp-leader",
+        namespace="kubeflow",
+        lease_duration=1.0,
+        registry=Registry(),
+    )
+    assert dog.step() == "no-lease"
+    assert follower.is_follower is True
+
+
+# ---------------------------------------------------------------------------
+# replica read spreading (satellite: READ_FROM_REPLICA url list)
+
+
+class _FakeEndpoint:
+    def __init__(self, name, fail=False, served_rv=None):
+        self.base_url = f"http://{name}"
+        self.fail = fail
+        self.calls = []
+        self._served_rv = served_rv
+
+    def get(self, kind, name, namespace=None):
+        self.calls.append(("get", kind, name))
+        if self.fail:
+            raise OSError("endpoint down")
+        return {"kind": kind, "metadata": {"name": name}}
+
+    def list(self, kind, **kwargs):
+        self.calls.append(("list", kind))
+        if self.fail:
+            raise OSError("endpoint down")
+        return []
+
+    def list_chunk(self, kind, **kwargs):
+        self.calls.append(("list_chunk", kind, kwargs.get("continue_token")))
+        if self.fail:
+            raise OSError("endpoint down")
+        return [], f"{self.base_url}-token"
+
+    def watch(self, kind, namespace=None, **kwargs):
+        self.calls.append(("watch", kind, namespace))
+        return f"watch:{self.base_url}:{kind}"
+
+    def applied_rv(self):
+        return self._served_rv
+
+
+def test_replica_fanout_spreads_and_fails_over():
+    from odh_kubeflow_tpu.machinery.client import ReplicaFanout
+
+    a, b = _FakeEndpoint("a", served_rv=10), _FakeEndpoint("b", served_rv=17)
+    fan = ReplicaFanout([a, b], cooldown=30.0)
+    for i in range(6):
+        fan.list("Notebook")
+    # round-robin: both endpoints serve
+    assert a.calls and b.calls
+    # the bounded-staleness stamp is CONSERVATIVE: the min observed
+    # horizon — whichever endpoint served the rows holds at least this
+    assert fan.applied_rv() == 10
+
+    # endpoint failure: the call falls through to the next replica and
+    # the dead endpoint is cooled down out of the rotation
+    a.fail = True
+    before = len(b.calls)
+    for i in range(4):
+        assert fan.get("Notebook", "nb") is not None
+    assert len(b.calls) >= before + 4
+    a_failures = len([c for c in a.calls if c[0] == "get"])
+    assert a_failures <= 1  # at most the probe that marked it down
+
+    # watches are rendezvous-sticky per (kind, namespace)
+    a.fail = False
+    w1 = fan.watch("Notebook", namespace="team-a")
+    w2 = fan.watch("Notebook", namespace="team-a")
+    assert w1 == w2
+
+
+def test_replica_fanout_watch_fails_over_past_dead_home():
+    """watch() itself never raises (the pump retries forever), so the
+    fanout probes the sticky home with a bounded read first — a dead
+    home is marked down and the stream establishes on a live replica."""
+    from odh_kubeflow_tpu.machinery.client import ReplicaFanout
+
+    a, b = _FakeEndpoint("a"), _FakeEndpoint("b")
+    fan = ReplicaFanout([a, b], cooldown=30.0)
+    home = fan.watch("Notebook", namespace="team-a")
+    dead = a if home.startswith("watch:http://a") else b
+    live = b if dead is a else a
+    dead.fail = True
+    w = fan.watch("Notebook", namespace="team-a")
+    assert w.startswith(f"watch:{live.base_url}")
+    # and the dead home served no stream
+    assert not any(c[0] == "watch" for c in dead.calls[-1:])
+
+
+def test_replica_fanout_pagination_sticks_to_one_endpoint():
+    """Every page of one continue walk comes from the SAME replica
+    (another endpoint's horizon is a different history — offsets into
+    it silently skip/repeat rows); a mid-walk endpoint death surfaces
+    as 410 so the caller restarts from a fresh list."""
+    from odh_kubeflow_tpu.machinery.client import ReplicaFanout
+    from odh_kubeflow_tpu.machinery.store import Expired
+
+    a, b = _FakeEndpoint("a"), _FakeEndpoint("b")
+    fan = ReplicaFanout([a, b], cooldown=30.0)
+    _, token = fan.list_chunk("Notebook", namespace="team-a", limit=10)
+    home = a if a.calls else b
+    for _ in range(3):
+        fan.list_chunk(
+            "Notebook", namespace="team-a", limit=10, continue_token=token
+        )
+    other = b if home is a else a
+    assert not other.calls, "a page of the walk hopped endpoints"
+    home.fail = True
+    with pytest.raises(Expired):
+        fan.list_chunk(
+            "Notebook", namespace="team-a", limit=10, continue_token=token
+        )
+    # a FIRST page (no token) is free to fail over
+    items, _ = fan.list_chunk("Notebook", namespace="team-a", limit=10)
+    assert items == []
+
+
+def test_replica_fanout_first_page_fails_over_from_healthy_listed_home():
+    """Regression: the home is still healthy-listed when its first
+    page fails — the failover loop must try the OTHER endpoint (a
+    recomputed order put the new winner in slot 0 and slicing [1:]
+    retried only the dead home)."""
+    from odh_kubeflow_tpu.machinery.client import ReplicaFanout
+
+    a, b = _FakeEndpoint("a"), _FakeEndpoint("b")
+    fan = ReplicaFanout([a, b], cooldown=30.0)
+    probe_home = fan._order(sticky_key="list\x00Notebook\x00team-a")[0]
+    home, other = (a, b) if probe_home == 0 else (b, a)
+    home.fail = True
+    items, _ = fan.list_chunk("Notebook", namespace="team-a", limit=10)
+    assert items == []
+    assert any(c[0] == "list_chunk" for c in other.calls), (
+        "failover never reached the healthy endpoint"
+    )
+
+
+def test_replica_fanout_walk_stays_pinned_when_better_endpoint_recovers():
+    """The continue token pins its endpoint: a better-ranked replica
+    RECOVERING mid-walk must not steal the next page (its history is
+    a different horizon — offsets into it skip/repeat rows)."""
+    from odh_kubeflow_tpu.machinery.client import ReplicaFanout
+
+    a, b = _FakeEndpoint("a"), _FakeEndpoint("b")
+    fan = ReplicaFanout([a, b], cooldown=30.0)
+    home_idx = fan._order(sticky_key="list\x00Notebook\x00team-a")[0]
+    home, other = (a, b) if home_idx == 0 else (b, a)
+    other_idx = 1 - home_idx
+    # the rendezvous home is down when the walk starts → first page
+    # (and the token) belong to the OTHER endpoint
+    fan._mark_down(home_idx, OSError("down"))
+    _, token = fan.list_chunk("Notebook", namespace="team-a", limit=10)
+    assert other.calls and not home.calls
+    # the home recovers (cooldown cleared) — later pages must STILL go
+    # to the token's endpoint, not the recovered rendezvous winner
+    fan._down_until.clear()
+    fan.list_chunk(
+        "Notebook", namespace="team-a", limit=10, continue_token=token
+    )
+    assert not any(c[0] == "list_chunk" for c in home.calls), (
+        "a recovered endpoint stole a pinned walk's page"
+    )
+    # the endpoint pin is stripped before the server sees the token
+    assert other.calls[-1][2] == f"{other.base_url}-token"
+
+
+def test_remote_watch_reconnect_window_bounds_a_dead_endpoint(tmp_path):
+    """With reconnect_window set, a watch whose endpoint is gone for
+    good ends with an error instead of reconnecting forever — the
+    consumer relists and (through the fanout probe) re-homes."""
+    import socket as socketlib
+
+    from odh_kubeflow_tpu.machinery.client import RemoteAPIServer
+
+    # grab a port nothing listens on
+    s = socketlib.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    client = RemoteAPIServer(
+        f"http://127.0.0.1:{port}", retry_base=0.01, retry_cap=0.05
+    )
+    register_crds(client)
+    w = client.watch("Notebook", reconnect_window=0.3)
+    deadline = time.time() + 5
+    while time.time() < deadline and not w.ended:
+        time.sleep(0.05)
+    assert w.ended and w.error is not None
+    w.stop()
+
+
+def test_replica_fanout_rendezvous_stable_under_blip():
+    """An endpoint blipping out of the healthy set remaps only the
+    keys it owned — sticky homes on the surviving endpoints hold."""
+    from odh_kubeflow_tpu.machinery.client import ReplicaFanout
+
+    eps = [_FakeEndpoint(n) for n in ("a", "b", "c")]
+    fan = ReplicaFanout(eps, cooldown=30.0)
+    keys = [f"Kind{i}\x00ns" for i in range(12)]
+    before = {k: fan._order(sticky_key=k)[0] for k in keys}
+    # pick one endpoint and blip it
+    blipped = before[keys[0]]
+    fan._mark_down(blipped, OSError("blip"))
+    after = {k: fan._order(sticky_key=k)[0] for k in keys}
+    for k in keys:
+        if before[k] != blipped:
+            assert after[k] == before[k], "unaffected sticky key remapped"
+        else:
+            assert after[k] != blipped
+
+
+def test_api_from_env_comma_list_builds_fanout(monkeypatch):
+    from odh_kubeflow_tpu.machinery.client import (
+        ReplicaFanout,
+        api_from_env,
+    )
+
+    api = api_from_env("http://replica-a:8002, http://replica-b:8002")
+    assert isinstance(api, ReplicaFanout)
+    assert [c.base_url for c in api.clients] == [
+        "http://replica-a:8002",
+        "http://replica-b:8002",
+    ]
+    # kind registry fans out so path mapping works on every endpoint
+    api.register_kind("x.dev/v1", "Gizmo", "gizmos", True)
+    for c in api.clients:
+        assert c.type_info("Gizmo").plural == "gizmos"
+
+
+def test_remote_client_mirrors_served_rv_header(tmp_path):
+    from odh_kubeflow_tpu.machinery import httpapi
+    from odh_kubeflow_tpu.machinery.client import RemoteAPIServer
+
+    api = APIServer()
+    register_crds(api)
+    _, port, srv = httpapi.serve(api, host="127.0.0.1", port=0)
+    try:
+        client = RemoteAPIServer(f"http://127.0.0.1:{port}")
+        register_crds(client)
+        assert client.applied_rv() is None  # no request yet
+        client.create(notebook("nb-rv"))
+        client.list("Notebook", namespace="team-a")
+        assert client.applied_rv() == api.applied_rv()
+    finally:
+        srv.shutdown()
