@@ -27,12 +27,17 @@ conformance:
 
 # syntax check + graftlint: per-file AST invariant rules PLUS the
 # whole-program call-graph rules (lock-order-cycle,
-# blocking-reachable-under-lock, await-holding-lock) — see
-# docs/GUIDE.md "Static analysis & concurrency discipline". Exit-code
-# gated; fails only on findings NOT in analysis/baseline.json.
+# blocking-reachable-under-lock, await-holding-lock) and the
+# exception-flow rules (error-contract, handler-masks-fencing,
+# dead-except) — see docs/GUIDE.md "Static analysis & concurrency
+# discipline" and "Error contracts". Exit-code gated; fails only on
+# findings NOT in analysis/baseline.json. The knob-registry lint
+# cross-checks every os.environ knob against analysis/knobs.json,
+# GUIDE.md, and manifest env stanzas.
 lint:
 	$(PYTHON) -m compileall -q odh_kubeflow_tpu tests loadtest bench.py __graft_entry__.py
 	$(PYTHON) -m odh_kubeflow_tpu.analysis
+	$(PYTHON) -m odh_kubeflow_tpu.analysis.knobs
 
 # deterministic schedule explorer (docs/GUIDE.md "Deterministic
 # schedule exploration"): seeded one-runnable-at-a-time interleavings

@@ -7,12 +7,18 @@ Three halves, one package:
   uncached-list, swallowed-exception, blocking-under-lock,
   metric-naming, …) and whole-program over the package call graph
   (``analysis/callgraph.py``): ``lock-order-cycle``,
-  ``blocking-reachable-under-lock``, ``await-holding-lock``, each
-  reporting witness call chains. Run with
-  ``python -m odh_kubeflow_tpu.analysis`` (exit-code gated, wired into
-  ``make lint`` and CI); ``--format=json`` for machines, and a
-  committed ``analysis/baseline.json`` ratchet so the gate fails only
-  on NEW findings.
+  ``blocking-reachable-under-lock``, ``await-holding-lock``, plus the
+  exception-flow rules (``analysis/exceptions.py`` — interprocedural
+  raise-set inference): ``error-contract``,
+  ``handler-masks-fencing``, ``dead-except``, each reporting witness
+  call chains. Run with ``python -m odh_kubeflow_tpu.analysis``
+  (exit-code gated, wired into ``make lint`` and CI);
+  ``--format=json`` for machines, and a committed
+  ``analysis/baseline.json`` ratchet so the gate fails only on NEW
+  findings. The knob-registry drift lint (``analysis/knobs.py`` +
+  ``knobs.json``) cross-checks every ``os.environ`` knob against the
+  registry, GUIDE.md, and manifest env stanzas
+  (``python -m odh_kubeflow_tpu.analysis.knobs``).
 - **sanitizer** (``analysis/sanitizer.py``): the ``GRAFT_SANITIZE=1``
   lock-wrapping layer that turns the randomized property tests into
   race probes (lock-order inversions, non-reentrant re-entry,

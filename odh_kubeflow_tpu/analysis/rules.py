@@ -1314,3 +1314,12 @@ class AwaitHoldingLockRule(ProgramRule):
                             f"loop coroutine {fn.short}: "
                             + callgraph.render_chain((head,) + chain),
                         )
+
+
+# ---------------------------------------------------------------------------
+# exception-flow rules (whole-program: analysis/exceptions.py)
+
+# error-contract / handler-masks-fencing / dead-except self-register on
+# import — raise-set inference over the same call graph, see the
+# module docstring for the contract table and suppression syntax
+from odh_kubeflow_tpu.analysis import exceptions as _exceptions  # noqa: E402,F401

@@ -60,7 +60,11 @@ class CullerConfig:
     idleness_check_seconds: float = 60.0
     cluster_domain: str = "cluster.local"
     probe_timeout: float = 5.0
-    # TPU activity: duty cycle above this percentage counts as active
+    # TPU activity: duty cycle above this percentage counts as active.
+    # check_tpu_duty_cycle=False skips the agent probe entirely
+    # (CULL_CHECK_TPU_DUTY_CYCLE env — clusters without the in-image
+    # tpu-activity-agent fall back to Jupyter-kernel idleness only)
+    check_tpu_duty_cycle: bool = True
     tpu_duty_cycle_threshold: float = 5.0
     # port the in-image tpu-activity-agent listens on (exposed by the
     # notebook Service for TPU notebooks; images/*/tpu-activity-agent)
@@ -160,7 +164,8 @@ class Culler:
         # stall the probe for its full timeout
         tpu = (
             self._get_json(self._tpu_url_fn(notebook))
-            if TPU_ACCELERATOR_ANNOTATION in obj_util.annotations_of(notebook)
+            if self.config.check_tpu_duty_cycle
+            and TPU_ACCELERATOR_ANNOTATION in obj_util.annotations_of(notebook)
             else None
         )
         if tpu is not None:

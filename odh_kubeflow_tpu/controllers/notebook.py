@@ -86,6 +86,9 @@ class NotebookControllerConfig:
     # wedge-breaker: a suspend whose snapshot never lands within this
     # window degrades to a plain stop (losing state beats leaking chips)
     suspend_grace_seconds: float = 600.0
+    # whether the culler probes the in-image tpu-activity-agent for
+    # duty cycle before declaring a TPU notebook idle
+    cull_check_tpu_duty_cycle: bool = True
 
     @staticmethod
     def from_env() -> "NotebookControllerConfig":
@@ -106,6 +109,7 @@ class NotebookControllerConfig:
             * 60.0,
             enable_queueing=flag("ENABLE_TPU_QUEUEING", "true"),
             enable_sessions=flag("ENABLE_SESSION_SUSPEND", "true"),
+            cull_check_tpu_duty_cycle=flag("CULL_CHECK_TPU_DUTY_CYCLE", "true"),
             suspend_grace_seconds=float(
                 env.get("SESSION_SUSPEND_GRACE_SECONDS", "600")
             ),
@@ -843,18 +847,14 @@ class NotebookController:
             # deepcopies; at N notebooks per drain that tax dominates)
             return
         notebook["status"] = status
-        updated = self.api.update_status(notebook)
+        if reconcilehelper.update_status_level_triggered(self.api, notebook) is None:
+            return  # Conflict: the conflicting write re-enqueues this key
         if observe_spawn:
             created = obj_util.meta(notebook).get("creationTimestamp", "")
             if created:
                 self.m_spawn_ready.observe(
                     max(_time.time() - obj_util.parse_rfc3339(created), 0.0)
                 )
-        # keep the in-hand dict fresh for follow-up status writes in the
-        # same reconcile (slice health, conditions)
-        notebook["metadata"]["resourceVersion"] = updated["metadata"][
-            "resourceVersion"
-        ]
 
     def _set_condition(self, notebook: Obj, reason: str, message: str) -> None:
         self._upsert_condition(notebook, "Degraded", "True", reason, message)
@@ -875,10 +875,7 @@ class NotebookController:
                 break
         else:
             conditions.append(cond)
-        updated = self.api.update_status(notebook)
-        notebook["metadata"]["resourceVersion"] = updated["metadata"][
-            "resourceVersion"
-        ]
+        reconcilehelper.update_status_level_triggered(self.api, notebook)
 
 
 def main() -> None:
@@ -905,6 +902,7 @@ def main() -> None:
                     idleness_check_seconds=cfg.idleness_check_seconds,
                     cluster_domain=cfg.cluster_domain,
                     suspend_on_cull=cfg.enable_sessions,
+                    check_tpu_duty_cycle=cfg.cull_check_tpu_duty_cycle,
                 ),
             )
         # the controller's own counters must live on the registry the

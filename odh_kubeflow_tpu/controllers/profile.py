@@ -31,13 +31,29 @@ Obj = dict[str, Any]
 PROFILE_FINALIZER = "profile-finalizer.kubeflow.org"
 OWNER_ANNOTATION = "owner"
 QUOTA_NAME = "kf-resource-quota"
-TPU_QUOTA_KEY = "requests.google.com/tpu"
+# the TPU-chip quota key injected into kf-resource-quota — the
+# profile-controller manifest sets QUOTA_TPU_KEY (reference
+# profile_controller.go:253-268 generalized)
+TPU_QUOTA_KEY = os.environ.get("QUOTA_TPU_KEY", "requests.google.com/tpu")
 USER_HEADER = os.environ.get("USERID_HEADER", "kubeflow-userid")
 DEFAULT_EDITOR = "default-editor"
 DEFAULT_VIEWER = "default-viewer"
 ADMIN_ROLE = "kubeflow-admin"
 EDIT_ROLE = "kubeflow-edit"
 VIEW_ROLE = "kubeflow-view"
+
+
+def _stamp_editor_sa(api: APIServer, ns: str, key: str, value: str) -> None:
+    """Annotate the namespace's default-editor ServiceAccount through
+    ``patch`` — the server-side guaranteedUpdate shape (read-merge-write
+    with Conflict retries, the error-contract policy anchor), so a race
+    with another controller stamping the same SA never surfaces."""
+    api.patch(
+        "ServiceAccount",
+        DEFAULT_EDITOR,
+        {"metadata": {"annotations": {key: value}}},
+        ns,
+    )
 
 
 class ProfilePlugin:
@@ -67,9 +83,7 @@ class GcpWorkloadIdentityPlugin(ProfilePlugin):
     def apply(self, api: APIServer, profile: Obj, spec: Obj) -> None:
         gcp_sa = spec.get("gcpServiceAccount", "")
         ns = obj_util.name_of(profile)
-        sa = mutable(api.get("ServiceAccount", DEFAULT_EDITOR, ns))
-        obj_util.set_annotation(sa, "iam.gke.io/gcp-service-account", gcp_sa)
-        api.update(sa)
+        _stamp_editor_sa(api, ns, "iam.gke.io/gcp-service-account", gcp_sa)
         member = f"serviceAccount:{ns}.svc.id.goog[{ns}/{DEFAULT_EDITOR}]"
         self.iam_client(gcp_sa, member, "add")
 
@@ -89,9 +103,7 @@ class AwsIamForServiceAccountPlugin(ProfilePlugin):
     def apply(self, api: APIServer, profile: Obj, spec: Obj) -> None:
         arn = spec.get("awsIamRole", "")
         ns = obj_util.name_of(profile)
-        sa = mutable(api.get("ServiceAccount", DEFAULT_EDITOR, ns))
-        obj_util.set_annotation(sa, "eks.amazonaws.com/role-arn", arn)
-        api.update(sa)
+        _stamp_editor_sa(api, ns, "eks.amazonaws.com/role-arn", arn)
         self.iam_client(arn, f"{ns}/{DEFAULT_EDITOR}", "add")
 
     def revoke(self, api: APIServer, profile: Obj, spec: Obj) -> None:
@@ -174,12 +186,16 @@ class ProfileController:
                 meta["finalizers"] = [
                     f for f in meta["finalizers"] if f != PROFILE_FINALIZER
                 ]
-                self.api.update(profile)
+                # a Conflict re-enqueues this Profile; the strip is
+                # idempotent on the next pass
+                self.api.update(profile)  # contract-ok: level-triggered
             return Result()
 
         if PROFILE_FINALIZER not in (meta.get("finalizers") or []):
             meta.setdefault("finalizers", []).append(PROFILE_FINALIZER)
-            profile = self.api.update(profile)
+            # a Conflict re-enqueues this Profile; the stamp is
+            # idempotent on the next pass
+            profile = self.api.update(profile)  # contract-ok: level-triggered
 
         try:
             self._reconcile_namespace(profile)

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional
 
-from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery import backoff, objects as obj_util
 from odh_kubeflow_tpu.machinery.objects import FrozenObjectError, mutable
 from odh_kubeflow_tpu.machinery.store import APIServer, Conflict, NotFound
 
@@ -74,6 +74,51 @@ _COPIERS: dict[str, Callable[[Obj, Obj], bool]] = {
 }
 
 
+def update_status_level_triggered(api: APIServer, obj: Obj) -> Optional[Obj]:
+    """Status-mirror write under the PR-5 posture: a Conflict means the
+    object moved under us — the conflicting write's own watch event
+    re-enqueues the key and the next reconcile mirrors from fresh
+    state, so retrying the stale resourceVersion in place cannot land
+    and the Conflict is absorbed (``None`` returned) instead of
+    surfacing as a reconcile error. On success the in-hand object's
+    resourceVersion is refreshed for follow-up status writes in the
+    same reconcile, and the updated object is returned."""
+    try:
+        updated = api.update_status(obj)
+    except Conflict:
+        return None
+    obj["metadata"]["resourceVersion"] = updated["metadata"]["resourceVersion"]
+    return updated
+
+
+def _reconcile_attempt(
+    api: APIServer, desired: Obj, copier: Callable[[Obj, Obj], bool]
+) -> tuple[Obj, bool]:
+    """One full create-or-update pass: read fresh, copy owned fields,
+    write. A Conflict re-runs the WHOLE pass (fresh read included) via
+    the retry wrapper in :func:`reconcile_object` — retrying just the
+    write would re-send the stale resourceVersion forever."""
+    kind = desired.get("kind", "")
+    meta = desired.get("metadata", {})
+    try:
+        current = api.get(kind, meta.get("name", ""), meta.get("namespace"))
+    except NotFound:
+        return api.create(desired), True
+    # copy-on-write against the shared cache: run the copier on the
+    # (possibly frozen) cached object; the steady state — nothing
+    # to change — completes with ZERO copies. Only when the copier
+    # actually needs to write does the frozen object raise, and we
+    # retry on a private mutable copy.
+    try:
+        changed = copier(desired, current)
+    except FrozenObjectError:
+        current = mutable(current)
+        changed = copier(desired, current)
+    if changed:
+        return api.update(current), False
+    return current, False
+
+
 def reconcile_object(
     api: APIServer,
     desired: Obj,
@@ -81,36 +126,19 @@ def reconcile_object(
     copier: Optional[Callable[[Obj, Obj], bool]] = None,
 ) -> tuple[Obj, bool]:
     """Create ``desired`` (with controller ownerReference) or update the
-    existing object using the kind-appropriate field copier. Retries
-    once on Conflict (reference: notebook_route.go:119-131 pattern).
-    Returns ``(object, created)`` — the flag lets callers count/emit on
-    first materialisation without a pre-flight existence GET."""
+    existing object using the kind-appropriate field copier. Conflicts
+    re-run the read-merge-write through ``machinery.backoff`` (jittered
+    exponential delays, capped attempts — the PR-5 retry policy; the
+    error-contract lint holds every reconcile path to it). Returns
+    ``(object, created)`` — the flag lets callers count/emit on first
+    materialisation without a pre-flight existence GET."""
     if owner is not None:
         obj_util.set_controller_reference(desired, owner)
-    kind = desired.get("kind", "")
-    copier = copier or _COPIERS.get(kind, copy_spec_wholesale)
-    meta = desired.get("metadata", {})
-    for attempt in (0, 1):
-        try:
-            current = api.get(kind, meta.get("name", ""), meta.get("namespace"))
-        except NotFound:
-            return api.create(desired), True
-        # copy-on-write against the shared cache: run the copier on the
-        # (possibly frozen) cached object; the steady state — nothing
-        # to change — completes with ZERO copies. Only when the copier
-        # actually needs to write does the frozen object raise, and we
-        # retry on a private mutable copy.
-        try:
-            changed = copier(desired, current)
-        except FrozenObjectError:
-            current = mutable(current)
-            changed = copier(desired, current)
-        if changed:
-            try:
-                return api.update(current), False
-            except Conflict:
-                if attempt:
-                    raise
-                continue
-        return current, False
-    return current, False
+    copier = copier or _COPIERS.get(desired.get("kind", ""), copy_spec_wholesale)
+    return backoff.retry(
+        lambda: _reconcile_attempt(api, desired, copier),
+        retryable=Conflict,
+        attempts=4,
+        base=0.01,
+        cap=0.5,
+    )

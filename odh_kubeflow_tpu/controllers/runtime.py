@@ -27,7 +27,12 @@ from typing import Any, Callable, Optional
 
 from odh_kubeflow_tpu.analysis import sanitizer as _sanitizer
 from odh_kubeflow_tpu.machinery import objects as obj_util
-from odh_kubeflow_tpu.machinery.store import APIServer, Watch
+from odh_kubeflow_tpu.machinery.store import (
+    APIServer,
+    FencedOut,
+    NotLeader,
+    Watch,
+)
 from odh_kubeflow_tpu.utils import prometheus, tracing
 
 log = logging.getLogger("controller-runtime")
@@ -193,6 +198,10 @@ class Controller:
         self._limiter = _RateLimiter()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # latched when a reconcile write is rejected by the fencing
+        # check — the observable trace of a deposed (or stalled-past-
+        # expiry) epoch between the rejection and the elector's verdict
+        self.fenced_out = False
 
         self.watches(
             for_kind,
@@ -311,6 +320,43 @@ class Controller:
                 fence = self.fence_fn() if self.fence_fn else contextlib.nullcontext()
                 with fence:
                     result = self.reconcile(req) or Result()
+            except (FencedOut, NotLeader) as e:
+                # authority failure, not a data race (PR-8 fencing
+                # rule): the write carried a stale/absent epoch and was
+                # REJECTED — correctness is already protected by the
+                # store, and retrying under the same fence cannot land,
+                # so the key is dropped (no backoff requeue). Do NOT
+                # hard-stop the controller here: a lease that merely
+                # EXPIRED during a stall re-acquires with the SAME
+                # token on the elector's next renew, and the next watch
+                # event picks the key back up under the fresh fence. A
+                # genuinely deposed replica keeps landing here (every
+                # write rejected, nothing applied) only until its
+                # elector observes the takeover and fires on_lost — the
+                # process-exit stand-down lives THERE
+                # (runner.run_controller wires on_lost → os._exit),
+                # where expiry-then-renew and deposition are
+                # distinguishable.
+                self._m_reconcile_time.observe(self.time_fn() - start)
+                self.metrics.reconcile_total.inc(
+                    {"controller": self.name, "result": "fenced_out"}
+                )
+                tracing.set_status("error", f"{type(e).__name__}: {e}")
+                self.fenced_out = True  # recorded for operators/drills
+                log.error(
+                    "%s: reconcile %s rejected by fencing (%s); dropping "
+                    "the key without requeue — the elector owns the "
+                    "stand-down decision",
+                    self.name,
+                    req,
+                    e,
+                )
+                self._done(req)
+                # same fresh-start posture as the sharded_out drop: the
+                # key was not processed here, so stale error-backoff
+                # state must not survive into its next incarnation
+                self._limiter.forget(req)
+                return
             except Exception as e:
                 elapsed = self.time_fn() - start
                 self._m_reconcile_time.observe(elapsed)

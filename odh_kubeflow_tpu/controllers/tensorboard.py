@@ -280,7 +280,7 @@ class TensorboardController:
         if (tb.get("status") or {}) == status:
             return  # steady state: skip the no-op status round-trip
         tb["status"] = status
-        self.api.update_status(tb)
+        reconcilehelper.update_status_level_triggered(self.api, tb)
 
 
 def main() -> None:

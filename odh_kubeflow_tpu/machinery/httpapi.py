@@ -489,6 +489,10 @@ class RestAPI:
         try:
             return self._dispatch(kind, route, method, qs, environ, start_response)
         except APIError as e:
+            # fencing-ok: protocol boundary — FencedOut maps to a 403 +
+            # Status(reason=FencedOut) response; the REMOTE caller is
+            # the deposed holder and must stand down, the server keeps
+            # serving
             headers = []
             if isinstance(e, TooManyRequests):
                 headers.append(_retry_after_header(e.retry_after))

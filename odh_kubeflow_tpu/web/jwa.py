@@ -256,7 +256,7 @@ class JupyterWebApp(CrudBackend):
                     for nb in self.api.list("Notebook", namespace=namespace)  # unbounded-ok: cache-served zero-copy read
                 ]
 
-            return self.listing_response(
+            return self.listing_response(  # contract-ok: kube 410 pagination contract — a stale continue token answers 410 Expired and the client restarts its walk from a fresh first page
                 "notebooks",
                 ("notebooks", namespace),
                 build_rows,
@@ -451,7 +451,7 @@ class JupyterWebApp(CrudBackend):
         @app.route("/api/namespaces/<namespace>/pvcs")
         def list_pvcs(request, namespace):
             self.authorize(request, "list", "persistentvolumeclaims", namespace)
-            return self.listing_response(
+            return self.listing_response(  # contract-ok: kube 410 pagination contract — a stale continue token answers 410 Expired and the client restarts its walk from a fresh first page
                 "pvcs",
                 ("pvcs", namespace),
                 lambda: self.api.list(  # unbounded-ok: cache-served zero-copy read

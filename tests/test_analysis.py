@@ -46,6 +46,10 @@ def test_rule_catalog_has_the_platform_rules():
         "lock-order-cycle",
         "blocking-reachable-under-lock",
         "await-holding-lock",
+        # exception-flow rules (analysis/exceptions.py)
+        "error-contract",
+        "handler-masks-fencing",
+        "dead-except",
     } <= ids
     assert len(ids) >= 5
 

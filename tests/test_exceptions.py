@@ -1,0 +1,676 @@
+"""Exception-flow analysis: error-contract / handler-masks-fencing /
+dead-except (analysis/exceptions.py).
+
+Per-rule fixture tests (true positive, suppressed, clean), unit tests
+of the raise-set inference (hierarchy mining, try/except narrowing,
+``backoff.retry`` absorption, handler-tuple constants), and the
+regression drills the acceptance criteria demand: re-broadening the
+fixed runtime fencing handler, reverting the reconcilehelper Conflict
+retry, and reverting the PR-5 client retry policy each re-light the
+corresponding rule with entry-point → raise witness chains, stable
+under ``--format=json``."""
+
+import json
+import shutil
+
+import pytest
+
+from odh_kubeflow_tpu.analysis import active_rules, lint_source
+from odh_kubeflow_tpu.analysis import exceptions as excmod
+from odh_kubeflow_tpu.analysis.callgraph import build_program
+from odh_kubeflow_tpu.analysis.graftlint import (
+    SourceFile,
+    main as lint_main,
+    package_root,
+    run_paths,
+)
+
+EXC_RULES = ["error-contract", "handler-masks-fencing", "dead-except"]
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+def _one_file_analysis(src_text, rel="controllers/x.py"):
+    program = build_program([SourceFile(rel, rel, src_text)])
+    return excmod.ExceptionAnalysis.of(program)
+
+
+# ---------------------------------------------------------------------------
+# inference unit tests
+
+
+def test_rule_catalog_has_the_exception_rules():
+    assert {r.id for r in active_rules()} >= set(EXC_RULES)
+
+
+def test_hierarchy_mined_from_fixture_classes():
+    src = (
+        "class APIError(Exception):\n    pass\n"
+        "class Conflict(APIError):\n    pass\n"
+        "class Custom(Conflict):\n    pass\n"
+    )
+    ea = _one_file_analysis(src, rel="machinery/store.py")
+    assert ea.hierarchy["Custom"] == "Conflict"
+    # hierarchy-aware catching: APIError absorbs the grandchild
+    assert ea.catches(("APIError",), "Custom")
+    assert ea.catches(("Exception",), "Custom")
+    assert not ea.catches(("NotFound",), "Custom")
+
+
+def test_fixture_mode_falls_back_to_default_hierarchy():
+    ea = _one_file_analysis("def f():\n    pass\n")
+    assert ea.hierarchy["Conflict"] == "APIError"
+    assert ea.hierarchy["FencedOut"] == "APIError"
+
+
+def test_verb_model_and_try_narrowing():
+    src = (
+        "class C:\n"
+        "    def f(self):\n"
+        "        try:\n"
+        "            return self.api.update(1)\n"
+        "        except Conflict:\n"
+        "            return None\n"
+        "    def g(self):\n"
+        "        return self.api.update(1)\n"
+    )
+    ea = _one_file_analysis(src)
+    f = {e for e, _s, can, _esc in ea.result_for("controllers/x.py::C.f").sites if can}
+    g = {e for e, _s, can, _esc in ea.result_for("controllers/x.py::C.g").sites if can}
+    assert "Conflict" not in f  # absorbed by the handler
+    assert "Conflict" in g
+    assert "FencedOut" in g  # mutations carry the fencing surface
+
+
+def test_handler_tuple_constant_resolved():
+    src = (
+        "_OUTAGE = (APIError, OSError)\n"
+        "class C:\n"
+        "    def f(self):\n"
+        "        try:\n"
+        "            return self.api.update(1)\n"
+        "        except _OUTAGE:\n"
+        "            return None\n"
+    )
+    ea = _one_file_analysis(src)
+    sites = ea.result_for("controllers/x.py::C.f").sites
+    assert not [e for e, _s, can, _esc in sites if can and e == "Conflict"]
+
+
+def test_bound_name_reraise_is_passthrough():
+    """``except APIError as e: …; raise e`` re-raises exactly like a
+    bare ``raise`` — the clause must not read as an absorber."""
+    src = (
+        "class C:\n"
+        "    def f(self):\n"
+        "        try:\n"
+        "            return self.api.update(1)\n"
+        "        except APIError as e:\n"
+        "            self.count = 1\n"
+        "            raise e\n"
+    )
+    ea = _one_file_analysis(src)
+    sites = ea.result_for("controllers/x.py::C.f").sites
+    assert [e for e, _s, can, _esc in sites if can and e == "Conflict"]
+
+
+def test_variable_raise_poisons_dead_except_completeness():
+    """``err = Conflict(…); raise err`` is invisible to the literal
+    raise scan — it must poison completeness so dead-except never
+    calls the (live) handler dead."""
+    src = (
+        "class C:\n"
+        "    def f(self):\n"
+        "        try:\n"
+        "            err = Conflict('x')\n"
+        "            raise err\n"
+        "        except Conflict:\n"
+        "            return None\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["dead-except"]) == []
+    # a non-platform constructor raise stays analyzable: the handler
+    # below really is dead
+    src = (
+        "class C:\n"
+        "    def f(self):\n"
+        "        try:\n"
+        "            raise ValueError('x')\n"
+        "        except Conflict:\n"
+        "            return None\n"
+    )
+    assert rule_ids(lint_source(src, "controllers/x.py", ["dead-except"])) == [
+        "dead-except"
+    ]
+
+
+def test_bare_reraise_is_passthrough():
+    src = (
+        "class C:\n"
+        "    def f(self):\n"
+        "        try:\n"
+        "            return self.api.update(1)\n"
+        "        except APIError:\n"
+        "            raise\n"
+    )
+    ea = _one_file_analysis(src)
+    sites = ea.result_for("controllers/x.py::C.f").sites
+    assert [e for e, _s, can, _esc in sites if can and e == "Conflict"]
+
+
+def test_retry_absorbs_contract_view_but_not_can_raise():
+    src = (
+        "from odh_kubeflow_tpu.machinery.backoff import retry\n"
+        "class C:\n"
+        "    def f(self):\n"
+        "        retry(lambda: self.api.update(1), retryable=Conflict)\n"
+    )
+    ea = _one_file_analysis(src)
+    rows = {
+        e: (can, esc)
+        for e, _s, can, esc in ea.result_for("controllers/x.py::C.f").sites
+    }
+    assert rows["Conflict"] == (True, False)  # retry IS the handling
+    assert rows["FencedOut"][1] is True  # not in the retryable set
+
+
+def test_witness_chain_spans_helper_calls():
+    src = (
+        "class C:\n"
+        "    def reconcile(self, req):\n"
+        "        self._sync(req)\n"
+        "    def _sync(self, req):\n"
+        "        self.api.update(req)\n"
+    )
+    findings = lint_source(src, "controllers/x.py", ["error-contract"])
+    [f] = [f for f in findings if "Conflict" in f.message]
+    assert "C.reconcile (x.py:3)" in f.message
+    assert "C._sync (x.py:5)" in f.message
+    assert "api.update() can raise Conflict" in f.message
+
+
+# ---------------------------------------------------------------------------
+# error-contract fixtures
+
+
+def test_error_contract_true_positive_reconcile():
+    src = (
+        "class C:\n"
+        "    def reconcile(self, req):\n"
+        "        return self.api.update(req)\n"
+    )
+    findings = lint_source(src, "controllers/x.py", ["error-contract"])
+    assert rule_ids(findings) == ["error-contract"]
+    assert "retryable Conflict" in findings[0].message
+
+
+def test_error_contract_web_handler_expired():
+    src = (
+        "class A:\n"
+        "    def _register(self, app):\n"
+        "        @app.route('/x')\n"
+        "        def h(request):\n"
+        "            return self.api.list_chunk('Pod', limit=5)\n"
+    )
+    findings = lint_source(src, "web/x.py", ["error-contract"])
+    assert any("Expired" in f.message for f in findings)
+    # same handler with the walk guarded is clean
+    src_ok = (
+        "class A:\n"
+        "    def _register(self, app):\n"
+        "        @app.route('/x')\n"
+        "        def h(request):\n"
+        "            try:\n"
+        "                return self.api.list_chunk('Pod', limit=5)\n"
+        "            except Expired:\n"
+        "                return None\n"
+    )
+    assert lint_source(src_ok, "web/x.py", ["error-contract"]) == []
+
+
+def test_error_contract_promoter_step():
+    src = (
+        "class W:\n"
+        "    def step(self):\n"
+        "        self.api.update({})\n"
+    )
+    findings = lint_source(src, "machinery/promoter.py", ["error-contract"])
+    assert any("promoter step" in f.message for f in findings)
+
+
+def test_error_contract_clean_variants():
+    # handled with except
+    src = (
+        "class C:\n"
+        "    def reconcile(self, req):\n"
+        "        try:\n"
+        "            return self.api.update(req)\n"
+        "        except Conflict:\n"
+        "            return None\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["error-contract"]) == []
+    # routed through backoff.retry
+    src = (
+        "from odh_kubeflow_tpu.machinery.backoff import retry\n"
+        "class C:\n"
+        "    def reconcile(self, req):\n"
+        "        return retry(lambda: self.api.update(req), retryable=Conflict)\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["error-contract"]) == []
+    # reads don't trip the contract (429 is anchor-absorbed)
+    src = (
+        "class C:\n"
+        "    def reconcile(self, req):\n"
+        "        return self.api.get('Pod', req.name)\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["error-contract"]) == []
+    # reconcile-shaped functions outside the contract sections pass
+    src = (
+        "class C:\n"
+        "    def reconcile(self, req):\n"
+        "        return self.api.update(req)\n"
+    )
+    assert lint_source(src, "models/x.py", ["error-contract"]) == []
+
+
+def test_error_contract_contract_ok_marker():
+    src = (
+        "class C:\n"
+        "    def reconcile(self, req):\n"
+        "        return self.api.update(req)  # contract-ok: level-triggered\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["error-contract"]) == []
+
+
+def test_error_contract_marker_certifies_through_caller_chain():
+    """A contract-ok marker INSIDE a helper clears the escape for every
+    entry point calling the helper — certification is by site, not by
+    entry function."""
+    src = (
+        "class C:\n"
+        "    def reconcile(self, req):\n"
+        "        self._sync(req)\n"
+        "    def _sync(self, req):\n"
+        "        self.api.update(req)  # contract-ok: level-triggered\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["error-contract"]) == []
+
+
+def test_error_contract_graftlint_disable_also_works():
+    src = (
+        "class C:\n"
+        "    def reconcile(self, req):\n"
+        "        return self.api.update(req)  # graftlint: disable=error-contract tested elsewhere\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["error-contract"]) == []
+
+
+# ---------------------------------------------------------------------------
+# handler-masks-fencing fixtures
+
+
+def test_masks_fencing_direct_catch_and_continue():
+    src = (
+        "class C:\n"
+        "    def run(self):\n"
+        "        try:\n"
+        "            self.api.update({})\n"
+        "        except FencedOut:\n"
+        "            pass\n"
+    )
+    findings = lint_source(src, "machinery/x.py", ["handler-masks-fencing"])
+    assert rule_ids(findings) == ["handler-masks-fencing"]
+    assert "FencedOut" in findings[0].message
+
+
+def test_masks_fencing_broad_catch_with_reachable_fencing():
+    src = (
+        "class C:\n"
+        "    def run(self):\n"
+        "        try:\n"
+        "            self.api.update({})\n"
+        "        except Exception:\n"
+        "            self.count = 1\n"
+    )
+    findings = lint_source(src, "machinery/x.py", ["handler-masks-fencing"])
+    assert rule_ids(findings) == ["handler-masks-fencing"]
+    assert "broad handler absorbs" in findings[0].message
+    assert "api.update() can raise FencedOut" in findings[0].message
+
+
+def test_masks_fencing_clean_variants():
+    # re-raise aborts
+    src = (
+        "class C:\n"
+        "    def run(self):\n"
+        "        try:\n"
+        "            self.api.update({})\n"
+        "        except FencedOut:\n"
+        "            raise\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["handler-masks-fencing"]) == []
+    # stand-down call aborts
+    src = (
+        "class C:\n"
+        "    def run(self):\n"
+        "        try:\n"
+        "            self.api.update({})\n"
+        "        except FencedOut:\n"
+        "            self.stop()\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["handler-masks-fencing"]) == []
+    # recording the deposition aborts
+    src = (
+        "class C:\n"
+        "    def run(self):\n"
+        "        try:\n"
+        "            self.api.update({})\n"
+        "        except FencedOut:\n"
+        "            self.fenced = True\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["handler-masks-fencing"]) == []
+    # a narrow fencing clause before the broad one clears it
+    src = (
+        "class C:\n"
+        "    def run(self):\n"
+        "        try:\n"
+        "            self.api.update({})\n"
+        "        except (FencedOut, NotLeader):\n"
+        "            raise\n"
+        "        except Exception:\n"
+        "            self.count = 1\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["handler-masks-fencing"]) == []
+    # broad handler around reads: no fencing error reachable
+    src = (
+        "class C:\n"
+        "    def run(self):\n"
+        "        try:\n"
+        "            self.api.get('Pod', 'x')\n"
+        "        except Exception:\n"
+        "            self.count = 1\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["handler-masks-fencing"]) == []
+    # web/ is out of scope (BFFs are unfenced by design)
+    src = (
+        "class C:\n"
+        "    def run(self):\n"
+        "        try:\n"
+        "            self.api.update({})\n"
+        "        except FencedOut:\n"
+        "            pass\n"
+    )
+    assert lint_source(src, "web/x.py", ["handler-masks-fencing"]) == []
+
+
+def test_masks_fencing_fencing_ok_marker():
+    src = (
+        "class C:\n"
+        "    def run(self):\n"
+        "        try:\n"
+        "            self.api.update({})\n"
+        "        except FencedOut:\n"
+        "            # fencing-ok: drill harness records the rejection\n"
+        "            self.count = 1\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["handler-masks-fencing"]) == []
+
+
+# ---------------------------------------------------------------------------
+# dead-except fixtures
+
+
+def test_dead_except_true_positive():
+    src = (
+        "class C:\n"
+        "    def f(self):\n"
+        "        try:\n"
+        "            return self.api.get('Pod', 'x')\n"
+        "        except Conflict:\n"
+        "            return None\n"
+    )
+    findings = lint_source(src, "controllers/x.py", ["dead-except"])
+    assert rule_ids(findings) == ["dead-except"]
+    assert "except Conflict is dead" in findings[0].message
+
+
+def test_dead_except_reachable_is_clean():
+    src = (
+        "class C:\n"
+        "    def f(self):\n"
+        "        try:\n"
+        "            return self.api.get('Pod', 'x')\n"
+        "        except NotFound:\n"
+        "            return None\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["dead-except"]) == []
+
+
+def test_dead_except_subclass_reachability_counts():
+    src = (
+        "class Custom(Conflict):\n"
+        "    pass\n"
+        "class C:\n"
+        "    def f(self):\n"
+        "        try:\n"
+        "            raise Custom('x')\n"
+        "        except Conflict:\n"
+        "            return None\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["dead-except"]) == []
+
+
+def test_dead_except_opaque_call_disables_the_check():
+    src = (
+        "class C:\n"
+        "    def f(self, helper):\n"
+        "        try:\n"
+        "            return helper()\n"
+        "        except Conflict:\n"
+        "            return None\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["dead-except"]) == []
+
+
+def test_dead_except_unclassified_verb_receiver_disables_the_check():
+    """`c.get(...)` might be a dict get or a store read — the body is
+    not provably complete, so no dead verdict."""
+    src = (
+        "class C:\n"
+        "    def f(self, c):\n"
+        "        try:\n"
+        "            return c.get('Pod', 'x')\n"
+        "        except NotFound:\n"
+        "            return None\n"
+        "        except Conflict:\n"
+        "            return None\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["dead-except"]) == []
+
+
+def test_dead_except_earlier_clause_absorption():
+    """A second clause for the SAME error is dead even though the error
+    is raisable — the first clause always wins."""
+    src = (
+        "class C:\n"
+        "    def f(self):\n"
+        "        try:\n"
+        "            return self.api.update({})\n"
+        "        except APIError:\n"
+        "            return None\n"
+        "        except Conflict:\n"
+        "            return 1\n"
+    )
+    findings = lint_source(src, "controllers/x.py", ["dead-except"])
+    assert rule_ids(findings) == ["dead-except"]
+    assert findings[0].line == 7
+
+
+def test_dead_except_suppressed():
+    src = (
+        "class C:\n"
+        "    def f(self):\n"
+        "        try:\n"
+        "            return self.api.get('Pod', 'x')\n"
+        "        except Conflict:  # graftlint: disable=dead-except future surface\n"
+        "            return None\n"
+    )
+    assert lint_source(src, "controllers/x.py", ["dead-except"]) == []
+
+
+def test_dead_except_out_of_scope_sections():
+    src = (
+        "class C:\n"
+        "    def f(self):\n"
+        "        try:\n"
+        "            return self.api.get('Pod', 'x')\n"
+        "        except Conflict:\n"
+        "            return None\n"
+    )
+    assert lint_source(src, "models/x.py", ["dead-except"]) == []
+
+
+# ---------------------------------------------------------------------------
+# regression drills: revert the fixes, the rules must re-find them
+
+
+@pytest.fixture(scope="module")
+def reverted_tree(tmp_path_factory):
+    """A copy of the real package with ISSUE-15's three fixes textually
+    reverted: the runtime fencing stand-down re-broadened, the
+    reconcilehelper Conflict retry removed, and the PR-5 client retry
+    policy deleted."""
+    root = tmp_path_factory.mktemp("reverted") / "odh_kubeflow_tpu"
+    shutil.copytree(
+        package_root(),
+        root,
+        ignore=shutil.ignore_patterns("__pycache__", "frontend"),
+    )
+
+    def edit(rel, old, new):
+        p = root / rel
+        text = p.read_text()
+        assert old in text, f"{rel}: expected fragment not found"
+        p.write_text(text.replace(old, new))
+
+    # (1) re-broaden the fencing handler: the narrow clause no longer
+    # catches FencedOut/NotLeader, so `except Exception` masks again
+    edit(
+        "controllers/runtime.py",
+        "except (FencedOut, NotLeader) as e:",
+        "except (KeyError, IndexError) as e:",
+    )
+    # (2) revert the retry site: reconcile_object calls the attempt
+    # directly — Conflict escapes every controller again
+    edit(
+        "controllers/reconcilehelper.py",
+        "return backoff.retry(\n"
+        "        lambda: _reconcile_attempt(api, desired, copier),\n"
+        "        retryable=Conflict,\n"
+        "        attempts=4,\n"
+        "        base=0.01,\n"
+        "        cap=0.5,\n"
+        "    )",
+        "return _reconcile_attempt(api, desired, copier)",
+    )
+    # (3) revert the PR-5 client retry policy: _request calls
+    # _do_request directly — the anchor fails and 429 escapes everywhere
+    edit(
+        "machinery/client.py",
+        "return backoff.retry(\n"
+        "            lambda: self._do_request(method, path, body, query),\n"
+        "            retryable=lambda e: self._retry_reason(method, e) is not None,\n"
+        "            attempts=self.retries,\n"
+        "            base=self.retry_base,\n"
+        "            cap=self.retry_cap,\n"
+        "            sleep_fn=self._sleep,\n"
+        "            on_retry=on_retry,\n"
+        "        )",
+        "return self._do_request(method, path, body, query)",
+    )
+    return root
+
+
+@pytest.fixture(scope="module")
+def reverted_findings(reverted_tree):
+    return run_paths([str(reverted_tree)], EXC_RULES)
+
+
+def test_drill_rebroadened_handler_refound(reverted_findings):
+    hits = [
+        f
+        for f in reverted_findings
+        if f.rule == "handler-masks-fencing"
+        and f.path == "controllers/runtime.py"
+    ]
+    assert hits, "re-broadened runtime handler not re-found"
+    assert any(
+        "broad handler absorbs FencedOut" in f.message
+        and "Controller._process" in f.message
+        for f in hits
+    )
+
+
+def test_drill_reverted_retry_site_refound_with_chain(reverted_findings):
+    hits = [
+        f
+        for f in reverted_findings
+        if f.rule == "error-contract" and "retryable Conflict" in f.message
+    ]
+    assert hits, "reverted reconcilehelper retry not re-found"
+    msg = next(
+        f.message for f in hits if f.path == "controllers/notebook.py"
+    )
+    # the full entry-point → raise witness chain
+    assert "NotebookController.reconcile" in msg
+    assert "reconcile_object" in msg
+    assert "_reconcile_attempt" in msg
+    assert "api.update() can raise Conflict" in msg
+
+
+def test_drill_reverted_client_policy_reports_anchor_and_escapes(
+    reverted_findings,
+):
+    anchor = [
+        f
+        for f in reverted_findings
+        if f.rule == "error-contract" and f.path == "machinery/client.py"
+    ]
+    assert anchor and "retry-policy anchor" in anchor[0].message
+    escapes = [
+        f
+        for f in reverted_findings
+        if "retryable TooManyRequests" in f.message
+    ]
+    assert escapes, "429 escapes not re-surfaced after the policy revert"
+    # witness chains run entry point → api call
+    assert any(
+        "reconcile" in f.message and "can raise TooManyRequests" in f.message
+        for f in escapes
+    )
+
+
+def test_drill_findings_stable_under_json(reverted_tree, capsys):
+    """Two identical CLI runs emit byte-identical --format=json output
+    (deterministic traversal, no hidden ordering)."""
+    argv = [
+        "--select",
+        ",".join(EXC_RULES),
+        "--format=json",
+        str(reverted_tree),
+    ]
+    assert lint_main(argv) == 1
+    first = capsys.readouterr().out
+    assert lint_main(argv) == 1
+    second = capsys.readouterr().out
+    assert first == second
+    parsed = json.loads(first)
+    assert parsed and all("message" in f for f in parsed)
+
+
+def test_clean_tree_has_no_exception_findings():
+    """The committed tree passes the three rules with an EMPTY baseline
+    — the fixes landed, nothing is ratcheted."""
+    findings = run_paths([package_root()], EXC_RULES)
+    assert findings == []

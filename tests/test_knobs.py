@@ -1,0 +1,230 @@
+"""Knob-registry drift lint (analysis/knobs.py + analysis/knobs.json).
+
+Scanner fixtures for every env-read idiom the package uses, the
+cross-check semantics on synthetic registries, and the tier-1 gate:
+the real tree has zero drift between code reads, the registry,
+GUIDE.md, and manifest env stanzas."""
+
+from odh_kubeflow_tpu.analysis import knobs
+
+
+# ---------------------------------------------------------------------------
+# scanner fixtures
+
+
+def test_scanner_direct_forms():
+    src = (
+        "import os\n"
+        "a = os.environ.get('KNOB_A', 'x')\n"
+        "b = os.environ['KNOB_B']\n"
+        "c = os.getenv('KNOB_C')\n"
+        "os.environ.setdefault('KNOB_D', '1')\n"
+    )
+    assert knobs.scan_source(src) == {"KNOB_A", "KNOB_B", "KNOB_C", "KNOB_D"}
+
+
+def test_scanner_environ_alias():
+    src = (
+        "import os\n"
+        "def from_env():\n"
+        "    env = os.environ\n"
+        "    return env.get('KNOB_E', '')\n"
+    )
+    assert knobs.scan_source(src) == {"KNOB_E"}
+
+
+def test_scanner_from_import_alias():
+    src = "from os import environ\nx = environ.get('KNOB_I')\n"
+    assert knobs.scan_source(src) == {"KNOB_I"}
+
+
+def test_scanner_name_constant():
+    src = (
+        "import os\n"
+        "CHAOS_ENV = 'GRAFT_CHAOS'\n"
+        "raw = os.environ.get(CHAOS_ENV, '')\n"
+    )
+    assert knobs.scan_source(src) == {"GRAFT_CHAOS"}
+
+
+def test_scanner_reader_helpers_including_nested():
+    src = (
+        "import os\n"
+        "def _env_int(name, default):\n"
+        "    return int(os.environ.get(name, str(default)))\n"
+        "X = _env_int('KNOB_F', 3)\n"
+        "def from_env():\n"
+        "    env = os.environ\n"
+        "    def flag(name, default='false'):\n"
+        "        return env.get(name, default) == 'true'\n"
+        "    return flag('KNOB_G')\n"
+    )
+    assert knobs.scan_source(src) == {"KNOB_F", "KNOB_G"}
+
+
+def test_scanner_ignores_wsgi_environ_dicts():
+    """WSGI handlers take a request dict named ``environ`` — its keys
+    are NOT platform knobs."""
+    src = (
+        "def app(environ, start_response):\n"
+        "    n = environ.get('CONTENT_LENGTH')\n"
+        "    m = environ['PATH_INFO']\n"
+        "    return n, m\n"
+    )
+    assert knobs.scan_source(src) == set()
+
+
+# ---------------------------------------------------------------------------
+# cross-check semantics (synthetic surfaces)
+
+
+def _reg(entries, external=()):
+    return {"knobs": entries, "manifest_external": list(external)}
+
+
+def _guide_for(reg):
+    """Guide text documenting every registry knob with its exact
+    appendix row (what --render-appendix emits)."""
+    return "\n".join(knobs.appendix_row(e) for e in reg["knobs"]) + "\n"
+
+
+def test_undocumented_knob_fails(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("import os\nx = os.environ.get('NEW_KNOB')\n")
+    out = knobs.knob_violations(
+        root=str(pkg), registry=_reg([]), guide="", manifests={}
+    )
+    assert len(out) == 1 and "undocumented knob 'NEW_KNOB'" in out[0]
+
+
+def test_phantom_knob_fails_and_dynamic_is_exempt(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("x = 1\n")
+    reg = _reg(
+        [
+            {"name": "GONE_KNOB", "scope": "x", "default": "", "description": "d"},
+            {
+                "name": "GENERATED_KNOB",
+                "scope": "pod",
+                "default": "",
+                "description": "d",
+                "dynamic": True,
+            },
+        ]
+    )
+    out = knobs.knob_violations(
+        root=str(pkg), registry=reg, guide=_guide_for(reg), manifests={}
+    )
+    assert len(out) == 1 and "phantom knob 'GONE_KNOB'" in out[0]
+
+
+def test_guide_gap_fails(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("import os\nx = os.environ.get('DOC_KNOB')\n")
+    reg = _reg(
+        [{"name": "DOC_KNOB", "scope": "x", "default": "", "description": "d"}]
+    )
+    out = knobs.knob_violations(
+        root=str(pkg), registry=reg, guide="", manifests={}
+    )
+    assert len(out) == 1 and "not documented in docs/GUIDE.md" in out[0]
+
+
+def test_stale_appendix_row_fails(tmp_path):
+    """A registry default/description change without re-rendering the
+    appendix is drift: the name is still backticked in the guide, but
+    the exact row no longer matches."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("import os\nx = os.environ.get('ROW_KNOB')\n")
+    reg = _reg(
+        [{"name": "ROW_KNOB", "scope": "x", "default": "2", "description": "d"}]
+    )
+    stale = "| `ROW_KNOB` | 1 | d |\n"  # old default still in the guide
+    out = knobs.knob_violations(
+        root=str(pkg), registry=reg, guide=stale, manifests={}
+    )
+    assert len(out) == 1 and "appendix row is stale" in out[0]
+    fresh = _guide_for(reg)
+    assert (
+        knobs.knob_violations(
+            root=str(pkg), registry=reg, guide=fresh, manifests={}
+        )
+        == []
+    )
+
+
+def test_render_appendix_rows_satisfy_the_lint():
+    reg = _reg(
+        [
+            {"name": "A_KNOB", "scope": "web", "default": "", "description": "a"},
+            {"name": "B_KNOB", "scope": "pod", "default": "7", "description": "b"},
+        ]
+    )
+    rendered = knobs.render_appendix(reg)
+    assert knobs.appendix_row(reg["knobs"][0]) in rendered
+    assert knobs.appendix_row(reg["knobs"][1]) in rendered
+    assert "### web" in rendered and "### pod" in rendered
+
+
+def test_unknown_manifest_env_fails_unless_allowlisted(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("x = 1\n")
+    manifests = {"MYSTERY_ENV": ["c/deploy.yaml"]}
+    out = knobs.knob_violations(
+        root=str(pkg), registry=_reg([]), guide="", manifests=manifests
+    )
+    assert len(out) == 1 and "manifest env 'MYSTERY_ENV'" in out[0]
+    out = knobs.knob_violations(
+        root=str(pkg),
+        registry=_reg([], external=["MYSTERY_ENV"]),
+        guide="",
+        manifests=manifests,
+    )
+    assert out == []
+
+
+def test_manifest_parser_reads_env_stanzas_and_literals(tmp_path):
+    mdir = tmp_path / "manifests"
+    mdir.mkdir()
+    (mdir / "deploy.yaml").write_text(
+        "spec:\n"
+        "  containers:\n"
+        "    - name: manager\n"
+        "      env:\n"
+        "        - name: SOME_KNOB\n"
+        "          value: 'x'\n"
+    )
+    (mdir / "kustomization.yaml").write_text(
+        "configMapGenerator:\n"
+        "  - name: cfg\n"
+        "    literals:\n"
+        "      - OTHER_KNOB=true\n"
+    )
+    names = knobs.manifest_env_names(str(mdir))
+    assert set(names) == {"SOME_KNOB", "OTHER_KNOB"}
+    # lowercase container/port names never match
+    assert "manager" not in names
+
+
+# ---------------------------------------------------------------------------
+# tier-1 gate: the real tree has zero drift
+
+
+def test_registry_is_wellformed():
+    reg = knobs.load_registry()
+    names = [e["name"] for e in reg["knobs"]]
+    assert len(names) == len(set(names)), "duplicate registry entries"
+    for e in reg["knobs"]:
+        assert e.get("scope") and e.get("description"), e["name"]
+    # the scan still sees a platform-sized knob surface (an empty scan
+    # means the detector broke, not that the tree got knob-free)
+    assert len(knobs.scan_package()) >= 80
+
+
+def test_package_knobs_have_zero_drift():
+    assert knobs.knob_violations() == []
