@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint sanitize chaos durability explore fleetbench replicabench zonedrill obs loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
+.PHONY: all test test-unit test-manifests lint sanitize chaos durability explore fleetbench replicabench zonedrill usagebench obs loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
 
 all: lint test
 
@@ -115,6 +115,23 @@ replicabench:
 zonedrill:
 	GRAFT_SANITIZE=1 GRAFT_CHAOS=17 $(PYTHON) -m pytest -q tests/test_zones.py
 	GRAFT_SANITIZE=1 $(PYTHON) -m loadtest.zone_drill
+
+# chip-hour metering drills (docs/GUIDE.md "Usage metering &
+# showback"): the meter's unit invariants + activity-agent probe
+# robustness under sanitizer + seeded chaos, the seeded
+# accounting-exactness drill (lifecycle churn + wedged agent + WAL
+# failover, ledger reconciled against a straight-line accountant to
+# ε), then the metering-overhead axis of the control-plane bench
+# (meter CPU per sampling window ≤2% of one core; writes to a scratch
+# copy so committed BENCH numbers change only when refreshed
+# deliberately)
+usagebench:
+	GRAFT_SANITIZE=1 GRAFT_CHAOS=20591 $(PYTHON) -m pytest -q \
+	  tests/test_usage.py tests/test_culler.py
+	GRAFT_SANITIZE=1 $(PYTHON) -m loadtest.usage_drill
+	cp BENCH_control_plane.json /tmp/usagebench.json
+	$(PYTHON) loadtest/control_plane_bench.py --usage \
+	  --out /tmp/usagebench.json
 
 # the randomized property suites re-run as race probes: sanitized
 # locks record acquisition order, re-entry, and blocking-under-lock

@@ -13,7 +13,11 @@ observability surface end to end:
    plain exposition stays exemplar-free;
 3. the SLO engine reports multi-window burn rates at the dashboard's
    ``/api/slo`` and as ``slo_burn_rate`` gauges;
-4. ``/debug/queues`` and ``/debug/locks`` answer.
+4. ``/debug/queues`` and ``/debug/locks`` answer;
+5. the usage-metering surface is live: ``/api/usage`` showback rows,
+   the JWA per-notebook usage block, the ``/debug/usage`` duty-cycle
+   timelines, the occupancy panel's utilization ratios, and the
+   ``tpu_pool_utilization_ratio`` gauge on ``/metrics``.
 
 Exits non-zero with the failing check named; prints one JSON summary
 line on success.
@@ -204,6 +208,61 @@ def main() -> None:
         status, _locks = http(f"{api}/debug/locks")
         check("locks-zpage", status == 200, "/debug/locks did not answer")
 
+        # -- 5: usage metering & showback ---------------------------------
+        usage = call("/api/usage?flush=1")["usage"]
+        check(
+            "usage-showback",
+            usage["openAllocations"] >= 1
+            and any(
+                r["namespace"] == "obs-team"
+                and r["allocatedChipSeconds"] > 0
+                for r in usage["namespaces"]
+            ),
+            f"no obs-team allocation in /api/usage: {usage}",
+        )
+        d = call("/jupyter/api/namespaces/obs-team/notebooks/obs-nb/details")
+        nb_usage = d["details"].get("usage")
+        check(
+            "usage-jwa-block",
+            isinstance(nb_usage, dict)
+            and nb_usage["allocated"]
+            and nb_usage["chips"] == 4,
+            f"JWA usage block wrong: {nb_usage}",
+        )
+        _, upage = http(f"{api}/debug/usage")
+        check(
+            "usage-zpage",
+            b"obs-nb" in upage,
+            "/debug/usage missing the notebook timeline",
+        )
+        _, uraw = http(f"{api}/debug/usage?format=json")
+        uj = json.loads(uraw)
+        check(
+            "usage-zpage",
+            uj["enabled"]
+            and any(
+                row["notebook"] == "obs-nb"
+                and any(e["kind"] == "sample" for e in row["events"])
+                for row in uj["timelines"]
+            ),
+            "no duty-cycle samples on the obs-nb timeline",
+        )
+        occupancy = call("/api/metrics")
+        check(
+            "usage-occupancy-ratio",
+            bool(occupancy["tpu"])
+            and all("utilizationRatio" in r for r in occupancy["tpu"])
+            and all("utilizationRatio" in r for r in occupancy["zones"]),
+            f"occupancy rows lack utilizationRatio: {occupancy}",
+        )
+        _, metrics3 = http(f"{api}/metrics")
+        check(
+            "usage-pool-gauge",
+            b"tpu_pool_utilization_ratio{" in metrics3
+            and b"tpu_chip_seconds_total{" in metrics3,
+            "usage metric families missing from /metrics",
+        )
+
         print(
             json.dumps(
                 {
@@ -213,6 +272,7 @@ def main() -> None:
                     "trace_spans": len(spans),
                     "slo_rows": len(rows),
                     "exemplars": len(exemplars),
+                    "usage_open_allocations": usage["openAllocations"],
                 }
             )
         )

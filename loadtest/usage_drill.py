@@ -1,0 +1,339 @@
+"""Usage-accounting drill: prove the chip-hour ledger exact to ε.
+
+Eight seeded notebooks with known piecewise-constant duty-cycle
+waveforms run through 40 simulated minutes of lifecycle churn —
+suspend/resume, preemption, zone drain, a permanently wedged activity
+agent, and a mid-drill **leader failover** (WAL close → replay →
+fresh :class:`UsageMeter` → ``recover()``) — against a WAL-backed
+store with a fake clock. A straight-line accountant integrates the
+same schedule with plain arithmetic (no windows, no buckets, no
+persistence); at the end the ledger must reconcile against it:
+
+- per-namespace allocated/active/idle/unsampled chip-seconds within ε
+- conservation: ``allocated == active + idle + unsampled`` (zero lost
+  chip-seconds)
+- the persisted UsageRecord windows sum to the live totals (window
+  splitting loses nothing, flush leaves nothing dirty)
+- no negative field anywhere in the ledger
+- the wedged notebook's silent span lands in **unsampled**, not idle
+- records survive the failover WAL replay and integration resumes
+  from ``flushedThrough`` — nothing lost, nothing double-counted
+
+Run: ``python -m loadtest.usage_drill`` (``make usagebench`` wraps it
+with GRAFT_SANITIZE=1 plus the pytest suite).
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import tempfile
+
+EPS = 0.05  # chip-seconds; totals here are O(10^4)
+T0 = 1_000_200.0  # aligned to the 300s window grid
+TICK = 15.0  # == UsageConfig.sample_seconds
+N_TICKS = 160  # 40 minutes
+FAILOVER_TICK = 100
+SEED = 20591  # arXiv 2503.20591
+
+CHECKS: list[tuple[str, bool, str]] = []
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    CHECKS.append((name, bool(ok), detail))
+    print(f"  [{'PASS' if ok else 'FAIL'}] {name}" + (f" — {detail}" if detail else ""))
+
+
+class Session:
+    """One notebook's drill-side state + straight-line ground truth."""
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.name = f"nb-{idx}"
+        self.namespace = "team-a" if idx < 4 else "team-b"
+        self.chips = [4, 8, 4, 16, 4, 8, 4, 8][idx]
+        self.pool = f"pool-{idx % 3}"
+        self.zone = "zone-a" if idx % 2 == 0 else "zone-b"
+        self.accel = "tpu-v5-lite-podslice" if idx % 2 == 0 else "tpu-v4-podslice"
+        rng = random.Random(SEED * 1000 + idx)
+        # piecewise-constant waveform: one duty level per 4-tick segment
+        self.wave = [
+            rng.choice([0.0, 20.0, 40.0, 60.0, 80.0, 100.0])
+            for _ in range(N_TICKS // 4 + 2)
+        ]
+        self.open_t: float | None = None
+        self.cover_t = 0.0  # sample-coverage cursor (trailing attribution)
+        self.gt_alloc = 0.0
+        self.gt_active = 0.0
+        self.gt_sampled = 0.0
+
+    def duty_at(self, tick: int) -> float:
+        return self.wave[tick // 4]
+
+    def workload(self, admitted_at: str) -> dict:
+        return {
+            "apiVersion": "scheduling.kubeflow.org/v1alpha1",
+            "kind": "Workload",
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": {
+                "hosts": 1,
+                "chipsPerHost": self.chips,
+                "acceleratorType": self.accel,
+                "topology": "2x2",
+            },
+            "status": {
+                "state": "Admitted",
+                "admittedAt": admitted_at,
+                "assignment": {"pool": self.pool, "zone": self.zone},
+            },
+        }
+
+
+def run_drill() -> None:
+    from odh_kubeflow_tpu.machinery.store import APIServer
+    from odh_kubeflow_tpu.machinery.wal import WriteAheadLog
+    from odh_kubeflow_tpu.machinery.usage import (
+        UsageConfig,
+        UsageMeter,
+        register_usage,
+    )
+    from odh_kubeflow_tpu.scheduling import register_scheduling
+    from odh_kubeflow_tpu.utils.prometheus import Registry
+
+    clock = {"t": T0}
+    cfg = UsageConfig(
+        enabled=True, sample_seconds=TICK, window_seconds=300.0
+    )
+    max_gap = cfg.max_sample_gap
+
+    wal_dir = tempfile.mkdtemp(prefix="usage-drill-wal-")
+    wal = WriteAheadLog(wal_dir)
+    api = APIServer(wal=wal)
+    register_scheduling(api)
+    register_usage(api)
+    meter = UsageMeter(
+        api, cfg, registry=Registry(), time_fn=lambda: clock["t"]
+    )
+
+    sessions = [Session(i) for i in range(8)]
+    # lifecycle schedule: tick -> [(action, session index, reason)]
+    events: dict[int, list[tuple[str, int, str]]] = {}
+
+    def at(tick, action, idx, reason=""):
+        events.setdefault(tick, []).append((action, idx, reason))
+
+    for s in sessions:
+        at(s.idx * 2, "admit", s.idx)
+    at(30, "release", 1, "suspend")
+    at(50, "admit", 1)  # resume
+    at(40, "release", 2, "preempted")
+    at(60, "admit", 2)  # re-admit after preemption
+    at(70, "release", 3, "zone-drain")
+    at(80, "admit", 3)  # re-placed in the surviving zone
+    at(120, "release", 5, "scale-down")  # gone for good
+    # nb-4's agent wedges: silent from tick 91 through 109 — a 300s
+    # gap spanning the failover, far past max_sample_gap
+    silent = {(4, k) for k in range(91, 110)}
+
+    def fmt(t: float) -> str:
+        import time as _time
+
+        return _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime(t))
+
+    def admit(s: Session, t: float) -> None:
+        wl = s.workload(fmt(t))
+        api.create(wl)
+        meter.workload_admitted(wl, t=t)
+        s.open_t = t
+        s.cover_t = t
+
+    def release(s: Session, reason: str, t: float) -> None:
+        api.delete("Workload", s.name, s.namespace)
+        meter.workload_released(s.namespace, s.name, reason=reason, t=t)
+        s.gt_alloc += s.chips * (t - s.open_t)
+        s.open_t = None
+
+    def apply_events(tick: int, t: float) -> None:
+        for action, idx, reason in events.get(tick, ()):
+            s = sessions[idx]
+            if action == "admit":
+                if idx == 3 and tick == 80:
+                    s.zone = "zone-b"  # drained out of zone-a
+                admit(s, t)
+            else:
+                release(s, reason, t)
+
+    apply_events(0, T0)
+    failover_records = 0
+    for tick in range(1, N_TICKS + 1):
+        t = T0 + tick * TICK
+        clock["t"] = t
+        # 1) duty samples for every open interval (trailing attribution)
+        for s in sessions:
+            if s.open_t is None or (s.idx, tick) in silent:
+                continue
+            duty = s.duty_at(tick)
+            meter.observe_sample(s.namespace, s.name, duty, t=t, source="drill")
+            dt = t - s.cover_t
+            if dt <= max_gap:
+                s.gt_sampled += s.chips * dt
+                s.gt_active += s.chips * dt * duty / 100.0
+            s.cover_t = t
+        # 2) lifecycle churn
+        apply_events(tick, t)
+        # 3) mid-drill leader failover: flush, crash, WAL replay, a
+        #    fresh meter recovers the ledger and resumes integration
+        if tick == FAILOVER_TICK:
+            meter.flush(t)
+            failover_records = len(api.list("UsageRecord"))
+            wal.close()
+            wal = WriteAheadLog(wal_dir)
+            api = APIServer.recover(wal)
+            meter = UsageMeter(
+                api, cfg, registry=Registry(), time_fn=lambda: clock["t"]
+            )
+            meter.recover()
+        # 4) periodic flush, as the serving poll loop would
+        elif tick % 20 == 0:
+            meter.flush(t)
+
+    t_end = T0 + N_TICKS * TICK
+    for s in sessions:
+        if s.open_t is not None:
+            s.gt_alloc += s.chips * (t_end - s.open_t)
+    meter.flush(t_end)
+
+    check(
+        "ledger survived failover WAL replay",
+        failover_records > 0
+        and len(meter._buckets) >= failover_records,
+        f"{failover_records} records at the crash",
+    )
+
+    # -- reconcile the ledger against the straight-line accountant -----------
+    gt = {}
+    for s in sessions:
+        row = gt.setdefault(
+            s.namespace, {"alloc": 0.0, "active": 0.0, "sampled": 0.0}
+        )
+        row["alloc"] += s.gt_alloc
+        row["active"] += s.gt_active
+        row["sampled"] += s.gt_sampled
+
+    summary = meter.summary(top_n=10, t=t_end)
+    by_ns = {r["namespace"]: r for r in summary["namespaces"]}
+    for ns, row in sorted(gt.items()):
+        m = by_ns.get(ns, {})
+        d_alloc = abs(m.get("allocatedChipSeconds", 0.0) - row["alloc"])
+        d_active = abs(m.get("activeChipSeconds", 0.0) - row["active"])
+        idle_gt = row["sampled"] - row["active"]
+        d_idle = abs(m.get("idleChipSeconds", 0.0) - idle_gt)
+        unsampled_gt = row["alloc"] - row["sampled"]
+        d_unsampled = abs(
+            m.get("unsampledChipSeconds", 0.0) - unsampled_gt
+        )
+        check(
+            f"{ns}: allocated exact",
+            d_alloc <= EPS,
+            f"ledger {m.get('allocatedChipSeconds')} vs truth "
+            f"{row['alloc']:.3f} (Δ{d_alloc:.6f})",
+        )
+        check(
+            f"{ns}: active exact",
+            d_active <= EPS,
+            f"Δ{d_active:.6f} of {row['active']:.3f}",
+        )
+        check(f"{ns}: idle exact", d_idle <= EPS, f"Δ{d_idle:.6f}")
+        check(
+            f"{ns}: unsampled exact",
+            d_unsampled <= EPS,
+            f"Δ{d_unsampled:.6f} of {unsampled_gt:.3f}",
+        )
+        conserved = abs(
+            m.get("allocatedChipSeconds", 0.0)
+            - m.get("activeChipSeconds", 0.0)
+            - m.get("idleChipSeconds", 0.0)
+            - m.get("unsampledChipSeconds", 0.0)
+        )
+        check(
+            f"{ns}: zero lost chip-seconds "
+            "(allocated == active + idle + unsampled)",
+            conserved <= EPS,
+            f"Δ{conserved:.6f}",
+        )
+
+    # -- the persisted windows must sum to the live totals -------------------
+    records = api.list("UsageRecord")
+    sums: dict[str, dict[str, float]] = {}
+    negatives = 0
+    for rec in records:
+        st = rec.get("status") or {}
+        ns = rec["metadata"]["namespace"]
+        row = sums.setdefault(
+            ns, {"alloc": 0.0, "active": 0.0, "sampled": 0.0}
+        )
+        row["alloc"] += st.get("allocatedChipSeconds", 0.0)
+        row["active"] += st.get("activeChipSeconds", 0.0)
+        row["sampled"] += st.get("sampledChipSeconds", 0.0)
+        negatives += sum(
+            1 for v in st.values() if isinstance(v, (int, float)) and v < 0
+        )
+    check("no negative field in any UsageRecord", negatives == 0)
+    for ns, row in sorted(gt.items()):
+        srow = sums.get(ns, {"alloc": 0.0, "active": 0.0, "sampled": 0.0})
+        ok = (
+            abs(srow["alloc"] - row["alloc"]) <= EPS
+            and abs(srow["active"] - row["active"]) <= EPS
+            and abs(srow["sampled"] - row["sampled"]) <= EPS
+        )
+        check(
+            f"{ns}: window records sum to totals",
+            ok,
+            f"{len([r for r in records if r['metadata']['namespace'] == ns])}"
+            " windows",
+        )
+
+    # -- the wedge is a gap, not idleness ------------------------------------
+    s4 = sessions[4]
+    nb4 = meter.notebook_usage(s4.namespace, s4.name, t=t_end)
+    gap_gt = s4.gt_alloc - s4.gt_sampled
+    check(
+        "wedged agent's silence lands in unsampled (gap, not zero)",
+        gap_gt >= s4.chips * max_gap
+        and abs(nb4["unsampledChipSeconds"] - gap_gt) <= EPS,
+        f"{nb4['unsampledChipSeconds']} chip-s unsampled "
+        f"(truth {gap_gt:.3f})",
+    )
+
+    # -- utilization surfaces ------------------------------------------------
+    util = meter.utilization(t=t_end)
+    ratios = (
+        list(util["pools"].values())
+        + list(util["zones"].values())
+        + list(util["accelerators"].values())
+    )
+    check(
+        "utilization ratios live for pools/zones/accelerators, all in [0,1]",
+        bool(util["pools"]) and bool(util["zones"])
+        and bool(util["accelerators"])
+        and all(0.0 <= r <= 1.0 for r in ratios),
+        f"{len(ratios)} ratios",
+    )
+    wal.close()
+
+
+def main() -> int:
+    print("usage drill: seeded waveforms through lifecycle churn + failover")
+    run_drill()
+    failed = [name for name, ok, _ in CHECKS if not ok]
+    print(
+        f"usage drill: {len(CHECKS) - len(failed)}/{len(CHECKS)} checks passed"
+    )
+    if failed:
+        print("FAILED: " + ", ".join(failed))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,10 @@ LAST_ACTIVITY_CHECK_ANNOTATION = (
 SUSPENDED_AT_ANNOTATION = "notebooks.kubeflow.org/suspended-at"
 SUSPEND_REASON_ANNOTATION = "notebooks.kubeflow.org/suspend-reason"
 RESUME_REQUESTED_ANNOTATION = "notebooks.kubeflow.org/resume-requested-at"
+# audit trail for duty-cycle-aware culling: the duty sample the culler
+# observed last (value + probe timestamp), stamped every probe so a
+# cull/keep decision is explainable after the fact
+TPU_DUTY_CYCLE_ANNOTATION = "notebooks.kubeflow.org/last-observed-duty-cycle"
 
 # TPU scheduling contract (replaces the reference's nvidia.com/gpu path,
 # BASELINE.json north star)

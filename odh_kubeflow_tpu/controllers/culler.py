@@ -34,6 +34,7 @@ from odh_kubeflow_tpu.apis import (
     SUSPEND_REASON_ANNOTATION,
     SUSPENDED_AT_ANNOTATION,
     TPU_ACCELERATOR_ANNOTATION,
+    TPU_DUTY_CYCLE_ANNOTATION,
 )
 from odh_kubeflow_tpu.controllers.runtime import Result
 from odh_kubeflow_tpu.machinery import objects as obj_util
@@ -85,9 +86,14 @@ class Culler:
         now_fn: Callable[[], float] = time.time,
         cull_counter=None,
         tpu_url_fn: Optional[Callable[[Obj], str]] = None,
+        meter: Optional[Any] = None,
     ):
         self.api = api
         self.config = config or CullerConfig()
+        # shared chip-hour ledger (machinery.usage.UsageMeter duck):
+        # the probed duty sample feeds the meter instead of being
+        # discarded after the threshold comparison
+        self.meter = meter
         self._base_url_fn = base_url_fn or self._default_base_url
         # TPU probe URL: the agent serves on its own port (the Jupyter
         # port can't proxy it). When a test injects base_url_fn only,
@@ -142,8 +148,10 @@ class Culler:
         latest: Optional[float] = None
 
         kernels = self._get_json(f"{base}/api/kernels")
-        if kernels is not None:
+        if isinstance(kernels, list):
             for k in kernels:
+                if not isinstance(k, dict):
+                    continue
                 if k.get("execution_state") == "busy":
                     return self.now()
                 la = k.get("last_activity")
@@ -152,8 +160,10 @@ class Culler:
                     latest = t if latest is None else max(latest, t)
 
         terminals = self._get_json(f"{base}/api/terminals")
-        if terminals is not None:
+        if isinstance(terminals, list):
             for term in terminals:
+                if not isinstance(term, dict):
+                    continue
                 la = term.get("last_activity")
                 if la:
                     t = _parse_time(la)
@@ -168,16 +178,49 @@ class Culler:
             and TPU_ACCELERATOR_ANNOTATION in obj_util.annotations_of(notebook)
             else None
         )
-        if tpu is not None:
-            duty = float(tpu.get("duty_cycle_pct", 0.0))
-            if duty >= self.config.tpu_duty_cycle_threshold:
-                return self.now()
+        if isinstance(tpu, dict):
+            # a valid-JSON-but-wrong-shape payload (or a non-numeric
+            # duty field) is no-information — a gap, exactly like an
+            # unreachable agent; it must neither crash the loop nor
+            # read as duty 0
+            try:
+                duty = float(tpu.get("duty_cycle_pct"))
+            except (TypeError, ValueError):
+                duty = None
+            if duty is not None:
+                self._observe_duty(notebook, duty)
+                if duty >= self.config.tpu_duty_cycle_threshold:
+                    return self.now()
             la = tpu.get("last_active")
             if la:
-                t = _parse_time(la)
-                latest = t if latest is None else max(latest, t)
+                try:
+                    t = _parse_time(la)
+                except (TypeError, ValueError):
+                    t = None
+                if t is not None:
+                    latest = t if latest is None else max(latest, t)
 
         return latest
+
+    def _observe_duty(self, notebook: Obj, duty: float) -> None:
+        """A probed duty sample is evidence, not just a threshold
+        input: feed it to the shared usage meter and stamp the
+        last-observed annotation (rides the reconcile's annotation
+        patch) so the cull decision is auditable."""
+        now = self.now()
+        if self.meter is not None:
+            self.meter.observe_sample(
+                obj_util.namespace_of(notebook),
+                obj_util.name_of(notebook),
+                duty,
+                t=now,
+                source="culler",
+            )
+        obj_util.set_annotation(
+            notebook,
+            TPU_DUTY_CYCLE_ANNOTATION,
+            f"{duty:g}@{_fmt_time(now)}",
+        )
 
     # -- annotation state machine -------------------------------------------
 

@@ -164,10 +164,15 @@ class NotebookController:
         config: Optional[NotebookControllerConfig] = None,
         registry: Optional[prometheus.Registry] = None,
         culler: Optional[Any] = None,
+        meter: Optional[Any] = None,
     ):
         self.api = api
         self.config = config or NotebookControllerConfig()
         self.culler = culler
+        # chip-hour ledger tap: a scale-down/suspend deletes the
+        # Workload here, which is an allocation release the scheduler
+        # never sees (machinery.usage.UsageMeter duck)
+        self.meter = meter
         self.recorder = EventRecorder(api, "notebook-controller")
         reg = registry or prometheus.default_registry
         self.m_create = reg.counter(
@@ -446,6 +451,11 @@ class NotebookController:
                     self.api.delete("Workload", name, ns)
                 except NotFound:
                     pass
+                else:
+                    if self.meter is not None:
+                        self.meter.workload_released(
+                            ns, name, reason="scale-down"
+                        )
                 return
             reconcilehelper.reconcile_object(self.api, desired, owner=notebook)
         except NotFound:

@@ -219,12 +219,15 @@ class RestAPI:
         metrics_registry: Optional[Registry] = None,
         inflight_limit: Optional[int] = None,
         fast_serialize: bool = True,
+        usage_meter: Optional[Any] = None,
     ):
         self.server = server
         self.authenticator = authenticator
         # served at /metrics when given (anonymous, like the health
         # probes — the controller-runtime metrics-listener posture)
         self.metrics_registry = metrics_registry
+        # backs the /debug/usage zpage (chip-hour ledger timelines)
+        self.usage_meter = usage_meter
         limit = DEFAULT_INFLIGHT_LIMIT if inflight_limit is None else inflight_limit
         self.limiter = InflightLimiter(limit) if limit > 0 else None
         # per-(kind, rv) serialized-bytes cache: list responses compose
@@ -352,6 +355,7 @@ class RestAPI:
                 start_response,
                 registry=self.metrics_registry,
                 api=self.server,
+                meter=self.usage_meter,
             )
             if resp is not None:
                 return resp
@@ -864,6 +868,7 @@ def serve(
     event_loop: Optional[bool] = None,
     workers: Optional[int] = None,
     fast_serialize: bool = True,
+    usage_meter: Optional[Any] = None,
 ) -> tuple[threading.Thread, int, Any]:
     """Serve the REST façade; returns (thread, bound_port, httpd).
     ``httpd.shutdown()`` stops it.
@@ -887,6 +892,7 @@ def serve(
         metrics_registry=metrics_registry,
         inflight_limit=inflight_limit,
         fast_serialize=fast_serialize,
+        usage_meter=usage_meter,
     )
     if event_loop is None:
         event_loop = event_loop_enabled()

@@ -226,6 +226,66 @@ def locks_text() -> str:
 
 
 # ---------------------------------------------------------------------------
+# usage (chip-hour ledger timelines)
+
+
+def usage_json(
+    meter: Optional[Any] = None, namespace: str = "", limit: int = 50
+) -> Obj:
+    if meter is None:
+        return {"enabled": False, "timelines": [], "summary": None}
+    return {
+        "enabled": bool(meter.config.enabled),
+        "summary": meter.summary(),
+        "timelines": meter.timelines(namespace=namespace, limit=limit),
+    }
+
+
+def usage_text(
+    meter: Optional[Any] = None, namespace: str = "", limit: int = 50
+) -> str:
+    data = usage_json(meter, namespace=namespace, limit=limit)
+    if meter is None:
+        return "/debug/usage\n\nno usage meter wired into this process\n"
+    lines = [
+        "/debug/usage — chip-hour ledger "
+        + ("(sampling on)" if data["enabled"] else "(sampling OFF)"),
+        "",
+    ]
+    summary = data["summary"] or {}
+    lines.append(
+        f"open allocations: {summary.get('openAllocations', 0)}  "
+        f"window={summary.get('windowSeconds')}s  "
+        f"retention={summary.get('retentionSeconds')}s"
+    )
+    lines.append("")
+    lines.append("namespaces (by allocated chip-seconds):")
+    for row in summary.get("namespaces", []):
+        util = row["utilization"]
+        lines.append(
+            f"  {row['namespace']}: alloc={row['allocatedChipSeconds']:.0f}s "
+            f"active={row['activeChipSeconds']:.0f}s "
+            f"idle={row['idleChipSeconds']:.0f}s "
+            f"util={util if util is None else f'{util:.1%}'}"
+        )
+    if not summary.get("namespaces"):
+        lines.append("  (no usage recorded)")
+    lines.append("")
+    lines.append("recent duty-cycle timelines (newest first):")
+    for tl in data["timelines"]:
+        state = "open" if tl["open"] else "closed"
+        lines.append(f"  {tl['namespace']}/{tl['notebook']} [{state}]:")
+        for ev in tl["events"]:
+            if ev["kind"] == "sample":
+                lines.append(f"    {ev['t']:.1f}  duty={ev['value']:.1f}%")
+            else:
+                lines.append(f"    {ev['t']:.1f}  -- {ev['value']} --")
+    if not data["timelines"]:
+        lines.append("  (no samples observed)")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
 # WSGI plumbing
 
 
@@ -235,6 +295,7 @@ def handle_debug(
     registry: Optional[Registry] = None,
     api: Optional[Any] = None,
     collector: Optional[tracing.SpanCollector] = None,
+    meter: Optional[Any] = None,
 ) -> Optional[list[bytes]]:
     """Serve a ``/debug/...`` request on a raw WSGI façade; None when
     the path isn't a debug page (the caller continues dispatch).
@@ -304,6 +365,11 @@ def handle_debug(
         if fmt == "json":
             return _json(200, locks_json())
         return _text(locks_text())
+    if path == "/debug/usage" and method == "GET":
+        ns = qs.get("namespace", [""])[0]
+        if fmt == "json":
+            return _json(200, usage_json(meter, namespace=ns))
+        return _text(usage_text(meter, namespace=ns))
     return _json(404, {"error": f"unknown debug page {path}"})
 
 
@@ -312,6 +378,7 @@ def install_debug_routes(
     registry: Optional[Registry] = None,
     api: Optional[Any] = None,
     require_user: bool = True,
+    meter: Optional[Any] = None,
 ) -> None:
     """Mount the zpages on a microweb App (the web/BFF processes get
     the same debug surface the apiserver façade serves natively).
@@ -354,3 +421,12 @@ def install_debug_routes(
     @app.route("/debug/locks")
     def debug_locks(request):
         return _render(request, locks_json, locks_text)
+
+    @app.route("/debug/usage")
+    def debug_usage(request):
+        ns = request.query.get("namespace", "")
+        return _render(
+            request,
+            lambda: usage_json(meter, namespace=ns),
+            lambda: usage_text(meter, namespace=ns),
+        )

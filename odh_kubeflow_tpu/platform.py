@@ -46,6 +46,11 @@ from odh_kubeflow_tpu.machinery.cache import (
 )
 from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
 from odh_kubeflow_tpu.machinery.store import APIServer
+from odh_kubeflow_tpu.machinery.usage import (
+    UsageConfig,
+    UsageMeter,
+    register_usage,
+)
 from odh_kubeflow_tpu.scheduling import register_scheduling
 from odh_kubeflow_tpu.scheduling.scheduler import SliceScheduler
 from odh_kubeflow_tpu.sessions import register_sessions
@@ -130,6 +135,7 @@ class Platform:
         register_crds(self.api)
         register_scheduling(self.api)
         register_sessions(self.api)
+        register_usage(self.api)
         install_default_cluster_roles(self.api)
         PodDefaultWebhook(self.api).register()
         NotebookWebhook(self.api).register()
@@ -164,18 +170,39 @@ class Platform:
             # stopping cold — the idle slice frees, the kernel survives
             suspend_on_cull=self.nb_config.enable_sessions,
         )
-        self.culler = Culler(self.cached_api, culler_cfg)
         self.manager = Manager(
             self.api, registry=self.metrics_registry, cache=self.cache
         )
         # the sim cluster is built before the controllers so its
         # checkpoint/restore container hooks can back the SessionManager
         self.cluster = FakeCluster(self.api) if sim else None
+        # chip-hour metering (machinery/usage.py): ALWAYS constructed —
+        # its counter families anchor the idle-waste SLO and the
+        # dashboard showback even when the USAGE_METERING flag only
+        # gates the background sampling thread. In sim mode the sampler
+        # reads the cluster's deterministic duty-cycle waveforms; in a
+        # real deployment it probes the in-pod activity agent.
+        self.usage_config = UsageConfig.from_env()
+        self.usage_meter = UsageMeter(
+            self.cached_api,
+            self.usage_config,
+            registry=self.metrics_registry,
+            sample_fn=(
+                (lambda ns, nb: self.cluster.duty_cycle(ns, nb))
+                if sim
+                else None
+            ),
+        )
+        self.usage_meter.recover()
+        self.culler = Culler(
+            self.cached_api, culler_cfg, meter=self.usage_meter
+        )
         self.notebook_controller = NotebookController(
             self.cached_api,
             self.nb_config,
             registry=self.metrics_registry,
             culler=self.culler if self.nb_config.enable_culling else None,
+            meter=self.usage_meter,
         )
         self.notebook_controller.register(self.manager)
         # suspend-to-checkpoint sessions (sessions/): snapshots kernels
@@ -192,6 +219,7 @@ class Platform:
                     if self.cluster is not None
                     else None
                 ),
+                meter=self.usage_meter,
             )
             self.session_manager.register(self.manager)
         # gang admission for TPU slices (scheduling/): the notebook
@@ -202,6 +230,7 @@ class Platform:
                 self.cached_api,
                 registry=self.metrics_registry,
                 suspender=self.session_manager,
+                meter=self.usage_meter,
             )
             if self.nb_config.enable_queueing
             else None
@@ -217,6 +246,7 @@ class Platform:
             self.cached_api,
             config_path=spawner_config_path,
             registry=self.metrics_registry,
+            meter=self.usage_meter,
         )
         self.vwa = VolumesWebApp(self.cached_api, registry=self.metrics_registry)
         self.twa = TensorboardsWebApp(
@@ -228,6 +258,7 @@ class Platform:
             kfam=self.kfam.service,
             registry=self.metrics_registry,
             slo_engine=self.slo_engine,
+            meter=self.usage_meter,
         )
 
         self.web = PrefixRouter(self.dashboard.app)
@@ -252,8 +283,15 @@ class Platform:
         self.slo_engine.start(
             interval=float(os.environ.get("SLO_TICK_SECONDS", "5"))
         )
+        # duty-cycle sampling + ledger flush loop (no-op when
+        # USAGE_METERING=false — the meter still exists for its hooks)
+        self.usage_meter.start()
         _, api_port, self._api_httpd = httpapi.serve(
-            self.api, host, api_port, metrics_registry=self.metrics_registry
+            self.api,
+            host,
+            api_port,
+            metrics_registry=self.metrics_registry,
+            usage_meter=self.usage_meter,
         )
 
         web_thread, web_port, self._web_httpd = _serve_wsgi(
@@ -277,6 +315,7 @@ class Platform:
 
     def stop(self) -> None:
         self._stop.set()
+        self.usage_meter.stop()
         self.slo_engine.stop()
         self.manager.stop()
         for httpd in (self._api_httpd, self._web_httpd):

@@ -83,9 +83,13 @@ class SliceScheduler:
         suspender: Optional[Any] = None,
         zone_storm_threshold: int = 2,
         zone_drain_cooldown: float = 60.0,
+        meter: Optional[Any] = None,
     ):
         self.api = api
         self.now = time_fn
+        # chip-hour ledger tap (machinery.usage.UsageMeter duck:
+        # workload_admitted / workload_released). None → no metering.
+        self.meter = meter
         # gangs losing hosts in ONE zone in ONE cycle before per-node
         # eviction escalates to a full zone drain
         self.zone_storm_threshold = max(int(zone_storm_threshold), 1)
@@ -817,6 +821,8 @@ class SliceScheduler:
         if written:
             self.m_wait.observe(wait)
             self.m_attempts.inc({"result": "admitted"})
+            if self.meter is not None:
+                self.meter.workload_admitted(wl)
             self._record(
                 wl,
                 "Normal",
@@ -1092,6 +1098,11 @@ class SliceScheduler:
                 "queuedAt": obj_util.now_rfc3339(),
             }
         )
+        if self.meter is not None:
+            # the gang pods are already gone whatever happens to the
+            # status write — the allocation ended here (close is
+            # idempotent, so a conflict-retried evict cannot double it)
+            self.meter.workload_released(ns, name, reason=metric_reason)
         if self._write_status(wl):
             self.m_preemptions.inc({"reason": metric_reason})
             self._record(wl, "Warning", reason, message)

@@ -191,10 +191,15 @@ class SessionManager:
         runtime: Optional[Any] = None,
         store: Optional[SessionCheckpointStore] = None,
         time_fn: Callable[[], float] = time.time,
+        meter: Optional[Any] = None,
     ):
         self.api = api
         self.config = config or SessionConfig()
         self.now = time_fn
+        # chip-hour ledger (machinery.usage.UsageMeter duck): suspend/
+        # restore transitions annotate the duty-cycle timeline so the
+        # /debug/usage view reads alongside the session state machine
+        self.meter = meter
         self.runtime = runtime or HttpSessionRuntime(
             cluster_domain=self.config.cluster_domain,
             port=self.config.agent_port,
@@ -443,6 +448,12 @@ class SessionManager:
             "reservation",
         )
         self._set_phase(notebook, PHASE_SUSPENDED)
+        if self.meter is not None:
+            self.meter.mark_event(
+                obj_util.namespace_of(notebook),
+                obj_util.name_of(notebook),
+                "suspended",
+            )
         if receipt.get("degraded"):
             self.recorder.warning(
                 notebook,
@@ -609,6 +620,12 @@ class SessionManager:
             else f"session resumed without state ({result})",
         )
         self._set_phase(notebook, "")
+        if self.meter is not None:
+            self.meter.mark_event(
+                obj_util.namespace_of(notebook),
+                obj_util.name_of(notebook),
+                f"resumed:{result}",
+            )
         return Result()
 
     # -- scheduler suspender hooks (checkpoint-then-preempt) ----------------

@@ -138,6 +138,21 @@ def default_slos() -> list[SLO]:
             histogram="session_resume_seconds",
             threshold_s=5.0,
         ),
+        SLO(
+            name="idle-waste",
+            description=(
+                "at least 50% of duty-sampled chip-seconds are active "
+                "compute (fleet utilization — the chip-hour economics "
+                "signal from the usage ledger; unsampled allocation "
+                "is excluded so a wedged agent cannot burn budget)"
+            ),
+            objective=0.5,
+            # one counter family, subset-label semantics: total sums
+            # both phases, bad selects phase="idle" (good = active)
+            total_metric="tpu_chip_seconds_total",
+            bad_metric="tpu_chip_seconds_total",
+            bad_labels={"phase": "idle"},
+        ),
     ]
 
 

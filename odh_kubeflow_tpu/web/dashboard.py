@@ -34,10 +34,16 @@ class DashboardApp:
         static_dir: Optional[str] = None,
         registry: Optional[prometheus.Registry] = None,
         slo_engine: Optional[Any] = None,
+        meter: Optional[Any] = None,
     ):
         self.api = api
         self.kfam = kfam or KfamService(api)
         self.registry = registry or prometheus.default_registry
+        # chip-hour ledger (machinery.usage.UsageMeter): feeds the
+        # /api/usage showback endpoint and the occupancy panel's
+        # utilization column; None (split-process dashboard without a
+        # meter) degrades both to empty
+        self.meter = meter
         # burn-rate rows for /api/slo (utils.slo.SLOEngine); built here
         # when not handed in. NOT started from the constructor — the
         # owner starts the sampling cadence (Platform.start for the
@@ -340,6 +346,15 @@ class DashboardApp:
                     suspended_chips[accel] = suspended_chips.get(
                         accel, 0
                     ) + float(checkpoint_chips(ck))
+            # utilization (active/allocated chip-seconds, from the
+            # usage ledger) rides next to the instantaneous occupancy
+            # numbers: a pool can be 100% occupied and 10% utilized —
+            # exactly the waste the showback surfaces
+            util = (
+                self.meter.utilization()
+                if self.meter is not None
+                else {"accelerators": {}, "zones": {}, "pools": {}}
+            )
             return success(
                 {
                     "tpu": [
@@ -350,6 +365,9 @@ class DashboardApp:
                             "suspendedChips": suspended_chips.get(accel, 0),
                             "committedChips": used.get(accel, 0)
                             + suspended_chips.get(accel, 0),
+                            "utilizationRatio": util["accelerators"].get(
+                                accel
+                            ),
                         }
                         for accel, cap in sorted(capacity.items())
                     ],
@@ -358,6 +376,7 @@ class DashboardApp:
                             "zone": zone,
                             "capacityChips": cap,
                             "usedChips": zone_used.get(zone, 0),
+                            "utilizationRatio": util["zones"].get(zone),
                         }
                         for zone, cap in sorted(zone_capacity.items())
                     ],
@@ -365,6 +384,26 @@ class DashboardApp:
                     "suspendedSessions": suspended_count,
                 }
             )
+
+        @app.route("/api/usage")
+        def usage(request):
+            """Showback: top-N namespaces by chip-hours with the
+            active/idle split, plus per-zone/pool/accelerator
+            utilization — the economics view of the fleet (chip-hours
+            scale with compute demand, not logged-in sessions).
+            ``flush=1`` forces a metering tick first (tests and ad-hoc
+            curls; the serving cadence otherwise samples in the
+            background)."""
+            user_of(request)
+            if self.meter is None:
+                return failure("usage metering not wired", 503)
+            if request.query.get("flush"):
+                self.meter.poll()
+            try:
+                top_n = int(request.query.get("top", "10"))
+            except ValueError:
+                top_n = 10
+            return success({"usage": self.meter.summary(top_n=top_n)})
 
         @app.route("/api/slo")
         def slo(request):

@@ -164,10 +164,14 @@ class JupyterWebApp(CrudBackend):
         config_path: Optional[str] = None,
         static_dir: Optional[str] = None,
         registry=None,
+        meter=None,
     ):
         super().__init__(
             api, "jupyter-web-app", static_dir=static_dir, registry=registry
         )
+        # chip-hour ledger (machinery.usage.UsageMeter): the detail
+        # page's per-notebook usage block; None degrades to no block
+        self.meter = meter
         self.config_path = config_path
         self._config_mtime: Optional[float] = None
         self._config = copy.deepcopy(DEFAULT_CONFIG)
@@ -357,6 +361,11 @@ class JupyterWebApp(CrudBackend):
                     "annotations": obj_util.annotations_of(nb),
                     "workload": self._workload_row(nb),
                     "checkpoint": self._checkpoint_row(nb),
+                    "usage": (
+                        self.meter.notebook_usage(namespace, name)
+                        if self.meter is not None
+                        else None
+                    ),
                 }
             })
 

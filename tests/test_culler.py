@@ -1,9 +1,13 @@
 """Culler tests: kernel idleness, TPU-duty-cycle-aware activity, stop
 annotation + atomic scale-to-zero, against a real HTTP fake of the
 Jupyter API (reference tier: pkg/culler/culler_test.go, but with the
-network probe exercised for real)."""
+network probe exercised for real). The activity-agent probe is also
+driven through its failure surface — hanging sockets, malformed
+payloads, wedged agents — where the contract is "a gap, never a zero":
+no annotation, no meter sample, and the cull loop keeps running."""
 
 import json
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, HTTPServer
@@ -14,6 +18,8 @@ from odh_kubeflow_tpu.apis import (
     LAST_ACTIVITY_ANNOTATION,
     STOP_ANNOTATION,
     TPU_ACCELERATOR_ANNOTATION,
+    TPU_DUTY_CYCLE_ANNOTATION,
+    TPU_TOPOLOGY_ANNOTATION,
     register_crds,
 )
 from odh_kubeflow_tpu.controllers.culler import Culler, CullerConfig, _fmt_time
@@ -235,3 +241,223 @@ def test_culling_metrics_fire(jupyter_server):
     text = registry.exposition()
     assert "notebook_culling_total 1" in text
     assert "last_notebook_culling_timestamp_seconds 5000761" in text
+
+
+# ---------------------------------------------------------------------------
+# activity-agent probe robustness + the culler→meter feed (one probe,
+# three consumers: cull decision, audit annotation, usage ledger)
+
+
+def make_metered_env(base_url, now_fn, probe_timeout=5.0):
+    """Like make_env but TPU-pooled and with a wired UsageMeter, so the
+    probed duty samples land in the chip-hour ledger."""
+    from odh_kubeflow_tpu.machinery.usage import (
+        UsageConfig,
+        UsageMeter,
+        register_usage,
+    )
+    from odh_kubeflow_tpu.scheduling import register_scheduling
+    from odh_kubeflow_tpu.utils.prometheus import Registry
+
+    api = APIServer()
+    register_crds(api)
+    register_scheduling(api)
+    register_usage(api)
+    cluster = FakeCluster(api)
+    cluster.add_node("cpu-0")
+    cluster.add_tpu_node_pool("v5e", "tpu-v5-lite-podslice", "2x2")
+    registry = Registry()
+    # sample_seconds=30 → max_sample_gap=120: the test's 61 s probe
+    # cadence stays attributable
+    meter = UsageMeter(
+        api,
+        UsageConfig(enabled=True, sample_seconds=30.0),
+        registry=registry,
+        time_fn=now_fn,
+    )
+    culler = Culler(
+        api,
+        CullerConfig(
+            cull_idle_seconds=600,
+            idleness_check_seconds=60,
+            probe_timeout=probe_timeout,
+        ),
+        base_url_fn=lambda nb: base_url,
+        now_fn=now_fn,
+        meter=meter,
+    )
+    mgr = Manager(api, time_fn=now_fn)
+    NotebookController(
+        api, NotebookControllerConfig(enable_culling=True), culler=culler
+    ).register(mgr)
+    return api, cluster, mgr, culler, meter, registry
+
+
+def tpu_notebook(name="train"):
+    return notebook(
+        name=name,
+        annotations={
+            TPU_ACCELERATOR_ANNOTATION: "tpu-v5-lite-podslice",
+            TPU_TOPOLOGY_ANNOTATION: "2x2",
+        },
+    )
+
+
+def admitted_workload(api, meter, name, t, chips=4):
+    wl = {
+        "apiVersion": "scheduling.kubeflow.org/v1alpha1",
+        "kind": "Workload",
+        "metadata": {"name": name, "namespace": "team-a"},
+        "spec": {
+            "hosts": 1,
+            "chipsPerHost": chips,
+            "acceleratorType": "tpu-v5-lite-podslice",
+            "topology": "2x2",
+        },
+        "status": {
+            "state": "Admitted",
+            "assignment": {"pool": "v5e", "zone": "zone-a"},
+        },
+    }
+    api.create(wl)
+    meter.workload_admitted(wl, t=t)
+
+
+def test_probe_feeds_meter_and_stamps_duty_annotation(jupyter_server):
+    """One healthy probe, three consumers: the duty sample blocks the
+    cull, lands on the notebook as the last-observed-duty audit
+    annotation, and attributes active chip-seconds in the ledger."""
+    clock = {"t": 6_000_000.0}
+    api, cluster, mgr, culler, meter, registry = make_metered_env(
+        jupyter_server, lambda: clock["t"]
+    )
+    old = _fmt_time(clock["t"] - 10_000)
+    FakeJupyter.kernels = [{"execution_state": "idle", "last_activity": old}]
+    FakeJupyter.tpu = {"duty_cycle_pct": 42.5}
+
+    api.create(tpu_notebook())
+    mgr.drain()
+    cluster.step()
+    admitted_workload(api, meter, "train", clock["t"])
+    mgr.drain()
+    clock["t"] += 61  # past the check period: the probe runs
+    mgr.drain()  # one probe: attributes 61 s of duty 42.5 over 4 chips
+
+    nb = api.get("Notebook", "train", "team-a")
+    ann = nb["metadata"]["annotations"]
+    assert STOP_ANNOTATION not in ann  # duty ≥ threshold blocks the cull
+    assert ann[TPU_DUTY_CYCLE_ANNOTATION] == f"42.5@{_fmt_time(clock['t'])}"
+
+    usage = meter.notebook_usage("team-a", "train", t=clock["t"])
+    assert usage["allocated"] is True
+    assert usage["dutyCyclePct"] == 42.5
+    assert usage["activeChipSeconds"] == pytest.approx(4 * 61 * 0.425)
+
+    rows = meter.timelines("team-a")
+    samples = [e for e in rows[0]["events"] if e["kind"] == "sample"]
+    assert [s["value"] for s in samples] == [42.5]
+    assert 'tpu_duty_samples_total{source="culler"} 1' in registry.exposition()
+
+
+@pytest.mark.parametrize(
+    "payload",
+    [
+        "garbage",  # not a dict at all
+        17,
+        ["duty_cycle_pct", 99],
+        {"status": "ok"},  # dict, duty field missing
+        {"duty_cycle_pct": None},
+        {"duty_cycle_pct": "NaN-ish"},  # non-numeric duty
+    ],
+)
+def test_malformed_agent_payload_is_gap_not_zero(jupyter_server, payload):
+    """A wrong-shape agent response is no-information: no duty
+    annotation, no meter sample — and the wedged agent must not shield
+    the notebook from culling once the kernels are idle past threshold."""
+    clock = {"t": 7_000_000.0}
+    api, cluster, mgr, culler, meter, registry = make_metered_env(
+        jupyter_server, lambda: clock["t"]
+    )
+    FakeJupyter.kernels = [
+        {"execution_state": "idle", "last_activity": _fmt_time(clock["t"] - 10_000)}
+    ]
+    FakeJupyter.tpu = payload
+
+    api.create(tpu_notebook())
+    mgr.drain()
+    cluster.step()
+    clock["t"] += 61
+    mgr.drain()  # probe runs; malformed payload must not raise
+    clock["t"] += 700  # past cull_idle_seconds=600
+    mgr.drain()
+
+    nb = api.get("Notebook", "train", "team-a")
+    ann = nb["metadata"]["annotations"]
+    assert TPU_DUTY_CYCLE_ANNOTATION not in ann
+    assert STOP_ANNOTATION in ann  # the gap never blocked the cull
+    assert meter.timelines("team-a") == []  # no sample reached the ledger
+    assert 'source="culler"' not in registry.exposition()
+
+
+def test_hanging_agent_times_out_as_gap():
+    """An agent that accepts the connection and then never answers: the
+    probe times out (probe_timeout), reads as a gap, and the reconcile
+    still initializes last-activity and eventually culls."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)  # backlog accepts connects; nothing ever responds
+    try:
+        clock = {"t": 8_000_000.0}
+        api, cluster, mgr, culler, meter, registry = make_metered_env(
+            f"http://127.0.0.1:{srv.getsockname()[1]}",
+            lambda: clock["t"],
+            probe_timeout=0.25,
+        )
+        api.create(tpu_notebook())
+        mgr.drain()
+        cluster.step()
+        clock["t"] += 61
+        mgr.drain()  # all three probes hang → time out → None
+        nb = api.get("Notebook", "train", "team-a")
+        ann = nb["metadata"]["annotations"]
+        assert LAST_ACTIVITY_ANNOTATION in ann  # first-sight init survived
+        assert TPU_DUTY_CYCLE_ANNOTATION not in ann
+        clock["t"] += 700
+        mgr.drain()
+        nb = api.get("Notebook", "train", "team-a")
+        assert STOP_ANNOTATION in nb["metadata"]["annotations"]
+        assert meter.timelines("team-a") == []
+    finally:
+        srv.close()
+
+
+def test_malformed_last_active_and_zero_duty_still_cull(jupyter_server):
+    """duty_cycle_pct parses (0.0 → observed + stamped) but last_active
+    is garbage: the bad timestamp is dropped without crashing, and duty
+    0 below threshold does not refresh activity — the notebook culls."""
+    clock = {"t": 9_000_000.0}
+    api, cluster, mgr, culler, meter, registry = make_metered_env(
+        jupyter_server, lambda: clock["t"]
+    )
+    FakeJupyter.kernels = []
+    FakeJupyter.tpu = {"duty_cycle_pct": 0.0, "last_active": "not-a-timestamp"}
+
+    api.create(tpu_notebook())
+    mgr.drain()
+    cluster.step()
+    clock["t"] += 61
+    mgr.drain()
+    nb = api.get("Notebook", "train", "team-a")
+    ann = nb["metadata"]["annotations"]
+    # the sample itself is healthy: observed and stamped for audit
+    assert ann[TPU_DUTY_CYCLE_ANNOTATION].startswith("0@")
+    assert STOP_ANNOTATION not in ann
+    clock["t"] += 700
+    mgr.drain()
+    nb = api.get("Notebook", "train", "team-a")
+    assert STOP_ANNOTATION in nb["metadata"]["annotations"]
+    rows = meter.timelines("team-a")
+    assert [e["value"] for e in rows[0]["events"] if e["kind"] == "sample"] == [
+        0.0,
+        0.0,
+    ]

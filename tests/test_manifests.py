@@ -34,6 +34,7 @@ def test_all_yaml_parses():
 def test_crds_match_code_registrations():
     from odh_kubeflow_tpu.apis import register_crds
     from odh_kubeflow_tpu.machinery.store import APIServer
+    from odh_kubeflow_tpu.machinery.usage import register_usage
     from odh_kubeflow_tpu.scheduling import register_scheduling
     from odh_kubeflow_tpu.sessions import register_sessions
 
@@ -41,6 +42,7 @@ def test_crds_match_code_registrations():
     register_crds(api)
     register_scheduling(api)
     register_sessions(api)
+    register_usage(api)
 
     crds = {
         d["metadata"]["name"]: d
@@ -54,6 +56,7 @@ def test_crds_match_code_registrations():
         "PodDefault",
         "Workload",
         "SessionCheckpoint",
+        "UsageRecord",
     }
     for kind in expected:
         info = api.type_info(kind)

@@ -1,0 +1,365 @@
+"""UsageMeter unit tests: the chip-hour ledger's invariants pinned one
+behavior at a time — idempotent admit/release lifecycle, trailing
+attribution with gap-not-zero semantics, exact window splitting,
+failover recovery from ``flushedThrough``, the sweep self-heal, and
+exactness under a seeded chaos schedule (``GRAFT_CHAOS`` injects
+Conflict/429/5xx on the persistence path; the in-memory integrals must
+not care, and the records must converge once the weather clears).
+
+The same invariants are proven at scale, with lifecycle churn and a
+WAL failover, by ``loadtest/usage_drill.py`` (``make usagebench``).
+"""
+
+import time as _time
+
+import pytest
+
+from odh_kubeflow_tpu.machinery.faults import (
+    FaultInjector,
+    FaultSchedule,
+    chaos_seed,
+)
+from odh_kubeflow_tpu.machinery.store import APIServer
+from odh_kubeflow_tpu.machinery.usage import (
+    WINDOW_LABEL,
+    UsageConfig,
+    UsageMeter,
+    register_usage,
+)
+from odh_kubeflow_tpu.machinery.wal import WriteAheadLog
+from odh_kubeflow_tpu.scheduling import register_scheduling
+from odh_kubeflow_tpu.utils.prometheus import Registry
+
+T0 = 1_000_200.0  # aligned to the 300 s window grid
+SEED = chaos_seed() or 20591
+
+
+def fmt(t):
+    return _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime(t))
+
+
+def workload(
+    name="nb1",
+    namespace="team-a",
+    chips=4,
+    pool="pool-a",
+    zone="zone-a",
+    admitted_at=T0,
+):
+    return {
+        "apiVersion": "scheduling.kubeflow.org/v1alpha1",
+        "kind": "Workload",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "hosts": 1,
+            "chipsPerHost": chips,
+            "acceleratorType": "tpu-v5-lite-podslice",
+            "topology": "2x2",
+        },
+        "status": {
+            "state": "Admitted",
+            "admittedAt": fmt(admitted_at),
+            "assignment": {"pool": pool, "zone": zone},
+        },
+    }
+
+
+def make_meter(clock, api=None, sample_seconds=15.0, sample_fn=None):
+    if api is None:
+        api = APIServer()
+        register_scheduling(api)
+        register_usage(api)
+    meter = UsageMeter(
+        api,
+        UsageConfig(
+            enabled=True, sample_seconds=sample_seconds, window_seconds=300.0
+        ),
+        registry=Registry(),
+        time_fn=lambda: clock["t"],
+        sample_fn=sample_fn,
+    )
+    return api, meter
+
+
+def record_status(api, window_start, name="nb1", namespace="team-a"):
+    rec = api.get("UsageRecord", f"u{int(window_start)}-{name}", namespace)
+    return rec["status"]
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+
+
+def test_admit_release_idempotent():
+    """Double admit (hook + sweep racing benignly) opens once; every
+    evict path may fire release, and only the first close counts."""
+    clock = {"t": T0}
+    api, meter = make_meter(clock)
+    wl = workload()
+    api.create(wl)
+    meter.workload_admitted(wl, t=T0)
+    meter.workload_admitted(wl, t=T0 + 50)  # duplicate: no-op
+    meter.workload_released("team-a", "nb1", reason="preempted", t=T0 + 100)
+    meter.workload_released("team-a", "nb1", reason="node-lost", t=T0 + 200)
+    assert meter.flush(T0 + 200) == 1
+    st = record_status(api, T0)
+    assert st["allocatedChipSeconds"] == 4 * 100  # counted exactly once
+    assert meter.summary(t=T0 + 200)["openAllocations"] == 0
+    marks = [
+        e
+        for e in meter.timelines("team-a")[0]["events"]
+        if e["kind"] == "mark"
+    ]
+    assert [m["value"] for m in marks] == ["released:preempted"]
+
+
+def test_trailing_attribution_and_max_sample_gap():
+    """A sample covers the span since its predecessor; silence past
+    max_sample_gap stays unsampled — allocated but neither active nor
+    idle (a wedged agent must not manufacture idleness)."""
+    clock = {"t": T0}
+    api, meter = make_meter(clock)  # sample_seconds=15 → max gap 60
+    wl = workload()
+    api.create(wl)
+    meter.workload_admitted(wl, t=T0)
+    meter.observe_sample("team-a", "nb1", 50.0, t=T0 + 15)  # covers (T0, +15]
+    meter.observe_sample("team-a", "nb1", 100.0, t=T0 + 130)  # 115 s gap > 60
+    meter.observe_sample("team-a", "nb1", 100.0, t=T0 + 145)  # covers (+130, +145]
+    meter.flush(T0 + 145)
+    st = record_status(api, T0)
+    assert st["allocatedChipSeconds"] == 4 * 145
+    assert st["sampledChipSeconds"] == 4 * 30  # the gap span stayed out
+    assert st["activeChipSeconds"] == 4 * 15 * 0.5 + 4 * 15
+    assert st["idleChipSeconds"] == 4 * 15 * 0.5
+    assert st["unsampledChipSeconds"] == 4 * 145 - 4 * 30
+    # conservation: allocated == active + idle + unsampled
+    assert st["allocatedChipSeconds"] == pytest.approx(
+        st["activeChipSeconds"]
+        + st["idleChipSeconds"]
+        + st["unsampledChipSeconds"]
+    )
+
+
+def test_malformed_stale_and_clamped_samples():
+    """Malformed duty is a no-op (gap, never a zero); a stale sample
+    (t ≤ already-attributed) is ignored; out-of-range duty clamps."""
+    clock = {"t": T0}
+    api, meter = make_meter(clock)
+    wl = workload()
+    api.create(wl)
+    meter.workload_admitted(wl, t=T0)
+    meter.observe_sample("team-a", "nb1", "NaN-ish", t=T0 + 15)  # malformed
+    meter.observe_sample("team-a", "nb1", None, t=T0 + 15)  # malformed
+    meter.observe_sample("team-a", "nb1", 250.0, t=T0 + 15)  # clamps to 100
+    meter.observe_sample("team-a", "nb1", 80.0, t=T0 + 10)  # stale: ignored
+    meter.observe_sample("team-a", "nb1", -40.0, t=T0 + 30)  # clamps to 0
+    meter.flush(T0 + 30)
+    st = record_status(api, T0)
+    assert st["samples"] == 2  # malformed + stale attributed nothing
+    assert st["sampledChipSeconds"] == 4 * 30
+    assert st["activeChipSeconds"] == 4 * 15  # 100% then 0%
+    # malformed samples never even reach the timeline
+    events = meter.timelines("team-a")[0]["events"]
+    assert [e["value"] for e in events if e["kind"] == "sample"] == [
+        100.0,
+        80.0,
+        0.0,
+    ]
+
+
+def test_sample_without_allocation_is_gauge_only():
+    """No open allocation → nothing to attribute: the sample updates
+    gauge + timeline but writes no ledger record."""
+    clock = {"t": T0}
+    api, meter = make_meter(clock)
+    meter.observe_sample("team-a", "ghost", 75.0, t=T0 + 5)
+    assert meter.flush(T0 + 10) == 0
+    rows = meter.timelines("team-a")
+    assert rows[0]["notebook"] == "ghost" and rows[0]["open"] is False
+
+
+# ---------------------------------------------------------------------------
+# windows + persistence
+
+
+def test_window_split_is_exact_at_the_boundary():
+    """Allocation and samples spanning a window boundary split exactly
+    into the two UsageRecords; flushedThrough marks each window's
+    integration high-water."""
+    clock = {"t": T0}
+    # sample_seconds=150 → max gap 600: the 100 s boundary-spanning
+    # sample stays attributable
+    api, meter = make_meter(clock, sample_seconds=150.0)
+    wl = workload(admitted_at=T0 + 250)
+    api.create(wl)
+    meter.workload_admitted(wl, t=T0 + 250)
+    meter.observe_sample("team-a", "nb1", 50.0, t=T0 + 350)
+    assert meter.flush(T0 + 350) == 2
+    first = record_status(api, T0)
+    second = record_status(api, T0 + 300)
+    for st in (first, second):  # 50 s on each side of the boundary
+        assert st["allocatedChipSeconds"] == 4 * 50
+        assert st["sampledChipSeconds"] == 4 * 50
+        assert st["activeChipSeconds"] == 4 * 50 * 0.5
+    assert first["flushedThrough"] == T0 + 300
+    assert second["flushedThrough"] == T0 + 350
+    rec = api.get("UsageRecord", f"u{int(T0)}-nb1", "team-a")
+    assert rec["metadata"]["labels"][WINDOW_LABEL] == str(int(T0))
+
+
+def test_failover_recovers_ledger_without_loss(tmp_path):
+    """Leader crash between flushes: the successor's meter rebuilds the
+    buckets from the WAL-replayed UsageRecords and resumes integration
+    from flushedThrough — nothing lost, nothing double-counted."""
+    clock = {"t": T0}
+    wal = WriteAheadLog(str(tmp_path))
+    api = APIServer(wal=wal)
+    register_scheduling(api)
+    register_usage(api)
+    _, meter = make_meter(clock, api=api)
+    wl = workload()
+    api.create(wl)
+    meter.workload_admitted(wl, t=T0)
+    meter.observe_sample("team-a", "nb1", 50.0, t=T0 + 15)
+    clock["t"] = T0 + 15
+    assert meter.flush(T0 + 15) == 1
+
+    wal.close()  # crash; WAL replay on the successor
+    wal2 = WriteAheadLog(str(tmp_path))
+    api2 = APIServer.recover(wal2)
+    _, meter2 = make_meter(clock, api=api2)
+    meter2.recover()
+
+    nb = meter2.notebook_usage("team-a", "nb1", t=T0 + 15)
+    assert nb["allocated"] is True  # sweep reopened the admitted workload
+    assert nb["allocatedChipSeconds"] == 4 * 15  # nothing lost
+
+    meter2.observe_sample("team-a", "nb1", 50.0, t=T0 + 30)
+    clock["t"] = T0 + 30
+    meter2.flush(T0 + 30)
+    st = record_status(api2, T0)
+    assert st["allocatedChipSeconds"] == 4 * 30  # not 4*45: no double count
+    assert st["sampledChipSeconds"] == 4 * 30
+    assert st["activeChipSeconds"] == 4 * 30 * 0.5
+    wal2.close()
+
+
+def test_sweep_self_heals_missed_lifecycle():
+    """A workload admitted before the meter existed opens on sweep
+    (resuming from admittedAt); a release that bypassed the hooks
+    closes on sweep — allocation stops accruing."""
+    clock = {"t": T0 + 40}
+    api, meter = make_meter(clock)
+    api.create(workload(admitted_at=T0))  # no workload_admitted call
+    meter.sweep(T0 + 40)
+    nb = meter.notebook_usage("team-a", "nb1", t=T0 + 40)
+    assert nb["allocated"] is True
+    assert nb["allocatedChipSeconds"] == 4 * 40  # resumed from admittedAt
+
+    api.delete("Workload", "nb1", "team-a")  # release path the meter missed
+    clock["t"] = T0 + 100
+    meter.sweep(T0 + 100)
+    nb = meter.notebook_usage("team-a", "nb1", t=T0 + 500)
+    assert nb["allocated"] is False
+    assert nb["allocatedChipSeconds"] == 4 * 100  # frozen at the sweep close
+    events = meter.timelines("team-a")[0]["events"]
+    assert any(
+        e["kind"] == "mark" and e["value"] == "released:swept" for e in events
+    )
+
+
+def test_poll_samples_through_sample_fn_with_gap_on_none():
+    """The serving tick end to end: sweep opens from the store, the
+    injected sample_fn supplies duty (None == wedged agent), flush
+    persists. The wedge's span lands in unsampled."""
+    clock = {"t": T0}
+    duties = {"nb1": 60.0}
+    api, meter = make_meter(
+        clock, sample_fn=lambda ns, nb: duties.get(nb)
+    )
+    api.create(workload(admitted_at=T0))
+    clock["t"] = T0 + 15
+    meter.poll()  # opens via sweep, samples 15 s of duty 60
+    del duties["nb1"]  # agent wedges: no signal at all
+    clock["t"] = T0 + 150
+    meter.poll()  # no sample; allocation still accrues
+    duties["nb1"] = 60.0
+    clock["t"] = T0 + 165
+    meter.poll()  # dt=150 > max gap 60: span stays unsampled
+    clock["t"] = T0 + 180
+    meter.poll()  # back to normal: 15 s attributed
+    st = record_status(api, T0)
+    assert st["allocatedChipSeconds"] == 4 * 180
+    assert st["sampledChipSeconds"] == 4 * 30
+    assert st["activeChipSeconds"] == pytest.approx(4 * 30 * 0.6)
+    assert st["unsampledChipSeconds"] == 4 * 180 - 4 * 30
+
+
+# ---------------------------------------------------------------------------
+# chaos
+
+
+def test_ledger_exact_under_seeded_chaos():
+    """The persistence path runs under the CI chaos mix (injected
+    Conflict/429/5xx): failed upserts leave buckets dirty and retry on
+    the next flush; the in-memory integrals never waver. Once the
+    weather clears, the persisted windows must sum to the straight-line
+    ground truth exactly."""
+    clock = {"t": T0}
+    api = APIServer()
+    register_scheduling(api)
+    register_usage(api)
+    registry = Registry()
+    injector = FaultInjector(
+        api,
+        seed=SEED,
+        schedule=FaultSchedule.default(),
+        registry=registry,
+        sleep_fn=lambda _s: None,
+    )
+    meter = UsageMeter(
+        injector,
+        UsageConfig(enabled=True, sample_seconds=15.0, window_seconds=300.0),
+        registry=registry,
+        time_fn=lambda: clock["t"],
+    )
+    plan = {  # name -> (chips, duty, open_tick, close_tick|None); 15 s ticks
+        "nb-a": (4, 50.0, 0, None),
+        "nb-b": (8, 25.0, 0, 20),
+        "nb-c": (2, 100.0, 4, None),
+    }
+    open_at = {}
+    gt = {name: {"alloc": 0.0, "active": 0.0} for name in plan}
+    for tick in range(0, 41):
+        t = T0 + tick * 15.0
+        clock["t"] = t
+        for name, (chips, duty, open_tick, close_tick) in plan.items():
+            if tick == open_tick:
+                wl = workload(name=name, chips=chips, admitted_at=t)
+                api.create(wl)  # setup writes bypass the injector
+                meter.workload_admitted(wl, t=t)
+                open_at[name] = t
+            elif tick == close_tick:
+                api.delete("Workload", name, "team-a")
+                meter.workload_released("team-a", name, "preempted", t=t)
+                gt[name]["alloc"] += chips * (t - open_at.pop(name))
+            elif name in open_at:
+                meter.observe_sample("team-a", name, duty, t=t, source="test")
+                gt[name]["active"] += chips * 15.0 * duty / 100.0
+        if tick and tick % 4 == 0:
+            meter.flush(t)  # chaos may fail some upserts: stays dirty
+    t_end = T0 + 40 * 15.0
+    for name, opened in open_at.items():
+        gt[name]["alloc"] += plan[name][0] * (t_end - opened)
+    injector.set_schedule(FaultSchedule())  # the weather clears
+    meter.flush(t_end)  # every still-dirty bucket lands now
+
+    sums = {name: {"alloc": 0.0, "active": 0.0} for name in plan}
+    for rec in api.list("UsageRecord"):
+        st = rec.get("status") or {}
+        row = sums[rec["spec"]["notebook"]]
+        row["alloc"] += st.get("allocatedChipSeconds", 0.0)
+        row["active"] += st.get("activeChipSeconds", 0.0)
+    for name in plan:
+        assert sums[name]["alloc"] == pytest.approx(gt[name]["alloc"]), name
+        assert sums[name]["active"] == pytest.approx(gt[name]["active"]), name
