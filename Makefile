@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint sanitize chaos durability explore fleetbench replicabench zonedrill usagebench obs loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
+.PHONY: all test test-unit test-manifests lint sanitize chaos durability explore fleetbench replicabench zonedrill usagebench warmbench obs loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
 
 all: lint test
 
@@ -59,7 +59,9 @@ explore:
 chaos:
 	GRAFT_CHAOS=1 GRAFT_SANITIZE=1 $(PYTHON) -m pytest -q \
 	  tests/test_chaos.py tests/test_leader.py \
-	  tests/test_sessions.py::test_property_random_suspend_resume_under_chaos
+	  tests/test_sessions.py::test_property_random_suspend_resume_under_chaos \
+	  tests/test_warmup.py::test_singleflight_dedups_concurrent_compiles \
+	  tests/test_warmup.py::test_concurrent_claims_hand_out_exactly_one_standby
 
 # crash/failover drills (docs/GUIDE.md "Durability & failover"): WAL
 # kill-point sweep (process death at every commit point), disk-fault
@@ -71,7 +73,9 @@ chaos:
 # refreshed deliberately)
 durability:
 	GRAFT_SANITIZE=1 GRAFT_CHAOS=7 $(PYTHON) -m pytest -q \
-	  tests/test_durability.py tests/test_leader.py
+	  tests/test_durability.py tests/test_leader.py \
+	  tests/test_warmup.py::test_claim_kill_point_sweep_no_double_handout \
+	  tests/test_warmup.py::test_cache_entries_survive_wal_failover
 	cp BENCH_control_plane.json /tmp/durability_bench.json
 	$(PYTHON) loadtest/control_plane_bench.py --recovery-only \
 	  --recovery-counts 500,2000 --failover-reps 6 \
@@ -125,6 +129,17 @@ zonedrill:
 # (meter CPU per sampling window ≤2% of one core; writes to a scratch
 # copy so committed BENCH numbers change only when refreshed
 # deliberately)
+# warm-start drills (docs/GUIDE.md "Compilation cache & warm pools"):
+# the full warmup suite under the sanitizer (singleflight, corrupt
+# artifact, TTL/LRU GC, zone fail/heal, WAL failover, claim race +
+# kill-point sweep, zone-kill drain+backfill, JWA warm handout), then
+# the gated cold-vs-warm bench — warm spawn must beat the cold spawn
+# inside ONE sim run and the cache-service compile roundtrip must
+# land the warm compile under 1s
+warmbench:
+	GRAFT_SANITIZE=1 $(PYTHON) -m pytest -q tests/test_warmup.py
+	GRAFT_SANITIZE=1 $(PYTHON) -m loadtest.spawn_latency --warm-only
+
 usagebench:
 	GRAFT_SANITIZE=1 GRAFT_CHAOS=20591 $(PYTHON) -m pytest -q \
 	  tests/test_usage.py tests/test_culler.py

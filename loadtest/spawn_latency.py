@@ -13,13 +13,19 @@ BASELINE.md's headline latency metric. Two measured segments:
    user's first cell does: import the runtime, build the Llama-1B LoRA
    trainer, and run one train step to a fetched loss. Cold-compile
    time is the dominant term and is measured for real — twice, in
-   subprocesses sharing a ``JAX_COMPILATION_CACHE_DIR``: the cold run
-   populates the persistent cache, the warm run measures what a
-   re-spawned notebook pays (the TPU images and the ``tpu-runtime``
-   PodDefault pin the cache onto the workspace PVC, which survives
-   stop/cull/restart).
+   subprocesses routed through the compile-cache *service* (warmup/
+   subsystem): the cold run populates a staging dir that is ingested
+   as content-addressed ``CompileCacheEntry`` artifacts, the warm run
+   gets a dir materialized back from the service — the exact path a
+   warm-pool standby's pre-compiled cache mount takes.
 
-Prints one JSON line; ``--record`` rewrites the table row in
+``--warm-only`` (``make warmbench``) needs no accelerator: it races a
+cold spawn against a warm-pool claim in ONE sim run (the cold spawn
+pays the simulated image pull, the claim lands on the standby's
+pre-imaged slice) and runs a cold/warm compile probe pair through the
+cache service, gating warm-compile < 1s and warm < cold on both axes.
+
+Prints one JSON line; ``--record`` rewrites the table row(s) in
 BASELINE.md.
 """
 
@@ -417,6 +423,248 @@ def _first_step_subprocess(cache_dir: str) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def _cache_service(root: str):
+    """A standalone compile-cache service over a throwaway apiserver —
+    the same CompileCacheService the platform embeds, so the bench
+    exercises the real ingest/materialize/GC path, not a lookalike."""
+    import os
+
+    from odh_kubeflow_tpu.machinery.store import APIServer
+    from odh_kubeflow_tpu.warmup import register_warmup
+    from odh_kubeflow_tpu.warmup.compilecache import (
+        CompileCacheConfig,
+        CompileCacheService,
+    )
+
+    api = APIServer()
+    register_warmup(api)
+    return CompileCacheService(
+        api, CompileCacheConfig(cache_dir=os.path.join(root, "svc"))
+    )
+
+
+def measure_compile_cache_roundtrip(probe: bool = False) -> dict:
+    """Cold subprocess → ingest into the service → materialize → warm
+    subprocess. ``probe=True`` swaps the Llama trainer for a small
+    compile-heavy jitted probe so the roundtrip runs on CPU in CI."""
+    import os
+    import tempfile
+
+    import shutil
+
+    runner = _probe_subprocess if probe else _first_step_subprocess
+    topo = "bench"
+    with tempfile.TemporaryDirectory(prefix="warmcc-") as root:
+        svc = _cache_service(root)
+        # XLA folds the cache-dir path into the compile-env key, so a
+        # hit requires the SAME mount path cold and warm — which is the
+        # production contract anyway: COMPILE_CACHE_MOUNT pins one
+        # stable path into every pod
+        mount = os.path.join(root, "mount")
+        os.makedirs(mount)
+        cold = runner(mount)  # cold: fills the mount
+        ingested = svc.ingest_dir(mount, topology=topo)
+        shutil.rmtree(mount)  # fresh pod: the mount starts empty ...
+        materialized = svc.materialize_dir(mount, topology=topo)
+        warm = runner(mount)  # ... holding only what the service served
+        stats = svc.stats()
+    return {
+        "first_step": cold,
+        "first_step_warm": warm,
+        "compile_cache": {
+            "ingested": ingested,
+            "materialized": materialized,
+            **stats,
+        },
+    }
+
+
+def _compile_probe() -> dict:
+    """A deliberately compile-heavy jitted function (~1s cold on CPU)
+    whose warm cost is a persistent-cache deserialization — the CI
+    stand-in for the Llama first-step compile."""
+    from odh_kubeflow_tpu.warmup.compilecache import install_process_cache
+
+    cache_dir = install_process_cache()
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        for i in range(48):
+            x = jnp.tanh(x @ (x * (1.0 + i / 37.0)).T @ x) / (2.0 + i)
+        return x.sum()
+
+    x = jnp.ones((192, 192), jnp.float32)
+    t0 = time.monotonic()
+    step(x).block_until_ready()
+    return {
+        "first_step_compile_s": round(time.monotonic() - t0, 3),
+        "cache_dir": cache_dir or "",
+    }
+
+
+def _probe_subprocess(cache_dir: str) -> dict:
+    import os
+    import subprocess
+
+    env = dict(
+        os.environ,
+        JAX_COMPILATION_CACHE_DIR=cache_dir,
+        # the probe is small; cache everything so the warm run hits
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
+        JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES="0",
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "loadtest.spawn_latency", "--compile-probe"],
+        env=env,
+        capture_output=True,
+        text=True,
+        check=True,
+        timeout=580,
+    )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def measure_warm_spawn() -> dict:
+    """Cold spawn vs warm-pool claim in ONE sim run. The simulated
+    image pull is the cold tax; the warm spawn claims a standby whose
+    slice already pulled the image and whose template kernel state
+    restores through the ordinary resume machinery."""
+    from odh_kubeflow_tpu.platform import Platform
+    from odh_kubeflow_tpu.warmup import WARM_FROM_ANNOTATION
+    from odh_kubeflow_tpu.warmup.pool import new_warm_pool
+
+    image = "odh-kubeflow-tpu/jupyter-jax-tpu:v0.1.0"
+    platform = Platform(sim=True)
+    platform.cluster.add_node("cpu-0")
+    for i in range(2):
+        platform.cluster.add_tpu_node_pool(
+            f"v5e-{i}", "tpu-v5-lite-podslice", "2x2",
+            num_hosts=1, chips_per_host=4,
+        )
+    # every first placement on a pool pays this pull; the standby
+    # pre-pays it off the user's clock
+    platform.cluster.image_pull_seconds = 1.5
+    platform.api.create(
+        {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "Profile",
+            "metadata": {"name": "bench-team"},
+            "spec": {"owner": {"kind": "User", "name": "bench@example.com"}},
+        }
+    )
+    _, web_port = platform.start(api_port=0, web_port=0)
+    base = f"http://127.0.0.1:{web_port}"
+
+    def call(path, method="GET", body=None):
+        headers = {
+            "kubeflow-userid": "bench@example.com",
+            "Content-Type": "application/json",
+        }
+        if method != "GET":
+            headers["Cookie"] = "XSRF-TOKEN=t"
+            headers["x-xsrf-token"] = "t"
+        req = urllib.request.Request(
+            base + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+            headers=headers,
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read().decode())
+
+    def spawn(name):
+        t0 = time.monotonic()
+        call(
+            "/jupyter/api/namespaces/bench-team/notebooks",
+            method="POST",
+            body={
+                "name": name,
+                "image": image,
+                "cpu": "1",
+                "memory": "2Gi",
+                "workspaceVolume": None,
+                "dataVolumes": [],
+                "tpus": {
+                    "accelerator": "tpu-v5-lite-podslice",
+                    "topology": "2x2",
+                },
+            },
+        )
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            details = call(
+                f"/jupyter/api/namespaces/bench-team/notebooks/{name}/details"
+            )["details"]
+            if details["status"]["phase"] == "ready":
+                return time.monotonic() - t0, details
+            time.sleep(0.05)
+        raise RuntimeError(f"{name} never became ready")
+
+    try:
+        cold_s, _ = spawn("cold-nb")
+        # stand up the pool and let the standby pre-pull + pre-admit
+        platform.api.create(
+            new_warm_pool(
+                "bench-pool", "bench-team", size=1,
+                accelerator="tpu-v5-lite-podslice", topology="2x2",
+                image=image,
+            )
+        )
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            pool = platform.api.get("WarmPool", "bench-pool", "bench-team")
+            if (pool.get("status") or {}).get("readyStandbys") == 1:
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("warm pool never reached readyStandbys=1")
+        warm_s, details = spawn("warm-nb")
+        warm_from = (details.get("warm") or {}).get("pool")
+        nb = platform.api.get("Notebook", "warm-nb", "bench-team")
+        ann = (nb["metadata"].get("annotations") or {})
+        handout = ann.get(WARM_FROM_ANNOTATION) == "bench-pool"
+    finally:
+        platform.stop()
+    return {
+        "cold_spawn_s": round(cold_s, 3),
+        "warm_spawn_s": round(warm_s, 3),
+        "image_pull_s": platform.cluster.image_pull_seconds,
+        "warm_handout": handout,
+        "warm_pool": warm_from or "",
+        "kubelet": "simulated",
+    }
+
+
+def record_warm(result: dict) -> None:
+    import pathlib
+    import re
+
+    path = pathlib.Path(__file__).resolve().parent.parent / "BASELINE.md"
+    text = path.read_text()
+    line = (
+        f"| Warm-start (pool claim + compile cache) | "
+        f"**spawn {result['warm_spawn_s']}s warm vs "
+        f"{result['cold_spawn_s']}s cold** (standby claim skips the "
+        f"{result['image_pull_s']}s image pull, sim kubelet); **compile "
+        f"{result['first_step_warm']['first_step_compile_s']}s warm vs "
+        f"{result['first_step']['first_step_compile_s']}s cold** "
+        f"(cache-service ingest → materialize roundtrip, CPU probe; "
+        f"gate warm < 1s) "
+        f"| sim + CPU probe | loadtest/spawn_latency.py --warm-only |"
+    )
+    pattern = r"\| Warm-start \(pool claim \+ compile cache\) \|[^\n]*"
+    anchor = r"(\| Spawn → first JAX step latency \|[^\n]*\n)"
+    if re.search(pattern, text):
+        text = re.sub(pattern, line, text, count=1)
+    elif re.search(anchor, text):
+        text = re.sub(anchor, r"\1" + line.replace("\\", r"\\") + "\n", text, count=1)
+    else:
+        text += line + "\n"
+    path.write_text(text)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--record", action="store_true", help="update BASELINE.md")
@@ -425,6 +673,19 @@ def main() -> None:
         action="store_true",
         help="internal: just the ready→first-step half, honoring "
         "JAX_COMPILATION_CACHE_DIR from the environment",
+    )
+    parser.add_argument(
+        "--compile-probe",
+        action="store_true",
+        help="internal: the compile-heavy CPU probe, honoring "
+        "JAX_COMPILATION_CACHE_DIR from the environment",
+    )
+    parser.add_argument(
+        "--warm-only",
+        action="store_true",
+        help="`make warmbench`: cold-vs-warm spawn in one sim run plus "
+        "the cache-service compile roundtrip, gated (no accelerator "
+        "needed)",
     )
     parser.add_argument(
         "--suspend-only",
@@ -437,6 +698,46 @@ def main() -> None:
 
     if args.first_step_only:
         print(json.dumps(measure_first_jax_step()))
+        return
+
+    if args.compile_probe:
+        print(json.dumps(_compile_probe()))
+        return
+
+    if args.warm_only:
+        import os
+
+        result = measure_warm_spawn()
+        if os.environ.get("WARM_POOL_ENABLED", "true").lower() == "true":
+            # gate 1: the claim actually came from the pool, and the
+            # warm spawn beat the cold one inside the SAME sim run
+            if not result["warm_handout"]:
+                raise SystemExit(
+                    "GATE FAILED: spawn did not claim the warm standby"
+                )
+            if result["warm_spawn_s"] >= result["cold_spawn_s"]:
+                raise SystemExit(
+                    f"GATE FAILED: warm spawn {result['warm_spawn_s']}s "
+                    f"not faster than cold {result['cold_spawn_s']}s"
+                )
+        result.update(measure_compile_cache_roundtrip(probe=True))
+        cold_c = result["first_step"]["first_step_compile_s"]
+        warm_c = result["first_step_warm"]["first_step_compile_s"]
+        # gate 2: a materialized cache turns the compile into a
+        # deserialization — sub-second, and strictly under cold
+        if warm_c >= 1.0:
+            raise SystemExit(
+                f"GATE FAILED: warm compile {warm_c}s breaches the 1s bound"
+            )
+        if warm_c >= cold_c:
+            raise SystemExit(
+                f"GATE FAILED: warm compile {warm_c}s not faster than "
+                f"cold {cold_c}s"
+            )
+        result["gate"] = "passed"
+        print(json.dumps(result))
+        if args.record:
+            record_warm(result)
         return
 
     if args.suspend_only:
@@ -474,7 +775,6 @@ def main() -> None:
         return
 
     import os
-    import tempfile
 
     # the suspend/resume half needs the sessions subsystem; honor the
     # documented opt-out instead of timing out against a platform that
@@ -483,13 +783,20 @@ def main() -> None:
         os.environ.get("ENABLE_SESSION_SUSPEND", "true").lower() == "true"
     )
     spawn = measure_spawn_to_ready(with_suspend_resume=sessions_on)
-    with tempfile.TemporaryDirectory(prefix="jaxcache-") as cache_dir:
-        first = _first_step_subprocess(cache_dir)  # cold: populates cache
-        warm = _first_step_subprocess(cache_dir)  # warm: the re-spawn path
+    # the cold run stages into the cache service, the warm run reads a
+    # dir the service materialized — the standby's pre-compiled mount
+    roundtrip = measure_compile_cache_roundtrip()
+    first = roundtrip["first_step"]
+    warm = roundtrip["first_step_warm"]
+    if warm["first_step_compile_s"] >= 1.0:
+        raise SystemExit(
+            f"GATE FAILED: warm first-step compile "
+            f"{warm['first_step_compile_s']}s breaches the 1s bound "
+            f"(cold {first['first_step_compile_s']}s)"
+        )
     result = {
         **spawn,
-        "first_step": first,
-        "first_step_warm": warm,
+        **roundtrip,
         "total_s": round(
             spawn["spawn_to_ready_s"]
             + first["trainer_build_s"]

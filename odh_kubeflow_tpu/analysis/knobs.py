@@ -244,7 +244,7 @@ def guide_knob_mentions(text: str) -> set[str]:
 # scopes in appendix order (free-form strings; unknown scopes sort last)
 _SCOPE_ORDER = (
     "platform", "runner", "client", "replica", "controller", "scheduler",
-    "sessions", "profile", "web", "webhooks", "pod", "test",
+    "sessions", "warmup", "profile", "web", "webhooks", "pod", "test",
 )
 
 

@@ -95,7 +95,8 @@ class UncachedListRule(Rule):
     description = (
         "bare cluster-wide list() of an indexable kind on a hot path"
     )
-    dirs = ("controllers", "web", "scheduling", "webhooks", "sessions")
+    dirs = ("controllers", "web", "scheduling", "webhooks", "sessions",
+            "warmup")
 
     _SELECTIVE_KWARGS = ("namespace", "label_selector", "field_matches")
 
@@ -298,6 +299,10 @@ class BlockingUnderLockRule(Rule):
         # and the event-loop serving tier
         "machinery/wal.py",
         "machinery/eventloop.py",
+        # singleflight: the compile-cache inflight lock must only guard
+        # the table — compiles and artifact IO happen outside it
+        "warmup/compilecache.py",
+        "warmup/pool.py",
         # the replication pull loop blocks on sockets by design — but
         # NEVER under the replica store's lock (rv-pinned reads park on
         # a Condition there, which is the one exempt form)
@@ -543,7 +548,8 @@ class UnfencedWriteRule(Rule):
         "store write in a leader-electing module outside a fencing "
         "context"
     )
-    dirs = ("controllers", "machinery", "scheduling", "sessions", "web")
+    dirs = ("controllers", "machinery", "scheduling", "sessions", "web",
+            "warmup")
 
     # the fencing helpers themselves (and the runner, which only wires
     # electors into the Manager) are the mechanism, not consumers
@@ -950,7 +956,7 @@ class FrozenMutationRule(Rule):
     description = (
         "in-place mutation of a cache-sourced object without mutable()"
     )
-    dirs = ("controllers", "web", "scheduling", "sessions")
+    dirs = ("controllers", "web", "scheduling", "sessions", "warmup")
 
     def check(self, src: SourceFile) -> Iterator[Finding]:
         for node in ast.walk(src.tree):

@@ -119,6 +119,9 @@ def install_default_cluster_roles(api: APIServer) -> None:
         "tensorboard.kubeflow.org",
         # sessions/: users see their own suspend/resume checkpoints
         "sessions.kubeflow.org",
+        # warmup/: warm pools + compile-cache entries are visible so
+        # the spawner can explain a warm (or cold) handout
+        "warmup.kubeflow.org",
     ]
     kf_resources = [
         "notebooks",
@@ -126,6 +129,8 @@ def install_default_cluster_roles(api: APIServer) -> None:
         "tensorboards",
         "profiles",
         "sessioncheckpoints",
+        "warmpools",
+        "compilecacheentries",
     ]
     core_resources = [
         "persistentvolumeclaims",

@@ -53,6 +53,7 @@ from odh_kubeflow_tpu.scheduling.workload import (
 )
 from odh_kubeflow_tpu.utils import prometheus, tracing
 from odh_kubeflow_tpu.utils.tpu import TPU_TOPOLOGIES, chips_in_topology, hosts_in_slice
+from odh_kubeflow_tpu.warmup import PREFERRED_POOL_ANNOTATION
 
 Obj = dict[str, Any]
 
@@ -89,6 +90,11 @@ class NotebookControllerConfig:
     # whether the culler probes the in-image tpu-activity-agent for
     # duty cycle before declaring a TPU notebook idle
     cull_check_tpu_duty_cycle: bool = True
+    # compilation-cache service mount (warmup/ subsystem): when set,
+    # TPU notebook kernels get JAX_COMPILATION_CACHE_DIR pointed at
+    # this cache-service-backed path, so their first jit loads the
+    # fleet's shared artifacts instead of compiling
+    compile_cache_mount: str = ""
 
     @staticmethod
     def from_env() -> "NotebookControllerConfig":
@@ -113,6 +119,7 @@ class NotebookControllerConfig:
             suspend_grace_seconds=float(
                 env.get("SESSION_SUSPEND_GRACE_SECONDS", "600")
             ),
+            compile_cache_mount=env.get("COMPILE_CACHE_MOUNT", ""),
         )
 
 
@@ -435,7 +442,14 @@ class NotebookController:
                 "default priority 0",
             )
         desired = workload_from_statefulset(
-            sts, priority=priority, priority_class=pclass
+            sts,
+            priority=priority,
+            priority_class=pclass,
+            # warm-pool handout: the claimed notebook prefers the slice
+            # pool its standby just freed (warmup/ subsystem)
+            preferred_pool=obj_util.annotations_of(notebook).get(
+                PREFERRED_POOL_ANNOTATION, ""
+            ),
         )
         if desired is not None:
             # the Workload carries the notebook's spawn trace so the
@@ -683,6 +697,18 @@ class NotebookController:
         else:
             set_env({"name": "TPU_WORKER_ID", "value": "0"})
             set_env({"name": "TPU_WORKER_HOSTNAMES", "value": "localhost"})
+        if self.config.compile_cache_mount:
+            # kernels jit against the cache-service-backed mount: the
+            # fleet's shared compile artifacts load instead of
+            # recompiling (warmup/compilecache.py stages/ingests the
+            # directory; see docs/GUIDE.md "Compilation cache & warm
+            # pools")
+            set_env(
+                {
+                    "name": "JAX_COMPILATION_CACHE_DIR",
+                    "value": self.config.compile_cache_mount,
+                }
+            )
 
     def generate_service(
         self, notebook: Obj, tpu: Optional[TpuRequest] = None

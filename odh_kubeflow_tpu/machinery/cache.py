@@ -94,6 +94,8 @@ DEFAULT_CACHED_KINDS: tuple[str, ...] = (
     "Profile",
     "Tensorboard",
     "PodDefault",
+    "WarmPool",
+    "CompileCacheEntry",
 )
 
 _TOMBSTONE_LIMIT = 4096

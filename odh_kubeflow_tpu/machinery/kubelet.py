@@ -14,6 +14,8 @@ after mutations instead of racing a background thread.
 from __future__ import annotations
 
 import itertools
+import os
+import time
 from typing import Any, Optional
 
 from odh_kubeflow_tpu.apis import pod_tpu_chips
@@ -108,6 +110,16 @@ class FakeCluster:
         # fn(t) -> duty_cycle_pct; the usage meter samples these in sim
         # mode exactly as it would the in-pod activity agent
         self._waveforms: dict[tuple[str, str], Any] = {}
+        # simulated image pulls (warmup/ subsystem): a node that has
+        # never run an image keeps the pod Pending for
+        # SIM_IMAGE_PULL_SECONDS, then remembers it — warm-pool
+        # standbys pre-pull, so claimed sessions skip the wait. 0
+        # (default) preserves the instant-start behavior.
+        self.image_pull_seconds = float(
+            os.environ.get("SIM_IMAGE_PULL_SECONDS", "0") or 0
+        )
+        self._node_images: dict[str, set[str]] = {}
+        self._pull_started: dict[str, float] = {}
 
     # -- session-state helpers (tests drive these as "the kernel") ----------
 
@@ -594,6 +606,8 @@ class FakeCluster:
                     return
                 pod["spec"]["nodeName"] = target
                 pod = self.api.update(pod)
+        if not self._images_ready(pod):
+            return
         containers = obj_util.get_path(pod, "spec", "containers", default=[]) or []
         pod.setdefault("status", {})
         pod["status"].update(
@@ -631,6 +645,62 @@ class FakeCluster:
                 self.api.update_status(pod)
         else:
             self.api.update_status(pod)
+
+    # -- simulated image pulls (warmup/ subsystem) ---------------------------
+
+    def node_images(self, node: str) -> set[str]:
+        """Images this node has already pulled — its 'warmth'."""
+        return set(self._node_images.get(node, set()))
+
+    def _images_ready(self, pod: Obj) -> bool:
+        """Whether the pod's node holds every container image. A cold
+        node pays SIM_IMAGE_PULL_SECONDS of Pending (reason
+        ContainersNotReady / pulling), then remembers the images; a
+        warm node — one a standby already ran the image on — starts
+        instantly. With the knob at 0 the pull is instantaneous but
+        warmth is still tracked, so tests can observe which nodes a
+        warm pool pre-imaged."""
+        node = obj_util.get_path(pod, "spec", "nodeName")
+        if not node:
+            return True  # unscheduled pods never got here historically
+        images = {
+            c.get("image", "")
+            for c in obj_util.get_path(
+                pod, "spec", "containers", default=[]
+            )
+            or []
+            if c.get("image")
+        }
+        have = self._node_images.setdefault(str(node), set())
+        missing = images - have
+        uid = obj_util.meta(pod).get("uid", "")
+        if not missing:
+            self._pull_started.pop(uid, None)
+            return True
+        if self.image_pull_seconds <= 0:
+            have |= missing
+            return True
+        started = self._pull_started.setdefault(uid, time.time())
+        if time.time() - started < self.image_pull_seconds:
+            pod.setdefault("status", {})
+            pod["status"]["phase"] = "Pending"
+            pod["status"]["conditions"] = [
+                {"type": "PodScheduled", "status": "True"},
+                {
+                    "type": "Ready",
+                    "status": "False",
+                    "reason": "ContainersNotReady",
+                    "message": (
+                        "pulling image(s) "
+                        + ", ".join(sorted(missing))
+                    ),
+                },
+            ]
+            self.api.update_status(pod)
+            return False
+        have |= missing
+        self._pull_started.pop(uid, None)
+        return True
 
     # -- workload reconciliation --------------------------------------------
 

@@ -193,7 +193,16 @@ class DecodeEngine:
         spec_k: int = 4,
         spec_rounds_per_call: int = 4,
         metrics_registry: Optional[prometheus.Registry] = None,
+        compile_cache_dir: Optional[str] = None,
     ):
+        # persistent XLA compile cache (warmup/ subsystem): the serving
+        # path's prefill/decode programs are the biggest cold-start
+        # compiles after the train step. Explicit kwarg wins; falls back
+        # to JAX_COMPILATION_CACHE_DIR; no-op when neither is set.
+        from odh_kubeflow_tpu.warmup.compilecache import install_process_cache
+
+        install_process_cache(compile_cache_dir)
+
         self.params = params
         self.cfg = cfg
         self.lora = lora

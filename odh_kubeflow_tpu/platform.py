@@ -57,6 +57,12 @@ from odh_kubeflow_tpu.sessions import register_sessions
 from odh_kubeflow_tpu.sessions.manager import SessionConfig, SessionManager
 from odh_kubeflow_tpu.utils import prometheus
 from odh_kubeflow_tpu.utils.slo import SLOEngine
+from odh_kubeflow_tpu.warmup import register_warmup
+from odh_kubeflow_tpu.warmup.compilecache import (
+    CompileCacheConfig,
+    CompileCacheService,
+)
+from odh_kubeflow_tpu.warmup.pool import WarmPoolConfig, WarmPoolController
 from odh_kubeflow_tpu.web.dashboard import DashboardApp
 from odh_kubeflow_tpu.web.jwa import JupyterWebApp
 from odh_kubeflow_tpu.web.kfam_app import KfamApp
@@ -136,6 +142,7 @@ class Platform:
         register_scheduling(self.api)
         register_sessions(self.api)
         register_usage(self.api)
+        register_warmup(self.api)
         install_default_cluster_roles(self.api)
         PodDefaultWebhook(self.api).register()
         NotebookWebhook(self.api).register()
@@ -237,6 +244,32 @@ class Platform:
         )
         if self.scheduler is not None:
             self.scheduler.register(self.manager)
+        # warm-start subsystem (warmup/): the compilation-cache service
+        # is always constructed (its metrics anchor the warm-compile
+        # gate, and trainer/engine precompile routes through it); the
+        # warm-pool controller only runs when queueing is on — standbys
+        # are admitted through the slice queue, and without a scheduler
+        # they would pend forever (same gate as the scheduler itself).
+        self.compile_cache = CompileCacheService(
+            self.cached_api,
+            CompileCacheConfig.from_env(),
+            registry=self.metrics_registry,
+        )
+        self.warm_pool_config = WarmPoolConfig.from_env()
+        self.warm_pool_controller = None
+        if self.nb_config.enable_queueing and self.warm_pool_config.enabled:
+            self.warm_pool_controller = WarmPoolController(
+                self.cached_api,
+                self.warm_pool_config,
+                registry=self.metrics_registry,
+                session_store=(
+                    self.session_manager.store
+                    if self.session_manager is not None
+                    else None
+                ),
+                compile_cache=self.compile_cache,
+            )
+            self.warm_pool_controller.register(self.manager)
         self.profile_controller = ProfileController(self.cached_api)
         self.profile_controller.register(self.manager)
         self.tensorboard_controller = TensorboardController(self.cached_api)

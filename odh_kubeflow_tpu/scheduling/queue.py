@@ -159,16 +159,20 @@ class SliceInventory:
         chips_per_host: int,
         exclude_zones: Optional[set[str]] = None,
         zone_load: Optional[dict[str, int]] = None,
+        prefer_pool: Optional[str] = None,
     ) -> Optional[tuple[str, list[str]]]:
         """All-or-nothing topology-aware fit: ``hosts`` nodes in ONE
         matching pool, or None. Pool preference order:
 
         1. never a pool in ``exclude_zones`` (drained/dead domains);
-        2. the least-loaded zone by ``zone_load`` (chips already
+        2. ``prefer_pool`` when it fits — a warm-pool claim just freed
+           capacity there (pre-pulled image, warm node), so the
+           claimed gang should land on it even against zone spread;
+        3. the least-loaded zone by ``zone_load`` (chips already
            committed per zone) — the zone-spread preference that keeps
            one zone loss from taking every session;
-        3. on-demand before spot/preemptible capacity;
-        4. best-fit (fewest total free chips first) so big contiguous
+        4. on-demand before spot/preemptible capacity;
+        5. best-fit (fewest total free chips first) so big contiguous
            slices stay available for big gangs."""
         best: Optional[tuple[tuple, str, list[str]]] = None
         for pool in self.pools.values():
@@ -181,6 +185,7 @@ class SliceInventory:
                 continue
             slack = sum(pool.free.values())
             rank = (
+                0 if prefer_pool and pool.name == prefer_pool else 1,
                 (zone_load or {}).get(pool.zone, 0),
                 1 if pool.spot else 0,
                 slack,

@@ -396,6 +396,7 @@ class SliceScheduler:
                 "for the same pool",
             )
 
+        prefer_pool = spec.get("preferredPool") or None
         session_ok = quotas.fits_sessions(ns, obj_util.name_of(wl), chips)
         quota_ok = quotas.fits(ns, chips)
         fit = (
@@ -406,6 +407,7 @@ class SliceScheduler:
                 chips_per_host,
                 exclude_zones=exclude,
                 zone_load=zone_load,
+                prefer_pool=prefer_pool,
             )
             if quota_ok and session_ok
             else None
@@ -482,6 +484,7 @@ class SliceScheduler:
                     chips_per_host,
                     exclude_zones=exclude,
                     zone_load=zone_load,
+                    prefer_pool=prefer_pool,
                 )
 
         # oversubscription reclaim: still starved with no hard-kill

@@ -53,7 +53,11 @@ def resolve_priority(api: Any, notebook: Obj) -> tuple[int, str, bool]:
 
 
 def workload_from_statefulset(
-    sts: Obj, *, priority: int = 0, priority_class: str = ""
+    sts: Obj,
+    *,
+    priority: int = 0,
+    priority_class: str = "",
+    preferred_pool: str = "",
 ) -> Optional[Obj]:
     """Derive the gang Workload from a generated StatefulSet: host
     count from replicas, chips-per-host from the container's
@@ -72,6 +76,22 @@ def workload_from_statefulset(
     if not accel or chips_per_host <= 0 or hosts <= 0:
         return None
     name = obj_util.name_of(sts)
+    spec: Obj = {
+        "hosts": hosts,
+        "chipsPerHost": chips_per_host,
+        "chips": hosts * chips_per_host,
+        "acceleratorType": accel,
+        "topology": topology,
+        "priority": priority,
+        "priorityClassName": priority_class,
+        # the quota pool this workload draws from — one per profile
+        # namespace, matching kf-resource-quota's scope
+        "queue": obj_util.namespace_of(sts),
+    }
+    if preferred_pool:
+        # warm-pool claim placement hint: land on the slice pool the
+        # claimed standby just freed (see SliceInventory.fit)
+        spec["preferredPool"] = preferred_pool
     return {
         "apiVersion": WORKLOAD_API_VERSION,
         "kind": "Workload",
@@ -80,18 +100,7 @@ def workload_from_statefulset(
             "namespace": obj_util.namespace_of(sts),
             "labels": {WORKLOAD_LABEL: name},
         },
-        "spec": {
-            "hosts": hosts,
-            "chipsPerHost": chips_per_host,
-            "chips": hosts * chips_per_host,
-            "acceleratorType": accel,
-            "topology": topology,
-            "priority": priority,
-            "priorityClassName": priority_class,
-            # the quota pool this workload draws from — one per profile
-            # namespace, matching kf-resource-quota's scope
-            "queue": obj_util.namespace_of(sts),
-        },
+        "spec": spec,
     }
 
 

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from odh_kubeflow_tpu.models import llama, lora as lora_lib
 from odh_kubeflow_tpu.parallel.mesh import batch_spec, build_mesh, constrain
 from odh_kubeflow_tpu.utils import prometheus
+from odh_kubeflow_tpu.warmup.compilecache import install_process_cache
 
 Params = dict[str, Any]
 
@@ -188,6 +189,11 @@ class Trainer:
         metrics_registry: Optional[prometheus.Registry] = None,
     ):
         from odh_kubeflow_tpu.models import moe as moe_lib
+
+        # point jax's persistent compilation cache at the platform's
+        # mounted artifact dir before any trace/compile below — no-op
+        # unless JAX_COMPILATION_CACHE_DIR is set (warmup/ subsystem)
+        install_process_cache()
 
         self.model_cfg = model_cfg
         self.is_moe = isinstance(model_cfg, moe_lib.MoeConfig)

@@ -40,6 +40,13 @@ from odh_kubeflow_tpu.machinery.cache import list_by_index
 from odh_kubeflow_tpu.machinery.store import APIServer, NotFound
 from odh_kubeflow_tpu.scheduling import OVERSUBSCRIPTION_FACTOR_ANNOTATION
 from odh_kubeflow_tpu.utils.tpu import TPU_TOPOLOGIES
+from odh_kubeflow_tpu.warmup import (
+    CLAIMED_AT_ANNOTATION,
+    PREFERRED_POOL_ANNOTATION,
+    STANDBY_SOURCE_ANNOTATION,
+    WARM_FROM_ANNOTATION,
+    warm_source,
+)
 from odh_kubeflow_tpu.web.crud_backend import (
     CrudBackend,
     failure,
@@ -181,6 +188,13 @@ class JupyterWebApp(CrudBackend):
         self.sessions_enabled = (
             os.environ.get("ENABLE_SESSION_SUSPEND", "true").lower()
             == "true"
+        )
+        # warm-pool handout (warmup/ subsystem): spawn tries to claim a
+        # pre-admitted standby before the cold path. Harmless without
+        # pools (the claim simply finds none); the flag exists for
+        # operators who want cold spawns even with pools present.
+        self.warm_enabled = (
+            os.environ.get("WARM_POOL_ENABLED", "true").lower() == "true"
         )
         self._register_routes()
 
@@ -361,6 +375,7 @@ class JupyterWebApp(CrudBackend):
                     "annotations": obj_util.annotations_of(nb),
                     "workload": self._workload_row(nb),
                     "checkpoint": self._checkpoint_row(nb),
+                    "warm": self._warm_row(nb),
                     "usage": (
                         self.meter.notebook_usage(namespace, name)
                         if self.meter is not None
@@ -665,6 +680,29 @@ class JupyterWebApp(CrudBackend):
             )
         return row
 
+    def _warm_row(self, nb: Obj) -> Optional[Obj]:
+        """Warm-handout provenance: which pool served this notebook and
+        whether the pre-warmed session state has been restored into it
+        yet (checkpoint phase reaches Restored once the session manager
+        replays the template state)."""
+        src = warm_source(nb)
+        if src is None:
+            return None
+        restored = False
+        try:
+            ck = self.api.get(
+                "SessionCheckpoint",
+                obj_util.name_of(nb),
+                obj_util.namespace_of(nb),
+            )
+            restored = (
+                obj_util.get_path(ck, "status", "phase", default="")
+                == "Restored"
+            )
+        except NotFound:
+            pass
+        return {**src, "restored": restored}
+
     # -- form → Notebook (form.py:17-252) ------------------------------------
 
     def _resolve(self, body: Obj, field: str):
@@ -778,6 +816,37 @@ class JupyterWebApp(CrudBackend):
                 except Exception as e:  # AlreadyExists → reuse
                     if "exists" not in str(e):
                         raise
+
+        # warm-pool handout (warmup/): claim a ready standby matching
+        # (accelerator, topology, image). The claim is an atomic
+        # conditional update — concurrent spawns racing for the last
+        # standby get exactly one winner; a miss falls through to the
+        # ordinary cold spawn. The standby is deleted so its freed
+        # slice (pre-pulled image, warm node) is exactly where the new
+        # gang lands via the preferred-pool hint.
+        if self.warm_enabled and accelerator and accelerator != "none":
+            from odh_kubeflow_tpu.warmup.pool import claim_standby
+
+            warm = claim_standby(
+                self.api,
+                namespace,
+                accelerator=accelerator,
+                topology=tpu.get("topology", ""),
+                image=image,
+                claimant=f"{user or 'spawner'}/{name}",
+            )
+            if warm is not None:
+                annotations[WARM_FROM_ANNOTATION] = warm["pool"]
+                annotations[STANDBY_SOURCE_ANNOTATION] = warm["standby"]
+                annotations[CLAIMED_AT_ANNOTATION] = warm["claimedAt"]
+                if warm.get("slicePool"):
+                    annotations[PREFERRED_POOL_ANNOTATION] = warm[
+                        "slicePool"
+                    ]
+                try:
+                    self.api.delete("Notebook", warm["standby"], namespace)
+                except NotFound:
+                    pass  # pool controller reaped it first
 
         created = self.api.create(notebook)
         return success({"notebook": obj_util.name_of(created)}, status=201)

@@ -37,12 +37,14 @@ def test_crds_match_code_registrations():
     from odh_kubeflow_tpu.machinery.usage import register_usage
     from odh_kubeflow_tpu.scheduling import register_scheduling
     from odh_kubeflow_tpu.sessions import register_sessions
+    from odh_kubeflow_tpu.warmup import register_warmup
 
     api = APIServer()
     register_crds(api)
     register_scheduling(api)
     register_sessions(api)
     register_usage(api)
+    register_warmup(api)
 
     crds = {
         d["metadata"]["name"]: d
@@ -57,6 +59,8 @@ def test_crds_match_code_registrations():
         "Workload",
         "SessionCheckpoint",
         "UsageRecord",
+        "CompileCacheEntry",
+        "WarmPool",
     }
     for kind in expected:
         info = api.type_info(kind)
