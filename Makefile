@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint sanitize chaos durability explore fleetbench replicabench zonedrill usagebench warmbench obs loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
+.PHONY: all test test-unit test-manifests lint sanitize chaos durability explore fleetbench replicabench partitionbench zonedrill usagebench warmbench obs loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
 
 all: lint test
 
@@ -107,6 +107,23 @@ replicabench:
 	$(PYTHON) loadtest/control_plane_bench.py --replica --notebooks 2000 \
 	  --replica-streams 100 --out /tmp/replicabench.json
 	$(PYTHON) -m pytest -q tests/test_replica.py
+
+# partitioned write path (ISSUE 18, docs/GUIDE.md "Partitioned write
+# path"): the N=1M x 4-partition axis scaled down to N=2000 — real
+# leader PROCESSES behind client-side HRW routing, SAME correctness
+# gates (per-leader counts sum to N, merged limit/continue walk with
+# composite tokens has zero order/duplicate violations, cluster-
+# spanning merged watch delivers a post-ingest burst exactly once).
+# The >=5x aggregate-ingest speedup gate only binds on hosts with
+# >= 4 CPUs (leader compute cannot overlap on fewer cores); the
+# measured ratio is always recorded. Writes to a scratch copy (full
+# run: `python loadtest/control_plane_bench.py --partition
+# --notebooks 1000000`).
+partitionbench:
+	cp BENCH_control_plane.json /tmp/partitionbench.json
+	$(PYTHON) loadtest/control_plane_bench.py --partition --notebooks 2000 \
+	  --partitions 4 --out /tmp/partitionbench.json
+	$(PYTHON) -m pytest -q tests/test_partition.py
 
 # zone failure-domain drills (docs/GUIDE.md "Zones & failure
 # domains"): replicated-checkpoint write-all/heal, zone-spread

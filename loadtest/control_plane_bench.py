@@ -1351,6 +1351,368 @@ def bench_replica(
 
 
 # ---------------------------------------------------------------------------
+# partitioned-write-path axis (ISSUE 18; `make partitionbench` runs it
+# plus tests/test_partition.py)
+
+
+def _partition_leader_child(idx, wal_dir, fsync_ms, cmd_q, res_q) -> None:
+    """One partition leader as its own PROCESS (the deployment shape —
+    `PARTITION_LEADERS` points clients at N separate leader processes,
+    and co-located leaders would serialize their WAL work on one GIL).
+    Durable store: group-commit WAL on the same deterministic disk
+    model as the fleet/replica axes, HTTP served."""
+    import gc
+
+    from odh_kubeflow_tpu.machinery.wal import FileIO, WriteAheadLog
+
+    class BenchDiskIO(FileIO):
+        def fsync(self, f) -> None:
+            time.sleep(fsync_ms / 1000.0)
+            super().fsync(f)
+
+    gc.disable()  # big-heap ingest posture, same as the fleet axis
+    api = APIServer(
+        wal=WriteAheadLog(wal_dir, io=BenchDiskIO()), snapshot_interval=0
+    )
+    api.register_kind("kubeflow.org/v1beta1", "Notebook", "notebooks")
+    _, port, srv = httpapi.serve(api, port=0)
+    res_q.put(("ready", idx, port))
+    while True:
+        cmd = cmd_q.get()
+        if cmd == "count":
+            res_q.put(("count", idx, len(api._store.get("Notebook", {}))))
+        elif cmd == "stop":
+            break
+    srv.shutdown()
+    api.close()
+
+
+def _partition_writer_child(
+    widx, urls, total, writer_procs, threads, n_namespaces, go_evt, res_q
+) -> None:
+    """One closed-loop writer PROCESS driving a client-side
+    PartitionRouter over all leader URLs (the runner's
+    ``PARTITION_LEADERS`` shape: every create goes straight to its
+    namespace's owning leader, no 307 hop)."""
+    import threading as _threading
+
+    from odh_kubeflow_tpu.machinery.client import RemoteAPIServer
+    from odh_kubeflow_tpu.machinery.partition import PartitionRouter
+
+    backends = {}
+    for i, u in enumerate(urls):
+        c = RemoteAPIServer(u, retries=8, retry_cap=1.0)
+        c.register_kind("kubeflow.org/v1beta1", "Notebook", "notebooks")
+        backends[i] = c
+    router = PartitionRouter(backends, urls=dict(enumerate(urls)))
+
+    def nb(i: int) -> dict:
+        return {
+            "kind": "Notebook",
+            "metadata": {
+                "name": f"nb-{i:07d}",
+                "namespace": f"team-{i % n_namespaces:02d}",
+                "labels": {"tier": "fleet"},
+            },
+            "spec": {
+                "template": {"spec": {"containers": [{"name": "nb"}]}}
+            },
+        }
+
+    slots = writer_procs * threads
+    done = []
+
+    def worker(t: int):
+        slot = widx * threads + t
+        n = 0
+        for i in range(slot, total, slots):
+            router.create(nb(i))
+            n += 1
+        done.append(n)
+
+    ts = [
+        _threading.Thread(target=worker, args=(t,), daemon=True)
+        for t in range(threads)
+    ]
+    go_evt.wait()
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    res_q.put(("done", widx, sum(done), time.perf_counter() - t0))
+
+
+def bench_partition(
+    n_notebooks: int,
+    partitions: int = 4,
+    writers_per_leader: int = 12,
+    fsync_ms: float = 3.0,
+    page_limit: int = 500,
+    list_pages: int = 40,
+    watch_burst: int = 200,
+    speedup_gate: float = 5.0,
+) -> dict:
+    """The partitioned-write-path axis (ISSUE 18):
+
+    - **aggregate ingest**: N creates through ``partitions`` leader
+      PROCESSES behind client-side routing, against the SAME N through
+      one leader — the single-leader ceiling this axis exists to
+      break. Each leader runs the group-commit WAL on the
+      deterministic disk model; their fsync windows overlap across
+      processes, and at fleet N the single leader also pays the
+      big-store tax (O(store) index inserts, watch-cache churn) that
+      each N/P-sized partition does not. Gate: ≥ ``speedup_gate``x —
+      enforced only when the host exposes at least ``partitions``
+      CPUs. Leader processes overlap fsync windows on any host, but
+      compute only overlaps across real cores; on a smaller host the
+      wall-clock ratio measures the core count, not the write path,
+      so the speedup is recorded (with the host CPU count) and the
+      gate is marked unenforced rather than failed.
+    - **merged list correctness**: a sampled limit/continue walk with
+      composite tokens — every page within its limit, globally
+      ordered, no duplicates, and the per-leader counts sum to N.
+    - **merged watch**: a cluster-spanning watch assembled from one
+      leg per leader; a post-ingest burst must arrive exactly once,
+      with write→delivery latency reported.
+    """
+    import multiprocessing as mp
+    import shutil
+    import tempfile
+
+    from odh_kubeflow_tpu.machinery.client import RemoteAPIServer
+    from odh_kubeflow_tpu.machinery.partition import PartitionRouter
+
+    def run_topology(n_leaders: int, count: int) -> dict:
+        tmp = tempfile.mkdtemp(prefix=f"partbench-{n_leaders}-")
+        leaders, queues = [], []
+        try:
+            for i in range(n_leaders):
+                cmd_q, res_q = mp.Queue(), mp.Queue()
+                p = mp.Process(
+                    target=_partition_leader_child,
+                    args=(
+                        i, os.path.join(tmp, f"p{i}"), fsync_ms,
+                        cmd_q, res_q,
+                    ),
+                    daemon=True,
+                )
+                p.start()
+                leaders.append(p)
+                queues.append((cmd_q, res_q))
+            urls = {}
+            for i, (_c, r) in enumerate(queues):
+                tag, idx, port = r.get(timeout=30)
+                assert tag == "ready"
+                urls[idx] = f"http://127.0.0.1:{port}"
+            url_list = [urls[i] for i in range(n_leaders)]
+
+            writer_procs = n_leaders
+            go_evt, wres_q = mp.Event(), mp.Queue()
+            writers = [
+                mp.Process(
+                    target=_partition_writer_child,
+                    args=(
+                        w, url_list, count, writer_procs,
+                        writers_per_leader, 32, go_evt, wres_q,
+                    ),
+                    daemon=True,
+                )
+                for w in range(writer_procs)
+            ]
+            for w in writers:
+                w.start()
+            time.sleep(0.5 * writer_procs)  # client build-out, pre-go
+            t0 = time.perf_counter()
+            go_evt.set()
+            written = 0
+            for _ in writers:
+                tag, _widx, n, _el = wres_q.get(timeout=3600)
+                assert tag == "done"
+                written += n
+            elapsed = time.perf_counter() - t0
+            for w in writers:
+                w.join()
+
+            counts = {}
+            for cmd_q, _r in queues:
+                cmd_q.put("count")
+            for _c, res_q in queues:
+                tag, idx, n = res_q.get(timeout=60)
+                assert tag == "count"
+                counts[idx] = n
+
+            # merged read correctness through a parent-side router
+            backends = {}
+            for i, u in urls.items():
+                c = RemoteAPIServer(u)
+                c.register_kind(
+                    "kubeflow.org/v1beta1", "Notebook", "notebooks"
+                )
+                backends[i] = c
+            router = PartitionRouter(backends, urls=dict(urls))
+            pages, rows, last, dup = 0, 0, None, 0
+            seen_keys: set = set()
+            page_ms: list[float] = []
+            token = ""
+            while pages < list_pages:
+                t1 = time.perf_counter()
+                items, token = router.list_chunk(
+                    "Notebook", limit=page_limit, continue_token=token
+                )
+                page_ms.append((time.perf_counter() - t1) * 1000)
+                assert len(items) <= page_limit
+                for o in items:
+                    key = (
+                        o["metadata"]["namespace"], o["metadata"]["name"]
+                    )
+                    if last is not None and key <= last:
+                        dup += 1
+                    last = key
+                    if key in seen_keys:
+                        dup += 1
+                    seen_keys.add(key)
+                rows += len(items)
+                pages += 1
+                if not token:
+                    break
+
+            # merged watch: post-ingest burst, exactly-once delivery
+            w = router.watch("Notebook", send_initial=False, inline=False)
+            sent = {}
+            for i in range(watch_burst):
+                name = f"burst-{i:05d}"
+                router.create(
+                    {
+                        "kind": "Notebook",
+                        "metadata": {
+                            "name": name,
+                            "namespace": f"team-{i % 32:02d}",
+                        },
+                        "spec": {},
+                    }
+                )
+                sent[name] = time.perf_counter()
+            lat, got = [], {}
+            deadline = time.monotonic() + 30
+            while len(got) < watch_burst and time.monotonic() < deadline:
+                item = w.get(timeout=0.5)
+                if item is None:
+                    continue
+                etype, obj = item
+                if etype == "CONTROL":
+                    continue
+                name = obj.get("metadata", {}).get("name", "")
+                if name in sent:
+                    t_recv = time.perf_counter()
+                    if name in got:
+                        got[name] += 1
+                    else:
+                        got[name] = 1
+                        lat.append((t_recv - sent[name]) * 1000)
+            w.stop()
+            dup_events = sum(n - 1 for n in got.values())
+
+            for cmd_q, _r in queues:
+                cmd_q.put("stop")
+            for p in leaders:
+                p.join(timeout=30)
+
+            def pct(samples, p):
+                s = sorted(samples)
+                return s[min(int(p * len(s)), len(s) - 1)] if s else 0.0
+
+            return {
+                "leaders": n_leaders,
+                "per_s": round(count / elapsed, 1),
+                "elapsed_s": round(elapsed, 2),
+                "written": written,
+                "counts": counts,
+                "count_total": sum(counts.values()),
+                "merged_list": {
+                    "pages": pages,
+                    "rows": rows,
+                    "order_or_dup_violations": dup,
+                    "page_p50_ms": round(pct(page_ms, 0.50), 3),
+                    "page_p99_ms": round(pct(page_ms, 0.99), 3),
+                },
+                "merged_watch": {
+                    "burst": watch_burst,
+                    "delivered": len(got),
+                    "duplicates": dup_events,
+                    "p50_ms": round(pct(lat, 0.50), 3),
+                    "p99_ms": round(pct(lat, 0.99), 3),
+                },
+            }
+        finally:
+            for p in leaders:
+                if p.is_alive():
+                    p.terminate()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    single = run_topology(1, n_notebooks)
+    sharded = run_topology(partitions, n_notebooks)
+    speedup = round(sharded["per_s"] / max(single["per_s"], 0.001), 2)
+
+    try:
+        host_cpus = len(os.sched_getaffinity(0))
+    except AttributeError:
+        host_cpus = os.cpu_count() or 1
+    gate_enforced = speedup_gate > 0 and host_cpus >= partitions
+
+    out: dict = {
+        "n_notebooks": n_notebooks,
+        "partitions": partitions,
+        "writers_per_leader": writers_per_leader,
+        "disk_model_fsync_ms": fsync_ms,
+        "page_limit": page_limit,
+        "host_cpus": host_cpus,
+        "single_leader": single,
+        "partitioned": sharded,
+        "ingest_speedup": speedup,
+        "speedup_gate": speedup_gate,
+        "speedup_gate_enforced": gate_enforced,
+    }
+    if speedup_gate > 0 and not gate_enforced:
+        out["speedup_gate_note"] = (
+            f"{host_cpus} CPU(s) visible < {partitions} partitions: "
+            "leader processes cannot overlap compute, so the "
+            "wall-clock ratio measures the core count, not the "
+            "write path — speedup recorded, gate not enforced"
+        )
+
+    failures: list = []
+    if gate_enforced and speedup < speedup_gate:
+        failures.append(
+            f"aggregate ingest {sharded['per_s']}/s is only {speedup}x "
+            f"the single-leader {single['per_s']}/s (gate >= "
+            f"{speedup_gate}x)"
+        )
+    for phase in (single, sharded):
+        expect = n_notebooks  # leader counts are read before the burst
+        if phase["count_total"] != expect:
+            failures.append(
+                f"{phase['leaders']}-leader topology holds "
+                f"{phase['count_total']} notebooks, expected {expect}"
+            )
+        if phase["merged_list"]["order_or_dup_violations"]:
+            failures.append(
+                f"{phase['leaders']}-leader merged walk had "
+                f"{phase['merged_list']['order_or_dup_violations']} "
+                "order/duplicate violations"
+            )
+        mw = phase["merged_watch"]
+        if mw["delivered"] != watch_burst or mw["duplicates"]:
+            failures.append(
+                f"{phase['leaders']}-leader merged watch delivered "
+                f"{mw['delivered']}/{watch_burst} burst events with "
+                f"{mw['duplicates']} duplicates"
+            )
+    out["gates"] = {"passed": not failures, "failures": failures}
+    return out
+
+
+# ---------------------------------------------------------------------------
 # usage-metering axis: what the chip-hour ledger costs the control
 # plane (ISSUE 16; `make usagebench` runs it after the exactness drill)
 
@@ -1713,6 +2075,37 @@ def main() -> None:
         help="follower replicas pulling the leader's stream",
     )
     parser.add_argument(
+        "--partition",
+        action="store_true",
+        help="run ONLY the partitioned-write-path axis (--notebooks "
+        "sets N; --partitions leader processes behind client-side "
+        "routing vs the single-leader ceiling: aggregate ingest, "
+        "merged list/watch correctness) and merge it into --out under "
+        "the `partition` key; exits nonzero when a gate fails",
+    )
+    parser.add_argument(
+        "--partitions",
+        type=int,
+        default=4,
+        help="leader processes for the partitioned topology",
+    )
+    parser.add_argument(
+        "--partition-writers",
+        type=int,
+        default=12,
+        help="closed-loop writer threads per leader",
+    )
+    parser.add_argument(
+        "--partition-gate",
+        type=float,
+        default=5.0,
+        help="required aggregate-ingest speedup over the single "
+        "leader (the fleet-N gate is 5x; 0 disables). Only enforced "
+        "when the host exposes >= --partitions CPUs — leader "
+        "processes cannot overlap compute on fewer cores, so the "
+        "ratio is recorded but not gated",
+    )
+    parser.add_argument(
         "--usage",
         action="store_true",
         help="run ONLY the usage-metering overhead axis (--notebooks "
@@ -1823,6 +2216,58 @@ def main() -> None:
             print(
                 "REPLICA GATE FAILURES: "
                 + "; ".join(replica["gates"]["failures"]),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        return
+
+    if args.partition:
+        partition = bench_partition(
+            args.notebooks,
+            partitions=args.partitions,
+            writers_per_leader=args.partition_writers,
+            page_limit=args.fleet_page_limit,
+            speedup_gate=args.partition_gate,
+        )
+        merged = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                merged = json.load(f)
+        merged["partition"] = partition
+        with open(args.out, "w") as f:
+            json.dump(merged, f, indent=2)
+        print(json.dumps({"partition": partition}, indent=2))
+        single, sharded = (
+            partition["single_leader"], partition["partitioned"]
+        )
+        gate_label = (
+            f"gate >= {partition['speedup_gate']}x"
+            if partition["speedup_gate_enforced"]
+            else (
+                f"gate >= {partition['speedup_gate']}x NOT ENFORCED: "
+                f"{partition['host_cpus']} CPU(s) < "
+                f"{partition['partitions']} partitions"
+            )
+        )
+        print(
+            f"\npartition @ N={partition['n_notebooks']} x "
+            f"{partition['partitions']} partitions: aggregate ingest "
+            f"{single['per_s']} -> {sharded['per_s']}/s "
+            f"({partition['ingest_speedup']}x, "
+            f"{gate_label}) | merged list p99 "
+            f"{sharded['merged_list']['page_p99_ms']}ms/page over "
+            f"{sharded['merged_list']['pages']} pages, "
+            f"{sharded['merged_list']['order_or_dup_violations']} "
+            "order/dup violations | merged watch "
+            f"{sharded['merged_watch']['delivered']}/"
+            f"{sharded['merged_watch']['burst']} burst delivered, "
+            f"{sharded['merged_watch']['duplicates']} dups, p99 "
+            f"{sharded['merged_watch']['p99_ms']}ms"
+        )
+        if not partition["gates"]["passed"]:
+            print(
+                "PARTITION GATE FAILURES: "
+                + "; ".join(partition["gates"]["failures"]),
                 file=sys.stderr,
             )
             sys.exit(1)

@@ -97,6 +97,10 @@ class UncachedListRule(Rule):
     )
     dirs = ("controllers", "web", "scheduling", "webhooks", "sessions",
             "warmup")
+    # the partition router's merge and move paths issue list() calls
+    # themselves; an unselective cluster-wide scan of an indexable
+    # kind there multiplies by the partition count
+    files = ("machinery/partition.py",)
 
     _SELECTIVE_KWARGS = ("namespace", "label_selector", "field_matches")
 
@@ -159,11 +163,16 @@ class UnboundedListRule(Rule):
         "(fleet-sized payload)"
     )
     dirs = ("web",)
-    # beyond web/: the informer prime path, and the read-replica
-    # serving tier — a fleet-sized unpaginated list there defeats the
-    # whole point of scaling the read path out. (Base Rule.applies
-    # unions files + dirs.)
-    files = ("machinery/cache.py", "machinery/replica.py")
+    # beyond web/: the informer prime path, the read-replica serving
+    # tier, and the partition router's scatter-gather merge — a
+    # fleet-sized unpaginated list there defeats the whole point of
+    # scaling the read path out. (Base Rule.applies unions files +
+    # dirs.)
+    files = (
+        "machinery/cache.py",
+        "machinery/replica.py",
+        "machinery/partition.py",
+    )
 
     _LISTERS = frozenset({"api", "client", "server", "store", "backend"})
 
@@ -307,6 +316,10 @@ class BlockingUnderLockRule(Rule):
         # NEVER under the replica store's lock (rv-pinned reads park on
         # a Condition there, which is the one exempt form)
         "machinery/replica.py",
+        # the partition router's merged-watch pump lock serializes leg
+        # drains on the WRITE path — a blocking get under it would
+        # stall every mutator of every partition at once
+        "machinery/partition.py",
     )
 
     # one lock vocabulary for the per-file and whole-program analyses

@@ -645,12 +645,23 @@ class InformerCache:
             # this must cost nanoseconds, not a queue.Empty exception
             return self._heal_on_read(w, kind)
         pending: list[tuple[str, Obj]] = []
+        resync_needed = False
         for _ in range(budget):
             item = w.try_get()
             if item is None:
                 break
+            if item[0] == "CONTROL":
+                # merged-stream control frames (machinery.partition):
+                # a partition leg that 410'd past its compaction floor,
+                # or a namespace that moved partitions mid-stream —
+                # either way the fix is a relist of the kind. Plain
+                # heartbeat frames are dropped.
+                frame = item[1]
+                if frame.get("expired") or frame.get("moved"):
+                    resync_needed = True
+                continue
             pending.append(item)
-        if not pending:
+        if not pending and not resync_needed:
             # the nonzero qsize was the dead stream's None sentinel
             return self._heal_on_read(w, kind)
         if len(pending) > 1:
@@ -671,6 +682,16 @@ class InformerCache:
             if frozen is not None:
                 for fn in handlers:
                     fn(etype, frozen)
+        if resync_needed:
+            # AFTER the drained events: they predate the relist, and a
+            # moved namespace's objects carry rvs from a different
+            # partition's rv space — per-object rv guards cannot order
+            # them, only a rebuild can
+            log.warning(
+                "informer %s: partition control frame (move/410) on the "
+                "merged stream; resyncing the kind", kind,
+            )
+            self.resync(kind)
         return True
 
     def drain_once(self) -> bool:

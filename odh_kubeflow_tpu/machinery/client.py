@@ -119,9 +119,16 @@ class RemoteAPIServer:
         retry_cap: float = 2.0,
         page_size: Optional[int] = None,
         registry: Optional[prometheus.Registry] = None,
+        follow_not_leader: int = 1,
     ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # 307 NotLeader hops to follow transparently before surfacing
+        # the error. One hop covers the partitioned write path: the
+        # first answer's Location names the namespace's owning
+        # partition leader (machinery.partition). 0 = legacy surface-
+        # every-redirect behaviour.
+        self.follow_not_leader = max(int(follow_not_leader), 0)
         # kube client-go pager posture: with a page size, list() walks
         # the collection in limit-sized chunks via continue tokens —
         # no fleet-sized payload ever crosses the wire in one response.
@@ -345,10 +352,44 @@ class RemoteAPIServer:
         )
 
     def _do_request(
-        self, method: str, path: str, body: Optional[Obj] = None, query: str = ""
+        self,
+        method: str,
+        path: str,
+        body: Optional[Obj] = None,
+        query: str = "",
+    ) -> Obj:
+        for hop in range(self.follow_not_leader + 1):
+            try:
+                return self._do_request_once(method, path, body, query)
+            except NotLeader as e:
+                # kube-style 307: Location names the leader that owns
+                # this write (on a partitioned fleet, the namespace's
+                # partition leader). Follow it transparently, bounded:
+                # rebind `path` to the absolute Location URL —
+                # _do_request_once treats an absolute path as the full
+                # target.
+                if hop >= self.follow_not_leader or not e.leader_url:
+                    raise
+                self._m_retries.inc({"verb": method, "reason": "307"})
+                path = e.leader_url
+        raise AssertionError("unreachable")  # loop always returns/raises
+
+    def _do_request_once(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Obj] = None,
+        query: str = "",
     ) -> Obj:
         self._throttle()
-        url = self.base_url + path + (f"?{query}" if query else "")
+        # a 307 Location being followed arrives as an absolute URL in
+        # `path` (leader base + original PATH_INFO); query re-appended
+        # since Location does not carry it
+        url = (
+            path
+            if path.startswith(("http://", "https://"))
+            else self.base_url + path
+        ) + (f"?{query}" if query else "")
         # outbound request body (write path, not a serving response)
         data = (
             json.dumps(body).encode()  # dumps-ok: outbound request body

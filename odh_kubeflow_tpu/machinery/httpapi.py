@@ -573,11 +573,30 @@ class RestAPI:
           lines, interleaved with CONTROL frames carrying the leader's
           current rv/epoch/wall-clock. A ``from`` below the compacted
           window answers 410 (catch up from a snapshot instead).
+
+        When the serving store is a PartitionRouter, ``?partition=<i>``
+        scopes both endpoints to that partition's own backend — rv
+        spaces are per-partition, so a follower replicates exactly one
+        partition's history (the GUIDE's partitioned-replica shape).
         """
         if method != "GET":
             raise Invalid(f"unsupported {method} on {path}")
-        cut_fn = getattr(self.server, "replication_cut", None)
-        feed_fn = getattr(self.server, "replication_watch", None)
+        server = self.server
+        if "partition" in qs:
+            backend_fn = getattr(server, "partition_backend", None)
+            if backend_fn is None:
+                raise Invalid(
+                    "?partition= on an unpartitioned store; remove the "
+                    "parameter or point at the PartitionRouter"
+                )
+            try:
+                server = backend_fn(int(qs["partition"][0]))
+            except ValueError:
+                raise Invalid(
+                    "replication 'partition' must be numeric"
+                ) from None
+        cut_fn = getattr(server, "replication_cut", None)
+        feed_fn = getattr(server, "replication_watch", None)
         if path == "/replication/snapshot" and cut_fn is not None:
             # pointer collection under the store lock; the (possibly
             # fleet-sized) serialization runs here, off-lock
@@ -599,7 +618,7 @@ class RestAPI:
                 w,
                 self._replication_frame,
                 heartbeat=REPLICATION_HEARTBEAT_SECONDS,
-                heartbeat_fn=self._replication_control_line,
+                heartbeat_fn=lambda: self._replication_control_line(server),
             )
         return self._error(404, f"unrecognised path {path}", start_response)
 
@@ -620,13 +639,20 @@ class RestAPI:
             + b"\n"
         )
 
-    def _replication_control_line(self) -> bytes:
+    def _replication_control_line(self, server=None) -> bytes:
+        server = self.server if server is None else server
+        control_fn = getattr(server, "replication_control", None)
+        if control_fn is not None:
+            # a PartitionRouter's heartbeat: the per-partition
+            # (rv, epoch) vector — one scalar cannot describe N
+            # independent rv spaces
+            return serialize.dumps(control_fn()) + b"\n"
         return (
             serialize.dumps(
                 {
                     "type": "CONTROL",
-                    "rv": self.server.applied_rv(),
-                    "epoch": getattr(self.server, "replication_epoch", 0),
+                    "rv": server.applied_rv(),
+                    "epoch": getattr(server, "replication_epoch", 0),
                     "ts": time.time(),
                 }
             )

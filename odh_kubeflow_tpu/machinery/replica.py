@@ -408,11 +408,16 @@ class ReplicationClient:
         reconnect_cap: float = 2.0,
         timeout: float = 30.0,
         chaos_drop: Optional[Callable[[], bool]] = None,
+        partition: Optional[int] = None,
     ):
         self.replica = replica
         self.leader_url = (leader_url or replica.leader_url).rstrip("/")
         if not self.leader_url:
             raise ValueError("ReplicationClient needs a leader URL")
+        # replicate ONE partition of a PartitionRouter-fronted leader:
+        # ?partition=<i> scopes snapshot + stream to that partition's
+        # backend (rv spaces are per-partition)
+        self.partition = partition
         self.reconnect_base = reconnect_base
         self.reconnect_cap = reconnect_cap
         self.timeout = timeout
@@ -479,10 +484,16 @@ class ReplicationClient:
             time.sleep(0.01)
         return False
 
+    def _snapshot_url(self) -> str:
+        url = self.leader_url + "/replication/snapshot"
+        if self.partition is not None:
+            url += f"?partition={self.partition}"
+        return url
+
     def _leader_rv(self) -> Optional[int]:
         try:
             with urllib.request.urlopen(
-                self.leader_url + "/replication/snapshot",
+                self._snapshot_url(),
                 timeout=self.timeout,
             ) as r:
                 return int(json.loads(r.read().decode()).get("rv", 0))
@@ -535,7 +546,7 @@ class ReplicationClient:
     def _load_snapshot(self) -> None:
         _sanitizer.note_blocking("replication snapshot fetch")
         with urllib.request.urlopen(
-            self.leader_url + "/replication/snapshot", timeout=self.timeout
+            self._snapshot_url(), timeout=self.timeout
         ) as r:
             state = json.loads(r.read().decode())
         self.replica.load_snapshot(state)
@@ -549,6 +560,8 @@ class ReplicationClient:
     def _stream_once(self) -> None:
         from_rv = self.replica.applied_rv()
         url = f"{self.leader_url}/replication/stream?from={from_rv}"
+        if self.partition is not None:
+            url += f"&partition={self.partition}"
         _sanitizer.note_blocking("replication stream read")
         resp = None
         try:
@@ -760,6 +773,17 @@ def serve_replica() -> None:
     from odh_kubeflow_tpu.machinery import httpapi
 
     leader_url = os.environ["REPLICA_OF"]
+    if "," in leader_url or int(os.environ.get("STORE_PARTITIONS", "1")) > 1:
+        # partition-aware follower: REPLICA_OF=<url0>,<url1>,... (one
+        # URL per partition leader), or one router URL with
+        # STORE_PARTITIONS=N (?partition=<i>-scoped pulls), runs one
+        # follower per partition behind a PartitionRouter — merged
+        # fleet-wide reads, every mutation 307'd to the owning
+        # partition's leader. Promotion stays per-partition (run a
+        # classic single-URL watchdog follower next to each leader);
+        # this fleet-read shape deliberately does not self-promote.
+        _serve_partitioned_replica()
+        return
     registry = prometheus.Registry()
     replica = ReplicaStore(leader_url, registry=registry)
     replica.attach_metrics(registry)
@@ -850,6 +874,43 @@ def serve_replica() -> None:
         client.stop()
         if watchdog is not None:
             watchdog.stop()
+        srv.shutdown()
+
+
+def _serve_partitioned_replica() -> None:
+    """The ``REPLICA_OF=<url0>,<url1>,…`` arm of :func:`serve_replica`:
+    one follower ReplicaStore per partition leader, assembled into the
+    reads-only PartitionRouter :func:`machinery.partition.
+    replica_router_from_env` builds. Cluster-spanning lists/watches
+    merge across the follower fleet with the same composite-token
+    semantics the leader-side router serves."""
+    from odh_kubeflow_tpu.apis import register_crds
+    from odh_kubeflow_tpu.machinery import httpapi
+    from odh_kubeflow_tpu.machinery.partition import replica_router_from_env
+
+    built = replica_router_from_env()
+    assert built is not None  # caller checked for the comma
+    router, clients = built
+    registry = prometheus.Registry()
+    # CRD kinds on every partition follower: cold followers answer
+    # empty lists instead of 404ing while the first snapshots land
+    register_crds(router)
+    host = os.environ.get("HOST", "0.0.0.0")
+    port = int(os.environ.get("PORT", "8002"))
+    _, bound, srv = httpapi.serve(
+        router, host=host, port=port, metrics_registry=registry
+    )
+    print(
+        f"partitioned replica of {router.partition_count} leaders "
+        f"serving merged reads on :{bound}",
+        flush=True,
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        for c in clients:
+            c.stop()
         srv.shutdown()
 
 

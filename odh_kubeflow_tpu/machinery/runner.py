@@ -14,6 +14,27 @@ import time
 from typing import Callable
 
 
+def _partition_leaders(api):
+    """``PARTITION_LEADERS=<url0>,<url1>,…``: client-side routing for
+    a partitioned write path (machinery.partition) — one remote client
+    per partition leader behind a PartitionRouter, so every mutation
+    goes STRAIGHT to its namespace's owning leader instead of paying a
+    307 redirect hop, and cluster-spanning lists/watches merge
+    client-side with composite continue tokens. Unset = the single
+    ``KUBE_API_URL`` endpoint, exactly the old wiring."""
+    raw = os.environ.get("PARTITION_LEADERS", "")
+    if not raw:
+        return api
+    from odh_kubeflow_tpu.machinery.client import api_from_env
+    from odh_kubeflow_tpu.machinery.partition import PartitionRouter
+
+    urls = [u.strip().rstrip("/") for u in raw.split(",") if u.strip()]
+    backends = {i: api_from_env(url=u) for i, u in enumerate(urls)}
+    # owned = every partition: the client routes writes itself; each
+    # backend is that partition's own leader, no redirect needed
+    return PartitionRouter(backends, urls=dict(enumerate(urls)))
+
+
 def _split_reads(api):
     """``READ_FROM_REPLICA=<url>[,<url>…]``: serve this component's
     reads — lists, watches (so the informer cache feeds off the
@@ -95,7 +116,7 @@ def run_controller(name: str, register: Callable) -> None:
     # (chaos soak runs); unset = the raw client, zero overhead
     raw = api_from_env()
     _install_span_exporter(raw)
-    api = maybe_wrap(raw)
+    api = maybe_wrap(_partition_leaders(raw))
     api, cache = _wrap_cached(_split_reads(api))
 
     elector = None
@@ -193,7 +214,9 @@ def run_web(name: str, default_port: int, build: Callable) -> None:
 
     raw = api_from_env()
     _install_span_exporter(raw)
-    api, cache = _wrap_cached(_split_reads(maybe_wrap(raw)))
+    api, cache = _wrap_cached(
+        _split_reads(maybe_wrap(_partition_leaders(raw)))
+    )
     if cache is not None:
         cache.start(live=True)
         cache.wait_for_sync()

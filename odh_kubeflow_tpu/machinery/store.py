@@ -1979,6 +1979,79 @@ class APIServer:
                     h.update(serialize.dumps(per_kind[key]))
         return h.hexdigest()
 
+    @staticmethod
+    def compose_digests(parts: list[tuple[int, str, int]]) -> str:
+        """One fleet digest from per-partition ``(partition, digest,
+        rv)`` tuples: sha256 over their canonical serialization in
+        sorted order. Cross-partition coherence drills compare fleet
+        digests exactly the way the replication property test compares
+        per-store digests — equal fleet digests mean every partition
+        (and its replicas) serves byte-identical reads at matching
+        per-partition horizons."""
+        h = hashlib.sha256()
+        for partition, digest, rv in sorted(parts):
+            h.update(f"{partition}\x00{digest}\x00{rv}\x00".encode())
+        return h.hexdigest()
+
+    # -- partition-handover primitives --------------------------------------
+    #
+    # The partition mover (machinery/partition.py) ships a namespace
+    # between stores whose rv spaces are independent. These two verbs
+    # are its data plane: identity-preserving writes that flow through
+    # the normal WAL commit pipeline (durable before acked, watch
+    # events emitted, replicated to this partition's followers) but
+    # skip the USER-facing lifecycle — admission already ran in the
+    # source partition, and finalizers/cascade belong to whichever
+    # partition owns the namespace, not to a handover.
+
+    def import_object(self, obj: Obj) -> Obj:
+        """Upsert ``obj`` preserving its identity (uid, creation
+        timestamp, generation, finalizers, ownerReferences) under a
+        fresh LOCAL resourceVersion. The partition mover's snapshot/
+        tail apply: cross-partition rv spaces are independent, so the
+        rv is re-stamped, but everything ownerReference cascade and
+        controller dedupe logic keys on survives the move intact."""
+        kind = obj.get("kind", "")
+        info = self.type_info(kind)
+        obj = obj_util.deepcopy(obj)
+        obj.setdefault("apiVersion", info.api_version)
+        meta = obj.setdefault("metadata", {})
+        if not meta.get("name"):
+            raise Invalid("metadata.name required")
+        namespace = meta.get("namespace") if info.namespaced else None
+        with self._lock:
+            self._check_fence(kind)
+            key = self._key(info, namespace, name=meta["name"])
+            current, _ = self._effective(kind, key)
+            meta["resourceVersion"] = self._next_rv()
+            etype = "ADDED" if current is None else "MODIFIED"
+            entry = self._commit_mutation(etype, kind, key, obj)
+        self._await(entry)
+        return obj_util.deepcopy(obj)
+
+    def purge_object(
+        self, kind: str, name: str, namespace: Optional[str] = None
+    ) -> bool:
+        """Remove one object directly — no finalizer two-phase, no
+        ownerReference cascade — through the WAL pipeline (a DELETED
+        record, durable before acked). The mover's tail-delete apply
+        and its post-handover source scrub; every object in the moved
+        namespace is purged individually, so skipping the cascade
+        loses nothing. Returns False when the object is already gone
+        (the mover's resume path re-purges idempotently)."""
+        info = self.type_info(kind)
+        with self._lock:
+            self._check_fence(kind)
+            key = self._key(info, namespace, name)
+            current, _ = self._effective(kind, key)
+            if current is None:
+                return False
+            current = obj_util.deepcopy(current)
+            current["metadata"]["resourceVersion"] = self._next_rv()
+            entry = self._commit_mutation("DELETED", kind, key, current)
+        self._await(entry)
+        return True
+
     # -- watch dispatch (sharded fanout) ------------------------------------
 
     def _register_watch(self, w: Watch, inline: bool) -> None:

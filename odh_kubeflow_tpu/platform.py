@@ -45,6 +45,10 @@ from odh_kubeflow_tpu.machinery.cache import (
     register_platform_indexers,
 )
 from odh_kubeflow_tpu.machinery.kubelet import FakeCluster
+from odh_kubeflow_tpu.machinery.partition import (
+    build_partitions,
+    partitions_from_env,
+)
 from odh_kubeflow_tpu.machinery.store import APIServer
 from odh_kubeflow_tpu.machinery.usage import (
     UsageConfig,
@@ -120,21 +124,35 @@ class Platform:
         # watch-resume window from disk (see docs/GUIDE.md
         # "Durability & failover"). Unset = the in-memory-only store.
         wal_dir = os.environ.get("WAL_DIR", "")
-        if wal_dir:
+        # STORE_PARTITIONS=N shards the write path by namespace into N
+        # independent WAL+group-commit stacks behind a PartitionRouter
+        # (docs/GUIDE.md "Partitioned write path"); 1 = the classic
+        # single-leader store, no router in the path.
+        n_partitions = partitions_from_env()
+        snap_every = int(os.environ.get("SNAPSHOT_INTERVAL", "1024"))
+        # byte-based cadence rides alongside the count-based one
+        # (SNAPSHOT_BYTES=0 disables); GROUP_COMMIT=false pins the
+        # committer to one fsync per record (debug/bench baseline)
+        snap_bytes = int(os.environ.get("SNAPSHOT_BYTES", "0"))
+        group = os.environ.get("GROUP_COMMIT", "true").lower() == "true"
+        durable_kwargs = dict(
+            snapshot_interval=snap_every,
+            snapshot_bytes=snap_bytes,
+            group_commit=group,
+        )
+        if n_partitions > 1:
+            # each partition recovers its own WAL under <WAL_DIR>/p<i>
+            # (in-memory partitions when WAL_DIR is unset)
+            self.api = build_partitions(
+                n_partitions,
+                wal_dir=wal_dir,
+                **(durable_kwargs if wal_dir else {}),
+            )
+        elif wal_dir:
             from odh_kubeflow_tpu.machinery.wal import WriteAheadLog
 
-            wal = WriteAheadLog(wal_dir)
-            snap_every = int(os.environ.get("SNAPSHOT_INTERVAL", "1024"))
-            # byte-based cadence rides alongside the count-based one
-            # (SNAPSHOT_BYTES=0 disables); GROUP_COMMIT=false pins the
-            # committer to one fsync per record (debug/bench baseline)
-            snap_bytes = int(os.environ.get("SNAPSHOT_BYTES", "0"))
-            group = os.environ.get("GROUP_COMMIT", "true").lower() == "true"
             self.api = APIServer.recover(
-                wal,
-                snapshot_interval=snap_every,
-                snapshot_bytes=snap_bytes,
-                group_commit=group,
+                WriteAheadLog(wal_dir), **durable_kwargs
             )
         else:
             self.api = APIServer()

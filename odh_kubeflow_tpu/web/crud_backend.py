@@ -247,6 +247,19 @@ class CrudBackend:
                 served = None  # backend blip: the rows still stand
             if served is not None:
                 body["servedRv"] = int(served)
+        # partitioned fleets (machinery.partition): the scalar horizon
+        # is a SUM over independent per-partition rv spaces, so it is
+        # not comparable to the partition-scalar rv a write returned.
+        # Stamp the vector too, so consumers can check staleness
+        # against the partition their write landed in.
+        vec_fn = getattr(target, "applied_rvs", None)
+        if vec_fn is not None:
+            try:
+                body["servedRvPartitions"] = {
+                    str(p): int(rv) for p, rv in vec_fn().items()
+                }
+            except APIError:
+                pass  # backend blip: the rows still stand
         return body
 
     # -- listing pagination -------------------------------------------------

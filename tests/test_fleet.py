@@ -578,3 +578,122 @@ def test_cluster_page_walk_stays_sorted_under_interleaved_writes():
     # one we deleted behind the cursor
     assert original - deleted <= set(seen)
     assert api._sorted_keys["Notebook"] == sorted(api._store["Notebook"])
+
+
+# ---------------------------------------------------------------------------
+# partitioned merged streams (ISSUE 18): the compaction-floor boundary
+# contract, per partition
+
+
+def test_merged_watch_resume_at_each_partition_floor_and_isolated_410():
+    """Every partition keeps its own watch cache and compaction floor.
+    A merged-stream resume whose composite token pins each partition
+    exactly AT its ``_compacted_rv`` must replay each partition's FULL
+    retained window (the scalar-rv boundary contract, per leg). And a
+    token that is below ONE partition's floor surfaces that 410 as a
+    CONTROL frame on the merged stream — the other legs still replay
+    in full (one partition's 410 must not poison the merged stream)."""
+    from odh_kubeflow_tpu.machinery.partition import (
+        build_partitions,
+        encode_fleet_rvs,
+    )
+
+    router = build_partitions(3)
+    router.register_kind("kubeflow.org/v1beta1", "Notebook", "notebooks")
+    for b in router.backends.values():
+        b.WATCH_CACHE_SIZE = 16
+
+    namespaces = [f"team-{i}" for i in range(9)]
+    owners = {ns: router.owner_of(ns) for ns in namespaces}
+    assert set(owners.values()) == {0, 1, 2}, (
+        "9 rendezvous-hashed namespaces must spread over all 3 partitions"
+    )
+    for i in range(120):
+        ns = namespaces[i % len(namespaces)]
+        router.create(
+            {
+                "kind": "Notebook",
+                "metadata": {"name": f"nb-{i:04d}", "namespace": ns},
+                "spec": {"v": i},
+            }
+        )
+    for i in range(120):
+        ns = namespaces[i % len(namespaces)]
+        nb = router.get("Notebook", f"nb-{i:04d}", ns)
+        nb["spec"]["v"] = -i
+        router.update(nb)
+
+    floors = {p: b._compacted_rv for p, b in router.backends.items()}
+    assert all(f > 0 for f in floors.values()), (
+        "churn must have compacted every partition"
+    )
+    retained = {
+        p: [erv for erv, *_ in b._event_log]
+        for p, b in router.backends.items()
+    }
+
+    def collect(w):
+        got, controls = {p: [] for p in router.backends}, []
+        while True:
+            item = w.try_get()
+            if item is None:
+                break
+            etype, obj = item
+            if etype == "CONTROL":
+                controls.append(obj)
+                continue
+            ns = obj["metadata"]["namespace"]
+            got[owners[ns]].append(int(obj["metadata"]["resourceVersion"]))
+        return got, controls
+
+    # resume exactly AT every partition's floor: full windows, no 410
+    w = router.watch(
+        "Notebook", resource_version=encode_fleet_rvs("Notebook", floors)
+    )
+    got, controls = collect(w)
+    w.stop()
+    assert not [c for c in controls if c.get("expired")]
+    for p in router.backends:
+        assert got[p] == retained[p], (
+            f"partition {p}: resume at its floor must replay its whole "
+            f"retained window"
+        )
+
+    # one partition below its floor: ITS leg 410s (CONTROL frame), the
+    # other partitions' windows still replay in full
+    bad = dict(floors)
+    bad[0] = floors[0] - 1
+    w = router.watch(
+        "Notebook", resource_version=encode_fleet_rvs("Notebook", bad)
+    )
+    got, controls = collect(w)
+    assert w.expired_partitions == {0}
+    expired = [c for c in controls if c.get("expired")]
+    assert [c["partition"] for c in expired] == [0]
+    assert got[0] == [], "the expired leg must not deliver a partial window"
+    for p in (1, 2):
+        assert got[p] == retained[p], (
+            f"partition {p} poisoned by partition 0's 410"
+        )
+    # the merged stream is still live: a new write on a healthy
+    # partition flows through
+    live_ns = next(ns for ns, p in owners.items() if p == 1)
+    router.create(
+        {
+            "kind": "Notebook",
+            "metadata": {"name": "post-410", "namespace": live_ns},
+            "spec": {},
+        }
+    )
+    tail = []
+    while True:
+        item = w.try_get()
+        if item is None:
+            break
+        tail.append(item)
+    assert any(
+        e == "ADDED" and o["metadata"]["name"] == "post-410"
+        for e, o in tail
+        if e != "CONTROL"
+    )
+    w.stop()
