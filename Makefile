@@ -38,6 +38,7 @@ lint:
 	$(PYTHON) -m compileall -q odh_kubeflow_tpu tests loadtest bench.py __graft_entry__.py
 	$(PYTHON) -m odh_kubeflow_tpu.analysis
 	$(PYTHON) -m odh_kubeflow_tpu.analysis.knobs
+	$(PYTHON) -m odh_kubeflow_tpu.analysis.protocol
 
 # deterministic schedule explorer (docs/GUIDE.md "Deterministic
 # schedule exploration"): seeded one-runnable-at-a-time interleavings

@@ -1342,3 +1342,10 @@ class AwaitHoldingLockRule(ProgramRule):
 # import — raise-set inference over the same call graph, see the
 # module docstring for the contract table and suppression syntax
 from odh_kubeflow_tpu.analysis import exceptions as _exceptions  # noqa: E402,F401
+
+# protocol-surface rules (whole-program): duck-conformance verifies
+# every APIServer implementation against the reference protocol (and
+# the httpapi↔client error-mapping round trip); protocol-drift keeps
+# the kube-metadata contract registry honest against the tree
+from odh_kubeflow_tpu.analysis import ducks as _ducks  # noqa: E402,F401
+from odh_kubeflow_tpu.analysis import protocol as _protocol  # noqa: E402,F401

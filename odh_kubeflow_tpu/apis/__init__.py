@@ -52,6 +52,10 @@ TPU_ACCELERATOR_ANNOTATION = "notebooks.kubeflow.org/tpu-accelerator"
 TPU_TOPOLOGY_ANNOTATION = "notebooks.kubeflow.org/tpu-topology"
 TPU_ACCEL_NODE_LABEL = "cloud.google.com/gke-tpu-accelerator"
 TPU_TOPO_NODE_LABEL = "cloud.google.com/gke-tpu-topology"
+# pod-label opt-in for the TPU-runtime PodDefault (webhooks/poddefault
+# injects libtpu/XLA env into pods carrying it; JWA and the warm-pool
+# controller stamp it on TPU-flavored notebooks)
+TPU_RUNTIME_LABEL = "tpu-runtime"
 
 
 def notebook_agent_url(

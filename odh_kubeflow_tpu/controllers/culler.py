@@ -218,6 +218,7 @@ class Culler:
             )
         obj_util.set_annotation(
             notebook,
+            # protocol-ok: audit trail for operators (kubectl describe)
             TPU_DUTY_CYCLE_ANNOTATION,
             f"{duty:g}@{_fmt_time(now)}",
         )

@@ -86,6 +86,7 @@ class ExposureController:
                 "name": name,
                 "namespace": obj_util.namespace_of(notebook),
                 "annotations": {
+                    # protocol-ok: routed by the external auth proxy layer
                     "auth.kubeflow.org/redirect-path": (
                         f"/notebook/{obj_util.namespace_of(notebook)}/{name}/"
                     )
@@ -104,6 +105,7 @@ class ExposureController:
                 "namespace": obj_util.namespace_of(notebook),
                 "annotations": {
                     # cert issuer contract: materialise <name>-tls secret
+                    # protocol-ok: the external cert controller consumes it
                     "cert.kubeflow.org/serving-cert-secret-name": f"{name}-tls"
                 },
             },

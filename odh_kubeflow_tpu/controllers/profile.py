@@ -83,6 +83,7 @@ class GcpWorkloadIdentityPlugin(ProfilePlugin):
     def apply(self, api: APIServer, profile: Obj, spec: Obj) -> None:
         gcp_sa = spec.get("gcpServiceAccount", "")
         ns = obj_util.name_of(profile)
+        # protocol-ok: read by GKE workload identity, not package code
         _stamp_editor_sa(api, ns, "iam.gke.io/gcp-service-account", gcp_sa)
         member = f"serviceAccount:{ns}.svc.id.goog[{ns}/{DEFAULT_EDITOR}]"
         self.iam_client(gcp_sa, member, "add")
@@ -103,6 +104,7 @@ class AwsIamForServiceAccountPlugin(ProfilePlugin):
     def apply(self, api: APIServer, profile: Obj, spec: Obj) -> None:
         arn = spec.get("awsIamRole", "")
         ns = obj_util.name_of(profile)
+        # protocol-ok: read by the EKS pod-identity webhook
         _stamp_editor_sa(api, ns, "eks.amazonaws.com/role-arn", arn)
         self.iam_client(arn, f"{ns}/{DEFAULT_EDITOR}", "add")
 
@@ -125,6 +127,7 @@ class ProfileController:
         self.labels_path = labels_path
         self._default_labels = default_labels or {
             "istio-injection": "enabled",
+            # protocol-ok: consumed by the external katib webhook
             "katib.kubeflow.org/metrics-collector-injection": "enabled",
         }
         self.plugins = plugins or {
@@ -215,6 +218,7 @@ class ProfileController:
     def _reconcile_namespace(self, profile: Obj) -> None:
         name = obj_util.name_of(profile)
         labels = self.default_labels()
+        # protocol-ok: standard grouping label read by dashboards/kubectl
         labels["app.kubernetes.io/part-of"] = "kubeflow-profile"
         labels["kubernetes.io/metadata.name"] = name
         ns = {

@@ -137,6 +137,7 @@ class TensorboardController:
         elif parsed["kind"] == "gcs":
             # workload identity first; key-secret fallback by annotation
             pod_spec["serviceAccountName"] = "default-editor"
+            # protocol-ok: user-set on the Tensorboard; no package writer
             secret = obj_util.annotations_of(tb).get(GCP_SA_SECRET_ANNOTATION)
             if secret:
                 container["volumeMounts"] = [
@@ -195,6 +196,7 @@ class TensorboardController:
                                     {
                                         "matchExpressions": [
                                             {
+                                                # protocol-ok: kubelet-owned node identity label
                                                 "key": "kubernetes.io/hostname",
                                                 "operator": "In",
                                                 "values": [node],

@@ -1028,8 +1028,22 @@ class ReplicaFanout:
     def get(self, kind: str, name: str, namespace: Optional[str] = None) -> Obj:
         return self._call("get", kind, name, namespace)
 
-    def list(self, *args, **kwargs):
-        return self._call("list", *args, **kwargs)
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Obj] = None,
+        field_matches: Optional[dict[str, Any]] = None,
+        limit: Optional[int] = None,
+    ) -> list[Obj]:
+        return self._call(
+            "list",
+            kind,
+            namespace=namespace,
+            label_selector=label_selector,
+            field_matches=field_matches,
+            limit=limit,
+        )
 
     # marker appended to continue tokens to pin the walk's endpoint:
     # stickiness via rendezvous alone breaks when a better-ranked
@@ -1038,32 +1052,47 @@ class ReplicaFanout:
     _TOKEN_PIN = "@@replica:"
 
     def _page_endpoint(
-        self, kind: str, kwargs: dict
+        self, kind: str, namespace: Optional[str], token: Optional[str]
     ) -> tuple[int, Optional[str]]:
         """(endpoint index, unwrapped server token) for one page. A
         continued walk is pinned to the endpoint recorded in its own
         token; a fresh walk homes on the healthy rendezvous winner."""
-        token = kwargs.get("continue_token")
         if token and self._TOKEN_PIN in token:
             server_token, _, idx = token.rpartition(self._TOKEN_PIN)
             try:
                 return int(idx), server_token
             except ValueError:
                 pass  # foreign token shape: treat as unpinned
-        key = f"list\x00{kind}\x00{kwargs.get('namespace') or ''}"
+        key = f"list\x00{kind}\x00{namespace or ''}"
         return self._order(sticky_key=key)[0], token
 
-    def list_chunk(self, kind: str, *args, **kwargs):
+    def list_chunk(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Obj] = None,
+        field_matches: Optional[dict[str, Any]] = None,
+        limit: Optional[int] = None,
+        continue_token: Optional[str] = None,
+    ) -> tuple[list[Obj], str]:
         # EVERY page of one continue walk must come from the same
         # replica — another endpoint's horizon differs, and an offset
         # into a different history silently skips/repeats rows — so
         # the token itself carries the endpoint it belongs to
-        idx, server_token = self._page_endpoint(kind, kwargs)
-        pinned = bool(kwargs.get("continue_token"))
-        kwargs["continue_token"] = server_token
+        idx, server_token = self._page_endpoint(
+            kind, namespace, continue_token
+        )
+        pinned = bool(continue_token)
 
         def page(i: int):
-            items, token = self.clients[i].list_chunk(kind, *args, **kwargs)
+            items, token = self.clients[i].list_chunk(
+                kind,
+                namespace=namespace,
+                label_selector=label_selector,
+                field_matches=field_matches,
+                limit=limit,
+                continue_token=server_token,
+            )
             return items, (
                 f"{token}{self._TOKEN_PIN}{i}" if token else ""
             )
@@ -1083,7 +1112,7 @@ class ReplicaFanout:
                     "replica serving this paginated walk became "
                     "unavailable; restart from a fresh list"
                 ) from e
-            key = f"list\x00{kind}\x00{kwargs.get('namespace') or ''}"
+            key = f"list\x00{kind}\x00{namespace or ''}"
             for other in self._order(sticky_key=key):
                 if other == idx:
                     continue
@@ -1096,7 +1125,14 @@ class ReplicaFanout:
                     e = e2
             raise e
 
-    def watch(self, kind: str, namespace: Optional[str] = None, **kwargs):
+    def watch(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        send_initial: bool = True,
+        resource_version: Optional[str] = None,
+        reconnect_window: Optional[float] = None,
+    ) -> Watch:
         # sticky: the stream (and its resume rv space) lives on ONE
         # replica; the client pump's own reconnect loop handles blips.
         # watch() itself never raises (the pump retries forever), so a
@@ -1106,7 +1142,8 @@ class ReplicaFanout:
         # reconnect_window ends the stream so the consumer's relist +
         # re-watch comes back through this probe and re-homes.
         key = f"{kind}\x00{namespace or ''}"
-        kwargs.setdefault("reconnect_window", max(3 * self.cooldown, 15.0))
+        if reconnect_window is None:
+            reconnect_window = max(3 * self.cooldown, 15.0)
         last: Optional[Exception] = None
         for idx in self._order(sticky_key=key):
             try:
@@ -1117,7 +1154,13 @@ class ReplicaFanout:
                 self._mark_down(idx, e)
                 last = e
                 continue
-            return self.clients[idx].watch(kind, namespace=namespace, **kwargs)
+            return self.clients[idx].watch(
+                kind,
+                namespace=namespace,
+                send_initial=send_initial,
+                resource_version=resource_version,
+                reconnect_window=reconnect_window,
+            )
         assert last is not None
         raise last
 
@@ -1133,9 +1176,15 @@ class ReplicaFanout:
         ]
         return min(horizons) if horizons else None
 
-    def register_kind(self, *args, **kwargs) -> None:
+    def register_kind(
+        self,
+        api_version: str,
+        kind: str,
+        plural: str,
+        namespaced: bool = True,
+    ) -> None:
         for c in self.clients:
-            c.register_kind(*args, **kwargs)
+            c.register_kind(api_version, kind, plural, namespaced)
 
     def type_info(self, kind: str) -> TypeInfo:
         return self.clients[0].type_info(kind)
@@ -1143,7 +1192,7 @@ class ReplicaFanout:
     def kind_for_plural(self, plural: str) -> str:
         return self.clients[0].kind_for_plural(plural)
 
-    def register_admission_hook(self, *args, **kwargs) -> None:
+    def register_admission_hook(self, kinds, fn, mutating=True, name="") -> None:
         """No-op, same as every remote client."""
 
     def __getattr__(self, name: str):

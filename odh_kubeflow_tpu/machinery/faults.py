@@ -434,6 +434,7 @@ class FaultInjector:
         namespace: Optional[str] = None,
         send_initial: bool = True,
         resource_version: Optional[str] = None,
+        inline: bool = True,
     ) -> Watch:
         if self._offline:
             self._count("outage")
@@ -448,12 +449,24 @@ class FaultInjector:
                 f"injected expiry: resourceVersion {resource_version} is "
                 "too old"
             )
-        w = self.api.watch(
-            kind,
-            namespace=namespace,
-            send_initial=send_initial,
-            resource_version=resource_version,
-        )
+        # in-process stores take ``inline``; a wrapped RemoteAPIServer
+        # does not (it always pumps via a reader thread) — same
+        # degradation as the partition router's _leg_watch
+        try:
+            w = self.api.watch(
+                kind,
+                namespace=namespace,
+                send_initial=send_initial,
+                resource_version=resource_version,
+                inline=inline,
+            )
+        except TypeError:
+            w = self.api.watch(
+                kind,
+                namespace=namespace,
+                send_initial=send_initial,
+                resource_version=resource_version,
+            )
         with self._watch_lock:
             self._watches.append(w)
         return w
@@ -468,9 +481,36 @@ class FaultInjector:
             meta = obj.get("metadata", {})
             return self.get(obj["kind"], meta["name"], meta.get("namespace"))
 
-    def emit_event(self, *args: Any, **kwargs: Any) -> Obj:
+    def emit_event(
+        self,
+        involved: Obj,
+        reason: str,
+        message: str,
+        event_type: str = "Normal",
+        component: str = "",
+    ) -> Obj:
         self._fault_point("emit_event", mutating=True)
-        return self.api.emit_event(*args, **kwargs)
+        return self.api.emit_event(
+            involved,
+            reason,
+            message,
+            event_type=event_type,
+            component=component,
+        )
+
+    # -- replication / digest surface (explicit pass-throughs: a duck
+    #    served only by __getattr__ is invisible to conformance checks,
+    #    and a chaos-wrapped store must not silently lose the surface
+    #    the drills and the bytes cache key on) ------------------------------
+
+    def applied_rv(self) -> Optional[int]:
+        return self.api.applied_rv()
+
+    def kind_version(self, kind: str) -> int:
+        return self.api.kind_version(kind)
+
+    def state_digest(self) -> str:
+        return self.api.state_digest()
 
     # -- everything else (registry, admission, helpers) ---------------------
 

@@ -594,10 +594,24 @@ class PartitionRouter:
             self._ns_of_obj(obj), lambda b: b.create_or_get(obj)
         )
 
-    def emit_event(self, involved: Obj, *args, **kwargs) -> Obj:
+    def emit_event(
+        self,
+        involved: Obj,
+        reason: str,
+        message: str,
+        event_type: str = "Normal",
+        component: str = "",
+    ) -> Obj:
         ns = (involved.get("metadata") or {}).get("namespace") or "default"
         return self._mutate(
-            ns, lambda b: b.emit_event(involved, *args, **kwargs)
+            ns,
+            lambda b: b.emit_event(
+                involved,
+                reason,
+                message,
+                event_type=event_type,
+                component=component,
+            ),
         )
 
     def import_object(self, obj: Obj) -> Obj:
@@ -617,13 +631,21 @@ class PartitionRouter:
     #    kind, exactly like every kube apiserver replica serves every
     #    resource) ----------------------------------------------------------
 
-    def register_kind(self, *args, **kwargs) -> None:
+    def register_kind(
+        self,
+        api_version: str,
+        kind: str,
+        plural: str,
+        namespaced: bool = True,
+    ) -> None:
         for b in self.backends.values():
-            b.register_kind(*args, **kwargs)
+            b.register_kind(api_version, kind, plural, namespaced)
 
-    def register_admission_hook(self, *args, **kwargs) -> None:
+    def register_admission_hook(
+        self, kinds, fn, mutating: bool = True, name: str = ""
+    ) -> None:
         for b in self.backends.values():
-            b.register_admission_hook(*args, **kwargs)
+            b.register_admission_hook(kinds, fn, mutating=mutating, name=name)
 
     # -- reads ---------------------------------------------------------------
 

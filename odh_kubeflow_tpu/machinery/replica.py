@@ -176,9 +176,22 @@ class ReplicaStore(APIServer):
         self._reject_writes("create_or_get")
         return super().create_or_get(obj)
 
-    def emit_event(self, *args, **kwargs) -> Obj:
+    def emit_event(
+        self,
+        involved: Obj,
+        reason: str,
+        message: str,
+        event_type: str = "Normal",
+        component: str = "",
+    ) -> Obj:
         self._reject_writes("emit_event")
-        return super().emit_event(*args, **kwargs)
+        return super().emit_event(
+            involved,
+            reason,
+            message,
+            event_type=event_type,
+            component=component,
+        )
 
     # -- promotion ------------------------------------------------------------
 
@@ -733,24 +746,97 @@ class ReadSplitAPI:
         except NotFound:
             return self.write_api.get(kind, name, namespace)
 
-    def list(self, *args, **kwargs):
-        return self.read_api.list(*args, **kwargs)
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Obj] = None,
+        field_matches: Optional[dict[str, Any]] = None,
+        limit: Optional[int] = None,
+    ) -> list[Obj]:
+        return self.read_api.list(
+            kind,
+            namespace=namespace,
+            label_selector=label_selector,
+            field_matches=field_matches,
+            limit=limit,
+        )
 
-    def list_chunk(self, *args, **kwargs):
-        return self.read_api.list_chunk(*args, **kwargs)
+    def list_chunk(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Obj] = None,
+        field_matches: Optional[dict[str, Any]] = None,
+        limit: Optional[int] = None,
+        continue_token: Optional[str] = None,
+    ) -> tuple[list[Obj], str]:
+        return self.read_api.list_chunk(
+            kind,
+            namespace=namespace,
+            label_selector=label_selector,
+            field_matches=field_matches,
+            limit=limit,
+            continue_token=continue_token,
+        )
 
-    def watch(self, *args, **kwargs):
-        return self.read_api.watch(*args, **kwargs)
+    def watch(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        send_initial: bool = True,
+        resource_version: Optional[str] = None,
+        inline: bool = True,
+    ) -> Watch:
+        # in-process read arms (ReplicaStore) take ``inline``; remote
+        # fanout arms do not — same degradation the partition router's
+        # _leg_watch applies
+        try:
+            return self.read_api.watch(
+                kind,
+                namespace=namespace,
+                send_initial=send_initial,
+                resource_version=resource_version,
+                inline=inline,
+            )
+        except TypeError:
+            return self.read_api.watch(
+                kind,
+                namespace=namespace,
+                send_initial=send_initial,
+                resource_version=resource_version,
+            )
 
     def applied_rv(self) -> Optional[int]:
         fn = getattr(self.read_api, "applied_rv", None)
         return fn() if fn is not None else None
 
-    def register_kind(self, *args, **kwargs) -> None:
-        self.write_api.register_kind(*args, **kwargs)
+    def kind_version(self, kind: str) -> int:
+        # freshness keys must describe the arm that SERVES the reads —
+        # keying a bytes-cache on the leader's version while rows come
+        # from the replica would advance keys ahead of content
+        fn = getattr(self.read_api, "kind_version", None)
+        if fn is None:
+            fn = self.write_api.kind_version
+        return fn(kind)
+
+    def state_digest(self) -> str:
+        fn = getattr(self.read_api, "state_digest", None)
+        if fn is None:
+            fn = self.write_api.state_digest
+        return fn()
+
+    def register_kind(
+        self,
+        api_version: str,
+        kind: str,
+        plural: str,
+        namespaced: bool = True,
+    ) -> None:
+        self.write_api.register_kind(api_version, kind, plural, namespaced)
         reg = getattr(self.read_api, "register_kind", None)
         if reg is not None:
-            reg(*args, **kwargs)
+            reg(api_version, kind, plural, namespaced)
 
     def __getattr__(self, name: str):
         # writes, type registry, admission, emit_event, … — the leader

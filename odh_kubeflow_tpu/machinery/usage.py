@@ -663,8 +663,21 @@ class UsageMeter:
             records = self.api.list("UsageRecord")  # uncached-ok: one-shot recovery scan
         except APIError:
             records = []
+        cutoff = self.now() - self.config.retention_seconds
         with self._lock:
             for rec in records:
+                # retention fence on the window label: a long-dead
+                # leader's stale windows (which the pruner never saw)
+                # must not resurrect into the rebuilt ledger
+                try:
+                    window = float(obj_util.labels_of(rec).get(WINDOW_LABEL, ""))
+                except (TypeError, ValueError):
+                    window = None
+                if (
+                    window is not None
+                    and window + self.config.window_seconds < cutoff
+                ):
+                    continue
                 spec = rec.get("spec") or {}
                 status = rec.get("status") or {}
                 iv = _Interval(

@@ -115,6 +115,7 @@ class SliceInventory:
                     labels.get(TPU_TOPO_LABEL, ""),
                     zone=labels.get(ZONE_LABEL, ""),
                     spot=labels.get(SPOT_LABEL, "").lower() == "true"
+                    # protocol-ok: legacy GKE-written node label; sim models gke-spot only
                     or labels.get(PREEMPTIBLE_LABEL, "").lower() == "true",
                 )
             pool.free[name] = capacity
@@ -262,6 +263,7 @@ class QuotaSnapshot:
                             snap.factor[ns] = max(
                                 float(
                                     obj_util.annotations_of(quota).get(
+                                        # protocol-ok: operator-set on the quota
                                         OVERSUBSCRIPTION_FACTOR_ANNOTATION,
                                         "1",
                                     )

@@ -66,15 +66,25 @@ def register_sessions(api: Any) -> None:
 
 def checkpoint_of(api: Any, notebook: Obj) -> Optional[Obj]:
     """The notebook's SessionCheckpoint (named after it), or None when
-    it has none — or the sessions kind isn't registered at all."""
+    it has none — or the sessions kind isn't registered at all.
+
+    The checkpoint rides the notebook NAME, but a deleted-and-recreated
+    notebook reuses the name with a fresh uid — the ``notebook-uid``
+    label stamped at checkpoint creation fences a leftover checkpoint
+    out of the new notebook's resume path."""
     try:
-        return api.get(
+        ckpt = api.get(
             "SessionCheckpoint",
             obj_util.name_of(notebook),
             obj_util.namespace_of(notebook),
         )
     except NotFound:
         return None
+    want = obj_util.meta(notebook).get("uid", "")
+    have = obj_util.labels_of(ckpt).get(NOTEBOOK_UID_LABEL, "")
+    if want and have and want != have:
+        return None
+    return ckpt
 
 
 def checkpoint_durable(ckpt: Optional[Obj], suspended_at: str) -> bool:

@@ -42,6 +42,7 @@ from typing import Any, Callable, Optional
 
 from odh_kubeflow_tpu.apis import (
     TPU_ACCELERATOR_ANNOTATION,
+    TPU_RUNTIME_LABEL,
     TPU_TOPOLOGY_ANNOTATION,
 )
 from odh_kubeflow_tpu.controllers.runtime import Manager, Request, Result
@@ -371,7 +372,7 @@ class WarmPoolController:
                 "labels": {
                     "app": name,
                     POOL_LABEL: obj_util.name_of(pool),
-                    "tpu-runtime": "enabled",
+                    TPU_RUNTIME_LABEL: "enabled",
                 },
                 "annotations": {
                     STANDBY_ANNOTATION: "true",

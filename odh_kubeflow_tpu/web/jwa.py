@@ -33,6 +33,7 @@ from odh_kubeflow_tpu.apis import (
     TPU_ACCEL_NODE_LABEL,
     TPU_ACCELERATOR_ANNOTATION,
     TPU_RESOURCE,
+    TPU_RUNTIME_LABEL,
     TPU_TOPOLOGY_ANNOTATION,
 )
 from odh_kubeflow_tpu.machinery import objects as obj_util
@@ -144,7 +145,7 @@ DEFAULT_CONFIG: Obj = {
                                     "weight": 100,
                                     "podAffinityTerm": {
                                         "labelSelector": {
-                                            "matchLabels": {"tpu-runtime": "enabled"}
+                                            "matchLabels": {TPU_RUNTIME_LABEL: "enabled"}
                                         },
                                         "topologyKey": (
                                             "topology.kubernetes.io/zone"
@@ -750,7 +751,7 @@ class JupyterWebApp(CrudBackend):
             annotations[TPU_ACCELERATOR_ANNOTATION] = accelerator
             if tpu.get("topology"):
                 annotations[TPU_TOPOLOGY_ANNOTATION] = tpu["topology"]
-            labels["tpu-runtime"] = "enabled"  # PodDefault opt-in
+            labels[TPU_RUNTIME_LABEL] = "enabled"  # PodDefault opt-in
 
         # tolerationGroup / affinityConfig: admin-defined groups applied
         # by key (reference form.py:179-223)

@@ -82,6 +82,7 @@ class NotebookWebhook:
 
     def _auth_injection_enabled(self, notebook: Obj) -> bool:
         return (
+            # protocol-ok: user/spawner-set opt-in; no package writer
             obj_util.annotations_of(notebook).get(INJECT_AUTH_ANNOTATION) == "true"
         )
 
@@ -138,6 +139,7 @@ class NotebookWebhook:
                 "limits": {"cpu": "100m", "memory": "64Mi"},
             },
         }
+        # protocol-ok: user-set alongside the oauth opt-in annotation
         logout = obj_util.annotations_of(notebook).get(LOGOUT_URL_ANNOTATION)
         if logout:
             sidecar["args"].append(f"--logout-url={logout}")

@@ -29,7 +29,9 @@ Obj = dict[str, Any]
 EXCLUDE_ANNOTATION = "poddefaults.admission.kubeflow.org/exclude"
 APPLIED_ANNOTATION_PREFIX = "poddefaults.admission.kubeflow.org/poddefault-"
 
-TPU_RUNTIME_LABEL = "tpu-runtime"
+# canonical home is the shared constants module — JWA and the warm-pool
+# controller stamp the label, this webhook matches on it
+from odh_kubeflow_tpu.apis import TPU_RUNTIME_LABEL  # noqa: E402
 
 
 class MergeConflict(Denied):
@@ -146,6 +148,7 @@ class PodDefaultWebhook:
             return None
         pod = req.obj
         ann = obj_util.annotations_of(pod)
+        # protocol-ok: user-set opt-out; no package writer
         if ann.get(EXCLUDE_ANNOTATION) == "true":
             return None
         defaults = self._matching_poddefaults(pod)
@@ -155,6 +158,7 @@ class PodDefaultWebhook:
             self._apply(pod, pd)
             obj_util.set_annotation(
                 pod,
+                # protocol-ok: applied-PodDefault audit trail for operators
                 APPLIED_ANNOTATION_PREFIX + obj_util.name_of(pd),
                 (pd.get("spec") or {}).get("desc", obj_util.name_of(pd)),
             )

@@ -1,0 +1,355 @@
+"""APIServer duck-conformance analysis (analysis/ducks.py).
+
+Pins the fixture-mode reference to the live ``machinery/store.py``
+extraction, exercises every finding family on one-file fixtures
+(missing verb, signature drift, blind forwarding, undeclared-wrapper
+discovery, httpapi↔client round-trip closure), and runs the regression
+drills the acceptance criteria demand: deleting the FaultInjector aux
+surface and reverting a ReadSplitAPI verb to a blind catch-all each
+re-light the rule on a copy of the real package. The live tree is the
+tier-1 gate: zero findings over an EMPTY committed baseline."""
+
+import ast
+import os
+import shutil
+
+import pytest
+
+from odh_kubeflow_tpu.analysis import active_rules, lint_source
+from odh_kubeflow_tpu.analysis import ducks as ducksmod
+from odh_kubeflow_tpu.analysis.callgraph import build_program
+from odh_kubeflow_tpu.analysis.graftlint import (
+    SourceFile,
+    package_root,
+    run_package,
+    run_paths,
+    run_program_rules,
+)
+
+RULE = "duck-conformance"
+
+
+def _program_findings(sources):
+    return run_program_rules(sources, active_rules([RULE]))
+
+
+# ---------------------------------------------------------------------------
+# the reference protocol
+
+
+def test_rule_catalog_has_duck_conformance():
+    assert {r.id for r in active_rules()} >= {RULE}
+
+
+def test_default_reference_pinned_to_live_extraction():
+    """``DEFAULT_REFERENCE`` (the fixture-mode fallback) must match
+    what package runs extract from the real ``machinery/store.py`` —
+    byte-for-byte, so the hand copy cannot rot behind the source."""
+    rel = ducksmod.REFERENCE_FILE
+    path = os.path.join(package_root(), *rel.split("/"))
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    program = build_program([SourceFile(rel, rel, text)])
+    assert ducksmod.reference_protocol(program) == ducksmod.DEFAULT_REFERENCE
+    # and the live class explicitly serves the whole surface the
+    # fallback describes — nothing in the dict is a dead entry
+    cls = next(
+        n
+        for n in ast.parse(text).body
+        if isinstance(n, ast.ClassDef) and n.name == ducksmod.REFERENCE_CLASS
+    )
+    defined = {n.name for n in cls.body if isinstance(n, ast.FunctionDef)}
+    assert defined >= set(ducksmod.DEFAULT_REFERENCE)
+
+
+def test_reference_covers_declared_surface():
+    verbs = (
+        set(ducksmod.CORE_VERBS)
+        | set(ducksmod.REGISTRY_VERBS)
+        | set(ducksmod.AUX_SURFACE)
+    )
+    assert set(ducksmod.DEFAULT_REFERENCE) == verbs
+
+
+# ---------------------------------------------------------------------------
+# per-duck fixtures (one-file programs fall back to DEFAULT_REFERENCE)
+
+
+def test_missing_declared_verb_found():
+    src = (
+        "class CachedClient:\n"
+        "    def __getattr__(self, name):\n"
+        "        raise AttributeError(name)\n"
+        "    def get(self, kind, name, namespace=None):\n"
+        "        return {}\n"
+    )
+    findings = lint_source(src, "machinery/cache.py", [RULE])
+    assert len(findings) == 1
+    assert "CachedClient" in findings[0].message
+    assert "no explicit `list`" in findings[0].message
+
+
+def test_signature_drift_found():
+    src = (
+        "class CachedClient:\n"
+        "    def __getattr__(self, name):\n"
+        "        raise AttributeError(name)\n"
+        "    def get(self, kind, name):\n"
+        "        return {}\n"
+        "    def list(self, kind, namespace=None, label_selector=None,\n"
+        "             field_matches=None, limit=None):\n"
+        "        return []\n"
+    )
+    findings = lint_source(src, "machinery/cache.py", [RULE])
+    assert len(findings) == 1
+    assert "drops reference parameter `namespace`" in findings[0].message
+    assert "APIServer.get" in findings[0].message
+
+
+def test_blind_forward_found():
+    src = (
+        "class CachedClient:\n"
+        "    def __getattr__(self, name):\n"
+        "        raise AttributeError(name)\n"
+        "    def get(self, kind, name, namespace=None):\n"
+        "        return {}\n"
+        "    def list(self, *args, **kwargs):\n"
+        "        return self.api.list(*args, **kwargs)\n"
+    )
+    findings = lint_source(src, "machinery/cache.py", [RULE])
+    assert len(findings) == 1
+    assert "blind *args/**kwargs" in findings[0].message
+
+
+def test_suppression_silences_the_drift():
+    src = (
+        "class CachedClient:\n"
+        "    def __getattr__(self, name):\n"
+        "        raise AttributeError(name)\n"
+        "    def get(self, kind, name):  "
+        "# graftlint: disable=duck-conformance fixture\n"
+        "        return {}\n"
+        "    def list(self, kind, namespace=None, label_selector=None,\n"
+        "             field_matches=None, limit=None):\n"
+        "        return []\n"
+    )
+    assert lint_source(src, "machinery/cache.py", [RULE]) == []
+
+
+def test_declared_class_missing_entirely():
+    src = "class SomethingElse:\n    pass\n"
+    findings = lint_source(src, "machinery/cache.py", [RULE])
+    assert len(findings) == 1
+    assert "DUCKS declares CachedClient" in findings[0].message
+
+
+def test_conformant_duck_is_clean():
+    src = (
+        "class CachedClient:\n"
+        "    def __getattr__(self, name):\n"
+        "        raise AttributeError(name)\n"
+        "    def get(self, kind, name, namespace=None):\n"
+        "        return {}\n"
+        "    def list(self, kind, namespace=None, label_selector=None,\n"
+        "             field_matches=None, limit=None):\n"
+        "        return []\n"
+    )
+    assert lint_source(src, "machinery/cache.py", [RULE]) == []
+
+
+# ---------------------------------------------------------------------------
+# auto-discovery of undeclared wrappers
+
+
+def test_undeclared_wrapper_discovered():
+    src = (
+        "class ShinyWrapper:\n"
+        "    def get(self, kind, name, namespace=None):\n"
+        "        return {}\n"
+        "    def list(self, kind, namespace=None):\n"
+        "        return []\n"
+        "    def create(self, obj, dry_run=False):\n"
+        "        return obj\n"
+    )
+    findings = lint_source(src, "machinery/mywrap.py", [RULE])
+    assert len(findings) == 1
+    assert "ShinyWrapper" in findings[0].message
+    assert "not declared in the analysis.ducks DUCKS" in findings[0].message
+
+
+def test_two_verb_helper_is_not_a_duck():
+    src = (
+        "class PairReader:\n"
+        "    def get(self, kind, name, namespace=None):\n"
+        "        return {}\n"
+        "    def list(self, kind, namespace=None):\n"
+        "        return []\n"
+    )
+    assert lint_source(src, "machinery/mywrap.py", [RULE]) == []
+
+
+def test_discovery_outside_machinery_is_out_of_scope():
+    src = (
+        "class NotAStore:\n"
+        "    def get(self, key, default=None):\n"
+        "        return default\n"
+        "    def list(self, prefix):\n"
+        "        return []\n"
+        "    def create(self, row):\n"
+        "        return row\n"
+    )
+    assert lint_source(src, "web/mywrap.py", [RULE]) == []
+
+
+# ---------------------------------------------------------------------------
+# httpapi ↔ client error-mapping round trip
+
+_STORE_FIXTURE = (
+    "class APIError(Exception):\n    pass\n"
+    "class Conflict(APIError):\n    pass\n"
+    "class NotFound(APIError):\n    pass\n"
+)
+_HTTPAPI_FIXTURE = (
+    "from odh_kubeflow_tpu.machinery.store import Conflict, NotFound\n"
+    "_STATUS = {\n"
+    "    Conflict: 409,\n"
+    "    NotFound: 404,\n"
+    "}\n"
+)
+
+
+def _round_trip_findings(client_text):
+    sources = [
+        SourceFile(r, r, t)
+        for r, t in (
+            (ducksmod.REFERENCE_FILE, _STORE_FIXTURE),
+            (ducksmod.HTTPAPI_FILE, _HTTPAPI_FIXTURE),
+            (ducksmod.CLIENT_FILE, client_text),
+        )
+    ]
+    return _program_findings(sources)
+
+
+def test_round_trip_missing_reason_entry_found():
+    client = (
+        "from odh_kubeflow_tpu.machinery.store import Conflict\n"
+        "_ERR_BY_CODE = {409: Conflict}\n"
+        "_REASON_TO_ERR = {'Conflict': Conflict}\n"
+    )
+    findings = _round_trip_findings(client)
+    assert any(
+        "round trip is not the identity for NotFound" in f.message
+        and "HTTP 404" in f.message
+        for f in findings
+    )
+    # Conflict maps back to itself — only NotFound breaks the loop
+    assert not any(
+        "not the identity for Conflict" in f.message for f in findings
+    )
+
+
+def test_round_trip_reason_key_class_mismatch_found():
+    client = (
+        "from odh_kubeflow_tpu.machinery.store import Conflict, NotFound\n"
+        "_ERR_BY_CODE = {409: Conflict, 404: NotFound}\n"
+        "_REASON_TO_ERR = {'Conflict': NotFound, 'NotFound': NotFound}\n"
+    )
+    findings = _round_trip_findings(client)
+    assert any(
+        "maps reason 'Conflict' to NotFound" in f.message for f in findings
+    )
+
+
+def test_round_trip_identity_is_clean():
+    client = (
+        "from odh_kubeflow_tpu.machinery.store import Conflict, NotFound\n"
+        "_ERR_BY_CODE = {409: Conflict, 404: NotFound}\n"
+        "_REASON_TO_ERR = {'Conflict': Conflict, 'NotFound': NotFound}\n"
+    )
+    findings = _round_trip_findings(client)
+    assert not any("round trip" in f.message for f in findings)
+    assert not any("maps reason" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# regression drills: revert the PR's fixes, the rule must re-find them
+
+
+@pytest.fixture(scope="module")
+def broken_tree(tmp_path_factory):
+    """A copy of the real package with this PR's duck fixes reverted:
+    the FaultInjector aux pass-through deleted (the satellite-1 gap)
+    and a ReadSplitAPI verb collapsed back to a blind catch-all (the
+    satellite-2 signatures)."""
+    root = tmp_path_factory.mktemp("ducks") / "odh_kubeflow_tpu"
+    shutil.copytree(
+        package_root(),
+        root,
+        ignore=shutil.ignore_patterns("__pycache__", "frontend"),
+    )
+
+    def edit(rel, old, new):
+        p = root / rel
+        text = p.read_text()
+        assert old in text, f"{rel}: expected fragment not found"
+        p.write_text(text.replace(old, new))
+
+    # (1) delete the chaos wrapper's applied_rv pass-through — the
+    #     declared aux surface loses its explicit definition
+    edit(
+        "machinery/faults.py",
+        "    def applied_rv(self) -> Optional[int]:\n"
+        "        return self.api.applied_rv()\n",
+        "",
+    )
+    # (2) revert ReadSplitAPI.get to the pre-PR blind forward
+    edit(
+        "machinery/replica.py",
+        "    def get(self, kind: str, name: str,"
+        " namespace: Optional[str] = None) -> Obj:\n"
+        "        from odh_kubeflow_tpu.machinery.store import NotFound\n"
+        "\n"
+        "        try:\n"
+        "            return self.read_api.get(kind, name, namespace)\n"
+        "        except NotFound:\n"
+        "            return self.write_api.get(kind, name, namespace)\n",
+        "    def get(self, *args, **kwargs):\n"
+        "        return self.read_api.get(*args, **kwargs)\n",
+    )
+    return root
+
+
+@pytest.fixture(scope="module")
+def broken_findings(broken_tree):
+    return run_paths([str(broken_tree)], [RULE])
+
+
+def test_drill_deleted_aux_surface_refound(broken_findings):
+    hits = [
+        f
+        for f in broken_findings
+        if f.path == "machinery/faults.py"
+        and "no explicit `applied_rv`" in f.message
+    ]
+    assert hits, "deleted FaultInjector.applied_rv not re-found"
+    assert "auxiliary surface" in hits[0].message
+
+
+def test_drill_blind_forward_refound(broken_findings):
+    hits = [
+        f
+        for f in broken_findings
+        if f.path == "machinery/replica.py"
+        and "ReadSplitAPI.get" in f.message
+        and "blind *args/**kwargs" in f.message
+    ]
+    assert hits, "reverted ReadSplitAPI.get catch-all not re-found"
+    assert "APIServer.get" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# tier-1 gate: the live tree is clean over an EMPTY baseline
+
+
+def test_live_tree_is_clean():
+    assert run_package(select=[RULE]) == []

@@ -1,0 +1,508 @@
+"""Metadata-protocol registry drift lint (analysis/protocol.py +
+analysis/protocol.json).
+
+Scanner fixtures for every metadata-touch idiom the package uses
+(subscript stores, helper writes, selector dicts, resolvable
+f-strings, prefix constants, ``# protocol-ok:`` markers, status-field
+shapes), the four-way cross-check semantics on synthetic surfaces, the
+``protocol-drift`` rule fixtures, and the regression drills: unmarking
+an external key, reverting the checkpoint uid fence, and stamping an
+unregistered key on a package copy each re-light the lint. The live
+tree is the tier-1 gate: zero violations, zero findings, appendix
+byte-exact."""
+
+import shutil
+
+import pytest
+
+from odh_kubeflow_tpu.analysis import active_rules, lint_source
+from odh_kubeflow_tpu.analysis import protocol
+from odh_kubeflow_tpu.analysis.graftlint import (
+    SourceFile,
+    package_root,
+    run_package,
+    run_paths,
+)
+
+RULE = "protocol-drift"
+
+
+def _scan(text, rel="controllers/x.py", declared=()):
+    src = SourceFile(rel, rel, text)
+    return protocol.scan_sources([src], frozenset(declared))
+
+
+# ---------------------------------------------------------------------------
+# key recognition
+
+
+def test_domain_keys_recognized():
+    assert protocol.is_protocol_key("notebooks.kubeflow.org/last-activity")
+    assert protocol.is_protocol_key("cloud.google.com/gke-tpu-topology")
+    assert protocol.is_protocol_key("app.kubernetes.io/part-of")
+
+
+def test_api_versions_and_non_domains_rejected():
+    assert not protocol.is_protocol_key("kubeflow.org/v1beta1")
+    assert not protocol.is_protocol_key("rbac.authorization.k8s.io/v1")
+    assert not protocol.is_protocol_key("sessions.kubeflow.org/v1alpha1")
+    assert not protocol.is_protocol_key("application/json")  # no dot
+    assert not protocol.is_protocol_key("a/b")
+    assert not protocol.is_protocol_key("kubeflow-resource-stopped")
+
+
+# ---------------------------------------------------------------------------
+# scanner fixtures
+
+
+def test_subscript_write_and_get_read():
+    scan = _scan(
+        "def f(ann):\n"
+        "    ann['example.com/alpha'] = '1'\n"
+        "    return ann.get('example.com/beta')\n"
+    )
+    assert scan.writers("example.com/alpha") == ["controllers/x.py"]
+    assert scan.readers("example.com/alpha") == []
+    assert scan.readers("example.com/beta") == ["controllers/x.py"]
+
+
+def test_module_constant_definition_is_not_a_touch():
+    scan = _scan("K = 'notebooks.kubeflow.org/last-activity'\n")
+    assert "notebooks.kubeflow.org/last-activity" not in scan.keys
+
+
+def test_suffix_constant_registers_bare_value():
+    scan = _scan(
+        "STOP_ANNOTATION = 'kubeflow-resource-stopped'\n"
+        "def f(ann):\n"
+        "    ann[STOP_ANNOTATION] = 'x'\n"
+        "    return STOP_ANNOTATION in ann\n"
+    )
+    assert scan.writers("kubeflow-resource-stopped") == ["controllers/x.py"]
+    assert scan.readers("kubeflow-resource-stopped") == ["controllers/x.py"]
+
+
+def test_fstring_constant_resolved():
+    scan = _scan(
+        "GROUP = 'scheduling.kubeflow.org'\n"
+        "WORKLOAD_LABEL = f'{GROUP}/workload'\n"
+        "def f(labels):\n"
+        "    labels[WORKLOAD_LABEL] = 'y'\n"
+    )
+    assert scan.writers("scheduling.kubeflow.org/workload") == [
+        "controllers/x.py"
+    ]
+
+
+def test_prefix_constant_and_setdefault_write():
+    scan = _scan(
+        "P_ANNOTATION_PREFIX = 'poddefault.kubeflow.org/applied-'\n"
+        "def f(ann, name):\n"
+        "    ann.setdefault(f'{P_ANNOTATION_PREFIX}{name}', '1')\n"
+    )
+    key = "poddefault.kubeflow.org/applied-"
+    assert key in scan.prefixes
+    assert scan.writers(key) == ["controllers/x.py"]
+
+
+def test_helper_calls_write_and_membership_reads():
+    scan = _scan(
+        "def f(nb, obj_util, api, arn, ann):\n"
+        "    obj_util.set_annotation(nb, 'example.com/stamped', 'v')\n"
+        "    _stamp_editor_sa(api, 'iam.example.com/role', arn)\n"
+        "    return 'example.com/probe' in ann\n"
+    )
+    assert scan.writers("example.com/stamped") == ["controllers/x.py"]
+    assert scan.writers("iam.example.com/role") == ["controllers/x.py"]
+    assert scan.readers("example.com/probe") == ["controllers/x.py"]
+
+
+def test_selector_positions_are_reads():
+    scan = _scan(
+        "def f(api):\n"
+        "    api.list('Pod', label_selector={'example.com/sel': 'v'})\n"
+        "    svc = {'spec': {'selector': {'example.com/svc': 'v'}}}\n"
+        "    np = {'podSelector': {'matchLabels': {'example.com/np': 'v'}}}\n"
+        "    return svc, np\n"
+    )
+    for key in ("example.com/sel", "example.com/svc", "example.com/np"):
+        assert scan.readers(key) == ["controllers/x.py"], key
+        assert scan.writers(key) == []
+
+
+def test_metadata_dict_literal_is_a_write():
+    scan = _scan(
+        "def f():\n"
+        "    return {'metadata': {'labels': {'example.com/built': 'v'}}}\n"
+    )
+    assert scan.writers("example.com/built") == ["controllers/x.py"]
+
+
+def test_marker_detected_on_statement_and_line_above():
+    scan = _scan(
+        "def f(ann):\n"
+        "    # protocol-ok: externally consumed\n"
+        "    ann['example.com/ext'] = '1'\n"
+        "    ann['example.com/raw'] = '2'  # protocol-ok: also marked\n"
+        "    ann['example.com/bare'] = '3'\n"
+    )
+    assert all(s.marked for s in scan.keys["example.com/ext"])
+    assert all(s.marked for s in scan.keys["example.com/raw"])
+    assert not any(s.marked for s in scan.keys["example.com/bare"])
+
+
+def test_status_field_shapes(tmp_path):
+    scan = _scan(
+        "def f(ckpt, wl, obj_util):\n"
+        "    ckpt['status']['phase'] = 'Suspended'\n"
+        "    wl['status'].update({'state': 'Admitted'})\n"
+        "    probe = (ckpt.get('status') or {}).get('phase')\n"
+        "    deep = obj_util.get_path(wl, 'status', 'state', default='')\n"
+        "    return probe, deep\n",
+        declared=("phase", "state"),
+    )
+    for field in ("phase", "state"):
+        accesses = {s.access for s in scan.status[field]}
+        assert accesses == {"write", "read"}, field
+
+
+def test_undeclared_status_fields_ignored():
+    scan = _scan(
+        "def f(ckpt):\n"
+        "    ckpt['status']['whatever'] = 1\n",
+        declared=("phase",),
+    )
+    assert scan.status == {}
+
+
+# ---------------------------------------------------------------------------
+# registry wellformedness (the committed protocol.json)
+
+
+def test_registry_wellformed():
+    reg = protocol.load_registry()
+    keys = [e["key"] for e in reg["keys"]]
+    assert len(keys) == len(set(keys)), "duplicate registry keys"
+    assert len(keys) >= 45, "registry lost keys"
+    for e in reg["keys"]:
+        assert e.get("type") in ("annotation", "label", "resource"), e["key"]
+        for field in ("rides_on", "description", "writers", "readers"):
+            assert field in e, f"{e['key']} missing {field}"
+        assert e["writers"] == sorted(e["writers"]), e["key"]
+        assert e["readers"] == sorted(e["readers"]), e["key"]
+    fields = [e["field"] for e in reg.get("status_fields", [])]
+    assert len(fields) == len(set(fields))
+    assert len(fields) >= 3
+
+
+# ---------------------------------------------------------------------------
+# cross-check semantics (synthetic surfaces)
+
+
+def _entry(key, **kw):
+    e = {
+        "key": key,
+        "type": "annotation",
+        "rides_on": "Notebook",
+        "description": "d",
+        "writers": [],
+        "readers": [],
+    }
+    e.update(kw)
+    return e
+
+
+def _reg(*entries, status=()):
+    return {"keys": list(entries), "status_fields": list(status)}
+
+
+def _guide(reg):
+    lines = [protocol.APPENDIX_HEADING]
+    lines += [protocol.appendix_row(e) for e in reg["keys"]]
+    lines += [protocol.status_row(e) for e in reg.get("status_fields", [])]
+    return "\n".join(lines) + "\n"
+
+
+def _site(rel, access, marked=False, line=1):
+    return protocol.Site(rel, line, access, marked)
+
+
+def _mk_scan(*adds, prefixes=()):
+    scan = protocol.Scan()
+    for key, site in adds:
+        scan.add(key, site)
+    scan.prefixes.update(prefixes)
+    return scan
+
+
+def _violations(reg, scan):
+    return protocol.protocol_violations(
+        registry=reg, guide=_guide(reg), scan=scan
+    )
+
+
+def test_undocumented_key_fails():
+    scan = _mk_scan(("example.com/new", _site("a.py", "write")))
+    out = _violations(_reg(), scan)
+    assert len(out) == 1
+    assert "undocumented metadata key 'example.com/new'" in out[0]
+    assert "a.py" in out[0]
+
+
+def test_phantom_key_fails():
+    reg = _reg(_entry("example.com/gone"))
+    out = _violations(reg, _mk_scan())
+    assert len(out) == 1
+    assert "phantom metadata key 'example.com/gone'" in out[0]
+
+
+def test_unmarked_orphan_writer_fails_and_marked_external_is_exempt():
+    reg = _reg(_entry("example.com/w", writers=["a.py"]))
+    scan = _mk_scan(("example.com/w", _site("a.py", "write")))
+    out = _violations(reg, scan)
+    assert len(out) == 1 and "orphan metadata key 'example.com/w'" in out[0]
+    # marked in code AND declared external in the registry → clean
+    reg = _reg(
+        _entry("example.com/w", writers=["a.py"], external="audit trail")
+    )
+    scan = _mk_scan(("example.com/w", _site("a.py", "write", marked=True)))
+    assert _violations(reg, scan) == []
+
+
+def test_external_entry_without_code_marker_fails():
+    reg = _reg(
+        _entry("example.com/r", readers=["a.py"], external="user-set")
+    )
+    scan = _mk_scan(("example.com/r", _site("a.py", "read")))
+    out = _violations(reg, scan)
+    assert any("marked external in the registry but no touch site" in v
+               for v in out)
+
+
+def test_writers_readers_drift_fails():
+    reg = _reg(_entry("example.com/k", writers=["b.py"], readers=["c.py"]))
+    scan = _mk_scan(
+        ("example.com/k", _site("a.py", "write")),
+        ("example.com/k", _site("c.py", "read")),
+    )
+    out = _violations(reg, scan)
+    assert len(out) == 1
+    assert "registry writers ['b.py'] != scanned ['a.py']" in out[0]
+    assert "--sync-registry" in out[0]
+
+
+def test_resource_type_exempt_from_orphan_analysis():
+    reg = _reg(
+        _entry("example.com/chips", type="resource", writers=["a.py"])
+    )
+    scan = _mk_scan(("example.com/chips", _site("a.py", "write")))
+    assert _violations(reg, scan) == []
+
+
+def test_prefix_entry_covers_extended_keys():
+    reg = _reg(
+        _entry(
+            "p.example.com/applied-",
+            prefix=True,
+            writers=["a.py"],
+            external="audit trail",
+        )
+    )
+    scan = _mk_scan(
+        ("p.example.com/applied-foo", _site("a.py", "write", marked=True)),
+        prefixes={"p.example.com/applied-"},
+    )
+    assert _violations(reg, scan) == []
+
+
+def test_declared_status_field_needs_live_ends():
+    reg = _reg(
+        status=[
+            {
+                "field": "phase",
+                "rides_on": "SessionCheckpoint",
+                "description": "d",
+                "writers": [],
+                "readers": [],
+            }
+        ]
+    )
+    out = _violations(reg, _mk_scan())
+    assert len(out) == 2
+    assert any("no package writer found" in v for v in out)
+    assert any("no package reader found" in v for v in out)
+
+
+def test_missing_appendix_and_stale_row_fail():
+    reg = _reg(
+        _entry(
+            "example.com/k",
+            writers=["a.py"],
+            readers=["b.py"],
+        )
+    )
+    scan = _mk_scan(
+        ("example.com/k", _site("a.py", "write")),
+        ("example.com/k", _site("b.py", "read")),
+    )
+    out = protocol.protocol_violations(registry=reg, guide="", scan=scan)
+    assert len(out) == 1 and "missing the" in out[0]
+    stale = protocol.APPENDIX_HEADING + "\n| `example.com/k` | old row |\n"
+    out = protocol.protocol_violations(registry=reg, guide=stale, scan=scan)
+    assert len(out) == 1 and "appendix row is stale" in out[0]
+
+
+def test_render_appendix_contains_every_row_and_is_stable():
+    reg = protocol.load_registry()
+    text = protocol.render_appendix(reg)
+    assert text == protocol.render_appendix(reg)
+    for e in reg["keys"]:
+        assert protocol.appendix_row(e) in text
+    for e in reg["status_fields"]:
+        assert protocol.status_row(e) in text
+    for heading in ("### Annotations", "### Labels", "### Status fields"):
+        assert heading in text
+
+
+# ---------------------------------------------------------------------------
+# the protocol-drift rule (graftlint surface)
+
+
+def test_rule_catalog_has_protocol_drift():
+    assert {r.id for r in active_rules()} >= {RULE}
+
+
+def test_unregistered_key_flagged_with_site_anchor():
+    src = (
+        "def f(ann):\n"
+        "    ann['example.test/zzz-unregistered'] = '1'\n"
+    )
+    findings = lint_source(src, "controllers/x.py", [RULE])
+    assert len(findings) == 1
+    assert findings[0].rule == RULE
+    assert findings[0].line == 2
+    assert "not in the protocol registry" in findings[0].message
+
+
+def test_suppression_silences_the_rule():
+    src = (
+        "def f(ann):\n"
+        "    ann['example.test/zzz-unregistered'] = '1'  "
+        "# graftlint: disable=protocol-drift fixture\n"
+    )
+    assert lint_source(src, "controllers/x.py", [RULE]) == []
+
+
+def test_registered_key_is_clean_in_fixture_mode():
+    src = (
+        "def f(ann, ts):\n"
+        "    ann['notebooks.kubeflow.org/last-activity'] = ts\n"
+    )
+    assert lint_source(src, "controllers/x.py", [RULE]) == []
+
+
+# ---------------------------------------------------------------------------
+# regression drills: break the protocol on a package copy
+
+
+@pytest.fixture(scope="module")
+def drifted_tree(tmp_path_factory):
+    """A copy of the real package with three protocol regressions:
+    the oversubscription external marker dropped, the checkpoint uid
+    fence (this PR's orphan fix) reverted, and a write of a key nobody
+    registered."""
+    root = tmp_path_factory.mktemp("proto") / "odh_kubeflow_tpu"
+    shutil.copytree(
+        package_root(),
+        root,
+        ignore=shutil.ignore_patterns("__pycache__", "frontend"),
+    )
+
+    def edit(rel, old, new):
+        p = root / rel
+        text = p.read_text()
+        assert old in text, f"{rel}: expected fragment not found"
+        p.write_text(text.replace(old, new))
+
+    # (1) drop the external marker from the quota annotation read
+    edit(
+        "scheduling/queue.py",
+        "# protocol-ok: operator-set on the quota",
+        "# operator-set on the quota",
+    )
+    # (2) revert the uid fence: the notebook-uid label is written at
+    #     checkpoint creation but nothing reads it back
+    edit(
+        "sessions/__init__.py",
+        '    want = obj_util.meta(notebook).get("uid", "")\n'
+        '    have = obj_util.labels_of(ckpt).get(NOTEBOOK_UID_LABEL, "")\n'
+        "    if want and have and want != have:\n"
+        "        return None\n"
+        "    return ckpt\n",
+        "    return ckpt\n",
+    )
+    # (3) stamp a key that is in no registry
+    pool = root / "warmup" / "pool.py"
+    pool.write_text(
+        pool.read_text()
+        + "\n\ndef _drill_stamp(meta):\n"
+        '    meta["example.test/drill-key"] = "1"\n'
+    )
+    return root
+
+
+@pytest.fixture(scope="module")
+def drifted_violations(drifted_tree):
+    return protocol.protocol_violations(root=str(drifted_tree))
+
+
+def test_drill_unmarked_external_key_refound(drifted_violations):
+    key = "scheduling.kubeflow.org/oversubscription-factor"
+    assert any(
+        f"metadata key {key!r} is marked external" in v
+        for v in drifted_violations
+    )
+    assert any(
+        f"orphan metadata key {key!r}" in v for v in drifted_violations
+    )
+
+
+def test_drill_reverted_uid_fence_refound(drifted_violations):
+    key = "sessions.kubeflow.org/notebook-uid"
+    orphan = [
+        v for v in drifted_violations if f"orphan metadata key {key!r}" in v
+    ]
+    assert orphan and "sessions/__init__.py" in orphan[0]
+    assert any(
+        f"metadata key {key!r}: registry readers" in v
+        for v in drifted_violations
+    )
+
+
+def test_drill_unregistered_key_refound(drifted_violations, drifted_tree):
+    assert any(
+        "undocumented metadata key 'example.test/drill-key'" in v
+        and "warmup/pool.py" in v
+        for v in drifted_violations
+    )
+    # and through the graftlint rule, anchored at the write site
+    findings = run_paths([str(drifted_tree)], [RULE])
+    hits = [
+        f
+        for f in findings
+        if f.path == "warmup/pool.py"
+        and "'example.test/drill-key'" in f.message
+    ]
+    assert hits and hits[0].rule == RULE
+
+
+# ---------------------------------------------------------------------------
+# tier-1 gates: the live tree is clean over an EMPTY baseline
+
+
+def test_live_tree_has_no_protocol_violations():
+    assert protocol.protocol_violations() == []
+
+
+def test_live_tree_rule_is_clean():
+    assert run_package(select=[RULE]) == []
