@@ -7,7 +7,7 @@
 # the cwd lands on sys.path instead.
 PYTHON ?= python
 
-.PHONY: all test test-unit test-manifests lint sanitize chaos durability explore fleetbench replicabench partitionbench zonedrill usagebench warmbench obs loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
+.PHONY: all test test-unit test-manifests lint sanitize chaos durability explore fleetbench replicabench partitionbench overloadbench zonedrill usagebench warmbench obs loadtest images bench dryrun platform serve spawn-latency suspend-bench webbench native kind-smoke conformance
 
 all: lint test
 
@@ -125,6 +125,19 @@ partitionbench:
 	$(PYTHON) loadtest/control_plane_bench.py --partition --notebooks 2000 \
 	  --partitions 4 --out /tmp/partitionbench.json
 	$(PYTHON) -m pytest -q tests/test_partition.py
+
+# overload-defense axis (docs/GUIDE.md "Overload defense"): the seeded
+# metastable-failure drill — a 4x-capacity burst with one
+# latency-poisoned partition — gated on burst goodput (>= 70% of
+# baseline), retry amplification (<= 1.3x), system-traffic p99 under
+# flood, recovery within 10s of burst end, and seed-exact replay;
+# then the deadline/budget/breaker/priority unit suite under the
+# sanitizer. Writes to a scratch copy of the bench JSON.
+overloadbench:
+	cp BENCH_control_plane.json /tmp/overloadbench.json
+	$(PYTHON) loadtest/control_plane_bench.py --overload \
+	  --out /tmp/overloadbench.json
+	GRAFT_SANITIZE=1 $(PYTHON) -m pytest -q tests/test_overload.py
 
 # zone failure-domain drills (docs/GUIDE.md "Zones & failure
 # domains"): replicated-checkpoint write-all/heal, zone-spread

@@ -1998,6 +1998,24 @@ def bench_recovery(
     }
 
 
+# ---------------------------------------------------------------------------
+# overload-defense axis (`make overloadbench` runs it plus
+# tests/test_overload.py)
+
+
+def bench_overload(seed: int | None = None) -> dict:
+    """The overload-defense axis: the seeded metastable-failure drill
+    from :mod:`loadtest.overload_drill` — a 4x-capacity burst with one
+    latency-poisoned partition, gated on burst goodput, retry
+    amplification, system-traffic p99 under flood, recovery time, and
+    seed-exact replay. See that module's docstring for the drill
+    anatomy; this wrapper just merges its result into the bench JSON
+    under the ``overload`` key."""
+    from loadtest.overload_drill import DEFAULT_SEED, run_drill
+
+    return run_drill(seed=DEFAULT_SEED if seed is None else seed)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--notebooks", type=int, default=500)
@@ -2112,6 +2130,22 @@ def main() -> None:
         "sets N; admit/sample/release hook cost vs a status write, "
         "flush cost per UsageRecord) and merge it into --out under the "
         "`usage` key; exits nonzero when the ≤2% overhead gate fails",
+    )
+    parser.add_argument(
+        "--overload",
+        action="store_true",
+        help="run ONLY the overload-defense axis (the seeded "
+        "metastable-failure drill: 4x burst + one latency-poisoned "
+        "partition) and merge it into --out under the `overload` key; "
+        "exits nonzero when a goodput/amplification/priority/recovery "
+        "gate fails",
+    )
+    parser.add_argument(
+        "--overload-seed",
+        type=int,
+        default=None,
+        help="drill seed (default: the drill's pinned seed, or "
+        "GRAFT_CHAOS when running standalone)",
     )
     parser.add_argument(
         "--recovery",
@@ -2299,6 +2333,41 @@ def main() -> None:
         if not usage["gates"]["passed"]:
             print(
                 "USAGE GATE FAILURES: " + "; ".join(usage["gates"]["failures"]),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        return
+
+    if args.overload:
+        overload_axis = bench_overload(seed=args.overload_seed)
+        merged = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                merged = json.load(f)
+        merged["overload"] = overload_axis
+        with open(args.out, "w") as f:
+            json.dump(merged, f, indent=2)
+        print(json.dumps({"overload": overload_axis}, indent=2))
+        base = overload_axis["baseline"]
+        burst = overload_axis["burst"]
+        print(
+            f"\noverload @ seed {overload_axis['seed']} (plan "
+            f"{overload_axis['plan_digest']}): baseline "
+            f"{base['goodput_per_s']}/s -> burst goodput "
+            f"{burst['goodput_per_s']}/s "
+            f"({burst['goodput_pct_of_baseline']}%, gate >= 70%) | "
+            f"amplification {burst['retry_amplification']}x "
+            "(gate <= 1.3x) | system p99 "
+            f"{base['system_p99_ms']} -> {burst['system_p99_ms']}ms "
+            f"(gate <= {burst['system_p99_gate_ms']}ms) | system "
+            f"admitted {burst['system_admit_pct']}% vs background "
+            f"shed {burst['background_shed_pct']}% | recovered in "
+            f"{overload_axis['recovery_s']}s (gate <= 10s)"
+        )
+        if not overload_axis["gates"]["passed"]:
+            print(
+                "OVERLOAD GATE FAILURES: "
+                + "; ".join(overload_axis["gates"]["failures"]),
                 file=sys.stderr,
             )
             sys.exit(1)

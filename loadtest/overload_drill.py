@@ -1,0 +1,510 @@
+"""Seeded metastable-failure drill: the overload-defense layer under a
+4x-capacity burst with one latency-poisoned partition.
+
+The classic metastable shape (Bronson et al., HotOS'21): a load spike
+plus one slow dependency, and an undefended fleet tips into a
+self-sustaining retry storm — goodput collapses and STAYS collapsed
+after the trigger clears. This drill replays that weather against the
+machinery/overload.py defenses and gates on the four properties that
+keep the failure from going metastable:
+
+- **goodput**: in-deadline successes during the burst stay >= 70% of
+  the pre-overload throughput (breakers fail the poisoned partition
+  fast instead of letting it drag every worker down);
+- **retry amplification**: total backend attempts / admitted logical
+  requests <= 1.3x (the shared retry budget — an undefended policy
+  retries every breaker shed and lands ~1.7x);
+- **priority isolation**: system-traffic p99 during the burst within
+  25% of its unloaded p99 (with a 10ms absolute floor for scheduler
+  noise on busy CI hosts), and system admission survives the flood
+  that sheds background traffic;
+- **recovery**: throughput back to >= 95% of baseline within 10s of
+  the burst ending (no metastable tail — breakers half-open, probe,
+  and close).
+
+Every scheduling decision (priority mix, key targeting) comes from one
+``random.Random(seed)`` and the fault injector derives per-thread rngs
+from the same seed, so the drill replays from its seed: the gate
+regenerates the workload plan and asserts it is bit-identical.
+
+Run: ``python -m loadtest.overload_drill`` (``make overloadbench``
+wraps it plus the pytest overload suite); merged into
+``BENCH_control_plane.json`` under the ``overload`` key by
+``control_plane_bench --overload``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import random
+import sys
+import threading
+import time
+from typing import Any, Optional
+
+DEFAULT_SEED = 20260807
+
+# per-level end-to-end deadlines (seconds). The system deadline sits
+# BELOW the injected partition latency on purpose: a lease renewal
+# that comes back after its window is useless, so a poisoned-partition
+# response must never count as system goodput. Every deadline sits
+# below the breaker cooldown, so a retry against an open breaker can
+# never sleep out the Retry-After hint while holding an admission
+# seat — backoff.retry surfaces the error instead.
+LEVEL_DEADLINES = (0.025, 0.05, 0.1, 0.1)
+
+INJECTED_LATENCY_S = 0.04
+POISONED_PARTITION = 0
+# 4 partitions, one poisoned: a quarter of the keyspace is behind the
+# latency cliff — the defended fleet must keep the other three at speed
+N_PARTITIONS = 4
+
+# every backend call carries a paced service time, so capacity is
+# seat-seconds (like a real fleet) rather than the GIL: a worker
+# parked in a service sleep yields, and the drill's concurrency —
+# admission seats held across the injected 40ms stalls vs reclaimed by
+# the breaker's fast-fail — is what the goodput gate measures
+SERVICE_TIME_S = 0.001
+
+
+class _Paced:
+    """APIServer duck adding ``SERVICE_TIME_S`` of service time to
+    reads; everything else delegates untouched."""
+
+    def __init__(self, api: Any):
+        self._api = api
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._api, name)
+
+    def get(self, *args: Any, **kwargs: Any) -> Any:
+        time.sleep(SERVICE_TIME_S)
+        return self._api.get(*args, **kwargs)
+
+# knob tuning for the compressed timescale. Breakers: injected 40ms
+# calls must read as slow, the pre-burst success history must age out
+# of the rolling window fast (a ratio breaker with a long window full
+# of healthy-era successes is blind to a fresh latency cliff), and
+# recovery must fit the 10s gate. APF ceilings: the default 90%
+# controller ceiling leaves a single system-exclusive seat at drill
+# scale — one in-flight lease renewal would block the next — so the
+# drill widens the system band the way a real deployment sizes its
+# APF levels against system-traffic concurrency demand.
+DRILL_ENV = {
+    "BREAKER_SLOW_SECONDS": "0.02",
+    "BREAKER_MIN_REQUESTS": "5",
+    "BREAKER_COOLDOWN_SECONDS": "0.25",
+    "BREAKER_WINDOW_SECONDS": "0.5",
+    "APF_LEVEL_SYSTEM": "100",
+    "APF_LEVEL_CONTROLLER": "70",
+    "APF_LEVEL_USER": "50",
+    "APF_LEVEL_BACKGROUND": "30",
+}
+
+
+def _pctl(samples: list[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    xs = sorted(samples)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def build_plan(
+    seed: int, namespaces: list[str], names: list[str], n_items: int
+) -> list[tuple[int, str, str]]:
+    """The seeded workload plan: ``(level, namespace, name)`` per
+    logical request. Pure function of its inputs — the replay gate
+    regenerates it and asserts bit-identical."""
+    from odh_kubeflow_tpu.machinery import overload
+
+    rng = random.Random(seed)
+    levels = (
+        [overload.LEVEL_SYSTEM] * 10
+        + [overload.LEVEL_CONTROLLER] * 20
+        + [overload.LEVEL_USER] * 50
+        + [overload.LEVEL_BACKGROUND] * 20
+    )
+    return [
+        (rng.choice(levels), rng.choice(namespaces), rng.choice(names))
+        for _ in range(n_items)
+    ]
+
+
+def plan_digest(seed: int, plan: list[tuple[int, str, str]]) -> str:
+    h = hashlib.sha256(repr((seed, plan)).encode())
+    return h.hexdigest()[:16]
+
+
+class _Phase:
+    """Shared state for one measured phase: a cursor over the plan plus
+    per-level outcome accounting."""
+
+    def __init__(self, plan: list[tuple[int, str, str]]):
+        self.plan = plan
+        self._cursor = 0
+        self._lock = threading.Lock()
+        self.stop = threading.Event()
+        self.admitted = 0
+        self.attempts = 0
+        self.shed_admission = [0, 0, 0, 0]
+        self.offered = [0, 0, 0, 0]
+        self.ok_in_deadline = [0, 0, 0, 0]
+        self.ok_late = 0
+        self.errors = 0
+        self.latency_ms: dict[int, list[float]] = {0: [], 1: [], 2: [], 3: []}
+
+    def next_item(self) -> Optional[tuple[int, str, str]]:
+        with self._lock:
+            if self._cursor >= len(self.plan):
+                return None
+            item = self.plan[self._cursor]
+            self._cursor += 1
+            return item
+
+    def record(
+        self,
+        level: int,
+        ok: bool,
+        in_deadline: bool,
+        elapsed_ms: float,
+        attempts: int,
+    ) -> None:
+        with self._lock:
+            self.admitted += 1
+            self.attempts += attempts
+            if ok and in_deadline:
+                self.ok_in_deadline[level] += 1
+                self.latency_ms[level].append(elapsed_ms)
+            elif ok:
+                self.ok_late += 1
+            else:
+                self.errors += 1
+
+    def goodput(self) -> int:
+        return sum(self.ok_in_deadline)
+
+
+def _worker(phase: _Phase, limiter, router, budget, wid: int) -> None:
+    from odh_kubeflow_tpu.machinery import backoff, overload
+    from odh_kubeflow_tpu.machinery.store import (
+        APIError,
+        DeadlineExceeded,
+        TooManyRequests,
+    )
+
+    def transient(e: BaseException) -> bool:
+        if isinstance(e, DeadlineExceeded):
+            return False
+        if isinstance(e, TooManyRequests):
+            return True
+        return isinstance(e, APIError) and getattr(e, "code", 500) >= 500
+
+    while not phase.stop.is_set():
+        item = phase.next_item()
+        if item is None:
+            return
+        level, ns, name = item
+        with phase._lock:
+            phase.offered[level] += 1
+        with overload.deadline_scope(LEVEL_DEADLINES[level]):
+            try:
+                admitted = limiter.try_acquire(
+                    overload.LEVEL_NAMES[level], level=level
+                )
+            except DeadlineExceeded:
+                admitted = False
+            if not admitted:
+                with phase._lock:
+                    phase.shed_admission[level] += 1
+                time.sleep(0.001)  # don't spin the GIL on a full pool
+                continue
+            tries = [0]
+
+            def op():
+                tries[0] += 1
+                return router.get("Notebook", name, ns)
+
+            t0 = time.monotonic()
+            ok = True
+            try:
+                backoff.retry(
+                    op,
+                    retryable=transient,
+                    attempts=3,
+                    base=0.001,
+                    cap=0.004,
+                    budget=budget,
+                )
+            except (APIError, ValueError):
+                ok = False
+            finally:
+                limiter.release(overload.LEVEL_NAMES[level])
+            elapsed = time.monotonic() - t0
+            phase.record(
+                level,
+                ok,
+                elapsed <= LEVEL_DEADLINES[level],
+                elapsed * 1000.0,
+                tries[0],
+            )
+
+
+def _run_phase(
+    plan, limiter, router, budget, workers: int, duration: float
+) -> tuple[_Phase, float]:
+    phase = _Phase(plan)
+    threads = [
+        threading.Thread(
+            target=_worker, args=(phase, limiter, router, budget, i)
+        )
+        for i in range(workers)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(duration)
+    phase.stop.set()
+    for t in threads:
+        t.join()
+    return phase, time.monotonic() - t0
+
+
+def run_drill(
+    seed: int = DEFAULT_SEED,
+    workers: int = 3,
+    burst_factor: int = 4,
+    base_duration: float = 1.0,
+    burst_duration: float = 2.5,
+    recovery_limit_s: float = 10.0,
+) -> dict[str, Any]:
+    from odh_kubeflow_tpu.apis import register_crds
+    from odh_kubeflow_tpu.machinery import overload
+    from odh_kubeflow_tpu.machinery.faults import (
+        FaultInjector,
+        FaultSchedule,
+    )
+    from odh_kubeflow_tpu.machinery.httpapi import InflightLimiter
+    from odh_kubeflow_tpu.machinery.partition import (
+        PartitionRouter,
+        partition_of,
+    )
+    from odh_kubeflow_tpu.machinery.store import APIServer
+    from odh_kubeflow_tpu.utils import prometheus
+
+    saved_env = {k: os.environ.get(k) for k in DRILL_ENV}
+    os.environ.update(DRILL_ENV)
+    try:
+        registry = prometheus.Registry()
+        backends: dict[int, Any] = {}
+        injector = None
+        for p in range(N_PARTITIONS):
+            api = APIServer()
+            register_crds(api)
+            if p == POISONED_PARTITION:
+                injector = FaultInjector(
+                    _Paced(api), seed=seed,
+                    schedule=FaultSchedule.none(), registry=registry,
+                )
+                backends[p] = injector
+            else:
+                backends[p] = _Paced(api)
+        router = PartitionRouter(backends)
+
+        # two namespaces per partition so a quarter of the traffic
+        # hits the poisoned one; the mapping is HRW over the namespace
+        # string, stable across runs
+        by_partition: dict[int, list[str]] = {
+            p: [] for p in range(N_PARTITIONS)
+        }
+        i = 0
+        while any(len(v) < 2 for v in by_partition.values()):
+            ns = f"ns-{i}"
+            p = partition_of(ns, N_PARTITIONS)
+            if len(by_partition[p]) < 2:
+                by_partition[p].append(ns)
+            i += 1
+        namespaces = sorted(ns for v in by_partition.values() for ns in v)
+        names = [f"nb-{j}" for j in range(8)]
+        for ns in namespaces:
+            for name in names:
+                router.create({
+                    "apiVersion": "kubeflow.org/v1beta1",
+                    "kind": "Notebook",
+                    "metadata": {"name": name, "namespace": ns},
+                    "spec": {"template": {"spec": {"containers": [
+                        {"name": name, "image": "jax:latest"}
+                    ]}}},
+                })
+
+        # limit 10 with the drill's APF knobs -> level ceilings
+        # (10, 7, 5, 3): user traffic can only ever fill half the
+        # pool, and the 7->10 band is reachable by system traffic
+        # alone — real admission headroom, not one emergency seat
+        limiter = InflightLimiter(limit=10, registry=registry)
+        budget = overload.RetryBudget(
+            ratio=0.1, cap=20.0, registry=registry
+        )
+
+        plan = build_plan(seed, namespaces, names, n_items=200_000)
+        digest = plan_digest(seed, plan)
+        replay = build_plan(seed, namespaces, names, n_items=200_000)
+        replays_exactly = (
+            replay == plan and plan_digest(seed, replay) == digest
+        )
+        del replay
+
+        # warmup: absorb first-touch costs (imports, allocator, lock
+        # inflation) so they don't land in the baseline percentile
+        _run_phase(plan, limiter, router, budget, workers, 0.2)
+
+        # ---- act 1: unloaded baseline ---------------------------------
+        base, base_elapsed = _run_phase(
+            plan, limiter, router, budget, workers, base_duration
+        )
+        baseline_rps = base.goodput() / base_elapsed
+        sys_p99_unloaded = _pctl(base.latency_ms[0], 0.99)
+
+        # ---- act 2: 4x burst + one latency-poisoned partition ----------
+        # let the baseline-era successes age out of the breaker window
+        # first: the burst must start from a representative steady
+        # state, not one where a healthy-history ratio masks the cliff
+        time.sleep(float(DRILL_ENV["BREAKER_WINDOW_SECONDS"]))
+        assert injector is not None
+        injector.set_schedule(
+            FaultSchedule(
+                latency=0.95,
+                latency_seconds=INJECTED_LATENCY_S,
+                server_error=0.25,
+            )
+        )
+        burst, burst_elapsed = _run_phase(
+            plan, limiter, router, budget,
+            workers * burst_factor, burst_duration,
+        )
+        injector.set_schedule(FaultSchedule.none())
+        burst_end = time.monotonic()
+        goodput_rps = burst.goodput() / burst_elapsed
+        amplification = (
+            burst.attempts / burst.admitted if burst.admitted else 1.0
+        )
+        sys_p99_burst = _pctl(burst.latency_ms[0], 0.99)
+        sys_offered = burst.offered[0]
+        sys_admit_pct = (
+            100.0 * (1 - burst.shed_admission[0] / sys_offered)
+            if sys_offered else 100.0
+        )
+        bg_offered = burst.offered[3]
+        bg_shed_pct = (
+            100.0 * burst.shed_admission[3] / bg_offered
+            if bg_offered else 0.0
+        )
+
+        # ---- act 3: recovery -------------------------------------------
+        recovery_s = None
+        while time.monotonic() - burst_end < recovery_limit_s:
+            win, win_elapsed = _run_phase(
+                plan, limiter, router, budget, workers, 0.25
+            )
+            if win.goodput() / win_elapsed >= 0.95 * baseline_rps:
+                recovery_s = round(time.monotonic() - burst_end, 3)
+                break
+
+        sys_p99_gate_ms = round(max(1.25 * sys_p99_unloaded, 10.0), 3)
+        gates = {
+            "goodput_ge_70pct_of_baseline": goodput_rps
+            >= 0.7 * baseline_rps,
+            "retry_amplification_le_1.3x": amplification <= 1.3,
+            "system_p99_within_gate": sys_p99_burst <= sys_p99_gate_ms,
+            "system_admission_survives_flood": sys_admit_pct >= 95.0
+            and bg_shed_pct > (100.0 - sys_admit_pct),
+            "recovered_within_10s": recovery_s is not None,
+            "replays_exactly_from_seed": replays_exactly,
+        }
+        return {
+            "seed": seed,
+            "plan_digest": digest,
+            "workers": workers,
+            "burst_factor": burst_factor,
+            "partitions": N_PARTITIONS,
+            "poisoned_partition": POISONED_PARTITION,
+            "injected_latency_ms": INJECTED_LATENCY_S * 1000.0,
+            "baseline": {
+                "goodput_per_s": round(baseline_rps, 1),
+                "system_p99_ms": round(sys_p99_unloaded, 3),
+            },
+            "burst": {
+                "goodput_per_s": round(goodput_rps, 1),
+                "goodput_pct_of_baseline": round(
+                    100.0 * goodput_rps / baseline_rps, 1
+                )
+                if baseline_rps
+                else 0.0,
+                "admitted": burst.admitted,
+                "backend_attempts": burst.attempts,
+                "retry_amplification": round(amplification, 3),
+                "system_p99_ms": round(sys_p99_burst, 3),
+                "system_p99_gate_ms": sys_p99_gate_ms,
+                "system_admit_pct": round(sys_admit_pct, 1),
+                "background_shed_pct": round(bg_shed_pct, 1),
+                "ok_late": burst.ok_late,
+                "errors": burst.errors,
+                "faults_injected": int(
+                    injector.m_faults.value({"kind": "latency"})
+                ),
+            },
+            "recovery_s": recovery_s,
+            "retry_budget": {
+                "spent": int(
+                    registry.counter(
+                        "retry_budget_spent_total", "x"
+                    ).value()
+                ),
+                "exhausted": int(
+                    registry.counter(
+                        "retry_budget_exhausted_total", "x"
+                    ).value()
+                ),
+            },
+            "gates": {
+                "passed": all(gates.values()),
+                "failures": sorted(k for k, v in gates.items() if not v),
+                **{k: bool(v) for k, v in gates.items()},
+            },
+        }
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def main() -> int:
+    seed = int(os.environ.get("GRAFT_CHAOS", "") or DEFAULT_SEED)
+    result = run_drill(seed=seed)
+    base, burst = result["baseline"], result["burst"]
+    print(
+        f"overload drill @ seed {seed} (plan {result['plan_digest']}): "
+        f"baseline {base['goodput_per_s']}/s -> burst goodput "
+        f"{burst['goodput_per_s']}/s "
+        f"({burst['goodput_pct_of_baseline']}%, gate >= 70%) | "
+        f"amplification {burst['retry_amplification']}x (gate <= 1.3x) | "
+        f"system p99 {base['system_p99_ms']} -> {burst['system_p99_ms']}ms "
+        f"(gate <= {burst['system_p99_gate_ms']}ms) | system admitted "
+        f"{burst['system_admit_pct']}% vs background shed "
+        f"{burst['background_shed_pct']}% | recovered in "
+        f"{result['recovery_s']}s (gate <= 10s)"
+    )
+    if not result["gates"]["passed"]:
+        print(
+            "OVERLOAD GATE FAILURES: "
+            + "; ".join(result["gates"]["failures"]),
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

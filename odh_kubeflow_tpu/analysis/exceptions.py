@@ -101,6 +101,7 @@ DEFAULT_HIERARCHY: dict[str, Optional[str]] = {
     "Denied": "APIError",
     "Unauthorized": "APIError",
     "TooManyRequests": "APIError",
+    "DeadlineExceeded": "APIError",
     "Expired": "APIError",
     "FencedOut": "APIError",
     "NotLeader": "APIError",
@@ -119,7 +120,11 @@ FENCING = frozenset({"FencedOut", "NotLeader"})
 # unregistered kind (the "subsystem not installed" contract callers
 # probe with `except NotFound`).
 _VERB_COMMON = frozenset(
-    {"NotFound", "Denied", "Unauthorized", "TooManyRequests"}
+    # DeadlineExceeded: every verb sheds with 504 once the caller's
+    # end-to-end deadline expires (machinery/overload.py) — and it is
+    # deliberately NOT in RETRYABLE: the caller already gave up
+    {"NotFound", "Denied", "Unauthorized", "TooManyRequests",
+     "DeadlineExceeded"}
 )
 _MUTATION_COMMON = _VERB_COMMON | frozenset(
     {"Invalid", "BadRequest", "FencedOut", "NotLeader"}

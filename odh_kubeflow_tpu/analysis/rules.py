@@ -536,6 +536,122 @@ class RetryWithoutBackoffRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# unbudgeted-retry
+
+
+@register
+class UnbudgetedRetryRule(Rule):
+    """A retry site on the API path that ignores the process-wide
+    retry budget (``machinery.overload.shared_budget``) amplifies load
+    exactly when the fleet can least afford it: every stacked layer
+    multiplies attempts-per-logical-request during a brownout — the
+    retry-storm half of a metastable failure. Two shapes flag in
+    ``machinery/`` and ``web/``: a ``backoff.retry(...)`` call that
+    does not thread a ``budget=``, and a hand-rolled reconnect loop
+    pacing itself with ``backoff.next_delay`` that consults neither a
+    retry budget nor a circuit breaker anywhere in its body. The
+    escape hatch is ``# budget-ok: <reason>`` on a line of the flagged
+    call, for retries that genuinely must not be budget-bound: loops
+    that MUST go forever (the replication stream), purely local
+    optimistic-concurrency merges, and third-party-API etag races."""
+
+    id = "unbudgeted-retry"
+    description = (
+        "API-path retry without the shared overload retry budget "
+        "(thread budget= or justify with # budget-ok)"
+    )
+    dirs = ("machinery", "web")
+
+    _GUARD_TOKENS = ("budget", "breaker")
+
+    def _escaped(self, src: SourceFile, node: ast.AST) -> bool:
+        last = getattr(node, "end_lineno", None) or node.lineno
+        return any(
+            "budget-ok" in src.line(i)
+            for i in range(node.lineno, last + 1)
+        )
+
+    def _backoff_call(self, node: ast.AST, name: str) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        chain = _attr_chain(node.func)
+        return bool(
+            chain
+            and chain[-1] == name
+            and any("backoff" in c.lower() for c in chain[:-1])
+        )
+
+    def _iter_live(self, node: ast.AST, stop_at_loops: bool = False):
+        """Descendants executing in ``node``'s own iteration: nested
+        defs/lambdas run later and are pruned; with ``stop_at_loops``
+        nested loops are pruned too (innermost-loop attribution)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if stop_at_loops and isinstance(child, (ast.While, ast.For)):
+                continue
+            yield child
+            yield from self._iter_live(child, stop_at_loops)
+
+    def _loop_guarded(self, loop: ast.AST) -> bool:
+        """Whether the loop's live body consults a budget or breaker —
+        any name/attribute carrying either token (``self._breaker``,
+        ``budget.try_spend()``…)."""
+        for node in self._iter_live(loop):
+            if isinstance(node, ast.Name) and any(
+                t in node.id.lower() for t in self._GUARD_TOKENS
+            ):
+                return True
+            if isinstance(node, ast.Attribute) and any(
+                t in node.attr.lower() for t in self._GUARD_TOKENS
+            ):
+                return True
+            if isinstance(node, ast.keyword) and node.arg and any(
+                t in node.arg.lower() for t in self._GUARD_TOKENS
+            ):
+                return True
+        return False
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if self._backoff_call(node, "retry"):
+                has_budget = any(
+                    kw.arg == "budget" for kw in node.keywords
+                )
+                if not has_budget and not self._escaped(src, node):
+                    yield self.finding(
+                        src,
+                        node,
+                        "backoff.retry without a retry budget: thread "
+                        "budget=overload.shared_budget() (or a shared "
+                        "RetryBudget) so stacked retry layers share one "
+                        "amplification bound, or justify with "
+                        "# budget-ok: <reason>",
+                    )
+            if isinstance(node, (ast.While, ast.For)):
+                calls = [
+                    n
+                    for n in self._iter_live(node, stop_at_loops=True)
+                    if self._backoff_call(n, "next_delay")
+                ]
+                if not calls or self._loop_guarded(node):
+                    continue
+                for call in calls:
+                    if not self._escaped(src, call):
+                        yield self.finding(
+                            src,
+                            call,
+                            "reconnect loop paced by backoff.next_delay "
+                            "consults neither a retry budget nor a "
+                            "circuit breaker; gate it (see client.py's "
+                            "watch pump) or justify with "
+                            "# budget-ok: <reason>",
+                        )
+
+
+# ---------------------------------------------------------------------------
 # unfenced-write
 
 

@@ -26,7 +26,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from odh_kubeflow_tpu.analysis import sanitizer as _sanitizer
-from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery import objects as obj_util, overload
 from odh_kubeflow_tpu.machinery.store import (
     APIServer,
     FencedOut,
@@ -318,7 +318,12 @@ class Controller:
         ):
             try:
                 fence = self.fence_fn() if self.fence_fn else contextlib.nullcontext()
-                with fence:
+                # one reconcile runs under one end-to-end deadline
+                # (REQUEST_DEADLINE_DEFAULT): every API call it makes
+                # carries the remaining budget, so a wedged apiserver
+                # cannot pin a worker forever — the attempt 504s and
+                # the error-backoff requeue takes over
+                with fence, overload.deadline_scope():
                     result = self.reconcile(req) or Result()
             except (FencedOut, NotLeader) as e:
                 # authority failure, not a data race (PR-8 fencing

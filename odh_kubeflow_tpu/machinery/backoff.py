@@ -23,6 +23,8 @@ import random
 import time
 from typing import Any, Callable, Iterator, Optional, Sequence
 
+from odh_kubeflow_tpu.machinery import overload
+
 
 def next_delay(
     prev: Optional[float],
@@ -60,6 +62,8 @@ def retry(
     # schedule explorer's sleep interposition see retry pacing too
     sleep_fn: Optional[Callable[[float], None]] = None,
     on_retry: Optional[Callable[[BaseException, int, float], None]] = None,
+    budget: Optional[Any] = None,
+    deadline: Optional[float] = None,
 ) -> Any:
     """Call ``fn`` until it succeeds, a non-retryable error escapes, or
     ``attempts`` are exhausted (the last error re-raises). Sleeps a
@@ -70,7 +74,15 @@ def retry(
 
     ``retryable`` is an exception type, a sequence of types, or a
     predicate ``(exc) -> bool`` for policies that depend on more than
-    the type (the remote client's verb × error table)."""
+    the type (the remote client's verb × error table).
+
+    Overload defense (machinery.overload): ``budget`` is a
+    :class:`~odh_kubeflow_tpu.machinery.overload.RetryBudget` — each
+    retry must spend a token (a dry bucket surfaces the error instead
+    of amplifying) and each success refills it. ``deadline`` is an
+    absolute ``time.monotonic()`` bound; None consults the ambient
+    request deadline. A sleep that would outlive the deadline is never
+    taken — the last error surfaces immediately."""
     if isinstance(retryable, type):
         types: Any = (retryable,)
         should_retry: Callable[[BaseException], bool] = (
@@ -84,7 +96,7 @@ def retry(
     prev: Optional[float] = None
     for attempt in range(1, max(attempts, 1) + 1):
         try:
-            return fn()
+            result = fn()
         except Exception as e:  # noqa: BLE001 — re-raised unless retryable
             if attempt >= attempts or not should_retry(e):
                 raise
@@ -92,7 +104,24 @@ def retry(
             retry_after = getattr(e, "retry_after", None)
             if retry_after:
                 prev = max(prev, float(retry_after))
+            rem = (
+                overload.remaining()
+                if deadline is None
+                else deadline - time.monotonic()
+            )
+            if rem is not None and prev >= rem:
+                # the caller's deadline expires during (or before) the
+                # sleep: the retry could never be observed — surface
+                raise
+            if budget is not None and not budget.try_spend():
+                # fleet retry budget exhausted: retrying now is pure
+                # amplification — surface the error instead
+                raise
             if on_retry is not None:
                 on_retry(e, attempt, prev)
             (sleep_fn or time.sleep)(prev)
+        else:
+            if budget is not None:
+                budget.on_success()
+            return result
     raise AssertionError("unreachable")  # pragma: no cover

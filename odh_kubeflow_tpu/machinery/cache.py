@@ -360,7 +360,7 @@ class InformerCache:
                         kind, send_initial=False
                     )
         if opening:
-            from odh_kubeflow_tpu.machinery import backoff
+            from odh_kubeflow_tpu.machinery import backoff, overload
 
             def transient(e: BaseException) -> bool:
                 # 4xx (Denied/NotFound/Invalid) is a configuration
@@ -381,6 +381,10 @@ class InformerCache:
                     attempts=5,
                     base=0.02,
                     cap=0.5,
+                    # one shared bucket with the client's own retries:
+                    # a fleet-wide brownout must not let every cache
+                    # prime retry independently on top of the client
+                    budget=overload.shared_budget(),
                 )
         if live:
             with self._lock:

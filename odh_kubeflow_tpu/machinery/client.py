@@ -28,13 +28,14 @@ from collections import OrderedDict
 from typing import Any, Optional
 
 from odh_kubeflow_tpu.analysis import sanitizer as _sanitizer
-from odh_kubeflow_tpu.machinery import backoff, objects as obj_util
+from odh_kubeflow_tpu.machinery import backoff, objects as obj_util, overload
 from odh_kubeflow_tpu.utils import prometheus, tracing
 from odh_kubeflow_tpu.machinery.store import (
     AlreadyExists,
     APIError,
     BadRequest,
     Conflict,
+    DeadlineExceeded,
     Denied,
     Expired,
     FencedOut,
@@ -62,6 +63,7 @@ _ERR_BY_CODE = {
     422: Invalid,
     403: Denied,
     429: TooManyRequests,
+    504: DeadlineExceeded,
 }
 _REASON_TO_ERR = {
     "AlreadyExists": AlreadyExists,
@@ -74,6 +76,9 @@ _REASON_TO_ERR = {
     "Expired": Expired,
     "FencedOut": FencedOut,
     "TooManyRequests": TooManyRequests,
+    # the end-to-end deadline expired server-side (504): the time
+    # budget is spent — never retried, whatever the verb
+    "DeadlineExceeded": DeadlineExceeded,
     # a mutation hit a read replica: the caller must write to the
     # leader (the 307's Location header / the split client's write arm)
     "NotLeader": NotLeader,
@@ -120,6 +125,8 @@ class RemoteAPIServer:
         page_size: Optional[int] = None,
         registry: Optional[prometheus.Registry] = None,
         follow_not_leader: int = 1,
+        retry_budget: Optional[overload.RetryBudget] = None,
+        breaker: Optional[overload.CircuitBreaker] = None,
     ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
@@ -142,6 +149,15 @@ class RemoteAPIServer:
         # injectable for tests; None = time.sleep looked up at call
         # time (keeps the sanitizer/schedule-explorer sleep patch live)
         self._sleep: Optional[Any] = None
+        # overload defense (machinery.overload): every retry spends a
+        # token from the PROCESS-shared budget (stacked retry layers
+        # share one amplification bound), and this endpoint's circuit
+        # breaker sheds calls locally while it is sick instead of
+        # tying up inflight slots on a drowning server
+        self._budget = (
+            overload.shared_budget() if retry_budget is None else retry_budget
+        )
+        self._breaker = overload.CircuitBreaker() if breaker is None else breaker
         reg = registry or prometheus.default_registry
         self._m_retries = reg.counter(
             "client_retries_total",
@@ -151,6 +167,12 @@ class RemoteAPIServer:
         self._m_watch_reestablished = reg.counter(
             "watch_reestablished_total",
             "Watch streams re-established after a dropped connection",
+        )
+        self._m_watch_shed = reg.counter(
+            "watch_reconnects_shed_total",
+            "Watch reconnect attempts shed because the endpoint's "
+            "circuit breaker was open (probed on the breaker's "
+            "cadence instead of hammered)",
         )
         self._m_list_restarts = reg.counter(
             "client_list_restarts_total",
@@ -307,11 +329,21 @@ class RemoteAPIServer:
         if fence is not None:
             ns, lease, token = fence
             headers["X-Fencing-Token"] = f"{ns}/{lease}/{token}"
+        # propagate the remaining end-to-end time budget (delta-seconds
+        # — clock-skew safe; the server re-anchors on its own monotonic
+        # clock and sheds expired work with 504 before doing it)
+        deadline = overload.header_value()
+        if deadline is not None:
+            headers[overload.DEADLINE_HEADER] = deadline
         return headers
 
     def _retry_reason(self, method: str, e: Exception) -> Optional[str]:
         """Whether (and why) this failure is retryable for this verb —
         the policy table in docs/GUIDE.md. None = surface it now."""
+        if isinstance(e, DeadlineExceeded):
+            # the end-to-end time budget is spent: a retry inside it
+            # cannot be observed by the caller — pure amplification
+            return None
         if isinstance(e, TooManyRequests):
             return "429"  # not executed server-side: all verbs retry
         if isinstance(e, APIError):
@@ -330,8 +362,10 @@ class RemoteAPIServer:
     ) -> Obj:
         """One API call through the shared retry helper
         (``machinery.backoff``): capped attempts, exponential +
-        decorrelated jitter, Retry-After honoured, and the verb × error
-        policy of ``_retry_reason`` as the retryable predicate."""
+        decorrelated jitter, Retry-After honoured, the verb × error
+        policy of ``_retry_reason`` as the retryable predicate, every
+        retry paid for from the shared :class:`overload.RetryBudget`,
+        and no sleep ever taken past the ambient deadline."""
 
         def on_retry(e: BaseException, attempt: int, delay: float) -> None:
             reason = self._retry_reason(method, e) or "?"
@@ -349,6 +383,7 @@ class RemoteAPIServer:
             cap=self.retry_cap,
             sleep_fn=self._sleep,
             on_retry=on_retry,
+            budget=self._budget,
         )
 
     def _do_request(
@@ -381,6 +416,22 @@ class RemoteAPIServer:
         body: Optional[Obj] = None,
         query: str = "",
     ) -> Obj:
+        # overload defense, before any work: an expired end-to-end
+        # deadline sheds here (the server would only 504 it anyway),
+        # and an open circuit breaker sheds locally — a sick endpoint
+        # is probed on the breaker's cadence, not hammered by every
+        # caller. Breaker sheds surface as TooManyRequests so the
+        # verb × error policy retries them after the cooldown hint.
+        rem = overload.remaining()
+        if rem is not None and rem <= 0:
+            raise DeadlineExceeded(
+                f"deadline expired before {method} {path}"
+            )
+        if not self._breaker.allow():
+            raise TooManyRequests(
+                f"circuit breaker open for {self.base_url}",
+                retry_after=max(self._breaker.retry_after(), 0.05),
+            )
         self._throttle()
         # a 307 Location being followed arrives as an absolute URL in
         # `path` (leader base + original PATH_INFO); query re-appended
@@ -399,12 +450,20 @@ class RemoteAPIServer:
         req = urllib.request.Request(
             url, data=data, method=method, headers=self._headers(),
         )
+        # never wait longer than the caller's remaining time budget
+        timeout = (
+            self.timeout if rem is None else max(min(self.timeout, rem), 1e-3)
+        )
         # an HTTP round-trip must never run while holding a store/cache
         # lock (sanitizer probe; no-op when GRAFT_SANITIZE is off)
         _sanitizer.note_blocking(f"http {method} {path}")
+        # endpoint health for the breaker window: server-side failures
+        # (5xx, 429 shed, network/timeout) and slow answers count
+        # against the endpoint; 4xx are the CALLER's errors and do not
+        healthy, t0 = True, time.monotonic()
         try:
             with urllib.request.urlopen(
-                req, timeout=self.timeout, context=self._ssl_ctx
+                req, timeout=timeout, context=self._ssl_ctx
             ) as r:
                 served = r.headers.get("X-Served-RV")
                 if served is not None:
@@ -414,6 +473,9 @@ class RemoteAPIServer:
                         pass
                 return json.loads(r.read().decode() or "{}")
         except urllib.error.HTTPError as e:
+            # 504 is the CALLER's deadline expiring, not endpoint
+            # sickness — it must not trip the breaker
+            healthy = e.code == 504 or (e.code < 500 and e.code != 429)
             message, reason = str(e), ""
             try:
                 status = json.loads(e.read().decode())
@@ -442,6 +504,11 @@ class RemoteAPIServer:
                     leader_url=(e.headers or {}).get("Location", ""),
                 ) from None
             raise klass(message) from None
+        except (OSError, http.client.HTTPException):
+            healthy = False
+            raise
+        finally:
+            self._breaker.record(healthy, time.monotonic() - t0)
 
     def _note_served_rv(self, rv: int) -> None:
         with self._lock:
@@ -687,6 +754,31 @@ class RemoteAPIServer:
             connected_once = False
             last_alive = time.monotonic()
             while not w._stopped:
+                if not self._breaker.allow():
+                    # the endpoint's circuit is open (every caller's
+                    # failures feed one breaker): probe on the
+                    # breaker's cadence instead of hammering an
+                    # unreachable endpoint in a reconnect hot loop
+                    self._m_watch_shed.inc()
+                    if (
+                        reconnect_window is not None
+                        and time.monotonic() - last_alive > reconnect_window
+                    ):
+                        w.error = APIError(
+                            f"watch {kind}: no successful connection for "
+                            f"{reconnect_window:.0f}s; relist and re-watch"
+                        )
+                        w.ended = True
+                        log.warning(
+                            "watch %s: endpoint breaker open beyond the "
+                            "%.0fs reconnect window; stream ended for "
+                            "re-homing", kind, reconnect_window,
+                        )
+                        break
+                    (self._sleep or time.sleep)(
+                        max(self._breaker.retry_after(), self.retry_base)
+                    )
+                    continue
                 resp = None
                 try:
                     # no read timeout: heartbeats arrive every 15s; a
@@ -702,6 +794,7 @@ class RemoteAPIServer:
                         context=self._ssl_ctx,
                     )
                     w._resp = resp
+                    self._breaker.record(True)
                     connected.set()
                     if connected_once:
                         self._m_watch_reestablished.inc()
@@ -740,6 +833,9 @@ class RemoteAPIServer:
                         kind, rv,
                     )
                 except urllib.error.HTTPError as e:
+                    # endpoint health feeds the shared breaker: 5xx and
+                    # 429 shed count against it, caller-side 4xx do not
+                    self._breaker.record(e.code < 500 and e.code != 429)
                     retry_after = _retry_after_of(e) if e.code == 429 else None
                     try:
                         e.read()
@@ -771,6 +867,7 @@ class RemoteAPIServer:
                         kind, e.code, rv,
                     )
                 except (OSError, ValueError, http.client.HTTPException):
+                    self._breaker.record(False)
                     if not w._stopped:
                         log.warning(
                             "watch %s: stream broke; reconnecting from rv=%s",
@@ -962,6 +1059,12 @@ class ReplicaFanout:
 
     # -- endpoint choice ------------------------------------------------------
 
+    def _breaker_blocking(self, idx: int) -> bool:
+        """True while the endpoint's own circuit breaker would shed a
+        call right now — fanout ranking treats it like a cooldown."""
+        breaker = getattr(self.clients[idx], "_breaker", None)
+        return breaker is not None and breaker.blocking
+
     def _order(self, sticky_key: Optional[str] = None) -> list[int]:
         now = time.monotonic()
         with self._lock:
@@ -969,6 +1072,7 @@ class ReplicaFanout:
                 i
                 for i in range(len(self.clients))
                 if self._down_until.get(i, 0.0) <= now
+                and not self._breaker_blocking(i)
             ]
             if sticky_key is None:
                 self._next += 1

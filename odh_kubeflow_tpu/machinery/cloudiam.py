@@ -132,7 +132,7 @@ class GcpIamClient:
             self._call("POST", f"{resource}:setIamPolicy", {"policy": updated})
 
         try:
-            backoff.retry(
+            backoff.retry(  # budget-ok: third-party IAM etag races, capped attempts against Google's API — not platform-fleet amplification
                 read_modify_write,
                 retryable=(_EtagConflict,),
                 attempts=self.max_retries,

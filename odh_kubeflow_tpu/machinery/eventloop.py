@@ -59,6 +59,8 @@ import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterator, Optional
 
+from odh_kubeflow_tpu.machinery import overload
+
 DEFAULT_WORKERS = int(os.environ.get("WEB_WORKERS", "8"))
 # routes whose EWMA handler runtime exceeds this run in the worker
 # pool; under it they run inline on the loop (dispatch overhead would
@@ -406,6 +408,10 @@ class _Connection(asyncio.Protocol):
             "wsgi.multithread": True,
             "wsgi.multiprocess": False,
             "wsgi.run_once": False,
+            # arrival stamp: anchors the X-Request-Deadline delta so
+            # time spent queued for the worker pool counts against the
+            # end-to-end budget (machinery.overload.environ_deadline)
+            "odh.request.arrival": time.monotonic(),
         }
         if "content-type" in headers:
             environ["CONTENT_TYPE"] = headers["content-type"]
@@ -712,6 +718,32 @@ class EventLoopServer:
         def start_response(status, headers, exc_info=None):
             state["status"] = status
             state["headers"] = list(headers)
+
+        # end-to-end deadline shed at dequeue (machinery.overload): a
+        # request can sit queued behind slow handlers long enough for
+        # its client to give up — running the app then is dead work
+        # that amplifies the overload. Malformed header values fall
+        # through: the app's own parse answers the 400.
+        try:
+            deadline = overload.environ_deadline(environ)
+        except ValueError:
+            deadline = None
+        if deadline is not None and deadline <= time.monotonic():
+            payload = (
+                b'{"kind": "Status", "apiVersion": "v1", "status": '
+                b'"Failure", "message": "request deadline expired '
+                b'before dispatch", "reason": "DeadlineExceeded", '
+                b'"code": 504}'
+            )
+            return (
+                "504 Gateway Timeout",
+                [
+                    ("Content-Type", "application/json"),
+                    ("Content-Length", str(len(payload))),
+                ],
+                payload,
+                0.0,
+            )
 
         t0 = time.perf_counter()
         try:

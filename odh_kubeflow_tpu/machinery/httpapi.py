@@ -44,7 +44,7 @@ from odh_kubeflow_tpu.machinery.eventloop import (
     WatchBody,
     event_loop_enabled,
 )
-from odh_kubeflow_tpu.machinery import zpages
+from odh_kubeflow_tpu.machinery import overload, zpages
 from odh_kubeflow_tpu.utils import prometheus, tracing
 from odh_kubeflow_tpu.utils.prometheus import Registry
 from odh_kubeflow_tpu.machinery.store import (
@@ -53,6 +53,7 @@ from odh_kubeflow_tpu.machinery.store import (
     APIServer,
     BadRequest,
     Conflict,
+    DeadlineExceeded,
     Denied,
     Expired,
     FencedOut,
@@ -79,6 +80,9 @@ _STATUS = {
     BadRequest: 400,
     Expired: 410,
     TooManyRequests: 429,
+    # the request's end-to-end deadline expired before the work
+    # completed; the caller already gave up (machinery.overload)
+    DeadlineExceeded: 504,
     # kube-style leader redirect: a mutation hit a read replica; the
     # Status reason is NotLeader and Location points at the leader
     NotLeader: 307,
@@ -99,24 +103,78 @@ INFLIGHT_RETRY_AFTER_SECONDS = 1.0
 
 
 class InflightLimiter:
-    """Per-client inflight counter (APF-lite). ``try_acquire`` admits
-    up to ``limit`` concurrent requests per client identity and sheds
-    the rest — the caller turns a False into a 429 with Retry-After.
-    Watches are exempt (long-running, same as kube's APF)."""
+    """Per-client inflight counter (APF-lite) with priority levels.
+    ``try_acquire`` admits up to ``limit`` concurrent requests per
+    client identity and sheds the rest — the caller turns a False into
+    a 429 with Retry-After. Watches are exempt (long-running, same as
+    kube's APF).
 
-    def __init__(self, limit: int, retry_after: float = INFLIGHT_RETRY_AFTER_SECONDS):
+    Priority-aware shedding (machinery.overload): the same ``limit``
+    also bounds GLOBAL inflight, with cumulative per-level ceilings
+    (``APF_LEVEL_*``, percent of the limit) — user traffic can only
+    ever fill part of the pool, so system traffic (lease renewals,
+    fencing, replication) always has admission headroom and is never
+    starved by a user-load flood.
+
+    Deadline-aware: a request whose propagated end-to-end deadline has
+    already expired raises :class:`DeadlineExceeded` from
+    ``try_acquire`` — it is shed with 504 *before* consuming a seat
+    (the client gave up; serving it is amplification, and admitting it
+    would let dead work crowd out live work)."""
+
+    def __init__(
+        self,
+        limit: int,
+        retry_after: float = INFLIGHT_RETRY_AFTER_SECONDS,
+        registry: Optional[Registry] = None,
+    ):
         self.limit = limit
         self.retry_after = retry_after
         self._lock = threading.Lock()
         self._inflight: dict[str, int] = {}
+        self._total = 0
+        self._ceilings = overload.level_ceilings(limit)
+        self._m_shed = None
+        if registry is not None:
+            self._m_shed = registry.counter(
+                "inflight_shed_total",
+                "Requests shed at admission, by priority level and "
+                "shed reason (per-client cap, level ceiling, or an "
+                "already-expired deadline)",
+                labelnames=("level", "reason"),
+            )
 
-    def try_acquire(self, client: str) -> bool:
+    def _shed(self, level: int, reason: str) -> None:
+        if self._m_shed is not None:
+            self._m_shed.inc(
+                {"level": overload.LEVEL_NAMES[level], "reason": reason}
+            )
+
+    def try_acquire(
+        self,
+        client: str,
+        level: int = overload.LEVEL_USER,
+        deadline: Optional[float] = None,
+    ) -> bool:
+        if deadline is None:
+            deadline = overload.current_deadline()
+        if deadline is not None and deadline <= time.monotonic():
+            self._shed(level, "deadline")
+            raise DeadlineExceeded(
+                "request deadline expired before admission"
+            )
         with self._lock:
             n = self._inflight.get(client, 0)
             if n >= self.limit:
-                return False
-            self._inflight[client] = n + 1
-            return True
+                per_client_full = True
+            elif self._total >= self._ceilings[level]:
+                per_client_full = False
+            else:
+                self._inflight[client] = n + 1
+                self._total += 1
+                return True
+        self._shed(level, "client" if per_client_full else "level")
+        return False
 
     def release(self, client: str) -> None:
         with self._lock:
@@ -125,6 +183,8 @@ class InflightLimiter:
                 self._inflight.pop(client, None)
             else:
                 self._inflight[client] = n
+            if self._total > 0:
+                self._total -= 1
 
 
 def _retry_after_header(seconds: float) -> tuple[str, str]:
@@ -229,7 +289,11 @@ class RestAPI:
         # backs the /debug/usage zpage (chip-hour ledger timelines)
         self.usage_meter = usage_meter
         limit = DEFAULT_INFLIGHT_LIMIT if inflight_limit is None else inflight_limit
-        self.limiter = InflightLimiter(limit) if limit > 0 else None
+        self.limiter = (
+            InflightLimiter(limit, registry=metrics_registry)
+            if limit > 0
+            else None
+        )
         # per-(kind, rv) serialized-bytes cache: list responses compose
         # from per-object bytes and watch events serialize ONCE for all
         # subscribers. fast_serialize=False is the bench's pre-PR
@@ -461,13 +525,43 @@ class RestAPI:
                     reason="BadRequest",
                 )
 
+        # the propagated end-to-end deadline (X-Request-Deadline,
+        # remaining delta-seconds) re-anchors on THIS host's monotonic
+        # clock; parsed before admission like the fence — malformed is
+        # a 400 that must not leak an inflight slot, and an already-
+        # expired deadline sheds with 504 BEFORE any work
+        try:
+            deadline = overload.environ_deadline(environ)
+        except ValueError:
+            return self._error(
+                400,
+                "malformed X-Request-Deadline "
+                f"{environ.get('HTTP_X_REQUEST_DEADLINE', '')!r} "
+                "(want remaining seconds)",
+                start_response,
+                reason="BadRequest",
+            )
+        # APF priority level: explicit self-declaration header, else
+        # system for the fleet's own consistency traffic (Lease
+        # renewals; /replication/ is classified at its own branch
+        # above), controller for reconcile-originated calls, user
+        # otherwise
+        level = overload.classify(
+            kind=kind,
+            path=path,
+            header=environ.get("HTTP_X_PRIORITY_LEVEL"),
+            controller="odh=controller" in environ.get("HTTP_TRACESTATE", ""),
+        )
+
         # APF-lite admission: cap concurrent non-watch requests per
-        # client identity, shedding excess with 429 + Retry-After
-        # instead of queueing unboundedly in the thread pool. Watches
-        # are exempt (long-running, kube's APF posture) — but ONLY what
-        # _dispatch actually serves as a watch (collection GETs);
-        # ?watch=true on a named resource is an ordinary read and must
-        # not buy its way past the limiter.
+        # client identity AND per priority level (cumulative ceilings —
+        # user traffic cannot fill the seats system traffic needs),
+        # shedding excess with 429 + Retry-After instead of queueing
+        # unboundedly in the thread pool. Watches are exempt
+        # (long-running, kube's APF posture) — but ONLY what _dispatch
+        # actually serves as a watch (collection GETs); ?watch=true on
+        # a named resource is an ordinary read and must not buy its
+        # way past the limiter.
         is_watch = (
             method == "GET"
             and route.name is None
@@ -478,7 +572,15 @@ class RestAPI:
             client = environ.get("odh.authenticated.user") or environ.get(
                 "REMOTE_ADDR", "anonymous"
             )
-            if not self.limiter.try_acquire(client):
+            try:
+                admitted = self.limiter.try_acquire(
+                    client, level=level, deadline=deadline
+                )
+            except DeadlineExceeded as e:
+                return self._error(
+                    504, str(e), start_response, reason="DeadlineExceeded"
+                )
+            if not admitted:
                 return self._error(
                     429,
                     f"too many in-flight requests for client {client!r}",
@@ -486,10 +588,23 @@ class RestAPI:
                     reason="TooManyRequests",
                     headers=[_retry_after_header(self.limiter.retry_after)],
                 )
-        # re-install the parsed fence on this handler's context so the
-        # store validates the epoch atomically with the apply, same as
-        # the embedded path
+        elif deadline is not None and deadline <= time.monotonic():
+            # no limiter (or watch): the pre-work deadline shed still
+            # applies — dead work is amplification either way
+            return self._error(
+                504,
+                "request deadline expired before dispatch",
+                start_response,
+                reason="DeadlineExceeded",
+            )
+        # re-install the parsed fence AND deadline on this handler's
+        # context so the store validates the epoch atomically with the
+        # apply and every downstream stage (ack wait, scatter-gather
+        # legs) sees the same time budget, same as the embedded path
         fence_reset = set_fence(fence) if fence is not None else None
+        deadline_reset = (
+            overload.set_deadline(deadline) if deadline is not None else None
+        )
         try:
             return self._dispatch(kind, route, method, qs, environ, start_response)
         except APIError as e:
@@ -516,6 +631,8 @@ class RestAPI:
         except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
             return self._error(500, f"{type(e).__name__}: {e}", start_response)
         finally:
+            if deadline_reset is not None:
+                overload.reset_deadline(deadline_reset)
             if fence_reset is not None:
                 reset_fence(fence_reset)
             if client is not None:

@@ -51,15 +51,17 @@ import weakref
 from typing import Any, Callable, Optional
 
 from odh_kubeflow_tpu.analysis import schedule as _schedule
-from odh_kubeflow_tpu.machinery import objects as obj_util
+from odh_kubeflow_tpu.machinery import objects as obj_util, overload
 from odh_kubeflow_tpu.machinery.leader import (
     _hrw_weight,
     fenced,
     lease_expired,
 )
 from odh_kubeflow_tpu.machinery.store import (
+    APIError,
     APIServer,
     BadRequest,
+    DeadlineExceeded,
     Expired,
     FencedOut,
     Invalid,
@@ -383,6 +385,12 @@ class PartitionRouter:
         self._merged: "weakref.WeakSet[MergedWatch]" = weakref.WeakSet()
         self.merge_page_limit = self.MERGE_PAGE_LIMIT
         self.move_retry_after = self.MOVE_RETRY_AFTER
+        # one circuit breaker per partition (machinery.overload): a
+        # sick partition sheds fast instead of dragging every
+        # scatter-gather merge and routed write down with it
+        self._breakers: dict[int, overload.CircuitBreaker] = {
+            p: overload.CircuitBreaker() for p in self.backends
+        }
 
     # -- assignment surface --------------------------------------------------
 
@@ -460,6 +468,49 @@ class PartitionRouter:
         # partition; namespaced ones go to their HRW/override owner
         return self._map.owner_of(namespace) if namespace else 0
 
+    # -- overload defense ----------------------------------------------------
+
+    @staticmethod
+    def _shed_expired(stage: str) -> None:
+        if overload.expired():
+            raise DeadlineExceeded(
+                f"request deadline expired before {stage}"
+            )
+
+    def _breaker_for(self, p: int) -> overload.CircuitBreaker:
+        try:
+            return self._breakers[p]
+        except KeyError:
+            return self._breakers.setdefault(p, overload.CircuitBreaker())
+
+    def _call_backend(self, p: int, call: Callable[[Any], Any]):
+        """One breaker-guarded backend call. An open breaker sheds
+        with a retryable 429 before touching the partition; outcomes
+        and latency feed the rolling window. Expected client errors
+        (4xx) and the caller's own expired deadline (504) are not
+        endpoint sickness."""
+        breaker = self._breaker_for(p)
+        if not breaker.allow():
+            raise TooManyRequests(
+                f"partition {p} circuit breaker open; shedding until the "
+                "endpoint proves healthy",
+                retry_after=max(breaker.retry_after(), 0.01),
+            )
+        healthy = True
+        t0 = time.monotonic()
+        try:
+            return call(self.backends[p])
+        except DeadlineExceeded:
+            raise
+        except APIError as e:
+            healthy = e.code < 500
+            raise
+        except Exception:
+            healthy = False
+            raise
+        finally:
+            breaker.record(healthy, time.monotonic() - t0)
+
     # -- cross-partition fencing --------------------------------------------
     #
     # A fencing Lease lives in ONE partition (its namespace's owner).
@@ -524,6 +575,9 @@ class PartitionRouter:
     # -- mutations (routed, 307 on the wrong leader) -------------------------
 
     def _mutate(self, namespace: Optional[str], call: Callable[[Any], Any]):
+        # an already-expired deadline sheds before ANY bookkeeping —
+        # the caller gave up, so the cheapest outcome is no work at all
+        self._shed_expired("partition write dispatch")
         # register in flight BEFORE the frozen check: quiesce_writes
         # sees this mutation even if it races the freeze, closing the
         # acked-but-unshipped window in the move protocol
@@ -542,7 +596,7 @@ class PartitionRouter:
                     leader_url=self.urls.get(p, ""),
                 )
             with self._fence_for(p):
-                return call(self.backends[p])
+                return self._call_backend(p, call)
         finally:
             if namespace:
                 with self._inflight_cv:
@@ -650,9 +704,12 @@ class PartitionRouter:
     # -- reads ---------------------------------------------------------------
 
     def get(self, kind: str, name: str, namespace: Optional[str] = None) -> Obj:
+        self._shed_expired("partition read dispatch")
         info = self.type_info(kind)
         p = self._route(namespace if info.namespaced else None)
-        return self.backends[p].get(kind, name, namespace=namespace)
+        return self._call_backend(
+            p, lambda b: b.get(kind, name, namespace=namespace)
+        )
 
     def list(
         self,
@@ -784,6 +841,10 @@ class PartitionRouter:
             re-pinning ONLY this partition (partial restart)."""
             b = self.backends[p]
             while True:
+                # every leg of the scatter-gather re-checks the
+                # deadline: a merge over N partitions must not keep
+                # paging N-1 healthy legs after the caller gave up
+                self._shed_expired(f"the partition {p} merge leg")
                 if p not in rvs:
                     # a remote backend reports None before its first
                     # response carried X-Served-RV; pin 0 and let the
@@ -795,12 +856,15 @@ class PartitionRouter:
                         {"rv": rvs[p], "kind": kind, "ns": "", "k": cursors[p]}
                     )
                 try:
-                    items, _ = b.list_chunk(
-                        kind,
-                        label_selector=label_selector,
-                        field_matches=field_matches,
-                        limit=per_page,
-                        continue_token=ptoken,
+                    items, _ = self._call_backend(
+                        p,
+                        lambda b: b.list_chunk(
+                            kind,
+                            label_selector=label_selector,
+                            field_matches=field_matches,
+                            limit=per_page,
+                            continue_token=ptoken,
+                        ),
                     )
                 except Expired:
                     # partial restart: fresh rv pin, SAME cursor — the

@@ -551,7 +551,7 @@ class ReplicationClient:
                     type(e).__name__, e, self.replica.applied_rv(),
                 )
             self.reconnects += 1
-            delay = backoff.next_delay(
+            delay = backoff.next_delay(  # budget-ok: the long-lived replication stream MUST reconnect forever — a drained budget silencing replication would be an availability bug
                 delay, base=self.reconnect_base, cap=self.reconnect_cap
             )
             self._stop.wait(delay)

@@ -52,7 +52,7 @@ from typing import Any, Callable, Iterator, Optional
 
 from odh_kubeflow_tpu.analysis import sanitizer as _sanitizer
 from odh_kubeflow_tpu.analysis import schedule as _schedule
-from odh_kubeflow_tpu.machinery import backoff, objects as obj_util
+from odh_kubeflow_tpu.machinery import backoff, objects as obj_util, overload
 from odh_kubeflow_tpu.machinery import serialize
 from odh_kubeflow_tpu.utils import tracing
 
@@ -120,6 +120,19 @@ class TooManyRequests(APIError):
     def __init__(self, message: str = "", retry_after: float = 1.0):
         super().__init__(message)
         self.retry_after = retry_after
+
+
+class DeadlineExceeded(APIError):
+    """HTTP 504: the request's end-to-end deadline
+    (``X-Request-Deadline`` / the ``machinery.overload`` contextvar)
+    expired before the work completed — the caller already gave up, so
+    the server sheds instead of finishing dead work. NOT retryable:
+    the time budget is spent; retrying inside it is amplification.
+    On a mutation path the write may still become durable (the
+    group-commit pipeline does not unwind an enqueued record) — the
+    ack is what timed out, exactly the kube-apiserver 504 contract."""
+
+    code = 504
 
 
 class Expired(APIError):
@@ -974,9 +987,22 @@ class APIServer:
             # a durability wait must never run under a store/cache lock
             # (sanitizer probe; no-op when GRAFT_SANITIZE is off).
             # schedule.wait_event participates in exploration and is a
-            # plain Event.wait otherwise.
+            # plain Event.wait otherwise. The ambient request deadline
+            # bounds the wait: a caller that already timed out gets
+            # 504 instead of parking a handler thread on an ack it will
+            # never read (the record itself stays enqueued and may
+            # still commit — see DeadlineExceeded).
             _sanitizer.note_blocking("wal.commit-wait")
-            _schedule.wait_event(entry.done)
+            rem = overload.remaining()
+            if rem is None:
+                _schedule.wait_event(entry.done)
+            elif rem <= 0 or not _schedule.wait_event(
+                entry.done, timeout=rem
+            ):
+                raise DeadlineExceeded(
+                    "deadline expired awaiting the commit ack (the "
+                    "write may still become durable)"
+                )
         if entry.error is not None:
             raise entry.error
 
@@ -1766,7 +1792,7 @@ class APIServer:
                     merged["metadata"][k] = current["metadata"][k]
             return self.update(merged)
 
-        return backoff.retry(
+        return backoff.retry(  # budget-ok: in-process optimistic-concurrency merge — retries re-run a local read-modify-write, no remote amplification
             attempt,
             retryable=lambda e: isinstance(e, Conflict),
             attempts=16,

@@ -10,6 +10,7 @@ the exact surface the CRUD backends need.
 
 from __future__ import annotations
 
+import contextlib
 import http
 import json
 import mimetypes
@@ -24,7 +25,7 @@ from typing import Any, Callable, Optional
 from wsgiref.simple_server import WSGIServer, make_server
 from socketserver import ThreadingMixIn
 
-from odh_kubeflow_tpu.machinery import serialize
+from odh_kubeflow_tpu.machinery import overload, serialize
 
 
 class HTTPError(Exception):
@@ -297,7 +298,29 @@ class App:
             f"{self.name}:{request.method} {request.path}",
             parent=tracing.nested_parent(remote),
         ):
-            return self._call_traced(request, environ, start_response)
+            with self._deadline_scope(environ):
+                return self._call_traced(request, environ, start_response)
+
+    @contextlib.contextmanager
+    def _deadline_scope(self, environ):
+        """Every web request runs under an end-to-end deadline: the
+        caller's ``X-Request-Deadline`` when one arrived (malformed
+        values are ignored at this tier — the API tier answers 400),
+        else the ``REQUEST_DEADLINE_DEFAULT`` stamp. API calls the
+        handler makes propagate the remaining budget downstream."""
+        try:
+            deadline = overload.environ_deadline(environ)
+        except ValueError:
+            deadline = None
+        if deadline is not None:
+            tok = overload.set_deadline(deadline)
+            try:
+                yield
+            finally:
+                overload.reset_deadline(tok)
+        else:
+            with overload.deadline_scope():
+                yield
 
     def _call_traced(self, request, environ, start_response):
         from odh_kubeflow_tpu.utils import tracing

@@ -39,6 +39,7 @@ def test_rule_catalog_has_the_platform_rules():
         "blocking-under-lock",
         "metric-naming",
         "retry-without-backoff",
+        "unbudgeted-retry",
         "unbounded-list",
         "hot-path-json-dumps",
         "unfenced-write",
@@ -678,6 +679,137 @@ def test_retry_without_backoff_suppressed_with_reason():
         "            pass\n"
     )
     assert lint_source(src, "machinery/x.py", ["retry-without-backoff"]) == []
+
+
+# ---------------------------------------------------------------------------
+# unbudgeted-retry
+
+
+def test_unbudgeted_retry_call_without_budget_flagged():
+    src = (
+        "from odh_kubeflow_tpu.machinery import backoff\n"
+        "def ensure(api, obj):\n"
+        "    return backoff.retry(lambda: api.create(obj), attempts=3)\n"
+    )
+    findings = lint_source(src, "machinery/x.py", ["unbudgeted-retry"])
+    assert rule_ids(findings) == ["unbudgeted-retry"]
+    assert "budget" in findings[0].message
+
+
+def test_unbudgeted_retry_next_delay_loop_flagged():
+    src = (
+        "from odh_kubeflow_tpu.machinery import backoff\n"
+        "def pump(api, kind, sleep):\n"
+        "    delay = None\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return api.watch(kind)\n"
+        "        except Exception:\n"
+        "            delay = backoff.next_delay(delay)\n"
+        "            sleep(delay)\n"
+    )
+    findings = lint_source(src, "machinery/x.py", ["unbudgeted-retry"])
+    assert rule_ids(findings) == ["unbudgeted-retry"]
+
+
+def test_unbudgeted_retry_clean_variants():
+    # budget= threads the shared bucket
+    src = (
+        "from odh_kubeflow_tpu.machinery import backoff, overload\n"
+        "def ensure(api, obj):\n"
+        "    return backoff.retry(\n"
+        "        lambda: api.create(obj),\n"
+        "        attempts=3,\n"
+        "        budget=overload.shared_budget(),\n"
+        "    )\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["unbudgeted-retry"]) == []
+    # a breaker-gated reconnect loop consults endpoint health
+    src = (
+        "from odh_kubeflow_tpu.machinery import backoff\n"
+        "def pump(self, api, kind, sleep):\n"
+        "    delay = None\n"
+        "    while True:\n"
+        "        if not self._breaker.allow():\n"
+        "            sleep(self._breaker.retry_after())\n"
+        "            continue\n"
+        "        try:\n"
+        "            return api.watch(kind)\n"
+        "        except Exception:\n"
+        "            delay = backoff.next_delay(delay)\n"
+        "            sleep(delay)\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["unbudgeted-retry"]) == []
+    # out-of-scope dirs (controllers route via reconcilehelper's own
+    # budgeted site; models never touch the API path)
+    src = (
+        "from odh_kubeflow_tpu.machinery import backoff\n"
+        "def ensure(api, obj):\n"
+        "    return backoff.retry(lambda: api.create(obj), attempts=3)\n"
+    )
+    assert lint_source(src, "models/x.py", ["unbudgeted-retry"]) == []
+
+
+def test_unbudgeted_retry_budget_ok_escape():
+    src = (
+        "from odh_kubeflow_tpu.machinery import backoff\n"
+        "def merge(api, obj):\n"
+        "    return backoff.retry(  # budget-ok: local merge, no fan-out\n"
+        "        lambda: api.update(obj),\n"
+        "        attempts=16,\n"
+        "    )\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["unbudgeted-retry"]) == []
+    src = (
+        "from odh_kubeflow_tpu.machinery import backoff\n"
+        "def pump(api, kind, sleep):\n"
+        "    delay = None\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return api.watch(kind)\n"
+        "        except Exception:\n"
+        "            delay = backoff.next_delay(delay)  # budget-ok: must reconnect forever\n"
+        "            sleep(delay)\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["unbudgeted-retry"]) == []
+    # the graftlint disable marker works too
+    src = (
+        "from odh_kubeflow_tpu.machinery import backoff\n"
+        "def ensure(api, obj):\n"
+        "    return backoff.retry(lambda: api.create(obj))  "
+        "# graftlint: disable=unbudgeted-retry dev-only path\n"
+    )
+    assert lint_source(src, "machinery/x.py", ["unbudgeted-retry"]) == []
+
+
+def test_unbudgeted_retry_whole_package_baseline_is_clean():
+    # every machinery/web retry site either threads the shared budget
+    # or carries a reviewed # budget-ok justification — keep it that way
+    findings = [
+        f for f in run_package() if f.rule == "unbudgeted-retry"
+    ]
+    assert findings == []
+
+
+def test_unbudgeted_retry_catches_reverted_client_budget():
+    # the retry-storm regression drill, lint half: revert the overload
+    # defense by stripping the budget kwarg from the REAL client retry
+    # call and the rule must light up on exactly that line — a future
+    # refactor that drops the budget cannot land clean
+    import pathlib
+
+    import odh_kubeflow_tpu.machinery.client as client_mod
+
+    src = pathlib.Path(client_mod.__file__).read_text()
+    reverted = src.replace("            budget=self._budget,\n", "")
+    assert reverted != src, "client retry call moved — update the drill"
+    findings = lint_source(
+        reverted, "machinery/client.py", ["unbudgeted-retry"]
+    )
+    assert len(findings) == 1
+    assert "backoff.retry" in findings[0].message
+    # and the shipped source is clean: the budget line is the fix
+    assert lint_source(src, "machinery/client.py", ["unbudgeted-retry"]) == []
 
 
 # ---------------------------------------------------------------------------

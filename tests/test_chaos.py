@@ -553,6 +553,220 @@ def test_cache_coherence_property_under_chaos():
 
 
 # ---------------------------------------------------------------------------
+# overload defense armed: the PR-5 properties re-run with the retry
+# budget and circuit breakers in the path (machinery/overload.py)
+
+
+def test_client_retry_policy_with_budget_armed():
+    """The verb × error retry policy under a sustained brownout with
+    the retry budget armed: total attempts across ALL logical requests
+    are bounded by logical + cap — the fleet-wide amplification gate
+    (attempts/logical ≤ 1.3×) the overload bench enforces — instead of
+    logical × retries."""
+    from odh_kubeflow_tpu.machinery import overload
+
+    registry = prometheus.Registry()
+    budget = overload.RetryBudget(ratio=0.0, cap=3.0, registry=registry)
+    c = RemoteAPIServer(
+        "http://127.0.0.1:1",
+        registry=registry,
+        retries=4,
+        retry_base=0.001,
+        retry_cap=0.002,
+        retry_budget=budget,
+    )
+    c._sleep = _no_sleep
+    attempts = {"n": 0}
+
+    def brownout(method, path, body=None, query=""):
+        attempts["n"] += 1
+        raise APIError("injected 503")
+
+    c._do_request = brownout
+    logical = 10
+    for _ in range(logical):
+        with pytest.raises(APIError):
+            c.list("Pod")
+    # 10 first tries + exactly cap=3 budgeted retries, not 10 × 4 = 40
+    assert attempts["n"] == logical + 3
+    assert attempts["n"] / logical <= 1.3
+    assert (
+        registry.counter("retry_budget_exhausted_total", "x").value() > 0
+    )
+
+    # the weather clears: successes refill the bucket (ratio) and the
+    # policy retries transient errors again
+    budget.ratio = 1.0
+    c._do_request = lambda m, p, body=None, query="": {"items": []}
+    for _ in range(3):
+        assert c.list("Pod") == []
+    attempts["n"] = 0
+    flaky = {"n": 0}
+
+    def heals(method, path, body=None, query=""):
+        flaky["n"] += 1
+        if flaky["n"] == 1:
+            raise APIError("last gasp")
+        return {"items": []}
+
+    c._do_request = heals
+    assert c.list("Pod") == []
+    assert flaky["n"] == 2  # the refilled budget paid for the retry
+
+
+def test_cache_prime_retries_are_budget_bounded():
+    """The informer's initial prime threads the PROCESS-shared budget:
+    under a total blackout its retries stop when the bucket runs dry
+    instead of burning the full per-call attempt allowance — stacked
+    layers share one amplification bound."""
+    from odh_kubeflow_tpu.machinery import overload
+
+    budget = overload._reset_shared_budget_for_tests()
+    try:
+        budget._tokens = 1.0  # one retry in the whole process
+        api = APIServer()
+        inj = _injector(api, FaultSchedule(server_error=1.0))
+        calls = {"n": 0}
+        real_list_chunk, real_list = inj.list_chunk, inj.list
+
+        def counting_chunk(*a, **kw):
+            calls["n"] += 1
+            return real_list_chunk(*a, **kw)
+
+        def counting_list(*a, **kw):
+            calls["n"] += 1
+            return real_list(*a, **kw)
+
+        inj.list_chunk, inj.list = counting_chunk, counting_list
+        cache = InformerCache(
+            inj, kinds=("ConfigMap",), registry=prometheus.Registry()
+        )
+        with pytest.raises(APIError):
+            cache.start(live=False)
+        # 1 first try + the single budgeted retry — not attempts=5
+        assert calls["n"] == 2
+    finally:
+        overload._reset_shared_budget_for_tests()
+
+
+def test_cache_coherence_property_with_overload_defense_armed():
+    """The cache-coherence property re-run with the overload layer
+    live: the shared retry budget armed (and spent by the prime/client
+    layers) and the chaos weather heavier on 5xx. Convergence must be
+    unchanged — budgets and breakers bound *amplification*, they must
+    never break healing, because relist/reestablish recovery paths are
+    not retry loops."""
+    from odh_kubeflow_tpu.analysis import sanitizer
+    from odh_kubeflow_tpu.machinery import overload
+
+    reports_before = len(sanitizer.reports())
+    budget = overload._reset_shared_budget_for_tests()
+    try:
+        rng = random.Random(SEED + 20)
+        api = APIServer()
+        registry = prometheus.Registry()
+        inj = _injector(
+            api,
+            FaultSchedule(
+                conflict=0.03,
+                too_many_requests=0.05,
+                server_error=0.12,
+                watch_drop=0.05,
+            ),
+            seed=SEED + 20,
+            registry=registry,
+        )
+        cache = InformerCache(inj, kinds=("ConfigMap",), registry=registry)
+        cache.reestablish_backoff = 0.0
+        cache.start(live=False)
+        live: set[str] = set()
+        for step in range(300):
+            op = rng.random()
+            name = f"cm-{rng.randrange(40)}"
+            ns = f"ns-{rng.randrange(3)}"
+            key = f"{ns}/{name}"
+            try:
+                if op < 0.45 or not live:
+                    inj.create(_cm(name, ns=ns, v=str(step)))
+                    live.add(key)
+                elif op < 0.75:
+                    inj.patch(
+                        "ConfigMap", name, {"data": {"v": str(step)}}, ns
+                    )
+                else:
+                    inj.delete("ConfigMap", name, ns)
+                    live.discard(key)
+            except (APIError, KeyError):
+                pass
+            if rng.random() < 0.3:
+                cache.drain_once()
+        inj.set_schedule(FaultSchedule.none())
+        for _ in range(6):
+            cache.drain_once()
+        assert _cache_state(cache, "ConfigMap") == _store_state(
+            api, "ConfigMap"
+        )
+        assert not cache.degraded("ConfigMap")
+        inj_total = sum(
+            inj.m_faults.value({"kind": k})
+            for k in ("server_error", "too_many_requests", "watch_drop")
+        )
+        assert inj_total > 0, "the schedule injected nothing — dead test"
+        # the budget is live in the path and never over-spends its cap
+        assert 0.0 <= budget.tokens() <= budget.cap
+        if sanitizer.enabled():
+            assert sanitizer.reports()[reports_before:] == []
+    finally:
+        overload._reset_shared_budget_for_tests()
+
+
+def test_retry_storm_regression_drill_reverted_budget_amplifies():
+    """Seeded retry-storm drill: the same brownout replayed twice from
+    one seed — once with the budget reverted (a stub that always pays,
+    i.e. the pre-overload-defense client) and once armed. The reverted
+    run MUST blow the 1.3× amplification gate and the armed run must
+    hold it; if the armed run ever amplifies, the defense regressed."""
+    from odh_kubeflow_tpu.machinery import overload
+
+    def drill(budget):
+        rng = random.Random(SEED + 40)
+        c = RemoteAPIServer(
+            "http://127.0.0.1:1",
+            registry=prometheus.Registry(),
+            retries=4,
+            retry_base=0.001,
+            retry_cap=0.002,
+            retry_budget=budget,
+        )
+        c._sleep = _no_sleep
+        attempts = {"n": 0}
+
+        def weather(method, path, body=None, query=""):
+            attempts["n"] += 1
+            if rng.random() < 0.9:
+                raise APIError("brownout")
+            return {"items": []}
+
+        c._do_request = weather
+        logical = 25
+        for _ in range(logical):
+            try:
+                c.list("Pod")
+            except APIError:
+                pass
+        return attempts["n"] / logical
+
+    class RevertedBudget(overload.RetryBudget):
+        def try_spend(self):  # the storm: every retry is free
+            return True
+
+    stormy = drill(RevertedBudget(ratio=0.0, cap=0.0))
+    armed = drill(overload.RetryBudget(ratio=0.05, cap=3.0))
+    assert stormy > 1.3, f"drill lost its teeth: reverted run {stormy:.2f}x"
+    assert armed <= 1.3, f"amplification gate: armed run {armed:.2f}x"
+
+
+# ---------------------------------------------------------------------------
 # scheduler: admit/preempt property under chaos
 
 

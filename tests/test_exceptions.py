@@ -586,6 +586,7 @@ def reverted_tree(tmp_path_factory):
         "            cap=self.retry_cap,\n"
         "            sleep_fn=self._sleep,\n"
         "            on_retry=on_retry,\n"
+        "            budget=self._budget,\n"
         "        )",
         "return self._do_request(method, path, body, query)",
     )

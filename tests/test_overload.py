@@ -1,0 +1,526 @@
+"""Overload defense (machinery/overload.py): end-to-end deadlines,
+retry budgets, circuit breakers, and priority-aware shedding.
+
+Unit coverage for each mechanism plus the wiring proofs: the REST
+façade sheds an expired deadline with 504 before dispatch (both the
+threaded server and the event loop), the group-commit ack wait is
+deadline-bounded, ``backoff.retry`` never sleeps past the deadline or
+a dry budget, the watch pump probes an open breaker on its cadence
+instead of hammering, and every new metric passes the tier-1 naming
+lint.
+"""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from odh_kubeflow_tpu.apis import register_crds
+from odh_kubeflow_tpu.machinery import backoff, httpapi, overload
+from odh_kubeflow_tpu.machinery.client import RemoteAPIServer
+from odh_kubeflow_tpu.machinery.partition import PartitionRouter
+from odh_kubeflow_tpu.machinery.store import (
+    APIServer,
+    Conflict,
+    DeadlineExceeded,
+    TooManyRequests,
+)
+from odh_kubeflow_tpu.utils import prometheus
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_deadline():
+    """Each test starts with a clean deadline context and a fresh
+    shared budget (the singleton survives across tests otherwise)."""
+    assert overload.current_deadline() is None
+    overload._reset_shared_budget_for_tests()
+    yield
+    overload._reset_shared_budget_for_tests()
+
+
+def _nb(name="nb1", ns="team-a"):
+    return {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "template": {
+                "spec": {"containers": [{"name": name, "image": "j:x"}]}
+            }
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# deadlines: contextvar, scope, wire format
+
+
+def test_deadline_contextvar_roundtrip():
+    assert overload.remaining() is None
+    assert not overload.expired()
+    assert overload.header_value() is None
+    tok = overload.set_deadline(time.monotonic() + 5.0)
+    try:
+        rem = overload.remaining()
+        assert rem is not None and 4.0 < rem <= 5.0
+        assert not overload.expired()
+        assert 4.0 < float(overload.header_value()) <= 5.0
+    finally:
+        overload.reset_deadline(tok)
+    assert overload.current_deadline() is None
+
+
+def test_expired_deadline_clamps_header_to_zero():
+    tok = overload.set_deadline(time.monotonic() - 1.0)
+    try:
+        assert overload.expired()
+        assert overload.header_value() == "0.000"
+    finally:
+        overload.reset_deadline(tok)
+
+
+def test_deadline_scope_never_loosens():
+    with overload.deadline_scope(10.0):
+        outer = overload.current_deadline()
+        # a looser inner scope keeps the tighter ambient deadline
+        with overload.deadline_scope(60.0):
+            assert overload.current_deadline() == outer
+        # a tighter inner scope wins, and pops on exit
+        with overload.deadline_scope(0.5):
+            assert overload.current_deadline() < outer
+        assert overload.current_deadline() == outer
+    assert overload.current_deadline() is None
+
+
+def test_deadline_scope_knob_off_installs_nothing(monkeypatch):
+    monkeypatch.setenv("REQUEST_DEADLINE_DEFAULT", "0")
+    with overload.deadline_scope():
+        assert overload.current_deadline() is None
+
+
+def test_environ_deadline_anchors_on_arrival_stamp():
+    arrival = time.monotonic() - 3.0
+    environ = {
+        "HTTP_X_REQUEST_DEADLINE": "2.5",
+        "odh.request.arrival": arrival,
+    }
+    # queued past its budget: 2.5s after an arrival 3s ago is expired
+    assert overload.environ_deadline(environ) == arrival + 2.5
+    assert overload.environ_deadline({"HTTP_X_REQUEST_DEADLINE": ""}) is None
+    assert overload.environ_deadline({}) is None
+    with pytest.raises(ValueError):
+        overload.environ_deadline({"HTTP_X_REQUEST_DEADLINE": "soon"})
+
+
+# ---------------------------------------------------------------------------
+# retry budget
+
+
+def test_retry_budget_spend_and_refill():
+    reg = prometheus.Registry()
+    b = overload.RetryBudget(ratio=0.5, cap=2.0, registry=reg)
+    assert b.try_spend() and b.try_spend()
+    # dry: retries are suppressed until successes refill
+    assert not b.try_spend()
+    assert b.tokens() == 0.0
+    b.on_success()
+    assert b.tokens() == 0.5
+    b.on_success()
+    assert b.try_spend()  # 1.0 accrued -> one retry allowed
+    for _ in range(100):
+        b.on_success()
+    assert b.tokens() == 2.0  # capped
+    assert reg.counter("retry_budget_spent_total", "x").value() == 3
+    assert reg.counter("retry_budget_exhausted_total", "x").value() >= 1
+
+
+def test_backoff_retry_stops_on_dry_budget():
+    budget = overload.RetryBudget(ratio=0.1, cap=1.0,
+                                  registry=prometheus.Registry())
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise Conflict("racing")
+
+    with pytest.raises(Conflict):
+        backoff.retry(
+            flaky,
+            retryable=Conflict,
+            attempts=10,
+            sleep_fn=lambda s: None,
+            budget=budget,
+        )
+    # 1 initial try + exactly cap=1 budgeted retry, not 10 attempts
+    assert len(calls) == 2
+
+
+def test_backoff_retry_success_refills_budget():
+    budget = overload.RetryBudget(ratio=0.25, cap=4.0,
+                                  registry=prometheus.Registry())
+    budget._tokens = 0.0
+    assert backoff.retry(lambda: "ok", budget=budget) == "ok"
+    assert budget.tokens() == 0.25
+
+
+def test_backoff_retry_never_sleeps_past_deadline():
+    calls = []
+    slept = []
+
+    def flaky():
+        calls.append(1)
+        raise Conflict("racing")
+
+    with pytest.raises(Conflict):
+        backoff.retry(
+            flaky,
+            retryable=Conflict,
+            attempts=50,
+            base=10.0,  # every delay would overshoot the 1s budget
+            cap=20.0,
+            sleep_fn=slept.append,
+            deadline=time.monotonic() + 1.0,
+        )
+    assert len(calls) == 1 and slept == []
+
+
+def test_backoff_retry_consults_ambient_deadline():
+    tok = overload.set_deadline(time.monotonic() + 0.5)
+    try:
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise Conflict("racing")
+
+        with pytest.raises(Conflict):
+            backoff.retry(
+                flaky, retryable=Conflict, attempts=50,
+                base=5.0, cap=5.0, sleep_fn=lambda s: None,
+            )
+        assert len(calls) == 1
+    finally:
+        overload.reset_deadline(tok)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+
+
+def _clock():
+    state = {"t": 1000.0}
+
+    def now():
+        return state["t"]
+
+    now.advance = lambda dt: state.__setitem__("t", state["t"] + dt)
+    return now
+
+
+def test_breaker_trips_at_threshold_and_probes_half_open():
+    now = _clock()
+    b = overload.CircuitBreaker(
+        window=10.0, threshold=0.5, min_requests=4, cooldown=2.0,
+        slow_seconds=5.0, clock=now,
+    )
+    assert b.state == b.CLOSED
+    for ok in (True, True, False, False):
+        assert b.allow()
+        b.record(ok)
+    assert b.state == b.OPEN and b.blocking
+    assert b.retry_after() == pytest.approx(2.0)
+    assert not b.allow()  # open: shed
+    now.advance(2.1)
+    assert b.allow()  # the single half-open probe
+    assert b.state == b.HALF_OPEN
+    assert not b.allow()  # second caller is shed while the probe flies
+    b.record(True)
+    assert b.state == b.CLOSED
+    assert b.allow()
+
+
+def test_breaker_probe_failure_reopens():
+    now = _clock()
+    b = overload.CircuitBreaker(
+        window=10.0, threshold=0.5, min_requests=2, cooldown=1.0, clock=now
+    )
+    b.record(False)
+    b.record(False)
+    assert b.state == b.OPEN
+    now.advance(1.5)
+    assert b.allow()
+    b.record(False)  # probe failed: back to open, fresh cooldown
+    assert b.state == b.OPEN
+    assert not b.allow()
+
+
+def test_breaker_slow_success_counts_as_failure():
+    now = _clock()
+    b = overload.CircuitBreaker(
+        window=10.0, threshold=0.5, min_requests=2, cooldown=1.0,
+        slow_seconds=0.2, clock=now,
+    )
+    b.record(True, latency=5.0)
+    b.record(True, latency=5.0)
+    assert b.state == b.OPEN  # "succeeding" slowly is still drowning
+
+
+def test_breaker_window_prunes_old_samples():
+    now = _clock()
+    b = overload.CircuitBreaker(
+        window=1.0, threshold=0.5, min_requests=3, cooldown=1.0, clock=now
+    )
+    b.record(False)
+    b.record(False)
+    now.advance(5.0)  # both failures age out of the window
+    b.record(False)
+    assert b.state == b.CLOSED  # only 1 in-window sample < min_requests
+
+
+# ---------------------------------------------------------------------------
+# priority levels
+
+
+def test_level_ceilings_are_cumulative_and_never_zero():
+    assert overload.level_ceilings(100) == (100, 90, 75, 50)
+    # every level keeps at least one seat even on a tiny pool
+    assert overload.level_ceilings(1) == (1, 1, 1, 1)
+
+
+def test_classify_priority():
+    assert overload.classify(kind="Lease") == overload.LEVEL_SYSTEM
+    assert (
+        overload.classify(path="/replication/stream")
+        == overload.LEVEL_SYSTEM
+    )
+    assert overload.classify(controller=True) == overload.LEVEL_CONTROLLER
+    assert overload.classify(kind="Notebook") == overload.LEVEL_USER
+    assert (
+        overload.classify(kind="Lease", header="background")
+        == overload.LEVEL_BACKGROUND
+    )
+    assert overload.classify(header="bogus") == overload.LEVEL_USER
+
+
+def test_inflight_limiter_priority_ceilings():
+    reg = prometheus.Registry()
+    lim = httpapi.InflightLimiter(4, registry=reg)  # ceilings 4/3/3/2
+    # background fills its 50% share then sheds...
+    assert lim.try_acquire("bg1", level=overload.LEVEL_BACKGROUND)
+    assert lim.try_acquire("bg2", level=overload.LEVEL_BACKGROUND)
+    assert not lim.try_acquire("bg3", level=overload.LEVEL_BACKGROUND)
+    # ...user traffic still gets its headroom above background...
+    assert lim.try_acquire("u1", level=overload.LEVEL_USER)
+    assert not lim.try_acquire("u2", level=overload.LEVEL_USER)
+    # ...and system traffic always has the top of the pool
+    assert lim.try_acquire("sys", level=overload.LEVEL_SYSTEM)
+    assert not lim.try_acquire("sys2", level=overload.LEVEL_SYSTEM)
+    lim.release("bg1")
+    assert lim.try_acquire("sys2", level=overload.LEVEL_SYSTEM)
+    shed = reg.counter("inflight_shed_total", "x", labelnames=("level", "reason"))
+    assert shed.value({"level": "background", "reason": "level"}) == 1
+    assert shed.value({"level": "user", "reason": "level"}) == 1
+
+
+def test_inflight_limiter_per_client_cap_still_applies():
+    lim = httpapi.InflightLimiter(2)
+    assert lim.try_acquire("a", level=overload.LEVEL_SYSTEM)
+    assert lim.try_acquire("a", level=overload.LEVEL_SYSTEM)
+    assert not lim.try_acquire("a", level=overload.LEVEL_SYSTEM)
+
+
+def test_inflight_limiter_sheds_expired_deadline_with_504():
+    reg = prometheus.Registry()
+    lim = httpapi.InflightLimiter(4, registry=reg)
+    with pytest.raises(DeadlineExceeded):
+        lim.try_acquire("a", deadline=time.monotonic() - 0.1)
+    shed = reg.counter("inflight_shed_total", "x", labelnames=("level", "reason"))
+    assert shed.value({"level": "user", "reason": "deadline"}) == 1
+
+
+# ---------------------------------------------------------------------------
+# wiring: store ack wait, REST façade, event loop, client, router
+
+
+def test_group_commit_ack_wait_is_deadline_bounded():
+    import threading
+    import types
+
+    server = APIServer()
+    # an entry whose covering fsync never completes (a wedged disk)
+    stuck = types.SimpleNamespace(done=threading.Event(), error=None)
+    tok = overload.set_deadline(time.monotonic() - 0.1)
+    try:
+        with pytest.raises(DeadlineExceeded) as ei:
+            server._await(stuck)
+        assert "durable" in str(ei.value)  # the 504-vs-write caveat
+    finally:
+        overload.reset_deadline(tok)
+    # a live deadline bounds the park instead of waiting forever
+    tok = overload.set_deadline(time.monotonic() + 0.05)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            server._await(stuck)
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        overload.reset_deadline(tok)
+    # no deadline + a completed entry: the normal ack path
+    stuck.done.set()
+    assert server._await(stuck) is None
+
+
+@pytest.fixture(params=[False, True], ids=["threaded", "eventloop"])
+def served(request):
+    server = APIServer()
+    register_crds(server)
+    _, port, httpd = httpapi.serve(
+        server, port=0, event_loop=request.param
+    )
+    yield server, port
+    httpd.shutdown()
+
+
+def test_rest_facade_sheds_expired_deadline_with_504(served):
+    _, port = served
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/v1/namespaces/team-a/notebooks",
+        headers={overload.DEADLINE_HEADER: "0.000"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 504
+    body = json.loads(ei.value.read().decode())
+    assert body["reason"] == "DeadlineExceeded"
+
+
+def test_rest_facade_rejects_malformed_deadline_with_400(served):
+    _, port = served
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/v1/namespaces/team-a/notebooks",
+        headers={overload.DEADLINE_HEADER: "soon"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+
+
+def test_rest_facade_serves_within_deadline(served):
+    _, port = served
+    client = RemoteAPIServer(
+        f"http://127.0.0.1:{port}", registry=prometheus.Registry()
+    )
+    register_crds(client)
+    with overload.deadline_scope(30.0):
+        created = client.create(_nb("dl-ok"))
+    assert created["metadata"]["uid"]
+
+
+def test_client_maps_504_and_does_not_retry_it(served):
+    _, port = served
+    reg = prometheus.Registry()
+    client = RemoteAPIServer(f"http://127.0.0.1:{port}", registry=reg)
+    register_crds(client)
+    # ambient deadline expired: the client sheds BEFORE the wire
+    tok = overload.set_deadline(time.monotonic() - 0.1)
+    try:
+        with pytest.raises(DeadlineExceeded):
+            client.get("Notebook", "x", "team-a")
+    finally:
+        overload.reset_deadline(tok)
+    # no retries were burned on the 504 (it is not retryable)
+    assert (
+        reg.counter(
+            "client_retries_total", "x", labelnames=("verb", "reason")
+        ).value()
+        == 0
+    )
+
+
+def test_client_breaker_open_sheds_locally():
+    breaker = overload.CircuitBreaker(min_requests=1, threshold=0.1,
+                                      cooldown=60.0)
+    breaker._state = breaker.OPEN
+    breaker._open_until = time.monotonic() + 60.0
+    client = RemoteAPIServer(
+        "http://127.0.0.1:1",
+        breaker=breaker,
+        retries=1,
+        registry=prometheus.Registry(),
+    )
+    register_crds(client)
+    with pytest.raises(TooManyRequests) as ei:
+        client.get("Notebook", "x", "team-a")
+    assert ei.value.retry_after > 0  # the probe-cadence hint
+
+
+def test_watch_reconnects_shed_through_open_breaker():
+    breaker = overload.CircuitBreaker(cooldown=60.0)
+    breaker._state = breaker.OPEN
+    breaker._open_until = time.monotonic() + 60.0
+    reg = prometheus.Registry()
+    client = RemoteAPIServer(
+        "http://127.0.0.1:1", breaker=breaker, registry=reg
+    )
+    register_crds(client)
+    client._sleep = lambda s: None
+    w = client.watch("Notebook", reconnect_window=0.0)
+    deadline = time.monotonic() + 5.0
+    while not w.ended and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert w.ended and w.error is not None
+    assert reg.counter("watch_reconnects_shed_total", "x").value() >= 1
+
+
+def test_partition_router_sheds_expired_deadline():
+    backends = {0: APIServer(), 1: APIServer()}
+    for b in backends.values():
+        register_crds(b)
+    router = PartitionRouter(backends)
+    tok = overload.set_deadline(time.monotonic() - 0.1)
+    try:
+        with pytest.raises(DeadlineExceeded):
+            router.create(_nb())
+        with pytest.raises(DeadlineExceeded):
+            router.get("Notebook", "x", "team-a")
+        with pytest.raises(DeadlineExceeded):
+            router.list_chunk("Notebook", limit=10)
+    finally:
+        overload.reset_deadline(tok)
+
+
+def test_partition_router_breaker_sheds_sick_partition():
+    backends = {0: APIServer(), 1: APIServer()}
+    for b in backends.values():
+        register_crds(b)
+    router = PartitionRouter(backends)
+    breaker = router._breaker_for(0)
+    breaker._state = breaker.OPEN
+    breaker._open_until = time.monotonic() + 60.0
+    with pytest.raises(TooManyRequests) as ei:
+        router.get("PriorityClass", "x")  # cluster-scoped -> partition 0
+    assert "circuit breaker" in str(ei.value)
+    assert ei.value.retry_after > 0
+
+
+# ---------------------------------------------------------------------------
+# metrics contract
+
+
+def test_overload_metrics_pass_naming_lint():
+    reg = prometheus.Registry()
+    overload.RetryBudget(registry=reg)
+    httpapi.InflightLimiter(4, registry=reg)
+    RemoteAPIServer("http://127.0.0.1:1", registry=reg)
+    names = {m.name for m in reg._metrics}
+    for expected in (
+        "retry_budget_spent_total",
+        "retry_budget_exhausted_total",
+        "inflight_shed_total",
+        "watch_reconnects_shed_total",
+    ):
+        assert expected in names
+    assert prometheus.lint_metric_names(reg) == []
